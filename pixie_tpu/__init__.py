@@ -1,0 +1,46 @@
+"""pixie_tpu — a TPU-native observability query framework.
+
+A brand-new implementation of the capabilities of Pixie (reference:
+``Emin3mU/pixie``): pluggable telemetry source connectors feeding an in-memory
+hot/cold columnar table store, queried with PxL (a Pythonic, pandas-like DSL)
+through a compiler, distributed planner, and a dataflow execution engine whose
+heavy operators (map/filter/group-by aggregation/join and the sketch UDAs)
+lower to jit-compiled JAX running on TPU.
+
+Architecture (TPU-first, not a port — see SURVEY.md for the reference map):
+
+- ``pixie_tpu.types``      value/relation type system (ref: src/shared/types)
+- ``pixie_tpu.table``      columnar RowBatch + hot/cold Table store
+                           (ref: src/table_store)
+- ``pixie_tpu.udf``        typed UDF/UDA/UDTF registry + builtin funcs
+                           (ref: src/carnot/udf, src/carnot/funcs)
+- ``pixie_tpu.ops``        the JAX/TPU kernels: segment reductions, sketch
+                           tensors (t-digest/log-histogram/HLL/count-min)
+- ``pixie_tpu.compiler``   PxL front end -> operator IR -> logical plan
+                           (ref: src/carnot/planner/compiler)
+- ``pixie_tpu.plan``       plan representation (ref: src/carnot/plan)
+- ``pixie_tpu.exec``       ExecNode dataflow engine (ref: src/carnot/exec)
+- ``pixie_tpu.parallel``   distributed planner: blocking-op split, partial-agg
+                           rewrite, device-mesh coordinator, shard_map/psum
+                           merge over ICI (ref: src/carnot/planner/distributed
+                           + the PEM->Kelvin gRPC bridge it replaces)
+- ``pixie_tpu.ingest``     source-connector framework + synthetic telemetry
+                           generators (ref: src/stirling, CPU-side by design)
+- ``pixie_tpu.metadata``   k8s-entity metadata state for ctx[] resolution
+                           (ref: src/shared/metadata)
+- ``pixie_tpu.engine``     the Carnot-equivalent engine facade
+- ``pixie_tpu.broker``     thin query broker (ref: src/vizier/services/query_broker)
+- ``pixie_tpu.api``        client API (ref: src/api)
+
+64-bit note: telemetry timestamps and counters are int64; we enable jax x64 so
+device columns keep their width. Hot kernels cast explicitly to
+float32/bfloat16 where precision allows, so this does not put f64 on the MXU.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from pixie_tpu.types import DataType, SemanticType, Relation  # noqa: E402,F401

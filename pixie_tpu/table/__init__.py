@@ -1,0 +1,12 @@
+"""Columnar storage: RowBatch dataflow unit + hot/cold Table + TableStore.
+
+Ref: src/table_store/ (Table, TableStore, RowBatch, schema). TPU-first
+re-design: STRING columns are dictionary-encoded once at write time so the
+query path only ever sees int32 codes (device-stageable); numeric columns are
+contiguous numpy on host, staged to HBM in fixed-size padded blocks.
+"""
+
+from pixie_tpu.table.column import DictColumn, StringDictionary  # noqa: F401
+from pixie_tpu.table.row_batch import RowBatch  # noqa: F401
+from pixie_tpu.table.table import Table, Cursor  # noqa: F401
+from pixie_tpu.table.table_store import TableStore  # noqa: F401

@@ -1,0 +1,117 @@
+"""Column representations.
+
+Ref: src/shared/types/column_wrapper.h:49 (ColumnWrapper) — but where the
+reference keeps strings as Arrow string arrays and hashes them row-at-a-time
+in the engine, we dictionary-encode at ingest (write-side, off the query
+critical path) so group-by keys and equality filters on strings become int32
+ops on device. ``StringDictionary`` is append-only: codes are dense and stable
+for the lifetime of a table, which makes them directly usable as segment ids
+in TPU segment reductions (pixie_tpu.ops.segment).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+# Optional native fast path (pixie_tpu/native): C++ dictionary encoder.
+try:  # pragma: no cover - exercised when the native lib is built
+    from pixie_tpu.native import host_runtime as _native
+except Exception:  # pragma: no cover
+    _native = None
+
+
+class StringDictionary:
+    """Append-only string<->int32 dictionary.
+
+    Thread-safe for concurrent encode (ingest) + read (query): the values list
+    only ever grows, and lookups take the lock only on miss.
+    """
+
+    __slots__ = ("_values", "_index", "_lock")
+
+    def __init__(self, values: list[str] | None = None):
+        self._values: list[str] = list(values) if values else []
+        self._index: dict[str, int] = {v: i for i, v in enumerate(self._values)}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def get_code(self, value: str) -> int:
+        """Code for value, adding it if unseen."""
+        code = self._index.get(value)
+        if code is not None:
+            return code
+        with self._lock:
+            code = self._index.get(value)
+            if code is None:
+                code = len(self._values)
+                self._values.append(value)
+                self._index[value] = code
+            return code
+
+    def lookup(self, value: str) -> int:
+        """Code for value, -1 if unseen (used by equality filters on strings)."""
+        return self._index.get(value, -1)
+
+    def encode(self, values) -> np.ndarray:
+        """Vectorized encode of an array/sequence of strings -> int32 codes."""
+        arr = np.asarray(values, dtype=object)
+        # Encode the unique values only, then broadcast back: telemetry string
+        # columns (service/pod names, methods, paths) are extremely low-
+        # cardinality relative to row count.
+        uniq, inverse = np.unique(arr, return_inverse=True)
+        uniq_codes = np.fromiter(
+            (self.get_code(v) for v in uniq), dtype=np.int32, count=len(uniq)
+        )
+        return uniq_codes[inverse].astype(np.int32, copy=False)
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        values = np.asarray(self._values, dtype=object)
+        out = np.empty(len(codes), dtype=object)
+        valid = (codes >= 0) & (codes < len(values))
+        out[valid] = values[codes[valid]]
+        out[~valid] = ""
+        return out
+
+    def values(self) -> list[str]:
+        return list(self._values)
+
+
+@dataclass
+class DictColumn:
+    """A dictionary-encoded string column: int32 codes + shared dictionary."""
+
+    codes: np.ndarray  # int32[n]
+    dictionary: StringDictionary
+
+    def __post_init__(self):
+        self.codes = np.asarray(self.codes, dtype=np.int32)
+
+    def __len__(self) -> int:
+        return len(self.codes)
+
+    def decode(self) -> np.ndarray:
+        return self.dictionary.decode(self.codes)
+
+    def take(self, indices) -> "DictColumn":
+        return DictColumn(self.codes[indices], self.dictionary)
+
+    def slice(self, start: int, stop: int) -> "DictColumn":
+        return DictColumn(self.codes[start:stop], self.dictionary)
+
+
+def concat_dict_columns(cols: list[DictColumn]) -> DictColumn:
+    dicts = {id(c.dictionary) for c in cols}
+    if len(dicts) != 1:
+        # Re-encode into the first column's dictionary (rare: cross-table
+        # unions). Codes are remapped through the string values.
+        base = cols[0].dictionary
+        parts = [cols[0].codes]
+        for c in cols[1:]:
+            parts.append(base.encode(c.decode()))
+        return DictColumn(np.concatenate(parts), base)
+    return DictColumn(np.concatenate([c.codes for c in cols]), cols[0].dictionary)

@@ -1,0 +1,210 @@
+"""RowBatch — the unit of dataflow between exec operators.
+
+Ref: src/table_store/schema/row_batch.h:40 (vector of Arrow arrays +
+RowDescriptor + eow/eos flags, proto (de)serialization for gRPC transfer).
+Ours is numpy-columnar with dictionary-encoded strings; (de)serialization for
+the inter-host data plane lives in ``to_bytes``/``from_bytes``.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Sequence
+
+import numpy as np
+
+from pixie_tpu.table.column import DictColumn, StringDictionary, concat_dict_columns
+from pixie_tpu.types import DataType, Relation
+from pixie_tpu.types.dtypes import host_dtype
+
+
+ColumnData = "np.ndarray | DictColumn"
+
+
+class RowBatch:
+    """Columnar batch: relation + per-column data + end-of-window/stream flags.
+
+    eow/eos semantics follow the reference (row_batch.h:40): ``eow`` marks the
+    end of a streaming window (blocking aggregates emit on it), ``eos`` marks
+    the end of the stream.
+    """
+
+    __slots__ = ("relation", "columns", "eow", "eos")
+
+    def __init__(
+        self,
+        relation: Relation,
+        columns: Sequence[ColumnData],
+        eow: bool = False,
+        eos: bool = False,
+    ):
+        if len(columns) != relation.num_columns():
+            raise ValueError(
+                f"{len(columns)} columns for relation with "
+                f"{relation.num_columns()} fields"
+            )
+        self.relation = relation
+        self.columns = list(columns)
+        self.eow = eow
+        self.eos = eos
+        n = self.num_rows
+        for i, c in enumerate(self.columns):
+            if len(c) != n:
+                raise ValueError(
+                    f"column {relation.col(i).name!r} has {len(c)} rows, expected {n}"
+                )
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_pydict(
+        cls,
+        relation: Relation,
+        data: dict,
+        dictionaries: dict[str, StringDictionary] | None = None,
+        eow: bool = False,
+        eos: bool = False,
+    ) -> "RowBatch":
+        """Build from a name->values dict; strings are dict-encoded."""
+        cols: list[ColumnData] = []
+        for schema in relation:
+            values = data[schema.name]
+            if schema.data_type == DataType.STRING and not isinstance(
+                values, DictColumn
+            ):
+                d = (dictionaries or {}).get(schema.name) or StringDictionary()
+                cols.append(DictColumn(d.encode(values), d))
+            elif isinstance(values, DictColumn):
+                cols.append(values)
+            else:
+                cols.append(
+                    np.asarray(values, dtype=host_dtype(schema.data_type))
+                )
+        return cls(relation, cols, eow=eow, eos=eos)
+
+    @classmethod
+    def with_zero_rows(cls, relation: Relation, eow=False, eos=False) -> "RowBatch":
+        """Ref: RowBatch::WithZeroRows — used to propagate bare eow/eos."""
+        cols: list[ColumnData] = []
+        for schema in relation:
+            if schema.data_type == DataType.STRING:
+                cols.append(
+                    DictColumn(np.empty(0, np.int32), StringDictionary())
+                )
+            else:
+                cols.append(np.empty(0, host_dtype(schema.data_type)))
+        return cls(relation, cols, eow=eow, eos=eos)
+
+    # -- accessors ---------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def col(self, name_or_idx) -> ColumnData:
+        if isinstance(name_or_idx, str):
+            return self.columns[self.relation.col_idx(name_or_idx)]
+        return self.columns[name_or_idx]
+
+    def num_bytes(self) -> int:
+        total = 0
+        for c in self.columns:
+            arr = c.codes if isinstance(c, DictColumn) else c
+            total += arr.nbytes if arr.dtype != object else sum(
+                len(str(v)) for v in arr
+            )
+        return total
+
+    # -- transforms --------------------------------------------------------
+    def select(self, names: list[str]) -> "RowBatch":
+        rel = self.relation.select(names)
+        return RowBatch(
+            rel, [self.col(n) for n in names], eow=self.eow, eos=self.eos
+        )
+
+    def take(self, indices) -> "RowBatch":
+        cols = [
+            c.take(indices) if isinstance(c, DictColumn) else c[indices]
+            for c in self.columns
+        ]
+        return RowBatch(self.relation, cols, eow=self.eow, eos=self.eos)
+
+    def slice(self, start: int, stop: int) -> "RowBatch":
+        cols = [
+            c.slice(start, stop) if isinstance(c, DictColumn) else c[start:stop]
+            for c in self.columns
+        ]
+        return RowBatch(self.relation, cols, eow=self.eow, eos=self.eos)
+
+    def with_flags(self, eow: bool, eos: bool) -> "RowBatch":
+        return RowBatch(self.relation, self.columns, eow=eow, eos=eos)
+
+    @classmethod
+    def concat(cls, batches: list["RowBatch"]) -> "RowBatch":
+        assert batches
+        rel = batches[0].relation
+        cols: list[ColumnData] = []
+        for i in range(rel.num_columns()):
+            parts = [b.columns[i] for b in batches]
+            if isinstance(parts[0], DictColumn):
+                cols.append(concat_dict_columns(parts))
+            else:
+                cols.append(np.concatenate(parts))
+        return cls(rel, cols, eow=batches[-1].eow, eos=batches[-1].eos)
+
+    # -- output ------------------------------------------------------------
+    def to_pydict(self, decode_strings: bool = True) -> dict:
+        out = {}
+        for schema, c in zip(self.relation, self.columns):
+            if isinstance(c, DictColumn):
+                out[schema.name] = (
+                    c.decode().tolist() if decode_strings else c.codes.tolist()
+                )
+            else:
+                out[schema.name] = c.tolist()
+        return out
+
+    def to_pandas(self):  # pragma: no cover - convenience
+        import pandas as pd
+
+        return pd.DataFrame(self.to_pydict())
+
+    # -- wire format (inter-host data plane; ref: row_batch proto serde) ----
+    def to_bytes(self) -> bytes:
+        """Serialize for DCN transfer. Strings ship as their decoded values so
+        the receiving host can re-encode into its own dictionaries."""
+        buf = io.BytesIO()
+        arrays = {}
+        meta = {"eow": self.eow, "eos": self.eos, "relation": self.relation.to_dict()}
+        for i, (schema, c) in enumerate(zip(self.relation, self.columns)):
+            if isinstance(c, DictColumn):
+                arrays[f"c{i}"] = np.asarray(c.decode().tolist(), dtype="U")
+            else:
+                arrays[f"c{i}"] = c
+        np.savez_compressed(buf, __meta__=np.frombuffer(
+            repr(meta).encode(), dtype=np.uint8
+        ), **arrays)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "RowBatch":
+        import ast
+
+        with np.load(io.BytesIO(data), allow_pickle=False) as npz:
+            meta = ast.literal_eval(bytes(npz["__meta__"]).decode())
+            rel = Relation.from_dict(meta["relation"])
+            cols: list[ColumnData] = []
+            for i, schema in enumerate(rel):
+                arr = npz[f"c{i}"]
+                if schema.data_type == DataType.STRING:
+                    d = StringDictionary()
+                    cols.append(DictColumn(d.encode(arr.astype(object)), d))
+                else:
+                    cols.append(arr.astype(host_dtype(schema.data_type)))
+            return cls(rel, cols, eow=bool(meta["eow"]), eos=bool(meta["eos"]))
+
+    def __repr__(self) -> str:
+        flags = (" eow" if self.eow else "") + (" eos" if self.eos else "")
+        return f"RowBatch({self.num_rows} rows, {self.relation}{flags})"

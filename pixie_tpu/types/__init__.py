@@ -1,0 +1,20 @@
+"""Shared type system.
+
+Reference parity: src/shared/types/typespb/types.proto:26,47,63 (DataType /
+PatternType / SemanticType enums), src/shared/types/types.h (value types),
+src/shared/types/column_wrapper.h (batch column abstraction — ours lives in
+pixie_tpu.table.column). Re-designed for TPU: every DataType knows its host
+(numpy) and device (jnp) representation; STRING columns are dictionary-encoded
+on host and only their int32 codes are device-stageable.
+"""
+
+from pixie_tpu.types.dtypes import (  # noqa: F401
+    DataType,
+    PatternType,
+    SemanticType,
+    device_dtype,
+    host_dtype,
+    is_device_stageable,
+    null_value,
+)
+from pixie_tpu.types.relation import ColumnSchema, Relation  # noqa: F401

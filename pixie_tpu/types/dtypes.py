@@ -1,0 +1,127 @@
+"""Data / semantic / pattern type enums and their host/device dtypes.
+
+Ref: src/shared/types/typespb/types.proto (enum values kept identical so plan
+dumps remain comparable), src/shared/types/types.h:1 (value widths).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class DataType(enum.IntEnum):
+    """Physical column types (ref: types.proto:26)."""
+
+    DATA_TYPE_UNKNOWN = 0
+    BOOLEAN = 1
+    INT64 = 2
+    UINT128 = 3
+    FLOAT64 = 4
+    STRING = 5
+    TIME64NS = 6
+
+
+class PatternType(enum.IntEnum):
+    """Value-pattern classification used by the compiler/UI (ref: types.proto:47)."""
+
+    UNSPECIFIED = 0
+    GENERAL = 100
+    STRUCTURED = 200
+    GENERAL_ENUM = 101
+
+
+class SemanticType(enum.IntEnum):
+    """Semantic annotations driving UDF inference + UI rendering (ref: types.proto:63)."""
+
+    ST_UNSPECIFIED = 0
+    ST_NONE = 1
+    ST_TIME_NS = 2
+    ST_AGENT_UID = 100
+    ST_ASID = 101
+    ST_UPID = 200
+    ST_SERVICE_NAME = 300
+    ST_POD_NAME = 400
+    ST_POD_PHASE = 401
+    ST_POD_STATUS = 402
+    ST_NODE_NAME = 500
+    ST_CONTAINER_NAME = 600
+    ST_CONTAINER_STATE = 601
+    ST_CONTAINER_STATUS = 602
+    ST_NAMESPACE_NAME = 700
+    ST_BYTES = 800
+    ST_PERCENT = 900
+    ST_DURATION_NS = 901
+    ST_THROUGHPUT_PER_NS = 902
+    ST_THROUGHPUT_BYTES_PER_NS = 903
+    ST_QUANTILES = 1000
+    ST_DURATION_NS_QUANTILES = 1001
+    ST_IP_ADDRESS = 1100
+    ST_PORT = 1200
+    ST_HTTP_REQ_METHOD = 1300
+    ST_HTTP_RESP_STATUS = 1400
+    ST_HTTP_RESP_MESSAGE = 1500
+    ST_SCRIPT_REFERENCE = 1600
+
+
+# Host (numpy) representation per physical type. UINT128 is a structured pair
+# of uint64 halves (ref: types.h UInt128Value {high, low}); STRING is a numpy
+# object array pre-encoding, int32 codes post-encoding.
+_HOST_DTYPES = {
+    DataType.BOOLEAN: np.dtype(np.bool_),
+    DataType.INT64: np.dtype(np.int64),
+    DataType.UINT128: np.dtype([("high", np.uint64), ("low", np.uint64)]),
+    DataType.FLOAT64: np.dtype(np.float64),
+    DataType.STRING: np.dtype(object),
+    DataType.TIME64NS: np.dtype(np.int64),
+}
+
+# Device (jnp-stageable) representation. STRING stages as its dictionary codes;
+# UINT128 stages as two int64 lanes. BOOLEAN stages as bool_.
+_DEVICE_DTYPES = {
+    DataType.BOOLEAN: np.dtype(np.bool_),
+    DataType.INT64: np.dtype(np.int64),
+    DataType.FLOAT64: np.dtype(np.float64),
+    DataType.TIME64NS: np.dtype(np.int64),
+    DataType.STRING: np.dtype(np.int32),  # dictionary codes
+    DataType.UINT128: np.dtype(np.int64),  # staged as [..., 2] hi/lo lanes
+}
+
+_NULL_VALUES = {
+    DataType.BOOLEAN: False,
+    DataType.INT64: 0,
+    DataType.FLOAT64: float("nan"),
+    DataType.TIME64NS: 0,
+    DataType.STRING: "",
+}
+
+
+def host_dtype(dt: DataType) -> np.dtype:
+    return _HOST_DTYPES[dt]
+
+
+def device_dtype(dt: DataType) -> np.dtype:
+    return _DEVICE_DTYPES[dt]
+
+
+def is_device_stageable(dt: DataType) -> bool:
+    """Whether a column of this type ships to HBM directly (STRING ships codes)."""
+    return dt in _DEVICE_DTYPES
+
+
+def null_value(dt: DataType):
+    return _NULL_VALUES[dt]
+
+
+def from_numpy_dtype(dtype: np.dtype) -> DataType:
+    """Best-effort mapping for ingesting raw numpy columns."""
+    if dtype == np.bool_:
+        return DataType.BOOLEAN
+    if np.issubdtype(dtype, np.integer):
+        return DataType.INT64
+    if np.issubdtype(dtype, np.floating):
+        return DataType.FLOAT64
+    if dtype == object or dtype.kind in ("U", "S"):
+        return DataType.STRING
+    raise TypeError(f"no DataType mapping for numpy dtype {dtype}")

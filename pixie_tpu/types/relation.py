@@ -1,0 +1,130 @@
+"""Relation = ordered column schema of a table / row batch.
+
+Ref: src/table_store/schema/relation.h:41 (Relation), row descriptors in
+src/table_store/schema/row_descriptor.h. Ours carries semantic types inline
+(the reference splits them across Relation + planner annotations).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Iterator
+
+from pixie_tpu.types.dtypes import DataType, PatternType, SemanticType
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnSchema:
+    name: str
+    data_type: DataType
+    semantic_type: SemanticType = SemanticType.ST_NONE
+    pattern_type: PatternType = PatternType.UNSPECIFIED
+    desc: str = ""
+
+    def with_name(self, name: str) -> "ColumnSchema":
+        return dataclasses.replace(self, name=name)
+
+
+class Relation:
+    """An ordered, named, typed column list with O(1) name lookup."""
+
+    def __init__(self, columns: Iterable[ColumnSchema] = ()):  # noqa: D401
+        self._columns: list[ColumnSchema] = list(columns)
+        self._index: dict[str, int] = {c.name: i for i, c in enumerate(self._columns)}
+        if len(self._index) != len(self._columns):
+            names = [c.name for c in self._columns]
+            dupes = {n for n in names if names.count(n) > 1}
+            raise ValueError(f"duplicate column names in relation: {sorted(dupes)}")
+
+    @classmethod
+    def of(cls, *cols: tuple) -> "Relation":
+        """Relation.of(("time_", DataType.TIME64NS, SemanticType.ST_TIME_NS), ...)."""
+        schemas = []
+        for c in cols:
+            if isinstance(c, ColumnSchema):
+                schemas.append(c)
+            else:
+                schemas.append(ColumnSchema(*c))
+        return cls(schemas)
+
+    # -- queries ----------------------------------------------------------
+    def num_columns(self) -> int:
+        return len(self._columns)
+
+    def has_column(self, name: str) -> bool:
+        return name in self._index
+
+    def col_idx(self, name: str) -> int:
+        try:
+            return self._index[name]
+        except KeyError:
+            raise KeyError(
+                f"column {name!r} not in relation {self.col_names()}"
+            ) from None
+
+    def col(self, name_or_idx) -> ColumnSchema:
+        if isinstance(name_or_idx, str):
+            return self._columns[self.col_idx(name_or_idx)]
+        return self._columns[name_or_idx]
+
+    def col_names(self) -> list[str]:
+        return [c.name for c in self._columns]
+
+    def col_types(self) -> list[DataType]:
+        return [c.data_type for c in self._columns]
+
+    # -- construction -----------------------------------------------------
+    def add_column(self, schema: ColumnSchema) -> "Relation":
+        return Relation(self._columns + [schema])
+
+    def select(self, names: Iterable[str]) -> "Relation":
+        return Relation([self.col(n) for n in names])
+
+    def rename(self, mapping: dict[str, str]) -> "Relation":
+        return Relation(
+            [c.with_name(mapping.get(c.name, c.name)) for c in self._columns]
+        )
+
+    # -- dunder -----------------------------------------------------------
+    def __iter__(self) -> Iterator[ColumnSchema]:
+        return iter(self._columns)
+
+    def __len__(self) -> int:
+        return len(self._columns)
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Relation):
+            return NotImplemented
+        return [
+            (c.name, c.data_type) for c in self._columns
+        ] == [(c.name, c.data_type) for c in other._columns]
+
+    def __hash__(self):
+        return hash(tuple((c.name, c.data_type) for c in self._columns))
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{c.name}:{c.data_type.name}" for c in self._columns)
+        return f"Relation[{cols}]"
+
+    def to_dict(self) -> list[dict]:
+        return [
+            {
+                "name": c.name,
+                "data_type": int(c.data_type),
+                "semantic_type": int(c.semantic_type),
+            }
+            for c in self._columns
+        ]
+
+    @classmethod
+    def from_dict(cls, cols: list[dict]) -> "Relation":
+        return cls(
+            [
+                ColumnSchema(
+                    c["name"],
+                    DataType(c["data_type"]),
+                    SemanticType(c.get("semantic_type", SemanticType.ST_NONE)),
+                )
+                for c in cols
+            ]
+        )

@@ -1,0 +1,24 @@
+"""Test harness config.
+
+Force the CPU backend with 8 virtual devices so the distributed layer
+(device-mesh sharding, psum merges) is exercised without TPU hardware —
+mirroring the reference's strategy of testing PEM/Kelvin distribution with
+fake DistributedState protos (SURVEY.md §4). Must run before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

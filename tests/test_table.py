@@ -1,0 +1,140 @@
+"""Table store tests (ref model: src/table_store/table/table_test.cc)."""
+
+import numpy as np
+
+from pixie_tpu.table import DictColumn, RowBatch, StringDictionary, Table, TableStore
+from pixie_tpu.types import DataType, Relation
+
+REL = Relation.of(
+    ("time_", DataType.TIME64NS),
+    ("latency", DataType.FLOAT64),
+    ("service", DataType.STRING),
+)
+
+
+def make_batch(times, lats, svcs, dicts=None, **flags):
+    return RowBatch.from_pydict(
+        REL,
+        {"time_": times, "latency": lats, "service": svcs},
+        dictionaries=dicts,
+        **flags,
+    )
+
+
+def test_string_dictionary():
+    d = StringDictionary()
+    codes = d.encode(["a", "b", "a", "c"])
+    assert codes.dtype == np.int32
+    assert codes[0] == codes[2]
+    assert len(d) == 3
+    assert list(d.decode(codes)) == ["a", "b", "a", "c"]
+    assert d.lookup("b") == codes[1]
+    assert d.lookup("zz") == -1
+
+
+def test_row_batch_basics():
+    rb = make_batch([1, 2, 3], [0.1, 0.2, 0.3], ["x", "y", "x"])
+    assert rb.num_rows == 3
+    assert isinstance(rb.col("service"), DictColumn)
+    sel = rb.select(["latency"])
+    assert sel.relation.col_names() == ["latency"]
+    taken = rb.take(np.array([2, 0]))
+    assert taken.to_pydict()["service"] == ["x", "x"]
+    cat = RowBatch.concat([rb, rb.slice(0, 1)])
+    assert cat.num_rows == 4
+
+
+def test_row_batch_wire_roundtrip():
+    rb = make_batch([1, 2], [0.5, 1.5], ["svc-a", "svc-b"], **{"eos": True})
+    rt = RowBatch.from_bytes(rb.to_bytes())
+    assert rt.eos and not rt.eow
+    assert rt.to_pydict() == rb.to_pydict()
+
+
+def test_table_write_read():
+    t = Table(REL, name="http_events")
+    t.write_pydict({"time_": [1, 2], "latency": [1.0, 2.0], "service": ["a", "b"]})
+    t.write_pydict({"time_": [3, 4], "latency": [3.0, 4.0], "service": ["a", "c"]})
+    cur = t.cursor()
+    out = []
+    while not cur.done():
+        b = cur.next_batch()
+        if b is None:
+            break
+        out.append(b)
+    merged = RowBatch.concat(out)
+    assert merged.num_rows == 4
+    assert merged.to_pydict()["service"] == ["a", "b", "a", "c"]
+    # codes are table-consistent across batches
+    svc = merged.col("service")
+    assert svc.codes[0] == svc.codes[2]
+
+
+def test_table_time_bounds():
+    t = Table(REL)
+    t.write_pydict(
+        {"time_": [10, 20, 30, 40], "latency": [1, 2, 3, 4], "service": list("abcd")}
+    )
+    cur = t.cursor(start_time=20, stop_time=30)
+    b = cur.next_batch()
+    assert b.to_pydict()["time_"] == [20, 30]
+
+
+def test_table_compaction_preserves_cursor():
+    t = Table(REL, compacted_rows=4)
+    for i in range(3):
+        t.write_pydict(
+            {
+                "time_": [i * 10 + 1, i * 10 + 2],
+                "latency": [1.0, 2.0],
+                "service": ["a", "b"],
+            }
+        )
+    cur = t.cursor()
+    first = cur.next_batch(max_rows=2)
+    assert first.num_rows == 2
+    assert t.compact() == 1  # 6 hot rows -> one 4-row cold batch + 2-row hot tail
+    rest = []
+    while True:
+        b = cur.next_batch(max_rows=100)
+        if b is None:
+            break
+        rest.append(b)
+    assert sum(b.num_rows for b in rest) == 4  # no duplicates, no loss
+
+
+def test_table_ring_expiry():
+    t = Table(REL, size_limit=1)  # absurdly small: keep only newest segment
+    for i in range(5):
+        t.write_pydict({"time_": [i], "latency": [float(i)], "service": ["s"]})
+    st = t.stats()
+    assert st.batches_expired >= 3
+    cur = t.cursor()
+    batches = []
+    while not cur.done():
+        b = cur.next_batch()
+        if b is None:
+            break
+        batches.append(b)
+    assert sum(b.num_rows for b in batches) < 5
+
+
+def test_table_store():
+    ts = TableStore()
+    t = ts.create_table("http_events", REL)
+    assert ts.get_table("http_events") is t
+    assert ts.get_relation("http_events") == REL
+    assert ts.table_names() == ["http_events"]
+    assert ts.relation_map()["http_events"].has_column("latency")
+
+
+def test_streaming_cursor():
+    t = Table(REL)
+    cur = t.cursor(streaming=True)
+    assert not cur.done()
+    assert cur.next_batch() is None
+    t.write_pydict({"time_": [1], "latency": [1.0], "service": ["a"]})
+    assert cur.next_batch().num_rows == 1
+    t.stop()
+    assert cur.next_batch() is None
+    assert cur.done()

@@ -1,0 +1,51 @@
+"""Type system tests (ref model: src/shared/types tests)."""
+
+import numpy as np
+import pytest
+
+from pixie_tpu.types import ColumnSchema, DataType, Relation, SemanticType
+from pixie_tpu.types.dtypes import device_dtype, from_numpy_dtype, host_dtype
+
+
+def test_relation_basic():
+    rel = Relation.of(
+        ("time_", DataType.TIME64NS, SemanticType.ST_TIME_NS),
+        ("latency", DataType.FLOAT64),
+        ("service", DataType.STRING, SemanticType.ST_SERVICE_NAME),
+    )
+    assert rel.num_columns() == 3
+    assert rel.col_idx("latency") == 1
+    assert rel.col("service").semantic_type == SemanticType.ST_SERVICE_NAME
+    assert rel.col_names() == ["time_", "latency", "service"]
+    assert rel.has_column("time_") and not rel.has_column("nope")
+
+
+def test_relation_duplicate_rejected():
+    with pytest.raises(ValueError):
+        Relation.of(("a", DataType.INT64), ("a", DataType.FLOAT64))
+
+
+def test_relation_transforms():
+    rel = Relation.of(("a", DataType.INT64), ("b", DataType.STRING))
+    sel = rel.select(["b"])
+    assert sel.col_names() == ["b"]
+    ren = rel.rename({"a": "x"})
+    assert ren.col_names() == ["x", "b"]
+    added = rel.add_column(ColumnSchema("c", DataType.FLOAT64))
+    assert added.num_columns() == 3
+    assert rel == Relation.of(("a", DataType.INT64), ("b", DataType.STRING))
+
+
+def test_relation_roundtrip_dict():
+    rel = Relation.of(
+        ("t", DataType.TIME64NS, SemanticType.ST_TIME_NS),
+        ("s", DataType.STRING),
+    )
+    assert Relation.from_dict(rel.to_dict()) == rel
+
+
+def test_dtype_mappings():
+    assert host_dtype(DataType.INT64) == np.int64
+    assert device_dtype(DataType.STRING) == np.int32  # dictionary codes
+    assert from_numpy_dtype(np.dtype(np.float32)) == DataType.FLOAT64
+    assert from_numpy_dtype(np.dtype(object)) == DataType.STRING
