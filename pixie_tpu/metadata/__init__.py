@@ -1,0 +1,20 @@
+"""K8s-entity metadata state (ref: src/shared/metadata/).
+
+The reference keeps a per-agent immutable ``AgentMetadataState`` snapshot
+(pods/services/containers/UPIDs) built from NATS-delivered k8s updates
+(state_manager.{h,cc}); Stirling uses it for PID->pod resolution and Carnot's
+metadata UDFs use it for `df.ctx[...]`. Ours is an in-process snapshot store
+fed by the ingest layer (synthetic topology for now) with the same
+consumer-facing surface: metadata scalar UDFs + the compiler's ctx[] rewrite.
+
+UPID format note: the reference packs (asid, pid, start_ts) into a UINT128;
+here a UPID is the string "asid:pid:start_ts" (dictionary-encoded, so
+metadata lookups run once per distinct process, not per row).
+"""
+
+from pixie_tpu.metadata.state import (  # noqa: F401
+    MetadataState,
+    MetadataStateManager,
+    PodInfo,
+    ServiceInfo,
+)

@@ -1,0 +1,144 @@
+"""Metadata snapshot store.
+
+Ref: src/shared/metadata/metadata_state.{h,cc} (AgentMetadataState: immutable
+k8s world snapshot), state_manager.{h,cc} (applies updates, publishes new
+snapshots). Consumers always read a consistent snapshot; the manager swaps
+snapshots atomically (a Python reference assignment) as updates arrive.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class PodInfo:
+    pod_id: str
+    name: str  # "<namespace>/<pod>"
+    namespace: str
+    service_id: str
+    node_name: str
+    ip: str
+    phase: str = "Running"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceInfo:
+    service_id: str
+    name: str  # "<namespace>/<service>"
+    namespace: str
+
+
+@dataclasses.dataclass(frozen=True)
+class MetadataState:
+    """Immutable snapshot. All maps are lookups by id/key."""
+
+    asid: int = 0
+    hostname: str = "localhost"
+    pods: dict = dataclasses.field(default_factory=dict)  # pod_id -> PodInfo
+    services: dict = dataclasses.field(default_factory=dict)  # svc_id -> ServiceInfo
+    upid_to_pod: dict = dataclasses.field(default_factory=dict)  # upid str -> pod_id
+    ip_to_pod: dict = dataclasses.field(default_factory=dict)  # ip -> pod_id
+    dns: dict = dataclasses.field(default_factory=dict)  # ip -> hostname
+
+    # -- resolution helpers (the surface metadata UDFs use) ----------------
+    def pod_for_upid(self, upid: str) -> Optional[PodInfo]:
+        pid = self.upid_to_pod.get(upid)
+        return self.pods.get(pid) if pid else None
+
+    def service_for_upid(self, upid: str) -> Optional[ServiceInfo]:
+        pod = self.pod_for_upid(upid)
+        if pod is None:
+            return None
+        return self.services.get(pod.service_id)
+
+    def pod_for_ip(self, ip: str) -> Optional[PodInfo]:
+        pid = self.ip_to_pod.get(ip)
+        return self.pods.get(pid) if pid else None
+
+
+class MetadataStateManager:
+    """Swappable current-snapshot holder (ref: AgentMetadataStateManager)."""
+
+    def __init__(self, state: MetadataState | None = None):
+        self._lock = threading.Lock()
+        self._state = state or MetadataState()
+        self._epoch = 0
+
+    def current(self) -> MetadataState:
+        return self._state
+
+    def set_state(self, state: MetadataState) -> None:
+        with self._lock:
+            self._state = state
+            self._epoch += 1
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    # -- incremental update surface (what a k8s watcher would call) --------
+    def apply_update(
+        self,
+        pods: list[PodInfo] = (),
+        services: list[ServiceInfo] = (),
+        upids: dict | None = None,
+    ) -> None:
+        with self._lock:
+            s = self._state
+            new_pods = dict(s.pods)
+            new_ip = dict(s.ip_to_pod)
+            for p in pods:
+                new_pods[p.pod_id] = p
+                if p.ip:
+                    new_ip[p.ip] = p.pod_id
+            new_services = dict(s.services)
+            for sv in services:
+                new_services[sv.service_id] = sv
+            new_upids = dict(s.upid_to_pod)
+            if upids:
+                new_upids.update(upids)
+            self._state = dataclasses.replace(
+                s,
+                pods=new_pods,
+                services=new_services,
+                upid_to_pod=new_upids,
+                ip_to_pod=new_ip,
+            )
+            self._epoch += 1
+
+
+def make_synthetic_state(
+    num_services: int = 8, pods_per_service: int = 3, asid: int = 1
+) -> MetadataState:
+    """Deterministic synthetic k8s topology for tests/benchmarks (analogous
+    in role to the reference's testing fixtures, not a port of them)."""
+    pods, services, upid_to_pod, ip_to_pod = {}, {}, {}, {}
+    svc_names = [f"default/svc-{i}" for i in range(num_services)]
+    for i, sname in enumerate(svc_names):
+        sid = f"svc-id-{i}"
+        services[sid] = ServiceInfo(sid, sname, "default")
+        for j in range(pods_per_service):
+            pid = f"pod-id-{i}-{j}"
+            ip = f"10.0.{i}.{j + 1}"
+            pods[pid] = PodInfo(
+                pod_id=pid,
+                name=f"default/svc-{i}-pod-{j}",
+                namespace="default",
+                service_id=sid,
+                node_name=f"node-{j % 4}",
+                ip=ip,
+            )
+            ip_to_pod[ip] = pid
+            upid = f"{asid}:{1000 + i * pods_per_service + j}:1"
+            upid_to_pod[upid] = pid
+    return MetadataState(
+        asid=asid,
+        pods=pods,
+        services=services,
+        upid_to_pod=upid_to_pod,
+        ip_to_pod=ip_to_pod,
+        dns={ip: p.name for ip, p in ((ip, pods[pid]) for ip, pid in ip_to_pod.items())},
+    )

@@ -1,0 +1,15 @@
+"""Device kernels: the JAX/XLA compute layer under the exec engine.
+
+This package is the TPU replacement for the reference's per-row C++ hot path
+(RowTuple hashing + absl hash maps + per-group UDA virtual calls,
+src/carnot/exec/agg_node.cc / row_tuple.h): group keys become dense int32
+segment ids, aggregation becomes masked segment reductions, and sketch UDAs
+(t-digest / HLL / count-min / log-histogram) are fixed-shape tensors whose
+merge is an elementwise or sort-based op — so the cross-device "Kelvin merge"
+is a psum/pmax collective over ICI instead of a gRPC stream.
+
+All functions here are jit-compatible, static-shape, and take explicit masks
+(padded batches are first-class: XLA wants fixed shapes).
+"""
+
+from pixie_tpu.ops import hashing, segment, tdigest, hll, countmin, histogram  # noqa: F401

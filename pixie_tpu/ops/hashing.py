@@ -1,0 +1,62 @@
+"""Device hash functions.
+
+Replaces the reference's RowTuple hashing (src/carnot/exec/row_tuple.h:
+absl-hash of packed variable-type tuples) with vectorized integer mixing that
+runs on the VPU. Strings are already dictionary codes by the time they reach
+the device, so every key column is an integer lane.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_U64 = jnp.uint64
+
+
+def _u64(c: int):
+    return np.uint64(c)
+
+
+def splitmix64(x: jax.Array) -> jax.Array:
+    """SplitMix64 finalizer — a full-avalanche 64-bit mix."""
+    z = x.astype(_U64) + _u64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> _u64(30))) * _u64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> _u64(27))) * _u64(0x94D049BB133111EB)
+    return z ^ (z >> _u64(31))
+
+
+def hash64(x: jax.Array, seed: int = 0) -> jax.Array:
+    """Hash any integer/float column to uint64."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        # Bit-cast so +/-0.0 collapse and NaNs hash stably enough for keys.
+        x = jax.lax.bitcast_convert_type(x.astype(jnp.float64), jnp.uint64)
+    elif x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint64)
+    return splitmix64(x.astype(_U64) ^ _u64((seed * 0x9E3779B97F4A7C15) & (2**64 - 1)))
+
+
+def combine(h1: jax.Array, h2: jax.Array) -> jax.Array:
+    """Order-dependent hash combine (boost-style) for multi-column keys."""
+    h1 = h1.astype(_U64)
+    return splitmix64(
+        h1 ^ (h2.astype(_U64) + _u64(0x9E3779B97F4A7C15) + (h1 << _u64(6)) + (h1 >> _u64(2)))
+    )
+
+
+def hash_columns(cols: list[jax.Array], seed: int = 0) -> jax.Array:
+    """Hash a multi-column key row-wise into uint64."""
+    h = hash64(cols[0], seed)
+    for c in cols[1:]:
+        h = combine(h, hash64(c, seed))
+    return h
+
+
+def clz64(x: jax.Array) -> jax.Array:
+    """Count leading zeros of uint64 (used by HLL rho)."""
+    x = x.astype(_U64)
+    # Smear the highest set bit downward, then popcount.
+    for s in (1, 2, 4, 8, 16, 32):
+        x = x | (x >> _u64(s))
+    return (64 - jax.lax.population_count(x).astype(jnp.int32)).astype(jnp.int32)

@@ -1,0 +1,71 @@
+"""Masked segment reductions — the group-by aggregation primitive.
+
+The reference aggregates row-at-a-time into an absl hash map of per-group UDA
+objects (src/carnot/exec/agg_node.cc: HashRowBatch -> AggHashValue ->
+UDA::Update). On TPU there are no dynamic hash maps inside a compiled
+program; instead group keys are dense int32 segment ids (strings arrive
+dictionary-encoded; other key types are densified host-side by
+pixie_tpu.exec's GroupDictionary) and aggregation is an XLA segment
+reduction over a static number of segments. Padding rows carry mask=False.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def seg_sum(values, seg_ids, num_segments: int, mask=None):
+    v = values if mask is None else jnp.where(mask, values, 0)
+    return jax.ops.segment_sum(v, seg_ids, num_segments=num_segments)
+
+
+def seg_count(seg_ids, num_segments: int, mask=None):
+    ones = (
+        jnp.ones(seg_ids.shape, jnp.int64)
+        if mask is None
+        else mask.astype(jnp.int64)
+    )
+    return jax.ops.segment_sum(ones, seg_ids, num_segments=num_segments)
+
+
+def seg_min(values, seg_ids, num_segments: int, mask=None):
+    if mask is not None:
+        fill = _identity_for(values.dtype, is_min=True)
+        values = jnp.where(mask, values, fill)
+    return jax.ops.segment_min(values, seg_ids, num_segments=num_segments)
+
+
+def seg_max(values, seg_ids, num_segments: int, mask=None):
+    if mask is not None:
+        fill = _identity_for(values.dtype, is_min=False)
+        values = jnp.where(mask, values, fill)
+    return jax.ops.segment_max(values, seg_ids, num_segments=num_segments)
+
+
+def seg_any(values, seg_ids, num_segments: int, mask=None):
+    v = values.astype(jnp.int32)
+    if mask is not None:
+        v = jnp.where(mask, v, 0)
+    return jax.ops.segment_max(v, seg_ids, num_segments=num_segments).astype(jnp.bool_)
+
+
+def seg_mean_state(values, seg_ids, num_segments: int, mask=None):
+    """(sum, count) pair — mergeable across shards before the divide."""
+    return (
+        seg_sum(values, seg_ids, num_segments, mask),
+        seg_count(seg_ids, num_segments, mask),
+    )
+
+
+def _identity_for(dtype, is_min: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if is_min else -jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if is_min else info.min, dtype)
+
+
+def flat_segment_ids(gids, inner_ids, inner_size: int):
+    """Compose (group, bucket) -> flat segment id for 2-D scatter-free
+    histogram updates: segment-reduce over gids*inner_size+inner then reshape."""
+    return gids.astype(jnp.int32) * inner_size + inner_ids.astype(jnp.int32)

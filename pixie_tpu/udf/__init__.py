@@ -1,0 +1,20 @@
+"""Typed UDF/UDA/UDTF framework.
+
+Ref: src/carnot/udf/ (ScalarUDF udf.h:78, UDA udf.h:104, Registry
+registry.h:101, vectorized exec udf_wrapper.h, UDTF udtf.h). TPU re-design:
+scalar UDFs are vectorized jax-traceable functions over whole columns (the
+reference's row-at-a-time Exec + its column-wise wrapper collapse into one
+thing); UDAs are pytree sketch states with init/update/merge/finalize where
+update folds a whole masked batch of (group-id, value) rows at once and merge
+is the cross-shard collective contract (psum/pmax for elementwise states,
+all-gather + tree-merge otherwise).
+"""
+
+from pixie_tpu.udf.udf import (  # noqa: F401
+    UDA,
+    UDTF,
+    Executor,
+    MergeKind,
+    ScalarUDF,
+)
+from pixie_tpu.udf.registry import Registry, RegistryKey, default_registry  # noqa: F401

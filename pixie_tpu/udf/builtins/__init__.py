@@ -1,0 +1,26 @@
+"""Builtin function library (ref: src/carnot/funcs/ — RegisterFuncsOrDie in
+funcs/funcs.cc). Each module registers its functions into a Registry."""
+
+from pixie_tpu.udf.registry import Registry
+
+
+def register_all(registry: Registry) -> None:
+    from pixie_tpu.udf.builtins import (
+        collections,
+        conditionals,
+        json_ops,
+        math_ops,
+        metadata_ops,
+        sketch_ops,
+        string_ops,
+        time_ops,
+    )
+
+    math_ops.register(registry)
+    sketch_ops.register(registry)
+    string_ops.register(registry)
+    json_ops.register(registry)
+    conditionals.register(registry)
+    time_ops.register(registry)
+    collections.register(registry)
+    metadata_ops.register(registry)

@@ -1,0 +1,50 @@
+"""Collection UDAs (ref: src/carnot/funcs/builtins/collections.h — AnyUDA
+:33). ``any`` keeps an arbitrary observed value per group; on TPU that is a
+segment-max over values (codes for strings), which is deterministic and
+collective-mergeable (pmax)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pixie_tpu.ops import segment
+from pixie_tpu.types import DataType
+from pixie_tpu.udf.registry import Registry
+from pixie_tpu.udf.udf import UDA, MergeKind
+
+F = DataType.FLOAT64
+I = DataType.INT64
+S = DataType.STRING
+B = DataType.BOOLEAN
+T = DataType.TIME64NS
+
+
+def register(r: Registry) -> None:
+    def any_uda(arg_t):
+        # Codes/ints: track max, init at int64 min (or -inf for floats).
+        if arg_t == F:
+            dtype, ident = jnp.float64, -jnp.inf
+        else:
+            dtype, ident = jnp.int64, jnp.iinfo(jnp.int64).min
+
+        def fin(st):
+            zero = jnp.zeros_like(st)
+            return jnp.where(st == ident, zero, st)
+
+        return UDA(
+            name="any",
+            arg_types=(arg_t,),
+            out_type=arg_t,
+            init=lambda g: jnp.full((g,), ident, dtype),
+            update=lambda st, gids, col, mask=None: jnp.maximum(
+                st, segment.seg_max(col.astype(dtype), gids, st.shape[0], mask)
+            ),
+            merge=jnp.maximum,
+            finalize=fin,
+            merge_kind=MergeKind.PMAX,
+            out_semantic=lambda sems: sems[0] if sems else None,
+            doc="An arbitrary (deterministic: max) value from the group.",
+        )
+
+    for t in (F, I, S, B, T):
+        r.register_uda(any_uda(t))

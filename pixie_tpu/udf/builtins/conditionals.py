@@ -1,0 +1,46 @@
+"""Conditional scalar UDFs (ref: src/carnot/funcs/builtins/conditionals.h —
+SelectUDF). Numeric select is a device jnp.where; string select operates on
+codes only when both branches share a dictionary, so it is registered HOST
+and the expression evaluator re-encodes as needed."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pixie_tpu.types import DataType
+from pixie_tpu.udf.registry import Registry
+from pixie_tpu.udf.udf import Executor, ScalarUDF
+
+S = DataType.STRING
+I = DataType.INT64
+B = DataType.BOOLEAN
+F = DataType.FLOAT64
+T = DataType.TIME64NS
+
+
+def register(r: Registry) -> None:
+    for t in (F, I, B, T):
+        r.register_scalar(
+            ScalarUDF(
+                "select",
+                (B, t, t),
+                t,
+                lambda c, a, b: jnp.where(c, a, b),
+                Executor.DEVICE,
+                out_semantic=lambda sems: sems[1] if len(sems) > 1 else None,
+            )
+        )
+
+    def select_str(cond, a, b):
+        cond = np.asarray(cond, dtype=bool)
+        n = len(cond)
+        pick = lambda col, i: col[i] if isinstance(col, np.ndarray) else col
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = pick(a, i) if cond[i] else pick(b, i)
+        return out
+
+    r.register_scalar(
+        ScalarUDF("select", (B, S, S), S, select_str, Executor.HOST)
+    )

@@ -1,0 +1,228 @@
+"""Arithmetic/comparison scalar UDFs and the core aggregate UDAs.
+
+Ref: src/carnot/funcs/builtins/math_ops.h — MeanUDA (:585), SumUDA (:631),
+MaxUDA (:663), MinUDA (:705), CountUDA (:746) and the scalar arithmetic
+templates. TPU re-design: scalars are jnp elementwise lambdas (XLA fuses them
+into neighbors); UDAs are masked segment reductions from pixie_tpu.ops with
+[num_groups]-shaped states and PSUM/PMAX/PMIN merge contracts.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pixie_tpu.ops import segment
+from pixie_tpu.types import DataType, SemanticType
+from pixie_tpu.udf.registry import Registry
+from pixie_tpu.udf.udf import UDA, Executor, MergeKind, ScalarUDF
+
+F = DataType.FLOAT64
+I = DataType.INT64
+B = DataType.BOOLEAN
+S = DataType.STRING
+T = DataType.TIME64NS
+
+
+def _preserve_first(sems):
+    return sems[0] if sems else SemanticType.ST_NONE
+
+
+_INT_DIV_SAFE = lambda a, b: jnp.where(b != 0, a / jnp.where(b == 0, 1, b), 0.0)
+
+
+def register(r: Registry) -> None:
+    # -- binary arithmetic (device) ---------------------------------------
+    table = [
+        ("add", lambda a, b: a + b, [((F, F), F), ((I, I), I)]),
+        ("subtract", lambda a, b: a - b, [((F, F), F), ((I, I), I)]),
+        ("multiply", lambda a, b: a * b, [((F, F), F), ((I, I), I)]),
+        # divide always returns float (ref: math_ops.h division semantics);
+        # guarded against div-by-zero which would trap row batches.
+        ("divide", _INT_DIV_SAFE, [((F, F), F), ((I, I), F)]),
+        ("modulo", lambda a, b: jnp.where(b != 0, a % jnp.where(b == 0, 1, b), 0),
+         [((I, I), I), ((F, F), F)]),
+        ("pow", lambda a, b: jnp.power(a, b), [((F, F), F)]),
+        ("logical_and", lambda a, b: a & b, [((B, B), B)]),
+        ("logical_or", lambda a, b: a | b, [((B, B), B)]),
+    ]
+    for name, fn, sigs in table:
+        for args, out in sigs:
+            r.register_scalar(
+                ScalarUDF(name, args, out, fn, Executor.DEVICE,
+                          out_semantic=_preserve_first)
+            )
+
+    # -- comparisons (device; string comparisons resolve via dictionary
+    #    codes in the expression evaluator before reaching these) ----------
+    cmps = [
+        ("equal", lambda a, b: a == b),
+        ("notEqual", lambda a, b: a != b),
+        ("lessThan", lambda a, b: a < b),
+        ("lessThanEqual", lambda a, b: a <= b),
+        ("greaterThan", lambda a, b: a > b),
+        ("greaterThanEqual", lambda a, b: a >= b),
+    ]
+    for name, fn in cmps:
+        for args in [(F, F), (I, I), (B, B), (T, T)]:
+            r.register_scalar(ScalarUDF(name, args, B, fn, Executor.DEVICE))
+    # code-space equality for strings (codes are comparable within a dict)
+    for name, fn in cmps[:2]:
+        r.register_scalar(ScalarUDF(name, (S, S), B, fn, Executor.DEVICE))
+
+    # -- unary (device) ----------------------------------------------------
+    unary = [
+        ("negate", lambda a: -a, [(F, F), (I, I)]),
+        ("logical_not", lambda a: ~a, [(B, B)]),
+        ("abs", jnp.abs, [(F, F), (I, I)]),
+        ("ceil", lambda a: jnp.ceil(a).astype(jnp.int64), [(F, I)]),
+        ("floor", lambda a: jnp.floor(a).astype(jnp.int64), [(F, I)]),
+        ("round", lambda a: jnp.round(a).astype(jnp.int64), [(F, I)]),
+        ("ln", jnp.log, [(F, F)]),
+        ("log2", jnp.log2, [(F, F)]),
+        ("log10", jnp.log10, [(F, F)]),
+        ("exp", jnp.exp, [(F, F)]),
+        ("sqrt", jnp.sqrt, [(F, F)]),
+    ]
+    for name, fn, sigs in unary:
+        for arg, out in sigs:
+            r.register_scalar(
+                ScalarUDF(name, (arg,), out, fn, Executor.DEVICE,
+                          out_semantic=_preserve_first)
+            )
+    r.register_scalar(
+        ScalarUDF("log", (F, F), F, lambda b, x: jnp.log(x) / jnp.log(b),
+                  Executor.DEVICE)
+    )
+
+    # -- UDAs --------------------------------------------------------------
+    def count_uda(arg_t):
+        return UDA(
+            name="count",
+            arg_types=(arg_t,),
+            out_type=I,
+            init=lambda g: jnp.zeros((g,), jnp.int64),
+            update=lambda st, gids, col, mask=None: st
+            + segment.seg_count(gids, st.shape[0], mask),
+            merge=lambda a, b: a + b,
+            finalize=lambda st: st,
+            merge_kind=MergeKind.PSUM,
+            doc="Number of rows in the group.",
+        )
+
+    for t in (F, I, S, B, T):
+        r.register_uda(count_uda(t))
+
+    def sum_uda(arg_t, out_t, acc_dtype):
+        return UDA(
+            name="sum",
+            arg_types=(arg_t,),
+            out_type=out_t,
+            init=lambda g: jnp.zeros((g,), acc_dtype),
+            update=lambda st, gids, col, mask=None: st
+            + segment.seg_sum(col.astype(acc_dtype), gids, st.shape[0], mask),
+            merge=lambda a, b: a + b,
+            finalize=lambda st: st,
+            merge_kind=MergeKind.PSUM,
+            out_semantic=_preserve_first,
+            doc="Sum of the column within the group.",
+        )
+
+    r.register_uda(sum_uda(F, F, jnp.float64))
+    r.register_uda(sum_uda(I, I, jnp.int64))
+    r.register_uda(sum_uda(B, I, jnp.int64))
+
+    def mean_uda(arg_t):
+        return UDA(
+            name="mean",
+            arg_types=(arg_t,),
+            out_type=F,
+            init=lambda g: {
+                "sum": jnp.zeros((g,), jnp.float64),
+                "count": jnp.zeros((g,), jnp.int64),
+            },
+            update=lambda st, gids, col, mask=None: {
+                "sum": st["sum"]
+                + segment.seg_sum(
+                    col.astype(jnp.float64), gids, st["sum"].shape[0], mask
+                ),
+                "count": st["count"]
+                + segment.seg_count(gids, st["count"].shape[0], mask),
+            },
+            merge=lambda a, b: {
+                "sum": a["sum"] + b["sum"],
+                "count": a["count"] + b["count"],
+            },
+            finalize=lambda st: st["sum"] / jnp.maximum(st["count"], 1),
+            merge_kind=MergeKind.PSUM,
+            out_semantic=_preserve_first,
+            doc="Arithmetic mean (sum/count pair state; merge-safe).",
+        )
+
+    r.register_uda(mean_uda(F))
+
+    def minmax_uda(name, arg_t, is_min):
+        seg_fn = segment.seg_min if is_min else segment.seg_max
+        dtype = jnp.float64 if arg_t == F else jnp.int64
+        ident = (
+            jnp.array(jnp.inf if is_min else -jnp.inf, dtype)
+            if arg_t == F
+            else jnp.array(
+                jnp.iinfo(jnp.int64).max if is_min else jnp.iinfo(jnp.int64).min,
+                dtype,
+            )
+        )
+        pick = jnp.minimum if is_min else jnp.maximum
+
+        def fin(st):
+            return jnp.where(st == ident, jnp.zeros_like(st), st)
+
+        return UDA(
+            name=name,
+            arg_types=(arg_t,),
+            out_type=arg_t,
+            init=lambda g: jnp.full((g,), ident, dtype),
+            update=lambda st, gids, col, mask=None: pick(
+                st, seg_fn(col.astype(dtype), gids, st.shape[0], mask)
+            ),
+            merge=pick,
+            finalize=fin,
+            merge_kind=MergeKind.PMIN if is_min else MergeKind.PMAX,
+            out_semantic=_preserve_first,
+            doc=f"{'Minimum' if is_min else 'Maximum'} value in the group.",
+        )
+
+    for arg_t in (F, I):
+        r.register_uda(minmax_uda("min", arg_t, True))
+        r.register_uda(minmax_uda("max", arg_t, False))
+
+    def var_state_uda(name, finalize):
+        return UDA(
+            name=name,
+            arg_types=(F,),
+            out_type=F,
+            init=lambda g: {
+                "n": jnp.zeros((g,), jnp.int64),
+                "sum": jnp.zeros((g,), jnp.float64),
+                "sumsq": jnp.zeros((g,), jnp.float64),
+            },
+            update=lambda st, gids, col, mask=None: {
+                "n": st["n"] + segment.seg_count(gids, st["n"].shape[0], mask),
+                "sum": st["sum"]
+                + segment.seg_sum(col, gids, st["sum"].shape[0], mask),
+                "sumsq": st["sumsq"]
+                + segment.seg_sum(col * col, gids, st["sumsq"].shape[0], mask),
+            },
+            merge=lambda a, b: {k: a[k] + b[k] for k in a},
+            finalize=finalize,
+            merge_kind=MergeKind.PSUM,
+            doc="Moment-based dispersion aggregate.",
+        )
+
+    def _var(st):
+        n = jnp.maximum(st["n"].astype(jnp.float64), 1.0)
+        v = st["sumsq"] / n - (st["sum"] / n) ** 2
+        return jnp.maximum(v, 0.0)
+
+    r.register_uda(var_state_uda("variance", _var))
+    r.register_uda(var_state_uda("stddev", lambda st: jnp.sqrt(_var(st))))

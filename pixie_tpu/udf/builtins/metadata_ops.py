@@ -1,0 +1,173 @@
+"""Metadata scalar UDFs: k8s entity lookups against the MetadataState.
+
+Ref: src/carnot/funcs/metadata/metadata_ops.* (UPIDToServiceNameUDF et al.,
+resolved against AgentMetadataState via FunctionContext). All host-executed
+and dict_compatible: UPIDs/IPs are dictionary-encoded strings, so each
+distinct process/endpoint resolves once per query, not once per row.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pixie_tpu.types import DataType, SemanticType
+from pixie_tpu.udf.registry import Registry
+from pixie_tpu.udf.udf import Executor, ScalarUDF
+
+S = DataType.STRING
+I = DataType.INT64
+
+
+def _lift(fn, out_dtype=object):
+    def wrapper(ctx, *cols):
+        state = ctx.metadata_state
+        n = max((len(c) for c in cols if isinstance(c, np.ndarray)), default=1)
+        out = np.empty(n, dtype=out_dtype)
+        for i in range(n):
+            args = [c[i] if isinstance(c, np.ndarray) else c for c in cols]
+            out[i] = fn(state, *args)
+        return out
+
+    return wrapper
+
+
+def register(r: Registry) -> None:
+    def reg(name, args, out, fn, out_dtype=object, semantic=None):
+        r.register_scalar(
+            ScalarUDF(
+                name,
+                args,
+                out,
+                _lift(fn, out_dtype),
+                Executor.HOST,
+                dict_compatible=True,
+                needs_ctx=True,
+                out_semantic=semantic,
+            )
+        )
+
+    # -- UPID resolvers ----------------------------------------------------
+    def pod_of(st, upid):
+        return st.pod_for_upid(upid)
+
+    reg(
+        "upid_to_pod_id",
+        (S,),
+        S,
+        lambda st, u: (pod_of(st, u).pod_id if pod_of(st, u) else ""),
+    )
+    reg(
+        "upid_to_pod_name",
+        (S,),
+        S,
+        lambda st, u: (pod_of(st, u).name if pod_of(st, u) else ""),
+        semantic=SemanticType.ST_POD_NAME,
+    )
+    reg(
+        "upid_to_namespace",
+        (S,),
+        S,
+        lambda st, u: (pod_of(st, u).namespace if pod_of(st, u) else ""),
+        semantic=SemanticType.ST_NAMESPACE_NAME,
+    )
+    reg(
+        "upid_to_node_name",
+        (S,),
+        S,
+        lambda st, u: (pod_of(st, u).node_name if pod_of(st, u) else ""),
+        semantic=SemanticType.ST_NODE_NAME,
+    )
+
+    def svc_of(st, upid):
+        return st.service_for_upid(upid)
+
+    reg(
+        "upid_to_service_name",
+        (S,),
+        S,
+        lambda st, u: (svc_of(st, u).name if svc_of(st, u) else ""),
+        semantic=SemanticType.ST_SERVICE_NAME,
+    )
+    reg(
+        "upid_to_service_id",
+        (S,),
+        S,
+        lambda st, u: (svc_of(st, u).service_id if svc_of(st, u) else ""),
+    )
+
+    def upid_to_pid(st, u):
+        try:
+            return int(u.split(":")[1])
+        except (IndexError, ValueError):
+            return -1
+
+    reg("upid_to_pid", (S,), I, upid_to_pid, np.int64)
+
+    def upid_to_asid(st, u):
+        try:
+            return int(u.split(":")[0])
+        except (IndexError, ValueError):
+            return -1
+
+    reg("upid_to_asid", (S,), I, upid_to_asid, np.int64)
+
+    # -- pod/service id resolvers -----------------------------------------
+    reg(
+        "pod_id_to_pod_name",
+        (S,),
+        S,
+        lambda st, pid: st.pods[pid].name if pid in st.pods else "",
+        semantic=SemanticType.ST_POD_NAME,
+    )
+    reg(
+        "pod_id_to_service_name",
+        (S,),
+        S,
+        lambda st, pid: (
+            st.services[st.pods[pid].service_id].name
+            if pid in st.pods and st.pods[pid].service_id in st.services
+            else ""
+        ),
+        semantic=SemanticType.ST_SERVICE_NAME,
+    )
+    reg(
+        "pod_id_to_service_id",
+        (S,),
+        S,
+        lambda st, pid: st.pods[pid].service_id if pid in st.pods else "",
+    )
+    reg(
+        "pod_id_to_namespace",
+        (S,),
+        S,
+        lambda st, pid: st.pods[pid].namespace if pid in st.pods else "",
+        semantic=SemanticType.ST_NAMESPACE_NAME,
+    )
+    reg(
+        "service_id_to_service_name",
+        (S,),
+        S,
+        lambda st, sid: st.services[sid].name if sid in st.services else "",
+        semantic=SemanticType.ST_SERVICE_NAME,
+    )
+    reg(
+        "ip_to_pod_id",
+        (S,),
+        S,
+        lambda st, ip: st.pod_for_ip(ip).pod_id if st.pod_for_ip(ip) else "",
+    )
+    reg(
+        "nslookup",
+        (S,),
+        S,
+        lambda st, ip: st.dns.get(ip, ip),
+    )
+    reg("_exec_hostname", (), S, lambda st: st.hostname)
+    reg("pod_name_to_pod_id", (S,), S,
+        lambda st, name: next(
+            (p.pod_id for p in st.pods.values() if p.name == name), ""
+        ))
+    reg("service_name_to_service_id", (S,), S,
+        lambda st, name: next(
+            (s.service_id for s in st.services.values() if s.name == name), ""
+        ))

@@ -1,0 +1,56 @@
+"""Time scalar UDFs (ref: src/carnot/funcs/builtins/math_ops.h BinUDF and
+funcs/builtins/time_ops). px.now / px.minutes etc. are compile-time values
+provided by the PxL object layer (pixie_tpu.compiler.objects), not UDFs —
+matching the reference where they are compiler intrinsics."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pixie_tpu.types import DataType, SemanticType
+from pixie_tpu.udf.registry import Registry
+from pixie_tpu.udf.udf import Executor, ScalarUDF
+
+I = DataType.INT64
+T = DataType.TIME64NS
+F = DataType.FLOAT64
+
+
+def register(r: Registry) -> None:
+    def bin_fn(t, size):
+        return t - t % jnp.maximum(size, 1)
+
+    for args, out in [((T, I), T), ((I, I), I), ((F, I), F)]:
+        r.register_scalar(
+            ScalarUDF(
+                "bin",
+                args,
+                out,
+                bin_fn,
+                Executor.DEVICE,
+                out_semantic=lambda sems: sems[0] if sems else None,
+            )
+        )
+
+    # DurationNanos: tag an int64 as a duration (semantic cast).
+    r.register_scalar(
+        ScalarUDF(
+            "DurationNanos",
+            (I,),
+            I,
+            lambda x: x.astype(jnp.int64) if hasattr(x, "astype") else x,
+            Executor.DEVICE,
+            out_semantic=SemanticType.ST_DURATION_NS,
+        )
+    )
+    # Time: int64 -> TIME64NS cast.
+    r.register_scalar(
+        ScalarUDF(
+            "Time",
+            (I,),
+            T,
+            lambda x: x,
+            Executor.DEVICE,
+            out_semantic=SemanticType.ST_TIME_NS,
+        )
+    )

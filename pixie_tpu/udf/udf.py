@@ -1,0 +1,124 @@
+"""UDF/UDA/UDTF base classes.
+
+Ref: src/carnot/udf/udf.h — ScalarUDF::Exec (:78), UDA::Update/Merge/Finalize
+with optional Serialize/DeSerialize for partial aggregates (:91-104). The
+reference executes row-at-a-time through virtual calls and wraps that in a
+column loop (udf_wrapper.h); here the column IS the unit: a scalar UDF is a
+function over whole device arrays (jit-fusable into its consumers), and a UDA
+state is a pytree of fixed-shape tensors with a leading num_groups axis.
+
+Partial aggregation (the PEM->Kelvin split, partial_op_mgr.h:94) maps to:
+  update on each shard -> merge across shards (collective) -> finalize once.
+``MergeKind`` declares how merge lowers onto the mesh:
+  PSUM / PMAX / PMIN  — elementwise; the distributed layer emits one
+                        lax.psum/pmax/pmin over ICI,
+  TREE                — order-insensitive but not elementwise (t-digest):
+                        all_gather states, fold with merge().
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Sequence
+
+from pixie_tpu.types import DataType, SemanticType
+
+
+class Executor(enum.Enum):
+    """Where a scalar UDF runs (ref: planner's scalar_udfs_run_on_executor
+    placement rules). DEVICE = jax-traceable over jnp arrays; HOST = numpy
+    (string/JSON/metadata funcs). HOST funcs with ``dict_compatible`` are
+    applied to a string column's dictionary values only, and the result is
+    gathered through the codes on device."""
+
+    DEVICE = "device"
+    HOST = "host"
+
+
+class MergeKind(enum.Enum):
+    PSUM = "psum"
+    PMAX = "pmax"
+    PMIN = "pmin"
+    TREE = "tree"
+
+
+@dataclasses.dataclass
+class ScalarUDF:
+    name: str
+    arg_types: tuple[DataType, ...]
+    out_type: DataType
+    fn: Callable[..., Any]
+    executor: Executor = Executor.DEVICE
+    # HOST string funcs that are pure elementwise value->value maps can run
+    # on the (tiny) dictionary instead of the full column.
+    dict_compatible: bool = False
+    # Optional init/non-column args appended after column args (e.g. the
+    # substring pattern). The reference models these as init_args (udf.h).
+    num_init_args: int = 0
+    # True -> fn(ctx, *cols) receives the exec FunctionContext (metadata
+    # state etc.; ref: udf.h FunctionContext).
+    needs_ctx: bool = False
+    out_semantic: SemanticType | Callable | None = None
+    doc: str = ""
+
+    def infer_semantic(self, arg_semantics: Sequence[SemanticType]) -> SemanticType:
+        if callable(self.out_semantic):
+            return self.out_semantic(list(arg_semantics))
+        if self.out_semantic is not None:
+            return self.out_semantic
+        return SemanticType.ST_NONE
+
+
+@dataclasses.dataclass
+class UDA:
+    """A vectorized, group-batched user-defined aggregate.
+
+    - ``init(num_groups) -> state`` pytree of [num_groups, ...] tensors
+    - ``update(state, gids, *cols, mask) -> state``   (jit-compatible)
+    - ``merge(a, b) -> state``                        (jit-compatible)
+    - ``finalize(state) -> column`` host or device; length num_groups
+    Serialize/DeSerialize (udf.h:98-100) are free: states are pytrees.
+    """
+
+    name: str
+    arg_types: tuple[DataType, ...]
+    out_type: DataType
+    init: Callable[[int], Any]
+    update: Callable[..., Any]
+    merge: Callable[[Any, Any], Any]
+    finalize: Callable[[Any], Any]
+    merge_kind: MergeKind = MergeKind.PSUM
+    out_semantic: SemanticType | Callable | None = None
+    # True when finalize output must be produced on host (e.g. JSON strings).
+    host_finalize: bool = False
+    doc: str = ""
+
+    @property
+    def supports_partial(self) -> bool:
+        """All our UDAs are partial-aggregable by construction (states are
+        serializable pytrees) — the reference gates this on Serialize support
+        (partial_op_mgr.h:94)."""
+        return True
+
+    def infer_semantic(self, arg_semantics: Sequence[SemanticType]) -> SemanticType:
+        if callable(self.out_semantic):
+            return self.out_semantic(list(arg_semantics))
+        if self.out_semantic is not None:
+            return self.out_semantic
+        return SemanticType.ST_NONE
+
+
+@dataclasses.dataclass
+class UDTF:
+    """User-defined table function (ref: udtf.h) — produces a table.
+
+    ``fn(ctx, **args) -> (Relation, dict of columns)``. Used for
+    introspection sources like GetAgentStatus (vizier/funcs/md_udtfs).
+    """
+
+    name: str
+    arg_spec: dict[str, DataType]
+    fn: Callable[..., Any]
+    executor: Executor = Executor.HOST
+    doc: str = ""

@@ -1,19 +1,30 @@
 """Test harness config.
 
-Force the CPU backend with 8 virtual devices so the distributed layer
+Force the local CPU backend with 8 virtual devices so the distributed layer
 (device-mesh sharding, psum merges) is exercised without TPU hardware —
 mirroring the reference's strategy of testing PEM/Kelvin distribution with
-fake DistributedState protos (SURVEY.md §4). Must run before jax imports.
+fake DistributedState protos (SURVEY.md §4).
+
+Two traps this guards against (this image routes JAX through the remote
+"axon" TPU tunnel, where every fresh XLA compile is a multi-second RPC):
+- the env pins JAX_PLATFORMS=axon, and the axon sitecustomize hook
+  re-pins jax_platforms='axon,cpu' at interpreter start, overriding the env;
+  only a post-import ``jax.config.update('jax_platforms', 'cpu')`` wins.
+- XLA_FLAGS must carry the virtual-device count before backends initialize.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,163 @@
+"""Device kernel tests (ref model: src/carnot/funcs/builtins/*_test.cc)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pixie_tpu.ops import countmin, hashing, histogram, hll, segment, tdigest
+
+
+class TestHashing:
+    def test_determinism_and_spread(self):
+        x = jnp.arange(1000, dtype=jnp.int64)
+        h1 = hashing.hash64(x)
+        h2 = hashing.hash64(x)
+        assert (np.asarray(h1) == np.asarray(h2)).all()
+        assert len(np.unique(np.asarray(h1))) == 1000
+        hs = hashing.hash64(x, seed=7)
+        assert (np.asarray(hs) != np.asarray(h1)).all()
+
+    def test_clz64(self):
+        vals = np.array([1, 2, 255, 2**63, 2**32, 12345678901234], dtype=np.uint64)
+        got = np.asarray(hashing.clz64(jnp.asarray(vals)))
+        want = [64 - int(v).bit_length() for v in vals]
+        assert got.tolist() == want
+
+    def test_multi_column(self):
+        a = jnp.array([1, 1, 2], dtype=jnp.int64)
+        b = jnp.array([1, 2, 1], dtype=jnp.int64)
+        h = np.asarray(hashing.hash_columns([a, b]))
+        assert len(np.unique(h)) == 3
+        # order matters
+        h2 = np.asarray(hashing.hash_columns([b, a]))
+        assert (h != h2).any()
+
+
+class TestSegment:
+    def test_masked_reductions(self, rng):
+        n, g = 1000, 7
+        gids = jnp.asarray(rng.integers(0, g, n), dtype=jnp.int32)
+        vals = jnp.asarray(rng.normal(size=n))
+        mask = jnp.asarray(rng.random(n) < 0.8)
+        np_g, np_v, np_m = map(np.asarray, (gids, vals, mask))
+        s = np.asarray(segment.seg_sum(vals, gids, g, mask))
+        c = np.asarray(segment.seg_count(gids, g, mask))
+        mn = np.asarray(segment.seg_min(vals, gids, g, mask))
+        mx = np.asarray(segment.seg_max(vals, gids, g, mask))
+        for k in range(g):
+            sel = np_v[(np_g == k) & np_m]
+            assert s[k] == pytest.approx(sel.sum(), rel=1e-9)
+            assert c[k] == len(sel)
+            assert mn[k] == pytest.approx(sel.min())
+            assert mx[k] == pytest.approx(sel.max())
+
+
+class TestHistogram:
+    def test_quantiles_relative_error(self, rng):
+        spec = histogram.DEFAULT_SPEC
+        g = 3
+        state = histogram.init(g, spec)
+        true_vals = {k: rng.lognormal(mean=10 + k, sigma=1.0, size=20000) for k in range(g)}
+        for k, v in true_vals.items():
+            gids = jnp.full((len(v),), k, jnp.int32)
+            state = histogram.update(state, gids, jnp.asarray(v), spec=spec)
+        qv = np.asarray(histogram.quantile_values(state, [0.5, 0.99], spec))
+        for k in range(g):
+            for qi, q in enumerate([0.5, 0.99]):
+                true = np.quantile(true_vals[k], q)
+                assert qv[k, qi] == pytest.approx(true, rel=0.05)
+
+    def test_merge_is_add_and_matches_single(self, rng):
+        v = rng.lognormal(10, 1, 10000)
+        gids = jnp.zeros(10000, jnp.int32)
+        full = histogram.update(histogram.init(1), gids, jnp.asarray(v))
+        h1 = histogram.update(histogram.init(1), gids[:5000], jnp.asarray(v[:5000]))
+        h2 = histogram.update(histogram.init(1), gids[5000:], jnp.asarray(v[5000:]))
+        assert (np.asarray(histogram.merge(h1, h2)) == np.asarray(full)).all()
+
+
+class TestTDigest:
+    def test_quantiles(self, rng):
+        g = 2
+        state = tdigest.init(g)
+        data = {0: rng.normal(1000, 100, 30000), 1: rng.exponential(50, 30000)}
+        for k, v in data.items():
+            for chunk in np.array_split(v, 3):
+                gids = jnp.full((len(chunk),), k, jnp.int32)
+                state = tdigest.update(state, gids, jnp.asarray(chunk))
+        qv = np.asarray(tdigest.quantile_values(state, [0.25, 0.5, 0.9, 0.99]))
+        for k, v in data.items():
+            for qi, q in enumerate([0.25, 0.5, 0.9, 0.99]):
+                true = np.quantile(v, q)
+                spread = np.quantile(v, 0.999) - np.quantile(v, 0.001)
+                assert abs(qv[k, qi] - true) < 0.05 * spread, (k, q, qv[k, qi], true)
+
+    def test_distributed_merge_close_to_single(self, rng):
+        v = rng.normal(0, 1, 40000)
+        shards = np.array_split(v, 4)
+        states = []
+        for s in shards:
+            st = tdigest.update(
+                tdigest.init(1), jnp.zeros(len(s), jnp.int32), jnp.asarray(s)
+            )
+            states.append(st)
+        merged = states[0]
+        for st in states[1:]:
+            merged = tdigest.merge(merged, st)
+        qv = np.asarray(tdigest.quantile_values(merged, [0.5, 0.95]))
+        assert qv[0, 0] == pytest.approx(np.quantile(v, 0.5), abs=0.05)
+        assert qv[0, 1] == pytest.approx(np.quantile(v, 0.95), abs=0.08)
+
+    def test_masked_update(self):
+        state = tdigest.init(1)
+        vals = jnp.asarray([1.0, 2.0, 1e9, 1e9])
+        mask = jnp.asarray([True, True, False, False])
+        state = tdigest.update(state, jnp.zeros(4, jnp.int32), vals, mask)
+        q = np.asarray(tdigest.quantile_values(state, [1.0]))
+        assert q[0, 0] <= 2.0 + 1e-6
+
+
+class TestHLL:
+    def test_estimate_accuracy(self, rng):
+        g = 3
+        state = hll.init(g)
+        cards = [100, 5000, 200000]
+        for k, c in enumerate(cards):
+            vals = jnp.asarray(rng.integers(0, 2**62, c), dtype=jnp.int64)
+            gids = jnp.full((c,), k, jnp.int32)
+            state = hll.update(state, gids, vals)
+        est = np.asarray(hll.estimate(state))
+        for k, c in enumerate(cards):
+            assert est[k] == pytest.approx(c, rel=0.08), (k, est[k], c)
+
+    def test_merge_idempotent_union(self, rng):
+        a_vals = jnp.asarray(rng.integers(0, 10**9, 5000), dtype=jnp.int64)
+        z = jnp.zeros(5000, jnp.int32)
+        a = hll.update(hll.init(1), z, a_vals)
+        b = hll.update(hll.init(1), z, a_vals)  # same values
+        est = np.asarray(hll.estimate(hll.merge(a, b)))[0]
+        single = np.asarray(hll.estimate(a))[0]
+        assert est == pytest.approx(single, rel=1e-6)
+
+
+class TestCountMin:
+    def test_heavy_hitter_counts(self, rng):
+        state = countmin.init(1)
+        # zipf-ish: value v appears ~ 10000/v times
+        vals = np.concatenate([np.full(10000 // (v + 1), v) for v in range(50)])
+        gids = jnp.zeros(len(vals), jnp.int32)
+        state = countmin.update(state, gids, jnp.asarray(vals, dtype=jnp.int64))
+        queries = jnp.asarray([0, 1, 4], dtype=jnp.int64)
+        est = np.asarray(countmin.query(state, jnp.zeros(3, jnp.int32), queries))
+        true = [10000, 5000, 2000]
+        for e, t in zip(est, true):
+            assert e >= t  # CM never undercounts
+            assert e <= t + 0.01 * len(vals)
+
+    def test_merge_is_add(self, rng):
+        vals = jnp.asarray(rng.integers(0, 100, 2000), dtype=jnp.int64)
+        z = jnp.zeros(2000, jnp.int32)
+        full = countmin.update(countmin.init(1), z, vals)
+        h1 = countmin.update(countmin.init(1), z[:1000], vals[:1000])
+        h2 = countmin.update(countmin.init(1), z[1000:], vals[1000:])
+        assert (np.asarray(countmin.merge(h1, h2)) == np.asarray(full)).all()

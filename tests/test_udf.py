@@ -1,0 +1,169 @@
+"""UDF/UDA registry + builtin tests (ref model: src/carnot/udf/registry_test.cc)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pixie_tpu.types import DataType
+from pixie_tpu.udf import MergeKind, default_registry
+
+F = DataType.FLOAT64
+I = DataType.INT64
+S = DataType.STRING
+B = DataType.BOOLEAN
+T = DataType.TIME64NS
+
+
+@pytest.fixture(scope="module")
+def reg():
+    return default_registry()
+
+
+class TestRegistry:
+    def test_exact_lookup(self, reg):
+        udf = reg.lookup_scalar("add", (F, F))
+        assert udf is not None and udf.out_type == F
+
+    def test_int_promotion(self, reg):
+        # pow only registered for (F, F); ints promote
+        udf = reg.lookup_scalar("pow", (I, I))
+        assert udf is not None and udf.arg_types == (F, F)
+
+    def test_int_preferred_over_promo(self, reg):
+        udf = reg.lookup_scalar("add", (I, I))
+        assert udf.arg_types == (I, I) and udf.out_type == I
+
+    def test_bool_promotion_for_mean(self, reg):
+        uda = reg.lookup_uda("mean", (B,))
+        assert uda is not None and uda.out_type == F
+
+    def test_time_promotion(self, reg):
+        uda = reg.lookup_uda("min", (T,))
+        assert uda is not None
+
+    def test_missing(self, reg):
+        assert reg.lookup_scalar("no_such_fn", (F,)) is None
+
+
+class TestMathUDAs:
+    def run_uda(self, reg, name, arg_t, gids, col, num_groups, mask=None):
+        uda = reg.lookup_uda(name, (arg_t,))
+        st = uda.init(num_groups)
+        st = uda.update(st, jnp.asarray(gids, jnp.int32), jnp.asarray(col), mask=mask)
+        return uda, np.asarray(uda.finalize(st))
+
+    def test_sum_count_mean_min_max(self, reg):
+        gids = [0, 1, 0, 1, 0]
+        col = [1.0, 2.0, 3.0, 4.0, 5.0]
+        _, s = self.run_uda(reg, "sum", F, gids, col, 2)
+        assert s.tolist() == [9.0, 6.0]
+        _, c = self.run_uda(reg, "count", F, gids, col, 2)
+        assert c.tolist() == [3, 2]
+        _, m = self.run_uda(reg, "mean", F, gids, col, 2)
+        assert m.tolist() == [3.0, 3.0]
+        _, mn = self.run_uda(reg, "min", F, gids, col, 2)
+        assert mn.tolist() == [1.0, 2.0]
+        _, mx = self.run_uda(reg, "max", F, gids, col, 2)
+        assert mx.tolist() == [5.0, 4.0]
+
+    def test_int_sum_stays_int(self, reg):
+        uda = default_registry().lookup_uda("sum", (I,))
+        assert uda.out_type == I
+
+    def test_partial_merge_equals_single(self, reg):
+        uda = reg.lookup_uda("mean", (F,))
+        g = jnp.asarray([0, 0, 1, 1], jnp.int32)
+        v = jnp.asarray([1.0, 3.0, 10.0, 30.0])
+        full = uda.update(uda.init(2), g, v)
+        p1 = uda.update(uda.init(2), g[:2], v[:2])
+        p2 = uda.update(uda.init(2), g[2:], v[2:])
+        merged = uda.merge(p1, p2)
+        assert np.allclose(
+            np.asarray(uda.finalize(merged)), np.asarray(uda.finalize(full))
+        )
+
+    def test_empty_group_finalize(self, reg):
+        _, mn = self.run_uda(reg, "min", F, [0, 0], [5.0, 3.0], 3)
+        assert mn[1] == 0.0 and mn[2] == 0.0  # untouched groups -> 0, not inf
+
+    def test_stddev(self, reg):
+        _, sd = self.run_uda(reg, "stddev", F, [0] * 4, [2.0, 4.0, 4.0, 6.0], 1)
+        assert sd[0] == pytest.approx(np.std([2, 4, 4, 6]))
+
+
+class TestSketchUDAs:
+    def test_quantiles_json_format(self, reg):
+        import json
+
+        uda = reg.lookup_uda("quantiles", (F,))
+        gids = jnp.zeros(1000, jnp.int32)
+        vals = jnp.asarray(np.linspace(1000.0, 2000.0, 1000))
+        st = uda.update(uda.init(1), gids, vals)
+        out = uda.finalize(st)
+        d = json.loads(out[0])
+        assert set(d) == {"p01", "p10", "p25", "p50", "p75", "p90", "p99"}
+        assert d["p50"] == pytest.approx(1500, rel=0.05)
+        assert uda.merge_kind == MergeKind.PSUM
+
+    def test_tdigest_variant(self, reg):
+        import json
+
+        uda = reg.lookup_uda("quantiles_tdigest", (F,))
+        assert uda.merge_kind == MergeKind.TREE
+        st = uda.update(
+            uda.init(1), jnp.zeros(500, jnp.int32), jnp.asarray(np.arange(500.0))
+        )
+        d = json.loads(uda.finalize(st)[0])
+        assert d["p50"] == pytest.approx(250, abs=15)
+
+    def test_hll_uda(self, reg):
+        uda = reg.lookup_uda("approx_count_distinct", (I,))
+        vals = jnp.asarray(np.arange(2000) % 500, dtype=jnp.int64)
+        st = uda.update(uda.init(1), jnp.zeros(2000, jnp.int32), vals)
+        est = np.asarray(uda.finalize(st))[0]
+        assert est == pytest.approx(500, rel=0.1)
+
+    def test_count_min_uda(self, reg):
+        import json
+
+        uda = reg.lookup_uda("count_min", (I,))
+        vals = jnp.asarray([7] * 100 + [3] * 50, dtype=jnp.int64)
+        st = uda.update(uda.init(1), jnp.zeros(150, jnp.int32), vals)
+        d = json.loads(uda.finalize(st)[0])
+        assert d["total"] == 150 and d["max_est"] >= 100
+
+
+class TestStringUDFs:
+    def test_contains(self, reg):
+        udf = reg.lookup_scalar("contains", (S, S))
+        out = udf.fn(np.array(["abc", "xyz"], dtype=object), "b")
+        assert out.tolist() == [True, False]
+        assert udf.dict_compatible
+
+    def test_pluck_float64(self, reg):
+        udf = reg.lookup_scalar("pluck_float64", (S, S))
+        col = np.array(['{"p50":1.5,"p99":9.0}', "bad json"], dtype=object)
+        out = udf.fn(col, "p99")
+        assert out[0] == 9.0 and np.isnan(out[1])
+
+    def test_script_reference_variadic(self, reg):
+        udf = reg.lookup_scalar("script_reference", (S, S, S, S))
+        out = udf.fn(np.array(["lbl"], dtype=object), "px/pod", "pod", "p1")
+        assert "px/pod" in out[0]
+
+
+class TestMetadataUDFs:
+    def test_upid_resolution(self, reg):
+        from pixie_tpu.metadata.state import make_synthetic_state
+
+        class Ctx:
+            metadata_state = make_synthetic_state(num_services=2, pods_per_service=1)
+
+        udf = reg.lookup_scalar("upid_to_service_name", (S,))
+        assert udf.needs_ctx
+        upids = np.array(["1:1000:1", "1:9999:1"], dtype=object)
+        out = udf.fn(Ctx(), upids)
+        assert out[0] == "default/svc-0" and out[1] == ""
+
+        pid_udf = reg.lookup_scalar("upid_to_pid", (S,))
+        assert pid_udf.fn(Ctx(), upids).tolist() == [1000, 9999]
