@@ -1,0 +1,24 @@
+"""Execution engine: operator nodes, expression evaluation, exec graph.
+
+Ref: src/carnot/exec/ — ExecNode lifecycle + stats (exec_node.h),
+ExecutionGraph pull-on-source/push-downstream loop (exec_graph.cc),
+operator nodes, expression evaluator, GRPC router (here: bridge router).
+"""
+
+from pixie_tpu.exec.exec_node import ExecNode, ExecNodeStats
+from pixie_tpu.exec.exec_state import ExecState, FunctionContext
+from pixie_tpu.exec.exec_graph import ExecutionGraph
+from pixie_tpu.exec.expression_evaluator import ExpressionEvaluator
+from pixie_tpu.exec.group_encoder import GroupEncoder
+from pixie_tpu.exec.router import BridgeRouter
+
+__all__ = [
+    "BridgeRouter",
+    "ExecNode",
+    "ExecNodeStats",
+    "ExecState",
+    "ExecutionGraph",
+    "ExpressionEvaluator",
+    "FunctionContext",
+    "GroupEncoder",
+]

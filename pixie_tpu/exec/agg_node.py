@@ -1,0 +1,268 @@
+"""Hash-aggregate node via dense gids + segment reductions.
+
+Ref: src/carnot/exec/agg_node.{h,cc} — the reference keeps an absl hash map
+of RowTuple→AggHashValue with per-group UDA object instances, updated
+row-at-a-time (HashRowBatch → UDA::Update), emitting on eos/eow
+(ConvertAggHashMapToRowBatch), with a partial-aggregate serialize path for
+the PEM→Kelvin split (EvaluatePartialAggregates).
+
+TPU re-design: group keys densify host-side to int32 gids (GroupEncoder);
+UDA state is a pytree with a leading [capacity] group axis updated by one
+vectorized `uda.update(state, gids, *cols)` per batch — a masked XLA segment
+reduction, not a per-row loop. Capacity grows by doubling (concat with
+`uda.init(extra)`, which is the merge identity by UDA contract). The partial
+stage emits a StateBatch (keys + state pytrees); the merge stage scatter-
+aligns incoming states onto local gids and folds with `uda.merge` — one code
+path for every MergeKind, since init == merge identity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from pixie_tpu.exec.exec_node import ExecNode
+from pixie_tpu.exec.group_encoder import GroupEncoder
+from pixie_tpu.plan.expressions import ColumnRef, expr_data_type
+from pixie_tpu.plan.operators import AggOp, AggStage
+from pixie_tpu.table.column import DictColumn, StringDictionary
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.types import DataType, Relation
+
+INITIAL_CAPACITY = 256
+
+
+@dataclasses.dataclass
+class StateBatch:
+    """Partial-aggregate handoff between fragments (ref: the serialized
+    partial-agg row batches of partial_op_mgr.h:94). Carries group keys in
+    gid order plus per-value state pytrees sliced to num_groups."""
+
+    key_columns: list  # per group col: np.ndarray or DictColumn, len num_groups
+    states: dict[str, Any]  # out_name -> pytree with leading [num_groups]
+    num_groups: int
+    group_names: tuple[str, ...]
+    eow: bool = False
+    eos: bool = False
+
+
+@dataclasses.dataclass
+class _AggSpec:
+    out_name: str
+    uda: Any
+    arg_names: tuple[str, ...]
+    arg_is_string: tuple[bool, ...]
+
+
+class AggNode(ExecNode):
+    def __init__(self, op: AggOp, output_relation: Relation, node_id: int):
+        super().__init__(op, output_relation, node_id)
+        self.op: AggOp = op
+        self._specs: list[_AggSpec] = []
+        self._encoder = GroupEncoder()
+        self._capacity = INITIAL_CAPACITY if op.groups else 1
+        self._states: dict[str, Any] = {}
+        self._key_dicts: dict[str, Optional[StringDictionary]] = {}
+        self._input_relation: Optional[Relation] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def prepare_impl(self, exec_state) -> None:
+        pass
+
+    def set_input_relation(self, rel: Relation, registry) -> None:
+        self._input_relation = rel
+        if self.op.stage == AggStage.MERGE and self.op.pre_agg_relation is not None:
+            rel = self.op.pre_agg_relation  # resolve UDAs as PARTIAL did
+        self._specs = []
+        for out_name, agg in self.op.values:
+            arg_types = [expr_data_type(a, rel, registry) for a in agg.args]
+            uda = registry.lookup_uda(agg.name, arg_types)
+            if uda is None:
+                raise ValueError(
+                    f"no aggregate {agg.name}"
+                    f"({', '.join(t.name for t in arg_types)})"
+                )
+            names, is_str = [], []
+            for a in agg.args:
+                if not isinstance(a, ColumnRef):
+                    raise ValueError(
+                        "aggregate args must be column refs (the compiler "
+                        "hoists computed args into a Map)"
+                    )
+                names.append(a.name)
+                is_str.append(rel.col(a.name).data_type == DataType.STRING)
+            self._specs.append(_AggSpec(out_name, uda, tuple(names), tuple(is_str)))
+        self._states = {
+            s.out_name: s.uda.init(self._capacity) for s in self._specs
+        }
+
+    # -- consume ------------------------------------------------------------
+    def consume_next_impl(self, exec_state, batch, parent_index: int) -> None:
+        if isinstance(batch, StateBatch):
+            self._consume_states(batch)
+            if batch.eos or (batch.eow and self.op.windowed):
+                self._emit(exec_state, eow=batch.eow, eos=batch.eos)
+            return
+        assert isinstance(batch, RowBatch)
+        if batch.num_rows:
+            gids = self._gids_for(batch)
+            self._ensure_capacity(self._encoder.num_groups or 1)
+            for spec in self._specs:
+                cols = [
+                    self._arg_array(batch, n, s)
+                    for n, s in zip(spec.arg_names, spec.arg_is_string)
+                ]
+                self._states[spec.out_name] = spec.uda.update(
+                    self._states[spec.out_name], gids, *cols
+                )
+        if batch.eos or (batch.eow and self.op.windowed):
+            self._emit(exec_state, eow=batch.eow, eos=batch.eos)
+
+    def _gids_for(self, batch: RowBatch) -> np.ndarray:
+        if not self.op.groups:
+            return np.zeros(batch.num_rows, np.int32)
+        key_cols = []
+        for g in self.op.groups:
+            col = batch.col(g)
+            if isinstance(col, DictColumn):
+                existing = self._key_dicts.get(g)
+                if existing is None:
+                    self._key_dicts[g] = col.dictionary
+                elif col.dictionary is not existing:
+                    # Cross-dictionary batch (e.g. across a union): re-encode
+                    # into the dictionary we first latched.
+                    col = DictColumn(existing.encode(col.decode()), existing)
+            key_cols.append(col)
+        return self._encoder.encode(key_cols)
+
+    def _arg_array(self, batch: RowBatch, name: str, is_string: bool):
+        col = batch.col(name)
+        if isinstance(col, DictColumn):
+            return col.codes  # UDAs over strings see dictionary codes
+        return col
+
+    def _ensure_capacity(self, needed: int) -> None:
+        while self._capacity < needed:
+            extra = self._capacity
+            for spec in self._specs:
+                grown = spec.uda.init(extra)
+                self._states[spec.out_name] = jax.tree.map(
+                    lambda a, b: np.concatenate([np.asarray(a), np.asarray(b)]),
+                    self._states[spec.out_name],
+                    grown,
+                )
+            self._capacity += extra
+
+    # -- merge stage --------------------------------------------------------
+    def _consume_states(self, sb: StateBatch) -> None:
+        if sb.num_groups == 0:
+            return
+        if self.op.groups:
+            key_cols = []
+            for g, col in zip(sb.group_names, sb.key_columns):
+                if isinstance(col, DictColumn):
+                    existing = self._key_dicts.get(g)
+                    if existing is None:
+                        self._key_dicts[g] = col.dictionary
+                    elif col.dictionary is not existing:
+                        col = DictColumn(existing.encode(col.decode()), existing)
+                key_cols.append(col)
+            idx = self._encoder.encode(key_cols)
+        else:
+            idx = np.zeros(sb.num_groups, np.int32)
+        self._ensure_capacity(self._encoder.num_groups or 1)
+        for spec in self._specs:
+            incoming = sb.states[spec.out_name]
+            aligned = jax.tree.map(
+                lambda z, inc: jax.numpy.asarray(z).at[idx].set(
+                    jax.numpy.asarray(inc)
+                ),
+                spec.uda.init(self._capacity),
+                incoming,
+            )
+            self._states[spec.out_name] = spec.uda.merge(
+                self._states[spec.out_name], aligned
+            )
+
+    # -- emit ---------------------------------------------------------------
+    def _num_out_groups(self) -> int:
+        if self.op.groups:
+            return self._encoder.num_groups
+        return 1  # group-by-none emits one row even on empty input (ref)
+
+    def _emit(self, exec_state, eow: bool, eos: bool) -> None:
+        n = self._num_out_groups()
+        if self.op.stage == AggStage.PARTIAL:
+            sliced = {
+                s.out_name: jax.tree.map(
+                    lambda a: np.asarray(a)[:n], self._states[s.out_name]
+                )
+                for s in self._specs
+            }
+            self.send(
+                exec_state,
+                StateBatch(
+                    key_columns=self._key_columns(),
+                    states=sliced,
+                    num_groups=n,
+                    group_names=self.op.groups,
+                    eow=eow,
+                    eos=eos,
+                ),
+            )
+        else:
+            self.send(exec_state, self._finalized_batch(n, eow=eow, eos=eos))
+        if eow and not eos:
+            self._reset_window()
+
+    def _key_columns(self) -> list:
+        cols = []
+        arrays = self._encoder.key_arrays()
+        for g, arr in zip(self.op.groups, arrays):
+            d = self._key_dicts.get(g)
+            if d is not None:
+                cols.append(DictColumn(arr.astype(np.int32), d))
+            else:
+                cols.append(arr)
+        return cols
+
+    def _finalized_batch(self, n: int, eow: bool, eos: bool) -> RowBatch:
+        out_cols: list = []
+        rel = self.output_relation
+        key_cols = self._key_columns()
+        for g, col in zip(self.op.groups, key_cols):
+            schema = rel.col(g)
+            if isinstance(col, DictColumn):
+                out_cols.append(col)
+            else:
+                from pixie_tpu.types.dtypes import host_dtype
+
+                out_cols.append(np.asarray(col, dtype=host_dtype(schema.data_type)))
+        for spec in self._specs:
+            state = jax.tree.map(
+                lambda a: jax.numpy.asarray(a)[:n], self._states[spec.out_name]
+            )
+            out = spec.uda.finalize(state)
+            schema = rel.col(spec.out_name)
+            if schema.data_type == DataType.STRING:
+                vals = np.asarray(out, dtype=object)
+                d = StringDictionary()
+                out_cols.append(DictColumn(d.encode(vals), d))
+            else:
+                from pixie_tpu.types.dtypes import host_dtype
+
+                out_cols.append(
+                    np.asarray(out, dtype=host_dtype(schema.data_type))
+                )
+        return RowBatch(rel, out_cols, eow=eow, eos=eos)
+
+    def _reset_window(self) -> None:
+        self._encoder.reset()
+        self._key_dicts.clear()
+        self._capacity = INITIAL_CAPACITY if self.op.groups else 1
+        self._states = {
+            s.out_name: s.uda.init(self._capacity) for s in self._specs
+        }

@@ -1,0 +1,167 @@
+"""ExecNode base classes with the reference's operator lifecycle and stats.
+
+Ref: src/carnot/exec/exec_node.h — ExecNode (:133) lifecycle
+Init/Prepare/Open/GenerateNext/ConsumeNext/Close; ProcessingNode (:343),
+SourceNode (:353), SinkNode (:379); ExecNodeStats (:60-128) tracks
+bytes/rows/batches in/out and self/total time, surfaced per-operator in
+query execution stats (carnot.cc:369-399).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.types import Relation
+
+
+@dataclasses.dataclass
+class ExecNodeStats:
+    bytes_in: int = 0
+    rows_in: int = 0
+    batches_in: int = 0
+    bytes_out: int = 0
+    rows_out: int = 0
+    batches_out: int = 0
+    total_time_ns: int = 0  # includes children's ConsumeNext time
+    self_time_ns: int = 0   # total minus time spent in children
+
+    def record_in(self, batch) -> None:
+        if isinstance(batch, RowBatch):
+            self.bytes_in += batch.num_bytes()
+            self.rows_in += batch.num_rows
+        self.batches_in += 1
+
+    def record_out(self, batch) -> None:
+        if isinstance(batch, RowBatch):
+            self.bytes_out += batch.num_bytes()
+            self.rows_out += batch.num_rows
+        self.batches_out += 1
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ExecNode:
+    """Base operator node.
+
+    Subclasses implement ``init_impl``, ``consume_next_impl`` (processing &
+    sink nodes) or ``generate_next_impl`` (source nodes), and optionally
+    ``open_impl``/``close_impl``. The base wires child push-down and stats.
+    """
+
+    is_source = False
+    is_sink = False
+
+    def __init__(self, op, output_relation: Relation, node_id: int):
+        self.op = op
+        self.output_relation = output_relation
+        self.node_id = node_id
+        # Outgoing dataflow edges: (child, parent_slot) — the slot is which
+        # input of the child this node feeds (joins distinguish build/probe;
+        # a self-join has two edges to the same child).
+        self.child_edges: list[tuple["ExecNode", int]] = []
+        self.stats = ExecNodeStats()
+        self._closed = False
+        self._sent_eos = False
+        self._aborted = False
+
+    @property
+    def name(self) -> str:
+        return f"{self.op.op_name}[{self.node_id}]"
+
+    def add_child(self, child: "ExecNode", parent_slot: int = 0) -> None:
+        self.child_edges.append((child, parent_slot))
+
+    @property
+    def children(self) -> list["ExecNode"]:
+        return [c for c, _ in self.child_edges]
+
+    # -- lifecycle (ref: exec_node.h Init/Prepare/Open/Close) ---------------
+    def init(self, exec_state) -> None:
+        self.init_impl(exec_state)
+
+    def prepare(self, exec_state) -> None:
+        self.prepare_impl(exec_state)
+
+    def open(self, exec_state) -> None:
+        self.open_impl(exec_state)
+
+    def close(self, exec_state) -> None:
+        if not self._closed:
+            self._closed = True
+            self.close_impl(exec_state)
+
+    def init_impl(self, exec_state) -> None:
+        pass
+
+    def prepare_impl(self, exec_state) -> None:
+        pass
+
+    def open_impl(self, exec_state) -> None:
+        pass
+
+    def close_impl(self, exec_state) -> None:
+        pass
+
+    # -- dataflow -----------------------------------------------------------
+    def consume_next(self, exec_state, batch, parent_index: int = 0) -> None:
+        """Push a batch into this node (ref: ConsumeNext, exec_node.h:213)."""
+        start = time.perf_counter_ns()
+        self.stats.record_in(batch)
+        child_ns_before = sum(c.stats.total_time_ns for c in self.children)
+        self.consume_next_impl(exec_state, batch, parent_index)
+        child_ns = sum(c.stats.total_time_ns for c in self.children) - child_ns_before
+        elapsed = time.perf_counter_ns() - start
+        self.stats.total_time_ns += elapsed
+        self.stats.self_time_ns += max(0, elapsed - child_ns)
+
+    def send(self, exec_state, batch) -> None:
+        """Emit a batch to all children, tracking eos propagation."""
+        self.stats.record_out(batch)
+        if getattr(batch, "eos", False):
+            self._sent_eos = True
+        for child, slot in self.child_edges:
+            child.consume_next(exec_state, batch, slot)
+
+    def consume_next_impl(self, exec_state, batch, parent_index: int) -> None:
+        raise NotImplementedError(f"{self.name} cannot consume")
+
+    # -- sources ------------------------------------------------------------
+    def generate_next(self, exec_state) -> bool:
+        """Pull one batch from a source; returns True if progress was made
+        (ref: GenerateNext, exec_node.h:194)."""
+        start = time.perf_counter_ns()
+        child_ns_before = sum(c.stats.total_time_ns for c in self.children)
+        progressed = self.generate_next_impl(exec_state)
+        child_ns = sum(c.stats.total_time_ns for c in self.children) - child_ns_before
+        elapsed = time.perf_counter_ns() - start
+        self.stats.total_time_ns += elapsed
+        self.stats.self_time_ns += max(0, elapsed - child_ns)
+        return progressed
+
+    def generate_next_impl(self, exec_state) -> bool:
+        raise NotImplementedError(f"{self.name} is not a source")
+
+    def abort(self) -> None:
+        """Stop a source early (ref: limit abort of abortable sources via
+        annotate_abortable_sources_for_limits_rule). Only called on sources
+        whose every path to a sink passes through the satisfied limit."""
+        self._aborted = True
+
+    def has_batches_remaining(self) -> bool:
+        """Source liveness (ref: SourceNode::HasBatchesRemaining)."""
+        return not self._sent_eos and not self._aborted
+
+    def __repr__(self):
+        return self.name
+
+
+class SourceNode(ExecNode):
+    is_source = True
+
+
+class SinkNode(ExecNode):
+    is_sink = True

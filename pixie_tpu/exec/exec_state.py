@@ -1,0 +1,66 @@
+"""Per-query execution state & function context.
+
+Ref: src/carnot/exec/exec_state.h — holds the table store, UDF registry,
+function context (metadata state for md UDFs), and query-scoped control
+(source aborts from limits, result destinations).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+
+@dataclasses.dataclass
+class FunctionContext:
+    """Passed to UDFs with ``needs_ctx`` (ref: udf.h FunctionContext) —
+    carries the agent's metadata state for k8s entity lookups."""
+
+    metadata_state: Any = None
+
+
+class ExecState:
+    def __init__(
+        self,
+        query_id: str,
+        table_store,
+        registry,
+        router=None,
+        metadata_state=None,
+        result_callback: Optional[Callable] = None,
+        instance: str = "local",
+        compute_backend: str = "cpu",
+    ):
+        self.query_id = query_id
+        self.table_store = table_store
+        self.registry = registry
+        self.router = router
+        self.func_ctx = FunctionContext(metadata_state)
+        # result_callback(table_name, row_batch) receives ResultSink output
+        # (ref: Carnot's result destination / TransferResultChunk stream).
+        self.result_callback = result_callback
+        self.instance = instance
+        # The exec-graph is the host-side (PEM-role) engine: its eager jax
+        # ops run on CPU so a remote-TPU default backend never sees per-op
+        # RPCs. TPU compute goes exclusively through the compiled/staged
+        # pipeline (pixie_tpu.parallel), one jit program per query.
+        self.compute_backend = compute_backend
+        self._keep_running = True
+
+    def compute_device(self):
+        if self.compute_backend is None:
+            return None
+        try:
+            import jax
+
+            return jax.local_devices(backend=self.compute_backend)[0]
+        except Exception:
+            return None
+
+    # -- limit/source abort (ref: exec_state keep-running + limit signal) ---
+    def stop_sources(self) -> None:
+        self._keep_running = False
+
+    @property
+    def keep_running(self) -> bool:
+        return self._keep_running

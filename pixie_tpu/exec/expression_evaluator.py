@@ -1,0 +1,316 @@
+"""Scalar expression evaluation over RowBatches.
+
+Ref: src/carnot/exec/expression_evaluator.{h,cc} — the reference has two
+strategies (vector-native over ColumnWrapper vectors, arrow-native over
+arrays). Ours also has two, split along the TPU boundary:
+
+- **host path** (``evaluate``): eager evaluation with numpy/jax over a
+  RowBatch; HOST-executor UDFs (strings/JSON/metadata) run here. String
+  columns stay dictionary-encoded; ``dict_compatible`` host funcs run on the
+  dictionary's (tiny) unique values and the result is gathered through the
+  codes — the row-count work never touches Python strings.
+- **device path** (``device_eval``): a pure-jnp evaluation over a dict of
+  arrays, safe to call inside jit/shard_map (the mesh pipeline traces it).
+  String semantics are code-space; host-func subtrees must have been
+  precomputed into lookup tables by ``build_aux`` (host side, per staging).
+
+String comparisons lower to int32 code comparisons (the write-side dictionary
+encode in table/column.py guarantees code comparability within a table).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from pixie_tpu.plan.expressions import (
+    ColumnRef,
+    Constant,
+    FuncCall,
+    ScalarExpression,
+    expr_data_type,
+)
+from pixie_tpu.table.column import DictColumn, StringDictionary
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.types import DataType, Relation
+from pixie_tpu.types.dtypes import host_dtype
+from pixie_tpu.udf.udf import Executor
+
+
+class ExpressionEvaluator:
+    """Evaluates named expressions (a Map's output list) or one predicate."""
+
+    def __init__(
+        self,
+        named_exprs: list[tuple[str, ScalarExpression]],
+        input_relation: Relation,
+        registry,
+        func_ctx=None,
+    ):
+        self.named_exprs = list(named_exprs)
+        self.input_relation = input_relation
+        self.registry = registry
+        self.func_ctx = func_ctx
+        self._resolved: dict[int, Any] = {}
+        for _, e in self.named_exprs:
+            self._resolve(e)
+
+    def _resolve(self, expr) -> None:
+        """Pre-resolve UDF lookups for every FuncCall in the tree."""
+        if isinstance(expr, FuncCall):
+            for a in expr.args:
+                self._resolve(a)
+            arg_types = [
+                expr_data_type(a, self.input_relation, self.registry)
+                for a in expr.args
+            ]
+            udf = self.registry.lookup_scalar(expr.name, arg_types)
+            if udf is None:
+                raise ValueError(
+                    f"no scalar function {expr.name}"
+                    f"({', '.join(t.name for t in arg_types)})"
+                )
+            self._resolved[id(expr)] = (udf, arg_types)
+
+    # ------------------------------------------------------------------ host
+    def evaluate(self, batch: RowBatch, output_relation: Relation) -> RowBatch:
+        env = {
+            schema.name: col
+            for schema, col in zip(batch.relation, batch.columns)
+        }
+        out_cols = []
+        for (name, e), schema in zip(self.named_exprs, output_relation):
+            v = self._eval(e, env, batch.num_rows)
+            out_cols.append(self._to_column(v, schema.data_type, batch.num_rows))
+        return RowBatch(output_relation, out_cols, eow=batch.eow, eos=batch.eos)
+
+    def evaluate_predicate(self, batch: RowBatch) -> np.ndarray:
+        assert len(self.named_exprs) == 1
+        v = self._eval(self.named_exprs[0][1], dict(
+            zip(batch.relation.col_names(), batch.columns)
+        ), batch.num_rows)
+        return np.asarray(v, dtype=bool)
+
+    def _to_column(self, v, data_type: DataType, num_rows: int):
+        if isinstance(v, DictColumn):
+            return v
+        if data_type == DataType.STRING:
+            if np.ndim(v) == 0:
+                v = np.full(num_rows, v, dtype=object)
+            d = StringDictionary()
+            return DictColumn(d.encode(np.asarray(v, dtype=object)), d)
+        arr = np.asarray(v, dtype=host_dtype(data_type))
+        if arr.ndim == 0:
+            arr = np.full(num_rows, arr, dtype=host_dtype(data_type))
+        return arr
+
+    def _eval(self, expr, env: dict, num_rows: int):
+        if isinstance(expr, ColumnRef):
+            return env[expr.name]
+        if isinstance(expr, Constant):
+            return expr.value
+        assert isinstance(expr, FuncCall), expr
+        udf, arg_types = self._resolved[id(expr)]
+        args = [self._eval(a, env, num_rows) for a in expr.args]
+        if any(t == DataType.STRING for t in arg_types):
+            out = self._eval_string_func(udf, arg_types, args, expr)
+        else:
+            fn_args = list(args) + list(expr.init_args)
+            if udf.needs_ctx:
+                out = udf.fn(self.func_ctx, *fn_args)
+            elif udf.executor == Executor.HOST:
+                out = np.asarray(udf.fn(*fn_args))
+            else:
+                out = udf.fn(*fn_args)
+        # String-producing funcs must hand a DictColumn to their consumer —
+        # a parent device comparison would otherwise compare Python objects
+        # against int32 codes.
+        if udf.out_type == DataType.STRING and not isinstance(
+            out, (DictColumn, str)
+        ):
+            arr = np.asarray(out, dtype=object)
+            if arr.ndim == 0:
+                return str(arr)
+            d = StringDictionary()
+            out = DictColumn(d.encode(arr), d)
+        return out
+
+    def _eval_string_func(self, udf, arg_types, args, expr):
+        """String-typed arguments: code-space compare for DEVICE funcs,
+        dictionary-value application for dict_compatible HOST funcs, decoded
+        application otherwise."""
+        if udf.executor == Executor.DEVICE:
+            # Code-space semantics (equal/notEqual). Align every string arg
+            # into one dictionary's code space.
+            base: Optional[StringDictionary] = None
+            for a, t in zip(args, arg_types):
+                if t == DataType.STRING and isinstance(a, DictColumn):
+                    base = a.dictionary
+                    break
+            if base is None:
+                # All string args are plain Python strings (const-vs-const):
+                # compare the values directly, not sentinel codes.
+                return udf.fn(*args, *expr.init_args)
+            mapped = []
+            for a, t in zip(args, arg_types):
+                if t != DataType.STRING:
+                    mapped.append(a)
+                elif isinstance(a, DictColumn):
+                    if a.dictionary is not base:
+                        mapped.append(base.encode(a.decode()))
+                    else:
+                        mapped.append(a.codes)
+                elif isinstance(a, str):
+                    # Unseen constants get -1, which equals nothing.
+                    mapped.append(np.int32(base.lookup(a)))
+                else:
+                    mapped.append(a)
+            return udf.fn(*mapped, *expr.init_args)
+
+        # HOST executor. The dictionary fast path pairs per-value results
+        # back through ONE codes array, so it requires exactly one
+        # DictColumn argument (two distinct columns sharing a dictionary
+        # still differ per-row).
+        dict_args = [a for a in args if isinstance(a, DictColumn)]
+        if udf.dict_compatible and len(dict_args) == 1:
+            d = dict_args[0].dictionary
+            values = np.asarray(d.values(), dtype=object)
+            fn_args = [
+                (values if isinstance(a, DictColumn) else a) for a in args
+            ] + list(expr.init_args)
+            if udf.needs_ctx:
+                fn_args = [self.func_ctx] + fn_args
+            per_value = np.asarray(udf.fn(*fn_args))
+            codes = dict_args[0].codes
+            if udf.out_type == DataType.STRING:
+                out_dict = StringDictionary()
+                mapped = out_dict.encode(per_value.astype(object))
+                # Negative codes (missing) map to "".
+                empty = out_dict.get_code("")
+                out_codes = np.where(codes >= 0, mapped[np.maximum(codes, 0)], empty)
+                return DictColumn(out_codes.astype(np.int32), out_dict)
+            safe = np.maximum(codes, 0)
+            out = per_value[safe]
+            if (codes < 0).any():
+                out = np.where(codes < 0, np.zeros_like(out), out)
+            return out
+        # Fallback: decode and run row-wise over full columns.
+        fn_args = [
+            (a.decode() if isinstance(a, DictColumn) else a) for a in args
+        ] + list(expr.init_args)
+        if udf.needs_ctx:
+            fn_args = [self.func_ctx] + fn_args
+        return np.asarray(udf.fn(*fn_args))
+
+    # ---------------------------------------------------------------- device
+    def device_eval(self, expr, env: dict, aux: dict):
+        """Pure-jnp evaluation for tracing inside jit/shard_map.
+
+        ``env`` maps column name → array (string columns as int32 codes);
+        ``aux`` maps aux keys from ``build_aux`` → arrays (constant codes as
+        0-d arrays, dict-func lookup tables as 1-d arrays).
+        """
+        if isinstance(expr, ColumnRef):
+            return env[expr.name]
+        if isinstance(expr, Constant):
+            if expr.data_type == DataType.STRING:
+                return aux[f"const:{id(expr)}"]
+            return expr.value
+        udf, arg_types = self._resolved[id(expr)]
+        lut_key = f"lut:{id(expr)}"
+        if lut_key in aux:
+            # Precomputed dictionary-value table; gather through codes.
+            (arg,) = [
+                self.device_eval(a, env, aux)
+                for a, t in zip(expr.args, arg_types)
+                if t == DataType.STRING
+            ]
+            import jax.numpy as jnp
+
+            return aux[lut_key][jnp.maximum(arg, 0)]
+        if udf.executor != Executor.DEVICE:
+            raise ValueError(
+                f"{udf.name} is a HOST function with no precomputed table; "
+                "cannot trace on device"
+            )
+        args = [self.device_eval(a, env, aux) for a in expr.args]
+        return udf.fn(*args, *expr.init_args)
+
+    def build_aux(self, expr, dictionaries: dict[str, StringDictionary]) -> dict:
+        """Host-side precomputation making ``expr`` device-traceable:
+        string constants → their int32 code; dict_compatible host-func
+        subtrees over a single string column → per-dictionary-value LUTs."""
+        aux: dict[str, np.ndarray] = {}
+        self._collect_aux(expr, dictionaries, aux)
+        return aux
+
+    def _collect_aux(self, expr, dictionaries, aux) -> None:
+        if isinstance(expr, Constant):
+            if expr.data_type == DataType.STRING:
+                # Resolve against the single dictionary in scope; the caller
+                # maps which column's dictionary applies via _const_dict.
+                d = self._const_dict(expr, dictionaries)
+                aux[f"const:{id(expr)}"] = np.int32(
+                    d.lookup(expr.value) if d is not None else -1
+                )
+            return
+        if not isinstance(expr, FuncCall):
+            return
+        udf, arg_types = self._resolved[id(expr)]
+        str_cols = [
+            a for a, t in zip(expr.args, arg_types)
+            if t == DataType.STRING and isinstance(a, ColumnRef)
+        ]
+        if (
+            udf.executor == Executor.HOST
+            and udf.dict_compatible
+            and len(str_cols) == 1
+            and all(
+                isinstance(a, (ColumnRef, Constant)) for a in expr.args
+            )
+        ):
+            d = dictionaries.get(str_cols[0].name)
+            if d is not None:
+                values = np.asarray(d.values(), dtype=object)
+                fn_args = [
+                    values if (t == DataType.STRING and isinstance(a, ColumnRef))
+                    else (a.value if isinstance(a, Constant) else None)
+                    for a, t in zip(expr.args, arg_types)
+                ] + list(expr.init_args)
+                if udf.needs_ctx:
+                    fn_args = [self.func_ctx] + fn_args
+                out = np.asarray(udf.fn(*fn_args))
+                if udf.out_type == DataType.STRING:
+                    raise ValueError(
+                        "string-producing host funcs need a Map before the "
+                        "device pipeline (reference precedent: "
+                        "scalar_udfs_run_on_executor placement rules)"
+                    )
+                aux[f"lut:{id(expr)}"] = out
+                return
+        for a in expr.args:
+            self._collect_aux(a, dictionaries, aux)
+
+    def _const_dict(self, const, dictionaries):
+        """Find which column's dictionary a string constant compares against
+        (the sibling string ColumnRef in its parent FuncCall)."""
+        for _, root in self.named_exprs:
+            parent = _find_parent(root, const)
+            if parent is None:
+                continue
+            for a in parent.args:
+                if isinstance(a, ColumnRef) and a.name in dictionaries:
+                    return dictionaries[a.name]
+        return None
+
+
+def _find_parent(root, target):
+    if isinstance(root, FuncCall):
+        for a in root.args:
+            if a is target:
+                return root
+            found = _find_parent(a, target)
+            if found is not None:
+                return found
+    return None

@@ -1,0 +1,82 @@
+"""GroupEncoder — densify group-by keys into stable int32 segment ids.
+
+The reference hashes RowTuples into an absl flat_hash_map per batch
+(src/carnot/exec/agg_node.cc HashRowBatch / row_tuple.h). XLA has no dynamic
+hash maps, so group keys are densified host-side into dense, stable gids that
+feed TPU segment reductions (pixie_tpu/ops/segment.py). Vectorized: each
+batch pays one np.unique over the key columns plus a dict probe per *new*
+unique key — telemetry group keys (service, pod, endpoint) are vastly fewer
+than rows.
+
+Strings participate via their dictionary codes (already dense per table), so
+the composite key is a small int matrix.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pixie_tpu.table.column import DictColumn
+
+
+class GroupEncoder:
+    def __init__(self):
+        self._gids: dict[tuple, int] = {}
+        # Per key column: list of values aligned with gid order (for
+        # reconstructing the output key columns at finalize).
+        self._key_rows: list[tuple] = []
+
+    @property
+    def num_groups(self) -> int:
+        return len(self._key_rows)
+
+    def encode(self, key_cols: list) -> np.ndarray:
+        """Map rows of the given key columns to gids, assigning new ids to
+        unseen keys. Returns int32[n]."""
+        if not key_cols:
+            n = 0
+            raise ValueError("encode requires at least one key column")
+        arrs = [
+            c.codes if isinstance(c, DictColumn) else np.asarray(c)
+            for c in key_cols
+        ]
+        n = len(arrs[0])
+        if n == 0:
+            return np.empty(0, np.int32)
+        # One np.unique over the stacked key matrix; probe dict per unique.
+        stacked = np.stack(arrs, axis=1) if len(arrs) > 1 else arrs[0][:, None]
+        uniq, inverse = np.unique(stacked, axis=0, return_inverse=True)
+        uniq_gids = np.empty(len(uniq), np.int32)
+        for i, row in enumerate(uniq):
+            key = tuple(row.tolist())
+            gid = self._gids.get(key)
+            if gid is None:
+                gid = len(self._key_rows)
+                self._gids[key] = gid
+                self._key_rows.append(key)
+            uniq_gids[i] = gid
+        return uniq_gids[inverse.ravel()].astype(np.int32, copy=False)
+
+    def lookup(self, key_cols: list) -> np.ndarray:
+        """Like encode but maps unseen keys to -1 (no assignment)."""
+        arrs = [
+            c.codes if isinstance(c, DictColumn) else np.asarray(c)
+            for c in key_cols
+        ]
+        stacked = np.stack(arrs, axis=1) if len(arrs) > 1 else arrs[0][:, None]
+        out = np.empty(len(stacked), np.int32)
+        for i, row in enumerate(stacked):
+            out[i] = self._gids.get(tuple(row.tolist()), -1)
+        return out
+
+    def key_arrays(self) -> list[np.ndarray]:
+        """Per key column, the values in gid order (int arrays; string key
+        columns come back as their dictionary codes)."""
+        if not self._key_rows:
+            return []
+        mat = np.asarray(self._key_rows)
+        return [mat[:, i] for i in range(mat.shape[1])]
+
+    def reset(self) -> None:
+        self._gids.clear()
+        self._key_rows.clear()

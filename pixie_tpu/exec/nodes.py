@@ -1,0 +1,277 @@
+"""Source, sink, and row-wise operator nodes.
+
+Ref: src/carnot/exec/{memory_source,memory_sink,empty_source,udtf_source,
+map,filter,limit,union}_node.* and grpc_{source,sink}_node.* (bridges).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from pixie_tpu.exec.agg_node import StateBatch
+from pixie_tpu.exec.exec_node import ExecNode, SinkNode, SourceNode
+from pixie_tpu.exec.expression_evaluator import ExpressionEvaluator
+from pixie_tpu.plan.operators import (
+    BridgeSinkOp,
+    BridgeSourceOp,
+    EmptySourceOp,
+    FilterOp,
+    LimitOp,
+    MapOp,
+    MemorySinkOp,
+    MemorySourceOp,
+    ResultSinkOp,
+    UDTFSourceOp,
+    UnionOp,
+)
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.table.table import TIME_COLUMN
+
+
+class MemorySourceNode(SourceNode):
+    """Reads a table through a time-bounded cursor (memory_source_node.h:42);
+    supports infinite streaming mode (:61)."""
+
+    def __init__(self, op: MemorySourceOp, output_relation, node_id):
+        super().__init__(op, output_relation, node_id)
+        self.op: MemorySourceOp = op
+        self._cursor = None
+        self._table = None
+
+    def prepare_impl(self, exec_state) -> None:
+        self._table = exec_state.table_store.get_table(
+            self.op.table_name, self.op.tablet or ""
+        )
+        if self._table is None:
+            raise KeyError(f"no table named {self.op.table_name!r}")
+        self._cursor = self._table.cursor(
+            self.op.start_time, self.op.stop_time, streaming=self.op.streaming
+        )
+
+    def generate_next_impl(self, exec_state) -> bool:
+        if self._sent_eos:
+            return False
+        batch = self._cursor.next_batch()
+        done = self._cursor.done()
+        if batch is None and not done:
+            return False  # streaming: nothing available yet
+        if batch is None:
+            batch = RowBatch.with_zero_rows(self._table.relation)
+        if self.op.column_names is not None:
+            batch = batch.select(list(self.op.column_names))
+        self.send(exec_state, batch.with_flags(eow=done, eos=done))
+        return True
+
+
+class EmptySourceNode(SourceNode):
+    def generate_next_impl(self, exec_state) -> bool:
+        if self._sent_eos:
+            return False
+        self.send(
+            exec_state,
+            RowBatch.with_zero_rows(self.output_relation, eow=True, eos=True),
+        )
+        return True
+
+
+class UDTFSourceNode(SourceNode):
+    """Runs a user-defined table function once (udtf_source_node)."""
+
+    def __init__(self, op: UDTFSourceOp, output_relation, node_id):
+        super().__init__(op, output_relation, node_id)
+        self.op: UDTFSourceOp = op
+
+    def generate_next_impl(self, exec_state) -> bool:
+        if self._sent_eos:
+            return False
+        udtf = exec_state.registry.lookup_udtf(self.op.udtf_name)
+        data = udtf.fn(exec_state.func_ctx, **dict(self.op.arg_values))
+        batch = RowBatch.from_pydict(self.output_relation, data)
+        self.send(exec_state, batch.with_flags(eow=True, eos=True))
+        return True
+
+
+class BridgeSourceNode(SourceNode):
+    """Receives batches routed from another fragment
+    (ref: grpc_source_node.h:39 + grpc_router.h:53)."""
+
+    def __init__(self, op: BridgeSourceOp, output_relation, node_id):
+        super().__init__(op, output_relation, node_id)
+        self.op: BridgeSourceOp = op
+        self._upstream_eos = 0
+        self._expected_producers = 1
+
+    def prepare_impl(self, exec_state) -> None:
+        self._expected_producers = exec_state.router.num_producers(
+            exec_state.query_id, self.op.bridge_id
+        )
+
+    def generate_next_impl(self, exec_state) -> bool:
+        item = exec_state.router.poll(exec_state.query_id, self.op.bridge_id)
+        if item is None:
+            return False
+        eos = getattr(item, "eos", False)
+        if eos:
+            self._upstream_eos += 1
+            all_done = self._upstream_eos >= self._expected_producers
+            if isinstance(item, RowBatch):
+                item = item.with_flags(eow=all_done and item.eow, eos=all_done)
+            else:
+                item.eos = all_done
+                item.eow = all_done and item.eow
+        self.send(exec_state, item)
+        return True
+
+    def has_batches_remaining(self) -> bool:
+        return self._upstream_eos < self._expected_producers
+
+
+class MapNode(ExecNode):
+    """Vectorized projection (map_node.*): one ExpressionEvaluator pass."""
+
+    def __init__(self, op: MapOp, output_relation, node_id):
+        super().__init__(op, output_relation, node_id)
+        self.op: MapOp = op
+        self._evaluator: Optional[ExpressionEvaluator] = None
+
+    def set_input_relation(self, rel, registry, func_ctx=None) -> None:
+        self._evaluator = ExpressionEvaluator(
+            list(self.op.exprs), rel, registry, func_ctx
+        )
+
+    def consume_next_impl(self, exec_state, batch, parent_index) -> None:
+        self._evaluator.func_ctx = exec_state.func_ctx
+        self.send(exec_state, self._evaluator.evaluate(batch, self.output_relation))
+
+
+class FilterNode(ExecNode):
+    def __init__(self, op: FilterOp, output_relation, node_id):
+        super().__init__(op, output_relation, node_id)
+        self.op: FilterOp = op
+        self._evaluator: Optional[ExpressionEvaluator] = None
+
+    def set_input_relation(self, rel, registry, func_ctx=None) -> None:
+        self._evaluator = ExpressionEvaluator(
+            [("pred", self.op.expr)], rel, registry, func_ctx
+        )
+
+    def consume_next_impl(self, exec_state, batch, parent_index) -> None:
+        self._evaluator.func_ctx = exec_state.func_ctx
+        if batch.num_rows:
+            mask = self._evaluator.evaluate_predicate(batch)
+            if not mask.all():
+                batch = batch.take(np.nonzero(mask)[0])
+        self.send(exec_state, batch)
+
+
+class LimitNode(ExecNode):
+    """Row limit; aborts upstream sources once satisfied (limit_node.*,
+    annotate_abortable_sources_for_limits_rule)."""
+
+    def __init__(self, op: LimitOp, output_relation, node_id):
+        super().__init__(op, output_relation, node_id)
+        self.op: LimitOp = op
+        self._seen = 0
+        self._done = False
+        # Sources whose every path to a sink passes through this limit;
+        # filled by ExecutionGraph init (ref: the planner's
+        # annotate_abortable_sources_for_limits_rule).
+        self.abortable_sources: list = []
+
+    def consume_next_impl(self, exec_state, batch, parent_index) -> None:
+        if self._done:
+            return
+        remaining = self.op.n - self._seen
+        out = batch
+        if batch.num_rows > remaining:
+            out = batch.slice(0, remaining)
+        self._seen += out.num_rows
+        if self._seen >= self.op.n:
+            self._done = True
+            out = out.with_flags(eow=True, eos=True)
+            for src in self.abortable_sources:
+                src.abort()
+        self.send(exec_state, out)
+
+
+class UnionNode(ExecNode):
+    """k-way union. With a time_ column, buffers until eos and emits one
+    time-ordered merge (ref: union_node's ordered merge); otherwise batches
+    pass through and eos waits for all parents."""
+
+    def __init__(self, op: UnionOp, output_relation, node_id):
+        super().__init__(op, output_relation, node_id)
+        self.op: UnionOp = op
+        self._num_parents = 1
+        self._eos_seen = 0
+        self._buffer: list[RowBatch] = []
+        self._ordered = False
+
+    def prepare_impl(self, exec_state) -> None:
+        self._num_parents = len(getattr(self, "parent_nodes", [None]))
+        self._ordered = self.output_relation.has_column(TIME_COLUMN)
+
+    def consume_next_impl(self, exec_state, batch, parent_index) -> None:
+        eos = batch.eos
+        if self._ordered:
+            if batch.num_rows:
+                self._buffer.append(batch)
+        elif batch.num_rows:
+            self.send(exec_state, batch.with_flags(eow=False, eos=False))
+        if eos:
+            self._eos_seen += 1
+            if self._eos_seen >= self._num_parents:
+                self._flush(exec_state)
+
+    def _flush(self, exec_state) -> None:
+        if self._ordered and self._buffer:
+            merged = RowBatch.concat(self._buffer)
+            order = np.argsort(
+                np.asarray(merged.col(TIME_COLUMN)), kind="stable"
+            )
+            self.send(exec_state, merged.take(order).with_flags(eow=True, eos=True))
+        else:
+            self.send(
+                exec_state,
+                RowBatch.with_zero_rows(self.output_relation, eow=True, eos=True),
+            )
+        self._buffer = []
+
+
+class MemorySinkNode(SinkNode):
+    """Collects results into an in-memory output table (memory_sink_node)."""
+
+    def __init__(self, op: MemorySinkOp, output_relation, node_id):
+        super().__init__(op, output_relation, node_id)
+        self.op: MemorySinkOp = op
+        self.batches: list[RowBatch] = []
+
+    def consume_next_impl(self, exec_state, batch, parent_index) -> None:
+        self.batches.append(batch)
+
+
+class ResultSinkNode(SinkNode):
+    """Streams result batches to the query's result destination
+    (ref: grpc_sink external mode → TransferResultChunk)."""
+
+    def __init__(self, op: ResultSinkOp, output_relation, node_id):
+        super().__init__(op, output_relation, node_id)
+        self.op: ResultSinkOp = op
+
+    def consume_next_impl(self, exec_state, batch, parent_index) -> None:
+        if exec_state.result_callback is not None:
+            exec_state.result_callback(self.op.table_name, batch)
+
+
+class BridgeSinkNode(SinkNode):
+    """Sends batches (row or state) to a bridge for another fragment
+    (ref: grpc_sink_node.h:54 internal mode)."""
+
+    def __init__(self, op: BridgeSinkOp, output_relation, node_id):
+        super().__init__(op, output_relation, node_id)
+        self.op: BridgeSinkOp = op
+
+    def consume_next_impl(self, exec_state, batch, parent_index) -> None:
+        exec_state.router.push(exec_state.query_id, self.op.bridge_id, batch)

@@ -1,0 +1,52 @@
+"""Bridge router — inter-fragment dataflow.
+
+Ref: src/carnot/exec/grpc_router.{h,cc} — the reference's GRPCRouter is a
+gRPC ResultSinkService that demultiplexes incoming TransferResultChunk
+streams to the right query's GRPCSourceNode, buffering until the node
+registers. Ours is transport-agnostic: in one process it is a dict of
+queues; the DCN transport (multi-host) wraps the same interface around
+serialized batches.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Optional
+
+
+class BridgeRouter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queues: dict[tuple[str, str], collections.deque] = (
+            collections.defaultdict(collections.deque)
+        )
+        self._producers: dict[tuple[str, str], int] = collections.defaultdict(int)
+
+    def register_producer(self, query_id: str, bridge_id: str) -> None:
+        """Each upstream fragment instance that will feed a bridge registers
+        so the consumer knows how many eos markers to expect (ref: the
+        router's per-source connection tracking)."""
+        with self._lock:
+            self._producers[(query_id, bridge_id)] += 1
+
+    def num_producers(self, query_id: str, bridge_id: str) -> int:
+        with self._lock:
+            return max(1, self._producers[(query_id, bridge_id)])
+
+    def push(self, query_id: str, bridge_id: str, item: Any) -> None:
+        with self._lock:
+            self._queues[(query_id, bridge_id)].append(item)
+
+    def poll(self, query_id: str, bridge_id: str) -> Optional[Any]:
+        with self._lock:
+            q = self._queues[(query_id, bridge_id)]
+            return q.popleft() if q else None
+
+    def cleanup_query(self, query_id: str) -> None:
+        """Drop a finished/cancelled query's buffers (ref: router query GC)."""
+        with self._lock:
+            for key in [k for k in self._queues if k[0] == query_id]:
+                del self._queues[key]
+            for key in [k for k in self._producers if k[0] == query_id]:
+                del self._producers[key]

@@ -1,0 +1,63 @@
+"""Plan representation: scalar expressions, operators, fragments, plans.
+
+Ref: src/carnot/plan/ (plan.{h,cc}, plan_fragment.{h,cc}, operators.{h,cc},
+scalar_expression.{h,cc}) — the deserialized, walkable form of a compiled
+query that the exec engine consumes.
+"""
+
+from pixie_tpu.plan.expressions import (
+    AggregateExpression,
+    ColumnRef,
+    Constant,
+    FuncCall,
+    ScalarExpression,
+    expr_data_type,
+    expr_semantic_type,
+    referenced_columns,
+)
+from pixie_tpu.plan.operators import (
+    AggOp,
+    AggStage,
+    BridgeSinkOp,
+    BridgeSourceOp,
+    EmptySourceOp,
+    FilterOp,
+    JoinOp,
+    LimitOp,
+    MapOp,
+    MemorySinkOp,
+    MemorySourceOp,
+    Operator,
+    ResultSinkOp,
+    UDTFSourceOp,
+    UnionOp,
+)
+from pixie_tpu.plan.plan import Plan, PlanFragment
+
+__all__ = [
+    "AggOp",
+    "AggStage",
+    "AggregateExpression",
+    "BridgeSinkOp",
+    "BridgeSourceOp",
+    "ColumnRef",
+    "Constant",
+    "EmptySourceOp",
+    "FilterOp",
+    "FuncCall",
+    "JoinOp",
+    "LimitOp",
+    "MapOp",
+    "MemorySinkOp",
+    "MemorySourceOp",
+    "Operator",
+    "Plan",
+    "PlanFragment",
+    "ResultSinkOp",
+    "ScalarExpression",
+    "UDTFSourceOp",
+    "UnionOp",
+    "expr_data_type",
+    "expr_semantic_type",
+    "referenced_columns",
+]

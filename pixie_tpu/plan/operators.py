@@ -1,0 +1,278 @@
+"""Plan operators.
+
+Ref: src/carnot/plan/operators.{h,cc} — MemorySourceOperator, MapOperator,
+FilterOperator, AggregateOperator, JoinOperator, LimitOperator,
+UnionOperator, MemorySinkOperator, GRPCSource/SinkOperator,
+UDTFSourceOperator, EmptySourceOperator. Each knows how to compute its
+output relation from its inputs' relations — the exec engine and the
+distributed splitter both rely on that.
+
+TPU-first notes: Agg carries an explicit ``stage`` (FULL / PARTIAL / MERGE)
+instead of the reference's partial_agg/finalize_results bool pair
+(planpb) so the splitter's partial-aggregate rewrite
+(distributed/splitter/partial_op_mgr.h:94) is a one-field edit, and the
+bridge operators are transport-agnostic (in-process queue on one host, DCN
+stream across hosts) rather than gRPC-specific.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional
+
+from pixie_tpu.plan.expressions import (
+    AggregateExpression,
+    ScalarExpression,
+    expr_data_type,
+    expr_semantic_type,
+)
+from pixie_tpu.types import ColumnSchema, DataType, Relation, SemanticType
+
+
+class Operator:
+    """Base plan operator. ``output_relation`` resolves schema bottom-up."""
+
+    __slots__ = ()
+
+    def output_relation(self, inputs: list[Relation], registry) -> Relation:
+        raise NotImplementedError
+
+    @property
+    def op_name(self) -> str:
+        return type(self).__name__.removesuffix("Op")
+
+    def __repr__(self):
+        return self.op_name
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class MemorySourceOp(Operator):
+    """Read a table via a time-bounded cursor (ref: memory_source_node.h:42)."""
+
+    table_name: str
+    column_names: Optional[tuple[str, ...]] = None  # None = all columns
+    start_time: Optional[int] = None
+    stop_time: Optional[int] = None
+    streaming: bool = False
+    tablet: Optional[str] = None
+
+    def output_relation(self, inputs, registry, table_relation=None) -> Relation:
+        if table_relation is None:
+            raise ValueError("MemorySourceOp needs the table relation to resolve")
+        if self.column_names is None:
+            return table_relation
+        return table_relation.select(list(self.column_names))
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class UDTFSourceOp(Operator):
+    """Run a user-defined table function (ref: udtf_source_node)."""
+
+    udtf_name: str
+    arg_values: tuple[tuple[str, Any], ...] = ()
+
+    def output_relation(self, inputs, registry) -> Relation:
+        udtf = registry.lookup_udtf(self.udtf_name)
+        if udtf is None:
+            raise ValueError(f"no UDTF named {self.udtf_name!r}")
+        return udtf.output_relation
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class EmptySourceOp(Operator):
+    """Produces a single empty batch with a fixed relation."""
+
+    relation: Relation
+
+    def output_relation(self, inputs, registry) -> Relation:
+        return self.relation
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class BridgeSourceOp(Operator):
+    """Receive batches from another fragment (ref: grpc_source_node.h:39)."""
+
+    bridge_id: str
+    relation: Relation
+
+    def output_relation(self, inputs, registry) -> Relation:
+        return self.relation
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class MapOp(Operator):
+    """Project/compute columns (ref: MapOperator). ``exprs`` fully define the
+    output — pass-through columns are explicit ColumnRefs."""
+
+    exprs: tuple[tuple[str, ScalarExpression], ...]
+
+    def output_relation(self, inputs, registry) -> Relation:
+        (rel,) = inputs
+        cols = []
+        for name, e in self.exprs:
+            cols.append(
+                ColumnSchema(
+                    name,
+                    expr_data_type(e, rel, registry),
+                    expr_semantic_type(e, rel, registry),
+                )
+            )
+        return Relation(cols)
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class FilterOp(Operator):
+    expr: ScalarExpression
+
+    def output_relation(self, inputs, registry) -> Relation:
+        (rel,) = inputs
+        if expr_data_type(self.expr, rel, registry) != DataType.BOOLEAN:
+            raise ValueError("filter predicate must be BOOLEAN")
+        return rel
+
+
+class AggStage(enum.Enum):
+    FULL = "full"        # update + finalize in one node
+    PARTIAL = "partial"  # update only; emit serialized group states
+    MERGE = "merge"      # consume states; merge + finalize
+
+    # Ref: partial_op_mgr.h:36,77,94 — the reference expresses this as
+    # (partial_agg, finalize_results) bools on AggregateOperator.
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class AggOp(Operator):
+    """Group-by aggregate (ref: AggregateOperator / exec agg_node.h:66).
+
+    ``windowed`` emits per end-of-window instead of end-of-stream.
+    """
+
+    groups: tuple[str, ...]
+    values: tuple[tuple[str, AggregateExpression], ...]
+    windowed: bool = False
+    stage: AggStage = AggStage.FULL
+    # MERGE stages resolve UDA overloads against the relation the matching
+    # PARTIAL stage consumed (set by the distributed splitter) — the merge
+    # input itself carries opaque state columns (ref: the plan proto carries
+    # resolved UDA ids across the PEM/Kelvin split instead).
+    pre_agg_relation: Optional[Relation] = None
+
+    def output_relation(self, inputs, registry) -> Relation:
+        (rel,) = inputs
+        value_rel = (
+            self.pre_agg_relation
+            if self.stage == AggStage.MERGE and self.pre_agg_relation is not None
+            else rel
+        )
+        cols = [
+            dataclasses.replace(rel.col(g), name=g) for g in self.groups
+        ]
+        for name, agg in self.values:
+            if self.stage == AggStage.PARTIAL:
+                # Serialized per-group UDA state travels as an opaque string
+                # column (ref: partial aggs serialize into string columns).
+                cols.append(ColumnSchema(name, DataType.STRING))
+            else:
+                cols.append(
+                    ColumnSchema(
+                        name,
+                        expr_data_type(agg, value_rel, registry),
+                        expr_semantic_type(agg, value_rel, registry),
+                    )
+                )
+        return Relation(cols)
+
+    def merge_input_relation(self, pre_agg_relation: Relation) -> Relation:
+        """Relation a MERGE-stage agg expects from its PARTIAL upstreams."""
+        cols = [pre_agg_relation.col(g) for g in self.groups]
+        for name, _ in self.values:
+            cols.append(ColumnSchema(name, DataType.STRING))
+        return Relation(cols)
+
+
+class JoinType(enum.Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    OUTER = "outer"
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class JoinOp(Operator):
+    """Hash equijoin (ref: equijoin_node.h:48). ``output_columns`` is a list
+    of (side, input_col, output_name); side 0 = left/build, 1 = right/probe.
+    """
+
+    how: JoinType
+    left_on: tuple[str, ...]
+    right_on: tuple[str, ...]
+    output_columns: tuple[tuple[int, str, str], ...]
+
+    def output_relation(self, inputs, registry) -> Relation:
+        left, right = inputs
+        cols = []
+        for side, in_name, out_name in self.output_columns:
+            src = left if side == 0 else right
+            cols.append(src.col(in_name).with_name(out_name))
+        return Relation(cols)
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class LimitOp(Operator):
+    """Row limit; aborts upstream abortable sources when satisfied
+    (ref: limit_node + annotate_abortable_sources_for_limits_rule)."""
+
+    n: int
+
+    def output_relation(self, inputs, registry) -> Relation:
+        (rel,) = inputs
+        return rel
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class UnionOp(Operator):
+    """k-way union; time-ordered merge when a time_ column exists
+    (ref: union_node does ordered merge on time_)."""
+
+    def output_relation(self, inputs, registry) -> Relation:
+        first = inputs[0]
+        for rel in inputs[1:]:
+            if rel != first:
+                raise ValueError(f"union inputs differ: {first} vs {rel}")
+        return first
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class MemorySinkOp(Operator):
+    """Write result into the local table store (ref: memory_sink_node)."""
+
+    name: str
+
+    def output_relation(self, inputs, registry) -> Relation:
+        (rel,) = inputs
+        return rel
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class ResultSinkOp(Operator):
+    """Stream to the query result destination (ref: GRPCSink in
+    external-result mode → query broker TransferResultChunk)."""
+
+    table_name: str
+
+    def output_relation(self, inputs, registry) -> Relation:
+        (rel,) = inputs
+        return rel
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class BridgeSinkOp(Operator):
+    """Send batches to another fragment (ref: grpc_sink_node.h:54 in
+    internal mode)."""
+
+    bridge_id: str
+
+    def output_relation(self, inputs, registry) -> Relation:
+        (rel,) = inputs
+        return rel

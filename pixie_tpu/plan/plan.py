@@ -1,0 +1,192 @@
+"""Plan & PlanFragment DAGs with walkers and relation propagation.
+
+Ref: src/carnot/plan/plan.{h,cc}, plan_fragment.{h,cc} — a Plan is a DAG of
+PlanFragments; a PlanFragment is a DAG of operators. PlanWalker /
+PlanFragmentWalker do topological traversal (used by the engine at
+carnot.cc:147-218,353).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable, Optional
+
+from pixie_tpu.plan.operators import (
+    AggOp,
+    AggStage,
+    BridgeSourceOp,
+    MemorySourceOp,
+    Operator,
+)
+from pixie_tpu.types import Relation
+
+
+@dataclasses.dataclass
+class _Node:
+    nid: int
+    op: Operator
+    parents: list[int]
+
+
+class PlanFragment:
+    """An operator DAG executed by one engine instance.
+
+    Nodes are added in any order; ``topo_order`` yields parents before
+    children. Edges run parent→child in dataflow direction (parent produces,
+    child consumes).
+    """
+
+    def __init__(self, fragment_id: int = 0):
+        self.fragment_id = fragment_id
+        self._nodes: dict[int, _Node] = {}
+        self._next_id = 0
+
+    def add(self, op: Operator, parents: Iterable[int] = ()) -> int:
+        nid = self._next_id
+        self._next_id += 1
+        parents = list(parents)
+        for p in parents:
+            if p not in self._nodes:
+                raise KeyError(f"unknown parent node {p}")
+        self._nodes[nid] = _Node(nid, op, parents)
+        return nid
+
+    # -- structure queries --------------------------------------------------
+    def node(self, nid: int) -> Operator:
+        return self._nodes[nid].op
+
+    def parents(self, nid: int) -> list[int]:
+        return list(self._nodes[nid].parents)
+
+    def children(self, nid: int) -> list[int]:
+        """Child node ids, with multiplicity (a self-join lists its single
+        parent twice; each occurrence is a distinct dataflow edge)."""
+        out = []
+        for n in self._nodes.values():
+            out.extend(n.nid for p in n.parents if p == nid)
+        return out
+
+    def nodes(self) -> list[int]:
+        return list(self._nodes)
+
+    def sources(self) -> list[int]:
+        return [n.nid for n in self._nodes.values() if not n.parents]
+
+    def sinks(self) -> list[int]:
+        with_children = {p for n in self._nodes.values() for p in n.parents}
+        return [nid for nid in self._nodes if nid not in with_children]
+
+    def topo_order(self) -> list[int]:
+        """Parents-before-children order (ref: PlanFragmentWalker)."""
+        indeg = {nid: len(n.parents) for nid, n in self._nodes.items()}
+        ready = sorted(nid for nid, d in indeg.items() if d == 0)
+        out: list[int] = []
+        while ready:
+            nid = ready.pop(0)
+            out.append(nid)
+            for c in self.children(nid):  # duplicates decrement per edge
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    ready.append(c)
+            ready.sort()
+        if len(out) != len(self._nodes):
+            raise ValueError("plan fragment has a cycle")
+        return out
+
+    def walk(self, fn: Callable[[int, Operator], None]) -> None:
+        for nid in self.topo_order():
+            fn(nid, self._nodes[nid].op)
+
+    # -- relation propagation ----------------------------------------------
+    def resolve_relations(
+        self,
+        registry,
+        table_relations: Optional[Callable[[MemorySourceOp], Relation]] = None,
+    ) -> dict[int, Relation]:
+        """Compute every node's output relation bottom-up."""
+        rels: dict[int, Relation] = {}
+        for nid in self.topo_order():
+            op = self._nodes[nid].op
+            inputs = [rels[p] for p in self._nodes[nid].parents]
+            if isinstance(op, MemorySourceOp):
+                if table_relations is None:
+                    raise ValueError("need table_relations to resolve sources")
+                rels[nid] = op.output_relation(
+                    inputs, registry, table_relation=table_relations(op)
+                )
+            else:
+                rels[nid] = op.output_relation(inputs, registry)
+        return rels
+
+    def has_blocking_agg(self) -> bool:
+        return any(
+            isinstance(n.op, AggOp) and not n.op.windowed
+            for n in self._nodes.values()
+        )
+
+    def bridge_source_ids(self) -> list[str]:
+        return [
+            n.op.bridge_id
+            for n in self._nodes.values()
+            if isinstance(n.op, BridgeSourceOp)
+        ]
+
+    def __repr__(self):
+        parts = []
+        for nid in self.topo_order():
+            n = self._nodes[nid]
+            src = f"{n.parents}→" if n.parents else ""
+            parts.append(f"{src}{nid}:{n.op.op_name}")
+        return f"Fragment#{self.fragment_id}[{', '.join(parts)}]"
+
+
+class Plan:
+    """A DAG of fragments. ``executing_instance`` labels which engine
+    instance (device shard / kelvin) runs each fragment — filled in by the
+    distributed coordinator; single-instance plans leave it None."""
+
+    def __init__(self, query_id: str = ""):
+        self.query_id = query_id
+        self.fragments: list[PlanFragment] = []
+        self.executing_instance: dict[int, Optional[str]] = {}
+
+    def add_fragment(self, instance: Optional[str] = None) -> PlanFragment:
+        f = PlanFragment(fragment_id=len(self.fragments))
+        self.fragments.append(f)
+        self.executing_instance[f.fragment_id] = instance
+        return f
+
+    def fragment_topo_order(self) -> list[PlanFragment]:
+        """Producer fragments before consumer fragments, inferred from
+        bridge ids (a fragment consuming bridge B depends on the fragment
+        producing B). Ref: PlanWalker over the fragment DAG."""
+        from pixie_tpu.plan.operators import BridgeSinkOp
+
+        producers: dict[str, int] = {}
+        for f in self.fragments:
+            for nid in f.nodes():
+                op = f.node(nid)
+                if isinstance(op, BridgeSinkOp):
+                    producers[op.bridge_id] = f.fragment_id
+        deps: dict[int, set[int]] = {f.fragment_id: set() for f in self.fragments}
+        for f in self.fragments:
+            for bid in f.bridge_source_ids():
+                if bid in producers:
+                    deps[f.fragment_id].add(producers[bid])
+        out: list[PlanFragment] = []
+        done: set[int] = set()
+        while len(done) < len(self.fragments):
+            progressed = False
+            for f in self.fragments:
+                if f.fragment_id in done:
+                    continue
+                if deps[f.fragment_id] <= done:
+                    out.append(f)
+                    done.add(f.fragment_id)
+                    progressed = True
+            if not progressed:
+                raise ValueError("fragment DAG has a cycle")
+        return out
+
+    def __repr__(self):
+        return f"Plan({self.query_id!r}, {self.fragments!r})"

@@ -120,5 +120,6 @@ class UDTF:
     name: str
     arg_spec: dict[str, DataType]
     fn: Callable[..., Any]
+    output_relation: Any = None  # pixie_tpu.types.Relation of produced rows
     executor: Executor = Executor.HOST
     doc: str = ""
