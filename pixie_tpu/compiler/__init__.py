@@ -1,0 +1,11 @@
+"""PxL compiler: Python-ast front end → operator IR → logical plan.
+
+Ref: src/carnot/planner/compiler/ — parser (libpypa there, stdlib ``ast``
+here since PxL is Python syntax), ASTVisitorImpl building the QLObject layer
+(objects/), operator IR (ir/), Analyzer rewrite rules, Optimizer, plan
+emission (compiler.cc:47-109).
+"""
+
+from pixie_tpu.compiler.compiler import Compiler, CompilerError
+
+__all__ = ["Compiler", "CompilerError"]

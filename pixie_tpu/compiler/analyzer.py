@@ -1,0 +1,182 @@
+"""Analyzer/optimizer rewrite rules over the IR.
+
+Ref: src/carnot/planner/compiler/analyzer/ (rule executor with ~20 rewrite
+rules resolving types/metadata/groups) and compiler/optimizer/ (operator
+merging and pruning). Our object layer resolves types/metadata eagerly, so
+the rules left here are the optimizer ones that matter for TPU execution:
+
+- merge_consecutive_maps: every ``df.x = ...`` emits a full-width Map; the
+  merge collapses chains into one Map so the device pipeline sees a single
+  fused projection (XLA then fuses it into the aggregation's prologue).
+- prune_columns: narrows MemorySource reads and Map outputs to columns that
+  some sink actually needs — less host→HBM staging traffic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from pixie_tpu.plan.expressions import (
+    AggregateExpression,
+    ColumnRef,
+    Constant,
+    FuncCall,
+    referenced_columns,
+)
+from pixie_tpu.plan.operators import (
+    AggOp,
+    FilterOp,
+    JoinOp,
+    LimitOp,
+    MapOp,
+    MemorySinkOp,
+    MemorySourceOp,
+    ResultSinkOp,
+    UnionOp,
+)
+
+
+def substitute(expr, mapping: dict):
+    """Replace ColumnRefs by expressions from ``mapping``."""
+    if isinstance(expr, ColumnRef):
+        return mapping.get(expr.name, expr)
+    if isinstance(expr, FuncCall):
+        return FuncCall(
+            expr.name,
+            tuple(substitute(a, mapping) for a in expr.args),
+            expr.init_args,
+        )
+    return expr
+
+
+def merge_consecutive_maps(ir) -> int:
+    """Map(B)∘Map(A) → Map(B∘A) when A's only consumer is B."""
+    merged = 0
+    changed = True
+    while changed:
+        changed = False
+        for nid in ir.topo_order():
+            if nid not in ir._ops:
+                continue
+            op = ir._ops.get(nid)
+            if not isinstance(op, MapOp):
+                continue
+            (parent,) = ir.parents(nid) or (None,)
+            if parent is None:
+                continue
+            pop = ir._ops.get(parent)
+            if not isinstance(pop, MapOp):
+                continue
+            if len(ir.children(parent)) != 1:
+                continue
+            upstream = dict(pop.exprs)
+            new_exprs = tuple(
+                (name, substitute(e, upstream)) for name, e in op.exprs
+            )
+            # Splice: nid's parent becomes pop's parent.
+            ir._ops[nid] = MapOp(new_exprs)
+            ir._parents[nid] = ir.parents(parent)
+            del ir._ops[parent], ir._parents[parent], ir._relations[parent]
+            ir._recompute_relation(nid)
+            merged += 1
+            changed = True
+            break
+    return merged
+
+
+def _required_inputs(op, needed_out: set, input_rels) -> list[set]:
+    """Which input columns each parent must provide, given the columns this
+    node's consumers need."""
+    if isinstance(op, MapOp):
+        used = set()
+        for name, e in op.exprs:
+            if name in needed_out:
+                used |= referenced_columns(e)
+        return [used]
+    if isinstance(op, FilterOp):
+        return [set(needed_out) | referenced_columns(op.expr)]
+    if isinstance(op, AggOp):
+        used = set(op.groups)
+        for _, agg in op.values:
+            used |= referenced_columns(agg)
+        return [used]
+    if isinstance(op, JoinOp):
+        left_need = {
+            in_name
+            for side, in_name, out in op.output_columns
+            if side == 0 and out in needed_out
+        } | set(op.left_on)
+        right_need = {
+            in_name
+            for side, in_name, out in op.output_columns
+            if side == 1 and out in needed_out
+        } | set(op.right_on)
+        return [left_need, right_need]
+    if isinstance(op, (LimitOp, MemorySinkOp, ResultSinkOp)):
+        return [set(needed_out)]
+    if isinstance(op, UnionOp):
+        return [set(needed_out) for _ in input_rels]
+    # Conservatively require everything for other ops.
+    return [set(r.col_names()) for r in input_rels]
+
+
+def prune_columns(ir) -> int:
+    """Narrow sources (and full-width Maps) to the columns sinks consume."""
+    needed: dict[int, set] = {}
+    order = ir.topo_order()
+    # Seed: sinks need all their columns.
+    for nid in order:
+        needed[nid] = set()
+    for nid in reversed(order):
+        op = ir._ops[nid]
+        if isinstance(op, (ResultSinkOp, MemorySinkOp)):
+            needed[nid] = set(ir.relation(nid).col_names())
+        parents = ir.parents(nid)
+        input_rels = [ir.relation(p) for p in parents]
+        reqs = _required_inputs(op, needed[nid], input_rels)
+        for p, req in zip(parents, reqs):
+            needed[p] |= req
+    changed = 0
+    for nid in order:
+        op = ir._ops[nid]
+        need = needed[nid]
+        if isinstance(op, MemorySourceOp):
+            current = ir.relation(nid).col_names()
+            keep = tuple(c for c in current if c in need)
+            if keep and set(keep) != set(current):
+                ir.replace_op(
+                    nid,
+                    dataclasses.replace(op, column_names=keep),
+                    recompute=False,
+                )
+                changed += 1
+        elif isinstance(op, MapOp):
+            keep = tuple((n, e) for n, e in op.exprs if n in need)
+            if keep and len(keep) != len(op.exprs):
+                ir.replace_op(nid, MapOp(keep), recompute=False)
+                changed += 1
+        elif isinstance(op, JoinOp):
+            keep = tuple(oc for oc in op.output_columns if oc[2] in need)
+            if keep and len(keep) != len(op.output_columns):
+                ir.replace_op(
+                    nid,
+                    dataclasses.replace(op, output_columns=keep),
+                    recompute=False,
+                )
+                changed += 1
+        elif isinstance(op, AggOp):
+            keep = tuple(v for v in op.values if v[0] in need or not need)
+            if keep and len(keep) != len(op.values):
+                ir.replace_op(
+                    nid, dataclasses.replace(op, values=keep), recompute=False
+                )
+                changed += 1
+    if changed:
+        ir.recompute_all()
+    return changed
+
+
+def run_all(ir) -> None:
+    merge_consecutive_maps(ir)
+    prune_columns(ir)
+    ir.prune_dead()

@@ -1,0 +1,339 @@
+"""AST interpreter for PxL scripts.
+
+Ref: src/carnot/planner/compiler/ast_visitor.{h,cc} (ASTVisitorImpl) — walks
+the parsed Python AST, manipulating QLObjects. PxL is Python syntax, so the
+stdlib ``ast`` module replaces the reference's libpypa parser
+(parser/parser.h:38).
+
+Supported surface (the reference's scripts use exactly this shape):
+module-level statements, assignments (names, df.attr, df['col']), function
+defs + calls, binary/compare/bool/unary ops, literals, lists/tuples/dicts,
+f-strings over compile-time values, and px.* calls.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Optional
+
+from pixie_tpu.compiler.objects import (
+    ColumnExpr,
+    CompilerError,
+    DataFrameObj,
+    PxModule,
+)
+
+_BINOP_FUNCS = {
+    ast.Add: "__add__",
+    ast.Sub: "__sub__",
+    ast.Mult: "__mul__",
+    ast.Div: "__truediv__",
+    ast.Mod: "__mod__",
+    ast.Pow: "__pow__",
+    ast.BitAnd: "__and__",
+    ast.BitOr: "__or__",
+}
+
+_CMPOP_FUNCS = {
+    ast.Eq: "__eq__",
+    ast.NotEq: "__ne__",
+    ast.Lt: "__lt__",
+    ast.LtE: "__le__",
+    ast.Gt: "__gt__",
+    ast.GtE: "__ge__",
+}
+
+
+class _UserFunc:
+    """A PxL-defined function, interpreted in a child scope on call."""
+
+    def __init__(self, visitor: "ASTVisitor", node: ast.FunctionDef, closure: dict):
+        self.visitor = visitor
+        self.node = node
+        self.closure = closure
+
+    def __call__(self, *args, **kwargs):
+        params = [a.arg for a in self.node.args.args]
+        defaults = self.node.args.defaults
+        bound = dict(self.closure)
+        # Bind defaults right-aligned, then positionals, then keywords.
+        for name, d in zip(params[len(params) - len(defaults):], defaults):
+            bound[name] = self.visitor._eval(d, bound)
+        for name, v in zip(params, args):
+            bound[name] = v
+        for k, v in kwargs.items():
+            if k not in params:
+                raise CompilerError(
+                    f"{self.node.name}() got unexpected keyword {k!r}"
+                )
+            bound[k] = v
+        missing = [p for p in params if p not in bound]
+        if missing:
+            raise CompilerError(
+                f"{self.node.name}() missing arguments {missing}"
+            )
+        return self.visitor._exec_body(self.node.body, bound)
+
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class ASTVisitor:
+    def __init__(self, px: PxModule, globals_: Optional[dict] = None):
+        self.px = px
+        self.env: dict[str, Any] = {"px": px}
+        if globals_:
+            self.env.update(globals_)
+
+    # -- statements ---------------------------------------------------------
+    def run(self, source: str) -> None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            raise CompilerError(f"PxL syntax error: {e}") from None
+        try:
+            self._exec_body(tree.body, self.env, module_level=True)
+        except _Return:
+            raise CompilerError("return outside function")
+
+    def _exec_body(self, body, scope: dict, module_level: bool = False):
+        try:
+            for stmt in body:
+                self._exec_stmt(stmt, scope)
+        except _Return as r:
+            if module_level:
+                raise
+            return r.value
+        return None
+
+    def _exec_stmt(self, stmt, scope: dict) -> None:
+        try:
+            self._exec_stmt_inner(stmt, scope)
+        except CompilerError as e:
+            if not getattr(e, "_located", False):
+                e._located = True
+                e.args = (f"line {stmt.lineno}: {e.args[0]}",) + e.args[1:]
+            raise
+
+    def _exec_stmt_inner(self, stmt, scope: dict) -> None:
+        if isinstance(stmt, ast.Assign):
+            value = self._eval(stmt.value, scope)
+            for target in stmt.targets:
+                self._assign(target, value, scope)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign(stmt.target, self._eval(stmt.value, scope), scope)
+        elif isinstance(stmt, ast.AugAssign):
+            cur = self._eval(_load_of(stmt.target), scope)
+            fn = _BINOP_FUNCS.get(type(stmt.op))
+            if fn is None:
+                raise CompilerError(f"unsupported operator {stmt.op}")
+            self._assign(stmt.target, _apply_binop(cur, fn, self._eval(stmt.value, scope)), scope)
+        elif isinstance(stmt, ast.Expr):
+            self._eval(stmt.value, scope)
+        elif isinstance(stmt, ast.FunctionDef):
+            scope[stmt.name] = _UserFunc(self, stmt, scope)
+        elif isinstance(stmt, ast.Return):
+            raise _Return(
+                self._eval(stmt.value, scope) if stmt.value else None
+            )
+        elif isinstance(stmt, ast.If):
+            cond = self._eval(stmt.test, scope)
+            if isinstance(cond, ColumnExpr):
+                raise CompilerError(
+                    "if over column expressions is not supported; use "
+                    "px.select or a filter df[cond]"
+                )
+            branch = stmt.body if cond else stmt.orelse
+            for s in branch:
+                self._exec_stmt(s, scope)
+        elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            # Scripts may `import px`; the name is pre-bound.
+            pass
+        elif isinstance(stmt, ast.Pass):
+            pass
+        else:
+            raise CompilerError(
+                f"unsupported statement {type(stmt).__name__}"
+            )
+
+    def _assign(self, target, value, scope: dict) -> None:
+        if isinstance(target, ast.Name):
+            scope[target.id] = value
+        elif isinstance(target, ast.Attribute):
+            obj = self._eval(target.value, scope)
+            if not isinstance(obj, DataFrameObj):
+                raise CompilerError(
+                    f"cannot set attribute on {type(obj).__name__}"
+                )
+            new_df = obj.assign_column(target.attr, value)
+            self._rebind(target.value, obj, new_df, scope)
+        elif isinstance(target, ast.Subscript):
+            obj = self._eval(target.value, scope)
+            key = self._eval(target.slice, scope)
+            if not isinstance(obj, DataFrameObj) or not isinstance(key, str):
+                raise CompilerError("only df['col'] = ... assignment supported")
+            new_df = obj.assign_column(key, value)
+            self._rebind(target.value, obj, new_df, scope)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            vals = list(value)
+            if len(vals) != len(target.elts):
+                raise CompilerError("unpacking arity mismatch")
+            for t, v in zip(target.elts, vals):
+                self._assign(t, v, scope)
+        else:
+            raise CompilerError(
+                f"unsupported assignment target {type(target).__name__}"
+            )
+
+    def _rebind(self, node, old, new, scope: dict) -> None:
+        """df.x = ... mutates the *name* df points at (PxL dataframes are
+        value-semantics over an immutable IR — the reference rebinds the
+        variable in its var table the same way)."""
+        if isinstance(node, ast.Name):
+            scope[node.id] = new
+        else:
+            raise CompilerError(
+                "column assignment requires a simple variable target"
+            )
+
+    # -- expressions --------------------------------------------------------
+    def _eval(self, node, scope: dict):
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id not in scope:
+                raise CompilerError(f"name {node.id!r} is not defined")
+            return scope[node.id]
+        if isinstance(node, ast.Attribute):
+            obj = self._eval(node.value, scope)
+            if isinstance(obj, DataFrameObj):
+                if node.attr in (
+                    "ctx", "relation", "groupby", "agg", "merge", "head",
+                    "drop", "append", "stream",
+                ):
+                    return getattr(obj, node.attr)
+                return obj._col(node.attr)
+            try:
+                return getattr(obj, node.attr)
+            except AttributeError:
+                raise CompilerError(
+                    f"{type(obj).__name__} has no attribute {node.attr!r}"
+                ) from None
+        if isinstance(node, ast.Subscript):
+            obj = self._eval(node.value, scope)
+            key = self._eval(node.slice, scope)
+            try:
+                return obj[key]
+            except (KeyError, IndexError, TypeError) as e:
+                raise CompilerError(str(e)) from None
+        if isinstance(node, ast.Call):
+            fn = self._eval(node.func, scope)
+            args = [self._eval(a, scope) for a in node.args]
+            kwargs = {
+                kw.arg: self._eval(kw.value, scope)
+                for kw in node.keywords
+                if kw.arg is not None
+            }
+            if not callable(fn):
+                raise CompilerError(f"{fn!r} is not callable")
+            return fn(*args, **kwargs)
+        if isinstance(node, ast.BinOp):
+            fn = _BINOP_FUNCS.get(type(node.op))
+            if fn is None:
+                raise CompilerError(f"unsupported operator {node.op}")
+            return _apply_binop(
+                self._eval(node.left, scope), fn, self._eval(node.right, scope)
+            )
+        if isinstance(node, ast.Compare):
+            if len(node.ops) != 1:
+                raise CompilerError("chained comparisons are not supported")
+            fn = _CMPOP_FUNCS.get(type(node.ops[0]))
+            if fn is None:
+                raise CompilerError(f"unsupported comparison {node.ops[0]}")
+            return _apply_binop(
+                self._eval(node.left, scope),
+                fn,
+                self._eval(node.comparators[0], scope),
+            )
+        if isinstance(node, ast.BoolOp):
+            fname = "__and__" if isinstance(node.op, ast.And) else "__or__"
+            vals = [self._eval(v, scope) for v in node.values]
+            out = vals[0]
+            for v in vals[1:]:
+                out = _apply_binop(out, fname, v)
+            return out
+        if isinstance(node, ast.UnaryOp):
+            v = self._eval(node.operand, scope)
+            if isinstance(node.op, ast.Not):
+                return ~v if isinstance(v, ColumnExpr) else (not v)
+            if isinstance(node.op, ast.USub):
+                return -v
+            if isinstance(node.op, ast.UAdd):
+                return +v if not isinstance(v, ColumnExpr) else v
+            raise CompilerError(f"unsupported unary op {node.op}")
+        if isinstance(node, (ast.List, ast.Tuple)):
+            vals = [self._eval(e, scope) for e in node.elts]
+            return vals if isinstance(node, ast.List) else tuple(vals)
+        if isinstance(node, ast.Dict):
+            return {
+                self._eval(k, scope): self._eval(v, scope)
+                for k, v in zip(node.keys, node.values)
+            }
+        if isinstance(node, ast.JoinedStr):
+            parts = []
+            for v in node.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(str(v.value))
+                elif isinstance(v, ast.FormattedValue):
+                    val = self._eval(v.value, scope)
+                    if isinstance(val, (ColumnExpr, DataFrameObj)):
+                        raise CompilerError(
+                            "f-strings over columns are not supported; use "
+                            "string functions"
+                        )
+                    parts.append(str(val))
+            return "".join(parts)
+        if isinstance(node, ast.IfExp):
+            cond = self._eval(node.test, scope)
+            if isinstance(cond, ColumnExpr):
+                raise CompilerError("use px.select for column conditionals")
+            return self._eval(node.body if cond else node.orelse, scope)
+        raise CompilerError(f"unsupported expression {type(node).__name__}")
+
+
+def _apply_binop(left, fname: str, right):
+    if isinstance(left, ColumnExpr) or isinstance(right, ColumnExpr):
+        if not isinstance(left, ColumnExpr):
+            # Reflected: build via the column operand.
+            refl = {
+                "__add__": "__radd__", "__sub__": "__rsub__",
+                "__mul__": "__rmul__", "__truediv__": "__rtruediv__",
+                "__mod__": "__rmod__", "__pow__": "__rpow__",
+                "__eq__": "__eq__",
+                "__ne__": "__ne__", "__lt__": "__gt__", "__le__": "__ge__",
+                "__gt__": "__lt__", "__ge__": "__le__",
+                "__and__": "__and__", "__or__": "__or__",
+            }[fname]
+            return getattr(right, refl)(left)
+        return getattr(left, fname)(right)
+    import operator as op
+
+    table = {
+        "__add__": op.add, "__sub__": op.sub, "__mul__": op.mul,
+        "__truediv__": op.truediv, "__mod__": op.mod, "__pow__": op.pow,
+        "__and__": op.and_, "__or__": op.or_, "__eq__": op.eq,
+        "__ne__": op.ne, "__lt__": op.lt, "__le__": op.le,
+        "__gt__": op.gt, "__ge__": op.ge,
+    }
+    return table[fname](left, right)
+
+
+def _load_of(target):
+    """Copy of an assignment target as a Load-context expression."""
+    import copy
+
+    node = copy.deepcopy(target)
+    node.ctx = ast.Load()
+    return node

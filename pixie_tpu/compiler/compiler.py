@@ -1,0 +1,57 @@
+"""Compiler facade: PxL source → logical Plan.
+
+Ref: src/carnot/planner/compiler/compiler.cc:47-109 (Compile/CompileToIR/
+QueryToIR): parse → ASTVisitor over QLObjects → IR → Analyzer → Optimizer →
+plan emission.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pixie_tpu.compiler import analyzer
+from pixie_tpu.compiler.ast_visitor import ASTVisitor
+from pixie_tpu.compiler.ir import IRGraph
+from pixie_tpu.compiler.objects import CompilerError, PxModule
+from pixie_tpu.plan.plan import Plan
+from pixie_tpu.types import Relation
+
+__all__ = ["Compiler", "CompilerError"]
+
+
+class Compiler:
+    def __init__(self, registry=None):
+        if registry is None:
+            from pixie_tpu.udf.registry import default_registry
+
+            registry = default_registry()
+        self.registry = registry
+
+    def compile_to_ir(
+        self,
+        query: str,
+        table_relations: dict[str, Relation],
+        now_ns: Optional[int] = None,
+        script_args: Optional[dict] = None,
+    ) -> IRGraph:
+        ir = IRGraph(self.registry, table_relations)
+        px = PxModule(ir, self.registry, now_ns)
+        visitor = ASTVisitor(px, globals_=script_args)
+        visitor.run(query)
+        if not px.display_calls:
+            raise CompilerError(
+                "script produced no output — call px.display(df, name)"
+            )
+        analyzer.run_all(ir)
+        return ir
+
+    def compile(
+        self,
+        query: str,
+        table_relations: dict[str, Relation],
+        now_ns: Optional[int] = None,
+        script_args: Optional[dict] = None,
+        query_id: str = "",
+    ) -> Plan:
+        ir = self.compile_to_ir(query, table_relations, now_ns, script_args)
+        return ir.to_plan(query_id)

@@ -1,0 +1,5 @@
+"""Compiler error type (ref: compilerpb error payloads with line info)."""
+
+
+class CompilerError(Exception):
+    pass

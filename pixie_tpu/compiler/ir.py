@@ -1,0 +1,158 @@
+"""Mutable operator IR graph.
+
+Ref: src/carnot/planner/ir/ (all_ir_nodes.h) — the compiler builds a mutable
+operator graph (MemorySource, Map, BlockingAgg, Join, Filter, Limit,
+GRPCSink...), the analyzer/optimizer rewrite it, and it lowers to the plan
+proto. Our IR reuses the (frozen) plan operator dataclasses as payloads;
+rewrites swap payloads with dataclasses.replace. Relations are resolved
+eagerly as nodes are added — type errors surface at the script line that
+caused them, like the reference's compile errors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from pixie_tpu.compiler.errors import CompilerError
+from pixie_tpu.plan import dag
+from pixie_tpu.plan.operators import MemorySourceOp, Operator
+from pixie_tpu.plan.plan import Plan, PlanFragment
+from pixie_tpu.types import Relation
+
+
+class IRGraph:
+    def __init__(self, registry, table_relations: dict[str, Relation]):
+        self.registry = registry
+        self.table_relations = dict(table_relations)
+        self._ops: dict[int, Operator] = {}
+        self._parents: dict[int, list[int]] = {}
+        self._relations: dict[int, Relation] = {}
+        self._next = 0
+
+    # -- construction -------------------------------------------------------
+    def add(self, op: Operator, parents: list[int] = ()) -> int:
+        parents = list(parents)
+        inputs = [self._relations[p] for p in parents]
+        if isinstance(op, MemorySourceOp):
+            if op.table_name not in self.table_relations:
+                raise CompilerError(
+                    f"table {op.table_name!r} does not exist; available: "
+                    f"{sorted(self.table_relations)}"
+                )
+            rel = op.output_relation(
+                inputs, self.registry,
+                table_relation=self.table_relations[op.table_name],
+            )
+        else:
+            rel = op.output_relation(inputs, self.registry)
+        nid = self._next
+        self._next += 1
+        self._ops[nid] = op
+        self._parents[nid] = parents
+        self._relations[nid] = rel
+        return nid
+
+    def replace_op(self, nid: int, op: Operator, recompute: bool = True) -> None:
+        """Swap a node's payload and recompute relations downstream. Pass
+        recompute=False when batching several rewrites (then call
+        ``recompute_all`` once) — mid-batch the graph may be transiently
+        inconsistent (e.g. a source narrowed before its consumer is)."""
+        self._ops[nid] = op
+        if recompute:
+            for n in self.topo_order():
+                if n == nid or nid in self._ancestors(n):
+                    self._recompute_relation(n)
+
+    def recompute_all(self) -> None:
+        for n in self.topo_order():
+            self._recompute_relation(n)
+
+    def _recompute_relation(self, nid: int) -> None:
+        op = self._ops[nid]
+        inputs = [self._relations[p] for p in self._parents[nid]]
+        if isinstance(op, MemorySourceOp):
+            self._relations[nid] = op.output_relation(
+                inputs, self.registry,
+                table_relation=self.table_relations[op.table_name],
+            )
+        else:
+            self._relations[nid] = op.output_relation(inputs, self.registry)
+
+    def _ancestors(self, nid: int) -> set:
+        out, stack = set(), list(self._parents[nid])
+        while stack:
+            p = stack.pop()
+            if p not in out:
+                out.add(p)
+                stack.extend(self._parents[p])
+        return out
+
+    # -- queries ------------------------------------------------------------
+    def op(self, nid: int) -> Operator:
+        return self._ops[nid]
+
+    def relation(self, nid: int) -> Relation:
+        return self._relations[nid]
+
+    def parents(self, nid: int) -> list[int]:
+        return list(self._parents[nid])
+
+    def children(self, nid: int) -> list[int]:
+        return dag.children_of(self._parents, nid)
+
+    def nodes(self) -> list[int]:
+        return list(self._ops)
+
+    def sinks(self) -> list[int]:
+        with_children = {p for ps in self._parents.values() for p in ps}
+        return [n for n in self._ops if n not in with_children]
+
+    def topo_order(self) -> list[int]:
+        return dag.topo_order(self._parents)
+
+    def prune_dead(self, keep: Optional[set] = None) -> None:
+        """Drop nodes that reach no sink-worthy node (ref: optimizer pruning
+        of unused operator chains)."""
+        from pixie_tpu.plan.operators import (
+            BridgeSinkOp,
+            MemorySinkOp,
+            ResultSinkOp,
+        )
+
+        keep = set(keep or ())
+        live = set(keep)
+        for n, op in self._ops.items():
+            if isinstance(op, (ResultSinkOp, MemorySinkOp, BridgeSinkOp)):
+                live.add(n)
+        # Walk ancestors of live nodes.
+        stack = list(live)
+        while stack:
+            n = stack.pop()
+            for p in self._parents[n]:
+                if p not in live:
+                    live.add(p)
+                    stack.append(p)
+        for n in list(self._ops):
+            if n not in live:
+                del self._ops[n], self._parents[n], self._relations[n]
+
+    # -- lowering -----------------------------------------------------------
+    def to_plan(self, query_id: str = "") -> Plan:
+        """Emit a single-fragment logical plan (the distributed planner
+        splits it; ref: compiler emits planpb consumed by distributed)."""
+        plan = Plan(query_id)
+        frag = plan.add_fragment()
+        mapping: dict[int, int] = {}
+        for nid in self.topo_order():
+            mapping[nid] = frag.add(
+                self._ops[nid], [mapping[p] for p in self._parents[nid]]
+            )
+        return plan
+
+    def __repr__(self):
+        parts = []
+        for nid in self.topo_order():
+            src = f"{self._parents[nid]}→" if self._parents[nid] else ""
+            parts.append(f"{src}{nid}:{self._ops[nid].op_name}")
+        return f"IR[{', '.join(parts)}]"

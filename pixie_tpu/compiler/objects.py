@@ -1,0 +1,499 @@
+"""QLObject layer: the objects a PxL script manipulates.
+
+Ref: src/carnot/planner/compiler/objects/ — PixieModule (px), Dataframe
+(objects/dataframe.h:40), expression objects, metadata property resolution.
+Each DataFrame wraps an IR node id; operations append IR nodes and return new
+DataFrames. Relations resolve eagerly so script errors carry the offending
+operation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import time
+from typing import Any, Optional
+
+from pixie_tpu.plan.expressions import (
+    AggregateExpression,
+    ColumnRef,
+    Constant,
+    FuncCall,
+    ScalarExpression,
+    expr_data_type,
+)
+from pixie_tpu.plan.operators import (
+    AggOp,
+    FilterOp,
+    JoinOp,
+    JoinType,
+    LimitOp,
+    MapOp,
+    MemorySourceOp,
+    ResultSinkOp,
+    UnionOp,
+)
+from pixie_tpu.types import DataType, SemanticType
+
+
+from pixie_tpu.compiler.errors import CompilerError  # noqa: E402
+
+
+def _lit_type(v) -> DataType:
+    if isinstance(v, bool):
+        return DataType.BOOLEAN
+    if isinstance(v, int):
+        return DataType.INT64
+    if isinstance(v, float):
+        return DataType.FLOAT64
+    if isinstance(v, str):
+        return DataType.STRING
+    raise CompilerError(f"unsupported literal {v!r}")
+
+
+def to_expr(v) -> ScalarExpression:
+    if isinstance(v, ColumnExpr):
+        return v.expr
+    if isinstance(v, ScalarExpression):
+        return v
+    return Constant(v, _lit_type(v))
+
+
+_BIN_FUNCS = {
+    "__add__": "add",
+    "__sub__": "subtract",
+    "__mul__": "multiply",
+    "__truediv__": "divide",
+    "__mod__": "modulo",
+    "__pow__": "pow",
+    "__and__": "logical_and",
+    "__or__": "logical_or",
+    "__eq__": "equal",
+    "__ne__": "notEqual",
+    "__lt__": "lessThan",
+    "__le__": "lessThanEqual",
+    "__gt__": "greaterThan",
+    "__ge__": "greaterThanEqual",
+}
+
+
+class ColumnExpr:
+    """A scalar expression bound to a DataFrame (ref: ExprObject)."""
+
+    def __init__(self, expr: ScalarExpression, df: Optional["DataFrameObj"] = None):
+        self.expr = expr
+        self.df = df
+
+    def _bin(self, name: str, other, reflected=False):
+        a, b = to_expr(self), to_expr(other)
+        if reflected:
+            a, b = b, a
+        return ColumnExpr(FuncCall(name, (a, b)), self.df or getattr(other, "df", None))
+
+    def __invert__(self):
+        return ColumnExpr(FuncCall("logical_not", (to_expr(self),)), self.df)
+
+    def __neg__(self):
+        return ColumnExpr(FuncCall("negate", (to_expr(self),)), self.df)
+
+    def __repr__(self):
+        return f"ColumnExpr({self.expr!r})"
+
+    def __hash__(self):  # __eq__ is overloaded; keep hashable by identity
+        return id(self)
+
+
+for _dunder, _fname in _BIN_FUNCS.items():
+    def _make(fname, refl):
+        def op(self, other):
+            return self._bin(fname, other, reflected=refl)
+        return op
+    setattr(ColumnExpr, _dunder, _make(_fname, False))
+    _r = _dunder.replace("__", "__r", 1)
+    if _dunder in (
+        "__add__", "__sub__", "__mul__", "__truediv__", "__mod__", "__pow__",
+    ):
+        setattr(ColumnExpr, _r, _make(_fname, True))
+
+
+@dataclasses.dataclass
+class FuncRef:
+    """``px.<name>`` — callable scalar function and/or aggregate reference
+    (ref: FuncObject). PxL uses the bare reference in agg tuples."""
+
+    name: str
+    registry: Any
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:
+            raise CompilerError(f"px.{self.name} takes positional args only")
+        df = next(
+            (a.df for a in args if isinstance(a, ColumnExpr) and a.df), None
+        )
+        # Resolve the overload: prefer all args as column/constant
+        # expressions; fall back to peeling trailing literals off into
+        # init_args (ref: udf.h init-arg signatures like the regex pattern).
+        exprs: list = []
+        tail: list = []
+        for a in args:
+            if isinstance(a, (ColumnExpr, ScalarExpression)) or (
+                isinstance(a, (str, int, float, bool)) and not tail
+            ):
+                exprs.append(to_expr(a))
+            else:
+                tail.append(a)
+        rel = df.relation if df is not None else None
+        for split in range(len(exprs), -1, -1):
+            head = tuple(exprs[:split])
+            init = tuple(
+                (e.value if isinstance(e, Constant) else e)
+                for e in exprs[split:]
+            ) + tuple(tail)
+            if any(isinstance(e, ScalarExpression) and not isinstance(e, Constant)
+                   for e in exprs[split:]):
+                break  # cannot demote column refs to init args
+            try:
+                types = [
+                    expr_data_type(e, rel, self.registry) for e in head
+                ] if rel is not None else [
+                    e.data_type if isinstance(e, Constant) else None
+                    for e in head
+                ]
+            except (KeyError, ValueError):
+                continue
+            if None not in types and (
+                self.registry.lookup_scalar(self.name, types) is not None
+                or self.registry.lookup_uda(self.name, types) is not None
+            ):
+                return ColumnExpr(FuncCall(self.name, head, init), df)
+        # No overload matched; emit with the all-exprs shape so the type
+        # error names the function with its actual argument types.
+        return ColumnExpr(FuncCall(self.name, tuple(exprs), tuple(tail)), df)
+
+
+_TIME_SUFFIX_NS = {
+    "ns": 1,
+    "us": 1_000,
+    "ms": 1_000_000,
+    "s": 1_000_000_000,
+    "m": 60_000_000_000,
+    "h": 3_600_000_000_000,
+    "d": 86_400_000_000_000,
+}
+
+
+def parse_relative_time(s: str, now_ns: int) -> int:
+    """'-5m' → now-5min in ns (ref: planner time parsing)."""
+    m = re.fullmatch(r"(-?\d+(?:\.\d+)?)(ns|us|ms|s|m|h|d)", s.strip())
+    if not m:
+        raise CompilerError(f"cannot parse time {s!r}")
+    return int(now_ns + float(m.group(1)) * _TIME_SUFFIX_NS[m.group(2)])
+
+
+# ctx[key] → metadata UDF over the UPID column (ref: the analyzer's
+# metadata resolution rules rewriting df.ctx into upid_to_* calls).
+_CTX_FUNCS = {
+    "service": "upid_to_service_name",
+    "service_name": "upid_to_service_name",
+    "service_id": "upid_to_service_id",
+    "pod": "upid_to_pod_name",
+    "pod_name": "upid_to_pod_name",
+    "pod_id": "upid_to_pod_id",
+    "namespace": "upid_to_namespace",
+    "node": "upid_to_node_name",
+    "node_name": "upid_to_node_name",
+    "pid": "upid_to_pid",
+    "asid": "upid_to_asid",
+}
+
+
+class CtxAccessor:
+    def __init__(self, df: "DataFrameObj"):
+        self.df = df
+
+    def __getitem__(self, key: str) -> ColumnExpr:
+        fn = _CTX_FUNCS.get(key)
+        if fn is None:
+            raise CompilerError(
+                f"ctx[{key!r}] is not a known metadata property "
+                f"(have: {sorted(_CTX_FUNCS)})"
+            )
+        upid = self.df._upid_column()
+        return ColumnExpr(FuncCall(fn, (ColumnRef(upid),)), self.df)
+
+
+class GroupedDataFrame:
+    def __init__(self, df: "DataFrameObj", by: tuple[str, ...]):
+        self.df = df
+        self.by = by
+        for g in by:
+            if not df.relation.has_column(g):
+                raise CompilerError(
+                    f"groupby column {g!r} not in {df.relation.col_names()}"
+                )
+
+    def agg(self, **kwargs) -> "DataFrameObj":
+        return self.df._agg(self.by, kwargs)
+
+
+class DataFrameObj:
+    """The PxL DataFrame (ref: objects/dataframe.h:40)."""
+
+    def __init__(self, ir, node_id: int):
+        self._ir = ir
+        self._id = node_id
+
+    # -- plumbing -----------------------------------------------------------
+    @property
+    def relation(self):
+        return self._ir.relation(self._id)
+
+    def _wrap(self, nid: int) -> "DataFrameObj":
+        return DataFrameObj(self._ir, nid)
+
+    def _col(self, name: str) -> ColumnExpr:
+        if not self.relation.has_column(name):
+            raise CompilerError(
+                f"column {name!r} not found; have {self.relation.col_names()}"
+            )
+        return ColumnExpr(ColumnRef(name), self)
+
+    def _upid_column(self) -> str:
+        for c in self.relation:
+            if c.semantic_type == SemanticType.ST_UPID:
+                return c.name
+        if self.relation.has_column("upid"):
+            return "upid"
+        raise CompilerError(
+            "ctx[] requires a UPID column in the DataFrame "
+            f"(have {self.relation.col_names()})"
+        )
+
+    # -- script surface -----------------------------------------------------
+    @property
+    def ctx(self) -> CtxAccessor:
+        return CtxAccessor(self)
+
+    def __getitem__(self, item):
+        if isinstance(item, str):
+            return self._col(item)
+        if isinstance(item, list):
+            exprs = tuple((n, ColumnRef(n)) for n in item)
+            for n in item:
+                if not self.relation.has_column(n):
+                    raise CompilerError(
+                        f"column {n!r} not found; have {self.relation.col_names()}"
+                    )
+            return self._wrap(self._ir.add(MapOp(exprs), [self._id]))
+        if isinstance(item, ColumnExpr):
+            return self._wrap(
+                self._ir.add(FilterOp(item.expr), [self._id])
+            )
+        raise CompilerError(f"cannot index DataFrame with {item!r}")
+
+    def assign_column(self, name: str, value) -> "DataFrameObj":
+        """df.x = expr — emits a Map keeping existing columns (updated in
+        place if `name` exists) plus the new one."""
+        expr = to_expr(value)
+        exprs = []
+        replaced = False
+        for c in self.relation:
+            if c.name == name:
+                exprs.append((name, expr))
+                replaced = True
+            else:
+                exprs.append((c.name, ColumnRef(c.name)))
+        if not replaced:
+            exprs.append((name, expr))
+        return self._wrap(self._ir.add(MapOp(tuple(exprs)), [self._id]))
+
+    def drop(self, columns=None) -> "DataFrameObj":
+        if isinstance(columns, str):
+            columns = [columns]
+        drop = set(columns or ())
+        missing = drop - set(self.relation.col_names())
+        if missing:
+            raise CompilerError(f"drop: no such columns {sorted(missing)}")
+        exprs = tuple(
+            (c.name, ColumnRef(c.name))
+            for c in self.relation
+            if c.name not in drop
+        )
+        return self._wrap(self._ir.add(MapOp(exprs), [self._id]))
+
+    def head(self, n: int = 5) -> "DataFrameObj":
+        return self._wrap(self._ir.add(LimitOp(int(n)), [self._id]))
+
+    def groupby(self, by) -> GroupedDataFrame:
+        if isinstance(by, str):
+            by = [by]
+        return GroupedDataFrame(self, tuple(by))
+
+    def agg(self, **kwargs) -> "DataFrameObj":
+        return self._agg((), kwargs)
+
+    def _agg(self, groups: tuple[str, ...], kwargs: dict) -> "DataFrameObj":
+        values = []
+        for out_name, spec in kwargs.items():
+            if (
+                not isinstance(spec, tuple)
+                or len(spec) != 2
+            ):
+                raise CompilerError(
+                    f"agg {out_name}=... must be a (column, px.fn) tuple"
+                )
+            col, fn = spec
+            fn_name = fn.name if isinstance(fn, FuncRef) else str(fn)
+            if not self.relation.has_column(col):
+                raise CompilerError(
+                    f"agg over unknown column {col!r}; have "
+                    f"{self.relation.col_names()}"
+                )
+            values.append(
+                (out_name, AggregateExpression(fn_name, (ColumnRef(col),)))
+            )
+        nid = self._ir.add(
+            AggOp(groups=groups, values=tuple(values)), [self._id]
+        )
+        return self._wrap(nid)
+
+    def merge(
+        self,
+        right: "DataFrameObj",
+        how: str = "inner",
+        left_on=None,
+        right_on=None,
+        suffixes=("_x", "_y"),
+    ) -> "DataFrameObj":
+        if isinstance(left_on, str):
+            left_on = [left_on]
+        if isinstance(right_on, str):
+            right_on = [right_on]
+        if not left_on or not right_on:
+            raise CompilerError("merge requires left_on and right_on")
+        lrel, rrel = self.relation, right.relation
+        rnames = set(rrel.col_names())
+        out_cols = []
+        for c in lrel:
+            out = c.name + suffixes[0] if c.name in rnames else c.name
+            out_cols.append((0, c.name, out))
+        lnames = set(lrel.col_names())
+        for c in rrel:
+            out = c.name + suffixes[1] if c.name in lnames else c.name
+            out_cols.append((1, c.name, out))
+        op = JoinOp(
+            how=JoinType(how),
+            left_on=tuple(left_on),
+            right_on=tuple(right_on),
+            output_columns=tuple(out_cols),
+        )
+        nid = self._ir.add(op, [self._id, right._id])
+        return self._wrap(nid)
+
+    def append(self, other: "DataFrameObj") -> "DataFrameObj":
+        return self._wrap(
+            self._ir.add(UnionOp(), [self._id, other._id])
+        )
+
+    def stream(self) -> "DataFrameObj":
+        """Mark the source chain streaming (memory_source_node.h:61)."""
+        for nid in [self._id] + list(self._ir._ancestors(self._id)):
+            op = self._ir.op(nid)
+            if isinstance(op, MemorySourceOp):
+                self._ir.replace_op(
+                    nid, dataclasses.replace(op, streaming=True)
+                )
+        return self
+
+    def __repr__(self):
+        return f"DataFrame({self.relation!r})"
+
+
+class PxModule:
+    """The ``px`` module object (ref: objects/pixie_module.*)."""
+
+    def __init__(self, ir, registry, now_ns: Optional[int] = None):
+        self._ir = ir
+        self._registry = registry
+        self.now_ns = now_ns if now_ns is not None else time.time_ns()
+        self.display_calls: list[tuple[int, str]] = []  # (ir node, name)
+
+    # -- frame construction -------------------------------------------------
+    def DataFrame(
+        self,
+        table: str,
+        select=None,
+        start_time=None,
+        end_time=None,
+    ) -> DataFrameObj:
+        nid = self._ir.add(
+            MemorySourceOp(
+                table_name=table,
+                column_names=tuple(select) if select else None,
+                start_time=self._time(start_time),
+                stop_time=self._time(end_time),
+            )
+        )
+        return DataFrameObj(self._ir, nid)
+
+    def _time(self, t) -> Optional[int]:
+        if t is None:
+            return None
+        if isinstance(t, str):
+            return parse_relative_time(t, self.now_ns)
+        return int(t)
+
+    def display(self, df: DataFrameObj, name: str = "output") -> None:
+        if not isinstance(df, DataFrameObj):
+            raise CompilerError("px.display takes a DataFrame")
+        nid = self._ir.add(ResultSinkOp(name), [df._id])
+        self.display_calls.append((nid, name))
+
+    # -- time helpers -------------------------------------------------------
+    def now(self) -> int:
+        return self.now_ns
+
+    @staticmethod
+    def nanoseconds(n):
+        return int(n)
+
+    @staticmethod
+    def microseconds(n):
+        return int(n) * 1_000
+
+    @staticmethod
+    def milliseconds(n):
+        return int(n) * 1_000_000
+
+    @staticmethod
+    def seconds(n):
+        return int(n) * 1_000_000_000
+
+    @staticmethod
+    def minutes(n):
+        return int(n) * 60_000_000_000
+
+    @staticmethod
+    def hours(n):
+        return int(n) * 3_600_000_000_000
+
+    @staticmethod
+    def days(n):
+        return int(n) * 86_400_000_000_000
+
+    def DurationNanos(self, n) -> int:
+        return int(n)
+
+    def Time(self, n) -> int:
+        return int(n)
+
+    # -- function namespace -------------------------------------------------
+    def __getattr__(self, name: str):
+        # Fall through to registry functions: px.mean, px.quantiles,
+        # px.upid_to_service_name, px.bin, ...
+        if name.startswith("_"):
+            raise AttributeError(name)
+        reg = self.__dict__.get("_registry")
+        if reg is not None and (reg.has_scalar(name) or reg.has_uda(name)):
+            return FuncRef(name, reg)
+        raise CompilerError(f"px has no attribute or function {name!r}")

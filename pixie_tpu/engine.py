@@ -1,0 +1,133 @@
+"""Carnot-equivalent engine facade.
+
+Ref: src/carnot/carnot.{h,cc} — Carnot::Create (carnot.h:52),
+ExecuteQuery (carnot.cc:122; compile then execute), ExecutePlan
+(carnot.cc:319; walk fragments, build exec graphs, run, stream results +
+per-operator stats to the result destination).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Optional
+
+from pixie_tpu.compiler import Compiler
+from pixie_tpu.exec import BridgeRouter, ExecState, ExecutionGraph
+from pixie_tpu.plan.operators import BridgeSinkOp
+from pixie_tpu.plan.plan import Plan
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.table.table_store import TableStore
+
+
+@dataclasses.dataclass
+class QueryResult:
+    """Streamed result tables + execution stats (ref: queryresultspb)."""
+
+    query_id: str
+    tables: dict[str, list[RowBatch]]
+    exec_stats: dict[str, dict]  # node name -> stats dict (analyze mode)
+    compile_time_ns: int = 0
+    exec_time_ns: int = 0
+
+    def table(self, name: str = None) -> dict:
+        if name is None:
+            if len(self.tables) != 1:
+                raise KeyError(f"result has tables {sorted(self.tables)}")
+            name = next(iter(self.tables))
+        batches = [b for b in self.tables[name] if b.num_rows]
+        if not batches:
+            return {}
+        return RowBatch.concat(batches).to_pydict()
+
+
+class Carnot:
+    """One engine instance (a PEM or Kelvin equivalent runs one of these)."""
+
+    def __init__(
+        self,
+        table_store: Optional[TableStore] = None,
+        registry=None,
+        metadata_state=None,
+        router: Optional[BridgeRouter] = None,
+        instance: str = "local",
+    ):
+        self.table_store = table_store or TableStore()
+        if registry is None:
+            from pixie_tpu.udf.registry import default_registry
+
+            registry = default_registry()
+        self.registry = registry
+        self.metadata_state = metadata_state
+        self.router = router or BridgeRouter()
+        self.instance = instance
+        self.compiler = Compiler(registry)
+
+    # -- the two entry points (carnot.h:72-81) ------------------------------
+    def execute_query(
+        self,
+        query: str,
+        query_id: Optional[str] = None,
+        analyze: bool = False,
+        now_ns: Optional[int] = None,
+        script_args: Optional[dict] = None,
+    ) -> QueryResult:
+        qid = query_id or str(uuid.uuid4())
+        t0 = time.perf_counter_ns()
+        plan = self.compiler.compile(
+            query,
+            self.table_store.relation_map(),
+            now_ns=now_ns,
+            script_args=script_args,
+            query_id=qid,
+        )
+        compile_ns = time.perf_counter_ns() - t0
+        result = self.execute_plan(plan, analyze=analyze)
+        result.compile_time_ns = compile_ns
+        return result
+
+    def execute_plan(self, plan: Plan, analyze: bool = False) -> QueryResult:
+        qid = plan.query_id or str(uuid.uuid4())
+        tables: dict[str, list[RowBatch]] = {}
+
+        def on_result(table_name: str, batch: RowBatch) -> None:
+            tables.setdefault(table_name, []).append(batch)
+
+        # Register bridge producers so consumers know their eos counts.
+        for frag in plan.fragments:
+            for nid in frag.nodes():
+                op = frag.node(nid)
+                if isinstance(op, BridgeSinkOp):
+                    self.router.register_producer(qid, op.bridge_id)
+
+        exec_stats: dict[str, dict] = {}
+        t0 = time.perf_counter_ns()
+        try:
+            # Producer fragments run before consumers (the reference runs
+            # them concurrently across agents; one engine instance runs its
+            # own fragments in dependency order — bridge queues buffer).
+            for frag in plan.fragment_topo_order():
+                state = ExecState(
+                    qid,
+                    self.table_store,
+                    self.registry,
+                    router=self.router,
+                    metadata_state=self.metadata_state,
+                    result_callback=on_result,
+                    instance=self.instance,
+                )
+                graph = ExecutionGraph(frag, state)
+                graph.execute()
+                if analyze:
+                    for name, s in graph.stats().items():
+                        exec_stats[f"f{frag.fragment_id}/{name}"] = s
+        finally:
+            self.router.cleanup_query(qid)
+        exec_ns = time.perf_counter_ns() - t0
+        return QueryResult(
+            query_id=qid,
+            tables=tables,
+            exec_stats=exec_stats,
+            exec_time_ns=exec_ns,
+        )

@@ -121,21 +121,24 @@ class AggNode(ExecNode):
         if batch.eos or (batch.eow and self.op.windowed):
             self._emit(exec_state, eow=batch.eow, eos=batch.eos)
 
+    def _latch_key_column(self, name: str, col):
+        """Latch the first dictionary seen per string key column; re-encode
+        cross-dictionary batches (e.g. across a union) into it so codes stay
+        comparable."""
+        if isinstance(col, DictColumn):
+            existing = self._key_dicts.get(name)
+            if existing is None:
+                self._key_dicts[name] = col.dictionary
+            elif col.dictionary is not existing:
+                col = DictColumn(existing.encode(col.decode()), existing)
+        return col
+
     def _gids_for(self, batch: RowBatch) -> np.ndarray:
         if not self.op.groups:
             return np.zeros(batch.num_rows, np.int32)
-        key_cols = []
-        for g in self.op.groups:
-            col = batch.col(g)
-            if isinstance(col, DictColumn):
-                existing = self._key_dicts.get(g)
-                if existing is None:
-                    self._key_dicts[g] = col.dictionary
-                elif col.dictionary is not existing:
-                    # Cross-dictionary batch (e.g. across a union): re-encode
-                    # into the dictionary we first latched.
-                    col = DictColumn(existing.encode(col.decode()), existing)
-            key_cols.append(col)
+        key_cols = [
+            self._latch_key_column(g, batch.col(g)) for g in self.op.groups
+        ]
         return self._encoder.encode(key_cols)
 
     def _arg_array(self, batch: RowBatch, name: str, is_string: bool):
@@ -161,15 +164,10 @@ class AggNode(ExecNode):
         if sb.num_groups == 0:
             return
         if self.op.groups:
-            key_cols = []
-            for g, col in zip(sb.group_names, sb.key_columns):
-                if isinstance(col, DictColumn):
-                    existing = self._key_dicts.get(g)
-                    if existing is None:
-                        self._key_dicts[g] = col.dictionary
-                    elif col.dictionary is not existing:
-                        col = DictColumn(existing.encode(col.decode()), existing)
-                key_cols.append(col)
+            key_cols = [
+                self._latch_key_column(g, col)
+                for g, col in zip(sb.group_names, sb.key_columns)
+            ]
             idx = self._encoder.encode(key_cols)
         else:
             idx = np.zeros(sb.num_groups, np.int32)

@@ -171,9 +171,13 @@ class ExpressionEvaluator:
         # HOST executor. The dictionary fast path pairs per-value results
         # back through ONE codes array, so it requires exactly one
         # DictColumn argument (two distinct columns sharing a dictionary
-        # still differ per-row).
+        # still differ per-row) and every other argument to be a scalar —
+        # a per-row array arg would be misaligned with per-unique values.
         dict_args = [a for a in args if isinstance(a, DictColumn)]
-        if udf.dict_compatible and len(dict_args) == 1:
+        others_scalar = all(
+            isinstance(a, DictColumn) or np.ndim(a) == 0 for a in args
+        )
+        if udf.dict_compatible and len(dict_args) == 1 and others_scalar:
             d = dict_args[0].dictionary
             values = np.asarray(d.values(), dtype=object)
             fn_args = [
@@ -267,7 +271,11 @@ class ExpressionEvaluator:
             and udf.dict_compatible
             and len(str_cols) == 1
             and all(
-                isinstance(a, (ColumnRef, Constant)) for a in expr.args
+                # Non-string args must be compile-time constants; a per-row
+                # column could not align with per-dictionary-value results.
+                isinstance(a, Constant)
+                or (isinstance(a, ColumnRef) and t == DataType.STRING)
+                for a, t in zip(expr.args, arg_types)
             )
         ):
             d = dictionaries.get(str_cols[0].name)

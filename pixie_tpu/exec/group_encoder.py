@@ -30,25 +30,37 @@ class GroupEncoder:
     def num_groups(self) -> int:
         return len(self._key_rows)
 
-    def encode(self, key_cols: list) -> np.ndarray:
-        """Map rows of the given key columns to gids, assigning new ids to
-        unseen keys. Returns int32[n]."""
-        if not key_cols:
-            n = 0
-            raise ValueError("encode requires at least one key column")
+    @staticmethod
+    def _key_rows_of(key_cols: list) -> tuple[int, "np.ndarray", "np.ndarray"]:
+        """(n, unique_rows, inverse) via one np.unique. Multi-column keys go
+        through a structured (record) array — stacking would upcast mixed
+        int64/float64 keys to float64 and collapse keys beyond 2^53."""
         arrs = [
             c.codes if isinstance(c, DictColumn) else np.asarray(c)
             for c in key_cols
         ]
         n = len(arrs[0])
         if n == 0:
+            return 0, np.empty(0), np.empty(0, np.int64)
+        if len(arrs) == 1:
+            uniq, inverse = np.unique(arrs[0], return_inverse=True)
+            rows = [(v,) for v in uniq.tolist()]
+        else:
+            rec = np.rec.fromarrays(arrs)
+            uniq, inverse = np.unique(rec, return_inverse=True)
+            rows = [tuple(r.tolist()) for r in uniq]
+        return n, rows, inverse
+
+    def encode(self, key_cols: list) -> np.ndarray:
+        """Map rows of the given key columns to gids, assigning new ids to
+        unseen keys. Returns int32[n]."""
+        if not key_cols:
+            raise ValueError("encode requires at least one key column")
+        n, rows, inverse = self._key_rows_of(key_cols)
+        if n == 0:
             return np.empty(0, np.int32)
-        # One np.unique over the stacked key matrix; probe dict per unique.
-        stacked = np.stack(arrs, axis=1) if len(arrs) > 1 else arrs[0][:, None]
-        uniq, inverse = np.unique(stacked, axis=0, return_inverse=True)
-        uniq_gids = np.empty(len(uniq), np.int32)
-        for i, row in enumerate(uniq):
-            key = tuple(row.tolist())
+        uniq_gids = np.empty(len(rows), np.int32)
+        for i, key in enumerate(rows):
             gid = self._gids.get(key)
             if gid is None:
                 gid = len(self._key_rows)
@@ -59,23 +71,27 @@ class GroupEncoder:
 
     def lookup(self, key_cols: list) -> np.ndarray:
         """Like encode but maps unseen keys to -1 (no assignment)."""
-        arrs = [
-            c.codes if isinstance(c, DictColumn) else np.asarray(c)
-            for c in key_cols
-        ]
-        stacked = np.stack(arrs, axis=1) if len(arrs) > 1 else arrs[0][:, None]
-        out = np.empty(len(stacked), np.int32)
-        for i, row in enumerate(stacked):
-            out[i] = self._gids.get(tuple(row.tolist()), -1)
-        return out
+        n, rows, inverse = self._key_rows_of(key_cols)
+        if n == 0:
+            return np.empty(0, np.int32)
+        uniq_gids = np.fromiter(
+            (self._gids.get(key, -1) for key in rows),
+            dtype=np.int32,
+            count=len(rows),
+        )
+        return uniq_gids[inverse.ravel()].astype(np.int32, copy=False)
 
     def key_arrays(self) -> list[np.ndarray]:
         """Per key column, the values in gid order (int arrays; string key
-        columns come back as their dictionary codes)."""
+        columns come back as their dictionary codes). Columns materialize
+        individually so mixed key dtypes keep full width."""
         if not self._key_rows:
             return []
-        mat = np.asarray(self._key_rows)
-        return [mat[:, i] for i in range(mat.shape[1])]
+        ncols = len(self._key_rows[0])
+        return [
+            np.asarray([r[i] for r in self._key_rows])
+            for i in range(ncols)
+        ]
 
     def reset(self) -> None:
         self._gids.clear()

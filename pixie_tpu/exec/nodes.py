@@ -125,6 +125,8 @@ class BridgeSourceNode(SourceNode):
         return True
 
     def has_batches_remaining(self) -> bool:
+        if self._aborted:
+            return False
         return self._upstream_eos < self._expected_producers
 
 

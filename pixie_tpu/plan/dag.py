@@ -1,0 +1,34 @@
+"""Shared DAG traversal helpers used by PlanFragment and the compiler IR.
+
+Edges are expressed as a parents map {node_id: [parent_ids]} — parent ids may
+repeat (a self-join lists one parent twice; each occurrence is a distinct
+dataflow edge).
+"""
+
+from __future__ import annotations
+
+
+def children_of(parents: dict[int, list[int]], nid: int) -> list[int]:
+    """Child ids with multiplicity (one entry per edge)."""
+    out: list[int] = []
+    for n, ps in parents.items():
+        out.extend(n for p in ps if p == nid)
+    return out
+
+
+def topo_order(parents: dict[int, list[int]]) -> list[int]:
+    """Parents-before-children order; raises on cycles."""
+    indeg = {n: len(ps) for n, ps in parents.items()}
+    ready = sorted(n for n, d in indeg.items() if d == 0)
+    out: list[int] = []
+    while ready:
+        nid = ready.pop(0)
+        out.append(nid)
+        for c in children_of(parents, nid):  # duplicates decrement per edge
+            indeg[c] -= 1
+            if indeg[c] == 0:
+                ready.append(c)
+        ready.sort()
+    if len(out) != len(parents):
+        raise ValueError("operator graph has a cycle")
+    return out

@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Iterable, Optional
 
+from pixie_tpu.plan import dag
 from pixie_tpu.plan.operators import (
     AggOp,
     AggStage,
@@ -61,10 +62,10 @@ class PlanFragment:
     def children(self, nid: int) -> list[int]:
         """Child node ids, with multiplicity (a self-join lists its single
         parent twice; each occurrence is a distinct dataflow edge)."""
-        out = []
-        for n in self._nodes.values():
-            out.extend(n.nid for p in n.parents if p == nid)
-        return out
+        return dag.children_of(self._parents_map(), nid)
+
+    def _parents_map(self) -> dict[int, list[int]]:
+        return {n.nid: n.parents for n in self._nodes.values()}
 
     def nodes(self) -> list[int]:
         return list(self._nodes)
@@ -78,20 +79,7 @@ class PlanFragment:
 
     def topo_order(self) -> list[int]:
         """Parents-before-children order (ref: PlanFragmentWalker)."""
-        indeg = {nid: len(n.parents) for nid, n in self._nodes.items()}
-        ready = sorted(nid for nid, d in indeg.items() if d == 0)
-        out: list[int] = []
-        while ready:
-            nid = ready.pop(0)
-            out.append(nid)
-            for c in self.children(nid):  # duplicates decrement per edge
-                indeg[c] -= 1
-                if indeg[c] == 0:
-                    ready.append(c)
-            ready.sort()
-        if len(out) != len(self._nodes):
-            raise ValueError("plan fragment has a cycle")
-        return out
+        return dag.topo_order(self._parents_map())
 
     def walk(self, fn: Callable[[int, Operator], None]) -> None:
         for nid in self.topo_order():

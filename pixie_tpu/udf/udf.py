@@ -113,8 +113,9 @@ class UDA:
 class UDTF:
     """User-defined table function (ref: udtf.h) — produces a table.
 
-    ``fn(ctx, **args) -> (Relation, dict of columns)``. Used for
-    introspection sources like GetAgentStatus (vizier/funcs/md_udtfs).
+    ``output_relation`` declares the produced schema; ``fn(ctx, **args)``
+    returns a name->values dict matching it. Used for introspection sources
+    like GetAgentStatus (vizier/funcs/md_udtfs).
     """
 
     name: str

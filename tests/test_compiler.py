@@ -1,0 +1,222 @@
+"""PxL compiler tests.
+
+Modeled on the reference's compiler tests (src/carnot/planner/compiler/
+compiler_test.cc, ast_visitor_test.cc) — PxL in, checked IR/plan out.
+"""
+
+import pytest
+
+from pixie_tpu.compiler import Compiler, CompilerError
+from pixie_tpu.plan.operators import (
+    AggOp,
+    FilterOp,
+    JoinOp,
+    LimitOp,
+    MapOp,
+    MemorySourceOp,
+    ResultSinkOp,
+    UnionOp,
+)
+from pixie_tpu.types import DataType, Relation, SemanticType
+
+F, I, S, B, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.BOOLEAN,
+    DataType.TIME64NS,
+)
+
+TABLES = {
+    "http_events": Relation.of(
+        ("time_", T, SemanticType.ST_TIME_NS),
+        ("upid", S, SemanticType.ST_UPID),
+        ("req_path", S),
+        ("req_method", S),
+        ("resp_status", I),
+        ("resp_latency_ns", I, SemanticType.ST_DURATION_NS),
+    ),
+    "conn_stats": Relation.of(
+        ("time_", T),
+        ("upid", S, SemanticType.ST_UPID),
+        ("remote_addr", S),
+        ("bytes_sent", I),
+        ("bytes_recv", I),
+    ),
+}
+
+NOW = 10**18
+
+
+def compile_ops(query, **kw):
+    plan = Compiler().compile(query, TABLES, now_ns=NOW, **kw)
+    (frag,) = plan.fragments
+    return frag, [type(frag.node(n)) for n in frag.topo_order()]
+
+
+def test_source_display():
+    frag, ops = compile_ops(
+        "import px\n"
+        "df = px.DataFrame(table='http_events', start_time='-5m')\n"
+        "px.display(df, 'out')\n"
+    )
+    assert ops == [MemorySourceOp, ResultSinkOp]
+    src = frag.node(frag.topo_order()[0])
+    assert src.start_time == NOW - 5 * 60 * 10**9
+
+
+def test_filter_map_limit():
+    frag, ops = compile_ops(
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[df.resp_status >= 400]\n"
+        "df.latency_ms = df.resp_latency_ns / 1000000\n"
+        "df = df.head(10)\n"
+        "px.display(df)\n"
+    )
+    assert ops == [MemorySourceOp, FilterOp, MapOp, LimitOp, ResultSinkOp]
+
+
+def test_map_merge_collapses_assignments():
+    frag, ops = compile_ops(
+        "df = px.DataFrame(table='http_events')\n"
+        "df.a = df.resp_latency_ns / 1000\n"
+        "df.b = df.a / 1000\n"
+        "df.c = df.b + 1\n"
+        "px.display(df)\n"
+    )
+    # Three chained assignments collapse into ONE Map.
+    assert ops == [MemorySourceOp, MapOp, ResultSinkOp]
+
+
+def test_column_pruning_narrows_source():
+    frag, ops = compile_ops(
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[['req_path', 'resp_status']]\n"
+        "px.display(df)\n"
+    )
+    src = frag.node(frag.topo_order()[0])
+    assert set(src.column_names) == {"req_path", "resp_status"}
+
+
+def test_groupby_agg():
+    frag, ops = compile_ops(
+        "df = px.DataFrame(table='http_events', start_time='-5m')\n"
+        "df.failure = df.resp_status >= 400\n"
+        "stats = df.groupby(['req_path']).agg(\n"
+        "    error_rate=('failure', px.mean),\n"
+        "    p=('resp_latency_ns', px.quantiles),\n"
+        "    n=('resp_latency_ns', px.count),\n"
+        ")\n"
+        "px.display(stats, 'stats')\n"
+    )
+    assert ops == [MemorySourceOp, MapOp, AggOp, ResultSinkOp]
+    agg = next(frag.node(n) for n in frag.nodes() if isinstance(frag.node(n), AggOp))
+    assert agg.groups == ("req_path",)
+    assert [v[0] for v in agg.values] == ["error_rate", "p", "n"]
+
+
+def test_ctx_metadata_resolution():
+    frag, ops = compile_ops(
+        "df = px.DataFrame(table='http_events')\n"
+        "df.service = df.ctx['service']\n"
+        "per_svc = df.groupby(['service']).agg(n=('time_', px.count))\n"
+        "px.display(per_svc)\n"
+    )
+    assert ops == [MemorySourceOp, MapOp, AggOp, ResultSinkOp]
+    m = next(frag.node(n) for n in frag.nodes() if isinstance(frag.node(n), MapOp))
+    svc_expr = dict(m.exprs)["service"]
+    assert svc_expr.name == "upid_to_service_name"
+
+
+def test_ctx_requires_upid():
+    with pytest.raises(CompilerError, match="UPID"):
+        compile_ops(
+            "df = px.DataFrame(table='http_events')\n"
+            "df = df[['req_path']]\n"
+            "df.service = df.ctx['service']\n"
+            "px.display(df)\n"
+        )
+
+
+def test_merge():
+    frag, ops = compile_ops(
+        "a = px.DataFrame(table='http_events')\n"
+        "b = px.DataFrame(table='conn_stats')\n"
+        "j = a.merge(b, how='inner', left_on='upid', right_on='upid',"
+        " suffixes=['', '_conn'])\n"
+        "px.display(j)\n"
+    )
+    assert JoinOp in ops
+    j = next(frag.node(n) for n in frag.nodes() if isinstance(frag.node(n), JoinOp))
+    out_names = [o[2] for o in j.output_columns]
+    assert "upid" in out_names and "upid_conn" in out_names
+    assert "time__conn" in out_names
+
+
+def test_append_union():
+    frag, ops = compile_ops(
+        "a = px.DataFrame(table='http_events')\n"
+        "b = px.DataFrame(table='http_events')\n"
+        "px.display(a.append(b))\n"
+    )
+    assert UnionOp in ops
+
+
+def test_user_function():
+    frag, ops = compile_ops(
+        "def add_latency(df):\n"
+        "    df.ms = df.resp_latency_ns / 1000000\n"
+        "    return df\n"
+        "df = add_latency(px.DataFrame(table='http_events'))\n"
+        "px.display(df)\n"
+    )
+    assert MapOp in ops
+
+
+def test_script_args():
+    frag, _ = compile_ops(
+        "df = px.DataFrame(table='http_events', start_time=start)\n"
+        "px.display(df)\n",
+        script_args={"start": "-1h"},
+    )
+    src = frag.node(frag.topo_order()[0])
+    assert src.start_time == NOW - 3600 * 10**9
+
+
+def test_errors_carry_line_numbers():
+    with pytest.raises(CompilerError, match="line 2"):
+        compile_ops(
+            "df = px.DataFrame(table='http_events')\n"
+            "df = df[df.nope == 1]\n"
+            "px.display(df)\n"
+        )
+
+
+def test_unknown_table():
+    with pytest.raises(CompilerError, match="no_such"):
+        compile_ops("px.display(px.DataFrame(table='no_such'))\n")
+
+
+def test_no_display_errors():
+    with pytest.raises(CompilerError, match="display"):
+        compile_ops("df = px.DataFrame(table='http_events')\n")
+
+
+def test_string_funcs_and_conditionals():
+    frag, ops = compile_ops(
+        "df = px.DataFrame(table='http_events')\n"
+        "df.path = px.substring(df.req_path, 0, 4)\n"
+        "df.ok = px.select(df.resp_status < 400, 'ok', 'err')\n"
+        "px.display(df)\n"
+    )
+    assert MapOp in ops
+
+
+def test_dead_code_pruned():
+    frag, ops = compile_ops(
+        "df = px.DataFrame(table='http_events')\n"
+        "unused = px.DataFrame(table='conn_stats')\n"
+        "unused2 = unused.groupby(['upid']).agg(n=('time_', px.count))\n"
+        "px.display(df, 'out')\n"
+    )
+    assert ops == [MemorySourceOp, ResultSinkOp]

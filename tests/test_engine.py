@@ -1,0 +1,156 @@
+"""Whole-engine tests: PxL in → compile → exec → result tables out.
+
+Modeled on src/carnot/carnot_test.cc — the reference's in-process
+integration tests against a seeded TableStore (CarnotTestUtils)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pixie_tpu.engine import Carnot
+from pixie_tpu.metadata.state import (
+    MetadataState,
+    PodInfo,
+    ServiceInfo,
+)
+from pixie_tpu.types import DataType, Relation, SemanticType
+
+F, I, S, B, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.BOOLEAN,
+    DataType.TIME64NS,
+)
+
+
+def make_metadata():
+    pods = {
+        "pod-1": PodInfo("pod-1", "px/frontend-abc", "px", "svc-1", "node-a", "10.0.0.1"),
+        "pod-2": PodInfo("pod-2", "px/backend-def", "px", "svc-2", "node-b", "10.0.0.2"),
+    }
+    services = {
+        "svc-1": ServiceInfo("svc-1", "px/frontend", "px"),
+        "svc-2": ServiceInfo("svc-2", "px/backend", "px"),
+    }
+    upids = {"123:4:5": "pod-1", "123:6:7": "pod-2"}
+    return MetadataState(pods=pods, services=services, upid_to_pod=upids)
+
+
+@pytest.fixture
+def carnot():
+    c = Carnot(metadata_state=make_metadata())
+    rel = Relation.of(
+        ("time_", T, SemanticType.ST_TIME_NS),
+        ("upid", S, SemanticType.ST_UPID),
+        ("req_path", S),
+        ("resp_status", I),
+        ("resp_latency_ns", I, SemanticType.ST_DURATION_NS),
+    )
+    t = c.table_store.create_table("http_events", rel)
+    n = 1000
+    rng = np.random.default_rng(7)
+    t.write_pydict(
+        {
+            "time_": np.arange(n) * 10**6,
+            "upid": np.where(np.arange(n) % 2 == 0, "123:4:5", "123:6:7").astype(object),
+            "req_path": np.where(np.arange(n) % 3 == 0, "/api/a", "/api/b").astype(object),
+            "resp_status": rng.choice([200, 200, 200, 500], n),
+            "resp_latency_ns": rng.integers(10**5, 10**8, n),
+        }
+    )
+    t.stop()
+    return c
+
+
+def test_http_data_query(carnot):
+    """BASELINE config 1: filter+project (px/http_data class)."""
+    res = carnot.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[df.resp_status >= 400]\n"
+        "df.latency_ms = df.resp_latency_ns / 1000000.0\n"
+        "df = df[['time_', 'req_path', 'resp_status', 'latency_ms']]\n"
+        "px.display(df, 'http')\n"
+    )
+    rows = res.table("http")
+    assert rows and all(s >= 400 for s in rows["resp_status"])
+    assert max(rows["latency_ms"]) <= 100.0
+
+
+def test_service_stats_query(carnot):
+    """BASELINE config 2: groupby(service) quantiles + error rate
+    (px/service_stats class; ref script service_stats.pxl:303-327)."""
+    res = carnot.execute_query(
+        "df = px.DataFrame(table='http_events', start_time='-1h')\n"
+        "df.service = df.ctx['service']\n"
+        "df.failure = df.resp_status >= 400\n"
+        "df.latency = df.resp_latency_ns / 1.0\n"
+        "per_svc = df.groupby(['service']).agg(\n"
+        "    latency=('latency', px.quantiles),\n"
+        "    error_rate=('failure', px.mean),\n"
+        "    throughput=('time_', px.count),\n"
+        ")\n"
+        "px.display(per_svc, 'service_stats')\n",
+        now_ns=10**9 * 3600,
+        analyze=True,
+    )
+    rows = res.table("service_stats")
+    assert sorted(rows["service"]) == ["px/backend", "px/frontend"]
+    assert sum(rows["throughput"]) == 1000
+    for q in rows["latency"]:
+        parsed = json.loads(q)
+        assert parsed["p50"] <= parsed["p99"]
+    for e in rows["error_rate"]:
+        assert 0.1 < e < 0.5
+    assert res.exec_stats  # analyze mode captured per-node stats
+
+
+def test_distinct_and_count_min(carnot):
+    """BASELINE config 3 flavor: HLL distinct (net-new UDA)."""
+    res = carnot.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "agg = df.groupby(['req_path']).agg(\n"
+        "    distinct_upids=('upid', px.approx_count_distinct),\n"
+        ")\n"
+        "px.display(agg)\n"
+    )
+    rows = res.table()
+    assert all(d == 2 for d in rows["distinct_upids"])
+
+
+def test_join_query(carnot):
+    t = carnot.table_store.create_table(
+        "owners", Relation.of(("req_path", S), ("team", S))
+    )
+    t.write_pydict({"req_path": ["/api/a"], "team": ["team-a"]})
+    t.stop()
+    res = carnot.execute_query(
+        "own = px.DataFrame(table='owners')\n"
+        "df = px.DataFrame(table='http_events')\n"
+        "j = own.merge(df, how='inner', left_on='req_path',"
+        " right_on='req_path', suffixes=['', '_r'])\n"
+        "agg = j.groupby(['team']).agg(n=('time__r' if False else 'resp_status', px.count))\n"
+        "px.display(agg)\n"
+    )
+    rows = res.table()
+    assert rows["team"] == ["team-a"]
+    assert rows["n"][0] == 334  # every 3rd row is /api/a
+
+
+def test_time_bounds(carnot):
+    res = carnot.execute_query(
+        "df = px.DataFrame(table='http_events', start_time='-1s', end_time='0s')\n"
+        "agg = df.agg(n=('time_', px.count))\n"
+        "px.display(agg)\n",
+        now_ns=10**6 * 500,  # halfway through the data
+    )
+    # rows 0..500 are within [now-1s, now]
+    assert res.table()["n"][0] == 501
+
+
+def test_compile_error_surfaces(carnot):
+    from pixie_tpu.compiler import CompilerError
+
+    with pytest.raises(CompilerError):
+        carnot.execute_query("px.display(px.DataFrame(table='nope'))\n")
