@@ -258,8 +258,15 @@ class ASTVisitor:
                 self._eval(node.comparators[0], scope),
             )
         if isinstance(node, ast.BoolOp):
-            fname = "__and__" if isinstance(node.op, ast.And) else "__or__"
             vals = [self._eval(v, scope) for v in node.values]
+            if not any(isinstance(v, ColumnExpr) for v in vals):
+                # Plain compile-time values keep Python truthiness
+                # semantics (short-circuit value, not bitwise).
+                out = vals[0]
+                for v in vals[1:]:
+                    out = (out and v) if isinstance(node.op, ast.And) else (out or v)
+                return out
+            fname = "__and__" if isinstance(node.op, ast.And) else "__or__"
             out = vals[0]
             for v in vals[1:]:
                 out = _apply_binop(out, fname, v)

@@ -15,8 +15,8 @@ from typing import Optional
 
 from pixie_tpu.compiler import Compiler
 from pixie_tpu.exec import BridgeRouter, ExecState, ExecutionGraph
-from pixie_tpu.plan.operators import BridgeSinkOp
-from pixie_tpu.plan.plan import Plan
+from pixie_tpu.plan.operators import BridgeSinkOp, InlineSourceOp
+from pixie_tpu.plan.plan import Plan, PlanFragment
 from pixie_tpu.table.row_batch import RowBatch
 from pixie_tpu.table.table_store import TableStore
 
@@ -42,6 +42,30 @@ class QueryResult:
         return RowBatch.concat(batches).to_pydict()
 
 
+def _splice_inline_source(
+    fragment: PlanFragment, agg_nid: int, key: str, batch
+) -> PlanFragment:
+    """Replace the device-executed prefix (agg + its ancestors) with an
+    InlineSource emitting the computed aggregate, keeping the suffix."""
+    ancestors = set()
+    stack = list(fragment.parents(agg_nid))
+    while stack:
+        p = stack.pop()
+        if p not in ancestors:
+            ancestors.add(p)
+            stack.extend(fragment.parents(p))
+    new = PlanFragment(fragment.fragment_id)
+    mapping: dict[int, int] = {}
+    mapping[agg_nid] = new.add(InlineSourceOp(key=key, relation=batch.relation))
+    for nid in fragment.topo_order():
+        if nid == agg_nid or nid in ancestors:
+            continue
+        mapping[nid] = new.add(
+            fragment.node(nid), [mapping[p] for p in fragment.parents(nid)]
+        )
+    return new
+
+
 class Carnot:
     """One engine instance (a PEM or Kelvin equivalent runs one of these)."""
 
@@ -52,6 +76,7 @@ class Carnot:
         metadata_state=None,
         router: Optional[BridgeRouter] = None,
         instance: str = "local",
+        device_executor=None,
     ):
         self.table_store = table_store or TableStore()
         if registry is None:
@@ -62,6 +87,10 @@ class Carnot:
         self.metadata_state = metadata_state
         self.router = router or BridgeRouter()
         self.instance = instance
+        # Optional pixie_tpu.parallel.MeshExecutor: fragments matching the
+        # hot source→map/filter→agg chain run as ONE compiled shard_map
+        # program on the device mesh; the host exec graph runs the suffix.
+        self.device_executor = device_executor
         self.compiler = Compiler(registry)
 
     # -- the two entry points (carnot.h:72-81) ------------------------------
@@ -117,6 +146,15 @@ class Carnot:
                     result_callback=on_result,
                     instance=self.instance,
                 )
+                if self.device_executor is not None:
+                    offloaded = self.device_executor.try_execute_fragment(
+                        frag, self.table_store, self.registry, state.func_ctx
+                    )
+                    if offloaded is not None:
+                        agg_nid, batch = offloaded
+                        key = f"device:{frag.fragment_id}:{agg_nid}"
+                        state.inline_batches[key] = [batch]
+                        frag = _splice_inline_source(frag, agg_nid, key, batch)
                 graph = ExecutionGraph(frag, state)
                 graph.execute()
                 if analyze:

@@ -22,6 +22,7 @@ from pixie_tpu.exec.nodes import (
     BridgeSourceNode,
     EmptySourceNode,
     FilterNode,
+    InlineSourceNode,
     LimitNode,
     MapNode,
     MemorySinkNode,
@@ -36,6 +37,7 @@ from pixie_tpu.plan.operators import (
     BridgeSourceOp,
     EmptySourceOp,
     FilterOp,
+    InlineSourceOp,
     JoinOp,
     LimitOp,
     MapOp,
@@ -53,6 +55,7 @@ DEFAULT_TIMEOUT_S = 30.0
 
 _NODE_TYPES = {
     MemorySourceOp: MemorySourceNode,
+    InlineSourceOp: InlineSourceNode,
     EmptySourceOp: EmptySourceNode,
     UDTFSourceOp: UDTFSourceNode,
     BridgeSourceOp: BridgeSourceNode,

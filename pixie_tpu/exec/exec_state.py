@@ -45,6 +45,9 @@ class ExecState:
         # RPCs. TPU compute goes exclusively through the compiled/staged
         # pipeline (pixie_tpu.parallel), one jit program per query.
         self.compute_backend = compute_backend
+        # Batches substituted by another executor (device pipeline results),
+        # keyed by InlineSourceOp.key.
+        self.inline_batches: dict[str, list] = {}
         self._keep_running = True
 
     def compute_device(self):

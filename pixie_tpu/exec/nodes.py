@@ -18,6 +18,7 @@ from pixie_tpu.plan.operators import (
     BridgeSourceOp,
     EmptySourceOp,
     FilterOp,
+    InlineSourceOp,
     LimitOp,
     MapOp,
     MemorySinkOp,
@@ -90,6 +91,23 @@ class UDTFSourceNode(SourceNode):
         data = udtf.fn(exec_state.func_ctx, **dict(self.op.arg_values))
         batch = RowBatch.from_pydict(self.output_relation, data)
         self.send(exec_state, batch.with_flags(eow=True, eos=True))
+        return True
+
+
+class InlineSourceNode(SourceNode):
+    """Emits batches stashed in exec_state.inline_batches[key]."""
+
+    def generate_next_impl(self, exec_state) -> bool:
+        if self._sent_eos:
+            return False
+        batches = exec_state.inline_batches.get(self.op.key, [])
+        for b in batches:
+            self.send(exec_state, b)
+        if not batches or not batches[-1].eos:
+            self.send(
+                exec_state,
+                RowBatch.with_zero_rows(self.output_relation, eow=True, eos=True),
+            )
         return True
 
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import math
 
+import jax
 import jax.numpy as jnp
 
 from pixie_tpu.ops import segment
@@ -57,7 +58,22 @@ def bin_index(values, spec: LogHistogramSpec = DEFAULT_SPEC):
 
 def update(state, gids, values, mask=None, spec: LogHistogramSpec = DEFAULT_SPEC):
     num_groups, nbins = state.shape
-    flat = segment.flat_segment_ids(gids, bin_index(values, spec), nbins)
+    bins = bin_index(values, spec)
+    if segment.matmul_strategy(num_groups):
+        # Two-level one-hot matmul: [n,G].T @ [n,NBINS] on the MXU — ~2.7x
+        # the scatter path on v5e (bf16 one-hots are exact 0/1; f32
+        # accumulation exact below 2^24 rows per call, blocks are 2^17).
+        import jax.numpy as jnp
+
+        ohg = jax.nn.one_hot(gids, num_groups, dtype=jnp.bfloat16)
+        if mask is not None:
+            ohg = ohg * mask[:, None].astype(jnp.bfloat16)
+        ohb = jax.nn.one_hot(bins, nbins, dtype=jnp.bfloat16)
+        counts = jnp.matmul(
+            ohg.T, ohb, preferred_element_type=jnp.float32
+        )
+        return state + jnp.round(counts).astype(state.dtype)
+    flat = segment.flat_segment_ids(gids, bins, nbins)
     counts = segment.seg_count(flat, num_groups * nbins, mask)
     return state + counts.reshape(num_groups, nbins)
 

@@ -1,0 +1,15 @@
+"""Distributed / device-mesh execution.
+
+Ref: src/carnot/planner/distributed/ (splitter, partial-agg rewrite,
+coordinator) and the PEM→Kelvin gRPC data plane it drives. TPU-native
+redesign per SURVEY.md §2.6: the data-parallel scatter-gather becomes a
+shard_map program over a jax Mesh — each device aggregates its shard of
+staged blocks (the PEM role), and the Kelvin merge step becomes XLA
+collectives over ICI (psum/pmax/pmin for elementwise UDA states, all_gather
++ tree fold for order-insensitive sketches like t-digest).
+"""
+
+from pixie_tpu.parallel.pipeline import MeshExecutor
+from pixie_tpu.parallel.staging import StagedColumns, stage_columns
+
+__all__ = ["MeshExecutor", "StagedColumns", "stage_columns"]

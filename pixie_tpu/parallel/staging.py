@@ -1,0 +1,128 @@
+"""Host→HBM staging: table columns → device-sharded padded blocks.
+
+The TPU analogue of the reference's per-PEM data locality: every device owns
+a contiguous shard of the table's rows ([D, nblk, B] layout, sharded on the
+leading device axis), padded to static shapes with a validity mask — XLA
+requires static shapes, and padding+mask is how streaming row counts meet
+that constraint (SURVEY.md §7 "Streaming/windowed execution vs XLA's static
+shapes").
+
+Strings never ship to HBM: their int32 dictionary codes do (table/column.py
+write-side encoding), and group keys densify to gids host-side before
+staging (ops/segment.py's contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pixie_tpu.table.column import DictColumn
+from pixie_tpu.table.table import Table
+
+DEFAULT_BLOCK_ROWS = 1 << 17
+
+
+@dataclasses.dataclass
+class StagedColumns:
+    """Columns resident on the mesh + the host-side key bookkeeping."""
+
+    blocks: dict[str, jax.Array]  # name -> [D, nblk, B], device-sharded
+    mask: jax.Array  # [D, nblk, B] bool, False on padding
+    gids: Optional[jax.Array]  # [D, nblk, B] int32 (None: no grouping)
+    num_rows: int
+    num_devices: int
+    block_rows: int
+    num_groups: int
+    capacity: int  # padded static group capacity (pow2)
+    key_columns: list  # per group col: np.ndarray or DictColumn, gid order
+    dictionaries: dict  # col name -> StringDictionary (for aux/LUT building)
+
+
+def _pow2_at_least(n: int, floor: int = 8) -> int:
+    c = floor
+    while c < n:
+        c <<= 1
+    return c
+
+
+def read_columns(
+    table: Table,
+    columns: list[str],
+    start_time: Optional[int] = None,
+    stop_time: Optional[int] = None,
+) -> tuple[dict[str, np.ndarray], int]:
+    """Materialize needed columns via a cursor (host side). String columns
+    come back as their int32 code arrays."""
+    batches = []
+    cur = table.cursor(start_time, stop_time)
+    while not cur.done():
+        b = cur.next_batch()
+        if b is None:
+            break
+        if b.num_rows:
+            batches.append(b)
+    cols: dict[str, np.ndarray] = {}
+    n = sum(b.num_rows for b in batches)
+    for name in columns:
+        parts = []
+        for b in batches:
+            c = b.col(name)
+            parts.append(c.codes if isinstance(c, DictColumn) else np.asarray(c))
+        cols[name] = (
+            np.concatenate(parts) if parts
+            else np.empty(0, np.int32)
+        )
+    return cols, n
+
+
+def stage_columns(
+    mesh: Mesh,
+    cols: dict[str, np.ndarray],
+    num_rows: int,
+    gids: Optional[np.ndarray] = None,
+    num_groups: int = 1,
+    key_columns: Optional[list] = None,
+    dictionaries: Optional[dict] = None,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+) -> StagedColumns:
+    """Pad/reshape host columns into [D, nblk, B] and shard over the mesh."""
+    (axis_name,) = mesh.axis_names
+    d = mesh.devices.size
+    b = min(block_rows, _pow2_at_least(max(num_rows // d, 1), floor=256))
+    nblk = max((num_rows + d * b - 1) // (d * b), 1)
+    total = d * nblk * b
+    sharding = NamedSharding(mesh, P(axis_name))
+
+    def shape3(arr, fill):
+        out = np.full(total, fill, dtype=arr.dtype if arr.size else np.int32)
+        out[:num_rows] = arr
+        return out.reshape(d, nblk, b)
+
+    mask = np.zeros(total, dtype=bool)
+    mask[:num_rows] = True
+    blocks = {
+        name: jax.device_put(shape3(a, 0), sharding) for name, a in cols.items()
+    }
+    mask_dev = jax.device_put(mask.reshape(d, nblk, b), sharding)
+    gids_dev = (
+        jax.device_put(shape3(gids.astype(np.int32), 0), sharding)
+        if gids is not None
+        else None
+    )
+    return StagedColumns(
+        blocks=blocks,
+        mask=mask_dev,
+        gids=gids_dev,
+        num_rows=num_rows,
+        num_devices=d,
+        block_rows=b,
+        num_groups=num_groups,
+        capacity=_pow2_at_least(max(num_groups, 1)),
+        key_columns=list(key_columns or []),
+        dictionaries=dict(dictionaries or {}),
+    )

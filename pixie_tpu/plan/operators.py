@@ -90,6 +90,19 @@ class EmptySourceOp(Operator):
 
 
 @dataclasses.dataclass(frozen=True, repr=False)
+class InlineSourceOp(Operator):
+    """Emits batches precomputed by another executor (the device pipeline
+    substitutes its aggregate output here so the remaining host suffix —
+    post-agg maps, limits, sinks — runs unchanged)."""
+
+    key: str
+    relation: Relation
+
+    def output_relation(self, inputs, registry) -> Relation:
+        return self.relation
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
 class BridgeSourceOp(Operator):
     """Receive batches from another fragment (ref: grpc_source_node.h:39)."""
 

@@ -130,7 +130,7 @@ def test_join_query(carnot):
         "df = px.DataFrame(table='http_events')\n"
         "j = own.merge(df, how='inner', left_on='req_path',"
         " right_on='req_path', suffixes=['', '_r'])\n"
-        "agg = j.groupby(['team']).agg(n=('time__r' if False else 'resp_status', px.count))\n"
+        "agg = j.groupby(['team']).agg(n=('resp_status', px.count))\n"
         "px.display(agg)\n"
     )
     rows = res.table()

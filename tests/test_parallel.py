@@ -1,0 +1,245 @@
+"""Device-mesh pipeline tests on the 8-virtual-device CPU mesh.
+
+Mirrors the reference's strategy of testing PEM/Kelvin distribution without
+a cluster (SURVEY.md §4): the shard_map program runs over 8 virtual devices,
+with results checked against the host exec-graph path and numpy truth.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from pixie_tpu.engine import Carnot
+from pixie_tpu.metadata.state import MetadataState, PodInfo, ServiceInfo
+from pixie_tpu.parallel import MeshExecutor
+from pixie_tpu.types import DataType, Relation, SemanticType
+
+F, I, S, B, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.BOOLEAN,
+    DataType.TIME64NS,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.array(jax.devices("cpu"))
+    assert devs.size == 8, "conftest must provide 8 virtual devices"
+    return Mesh(devs, ("d",))
+
+
+def seed_carnot(device_executor=None, n=10_000):
+    md = MetadataState(
+        pods={
+            "p1": PodInfo("p1", "px/web", "px", "s1", "n1", "10.0.0.1"),
+            "p2": PodInfo("p2", "px/db", "px", "s2", "n2", "10.0.0.2"),
+        },
+        services={
+            "s1": ServiceInfo("s1", "px/web", "px"),
+            "s2": ServiceInfo("s2", "px/db", "px"),
+        },
+        upid_to_pod={"1:1:1": "p1", "2:2:2": "p2"},
+    )
+    c = Carnot(metadata_state=md, device_executor=device_executor)
+    rel = Relation.of(
+        ("time_", T, SemanticType.ST_TIME_NS),
+        ("upid", S, SemanticType.ST_UPID),
+        ("service", S),
+        ("resp_status", I),
+        ("latency", F),
+    )
+    t = c.table_store.create_table("http_events", rel)
+    rng = np.random.default_rng(11)
+    data = {
+        "time_": np.arange(n) * 10**6,
+        "upid": rng.choice(["1:1:1", "2:2:2"], n).astype(object),
+        "service": rng.choice(["a", "b", "c"], n, p=[0.5, 0.3, 0.2]).astype(object),
+        "resp_status": rng.choice([200, 400, 500], n, p=[0.8, 0.1, 0.1]),
+        "latency": rng.exponential(30.0, n),
+    }
+    for off in range(0, n, 2048):
+        t.write_pydict({k: v[off : off + 2048] for k, v in data.items()})
+    t.compact()
+    t.stop()
+    return c, data
+
+
+SERVICE_STATS_PXL = (
+    "df = px.DataFrame(table='http_events')\n"
+    "df.failure = df.resp_status >= 400\n"
+    "stats = df.groupby(['service']).agg(\n"
+    "    total=('latency', px.sum),\n"
+    "    n=('time_', px.count),\n"
+    "    err=('failure', px.mean),\n"
+    "    hi=('latency', px.max),\n"
+    "    q=('latency', px.quantiles),\n"
+    ")\n"
+    "px.display(stats, 'out')\n"
+)
+
+
+def test_mesh_agg_matches_host_and_truth(mesh):
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    ch, _ = seed_carnot(None)
+    res_d = cd.execute_query(SERVICE_STATS_PXL)
+    res_h = ch.execute_query(SERVICE_STATS_PXL)
+    rows_d = res_d.table("out")
+    rows_h = res_h.table("out")
+    dd = {s: i for i, s in enumerate(rows_d["service"])}
+    hh = {s: i for i, s in enumerate(rows_h["service"])}
+    assert set(dd) == set(hh) == {"a", "b", "c"}
+    for svc in "abc":
+        mask = data["service"] == svc
+        assert rows_d["n"][dd[svc]] == rows_h["n"][hh[svc]] == int(mask.sum())
+        assert rows_d["total"][dd[svc]] == pytest.approx(
+            float(data["latency"][mask].sum()), rel=1e-9
+        )
+        assert rows_d["err"][dd[svc]] == pytest.approx(
+            float((data["resp_status"][mask] >= 400).mean()), rel=1e-9
+        )
+        assert rows_d["hi"][dd[svc]] == pytest.approx(
+            float(data["latency"][mask].max()), rel=1e-12
+        )
+        qd = json.loads(rows_d["q"][dd[svc]])
+        true_p50 = float(np.quantile(data["latency"][mask], 0.5))
+        assert qd["p50"] == pytest.approx(true_p50, rel=0.05)
+
+
+def test_mesh_filter_fused(mesh):
+    """Filters fuse into the device program as mask updates."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    res = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[df.resp_status >= 400]\n"
+        "df = df[df.service == 'a']\n"
+        "agg = df.agg(n=('time_', px.count), total=('latency', px.sum))\n"
+        "px.display(agg, 'out')\n"
+    )
+    rows = res.table("out")
+    mask = (data["resp_status"] >= 400) & (data["service"] == "a")
+    assert rows["n"] == [int(mask.sum())]
+    assert rows["total"][0] == pytest.approx(float(data["latency"][mask].sum()))
+
+
+def test_mesh_metadata_key_via_lut(mesh):
+    """ctx['service'] group key goes through the dictionary LUT on device."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    res = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "df.svc = df.ctx['service']\n"
+        "agg = df.groupby(['svc']).agg(n=('time_', px.count))\n"
+        "px.display(agg, 'out')\n"
+    )
+    rows = res.table("out")
+    by = dict(zip(rows["svc"], rows["n"]))
+    assert by["px/web"] == int((data["upid"] == "1:1:1").sum())
+    assert by["px/db"] == int((data["upid"] == "2:2:2").sum())
+
+
+def test_mesh_post_agg_suffix_runs_on_host(mesh):
+    """Post-agg maps (pluck) run in the host suffix after the splice."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    res = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "stats = df.groupby(['service']).agg(q=('latency', px.quantiles))\n"
+        "stats.p50 = px.pluck_float64(stats.q, 'p50')\n"
+        "stats = stats.drop(['q'])\n"
+        "px.display(stats, 'out')\n"
+    )
+    rows = res.table("out")
+    assert set(rows.keys()) == {"service", "p50"}
+    for svc, p50 in zip(rows["service"], rows["p50"]):
+        true = float(np.quantile(data["latency"][data["service"] == svc], 0.5))
+        assert p50 == pytest.approx(true, rel=0.05)
+
+
+def test_mesh_multikey_host_gids(mesh):
+    """Multi-column group keys fall back to host densification."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    res = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "agg = df.groupby(['service', 'resp_status']).agg(n=('time_', px.count))\n"
+        "px.display(agg, 'out')\n"
+    )
+    rows = res.table("out")
+    got = {
+        (s, int(st)): n
+        for s, st, n in zip(rows["service"], rows["resp_status"], rows["n"])
+    }
+    for (s, st), n in got.items():
+        true = int(((data["service"] == s) & (data["resp_status"] == st)).sum())
+        assert n == true
+    assert sum(got.values()) == len(data["service"])
+
+
+def test_mesh_no_phantom_groups(mesh):
+    """Groups whose rows are all filtered out must not appear (host-engine
+    semantics; the device path uses an implicit presence counter)."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    res = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[df.service == 'a']\n"
+        "agg = df.groupby(['service']).agg(n=('time_', px.count))\n"
+        "px.display(agg, 'out')\n"
+    )
+    rows = res.table("out")
+    assert rows["service"] == ["a"]
+    assert rows["n"] == [int((data["service"] == "a").sum())]
+
+
+def test_mesh_shared_source_falls_back(mesh):
+    """A source feeding another branch cannot be spliced out — the query
+    falls back to the host engine instead of crashing."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    res = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "px.display(df[['time_']], 'raw')\n"
+        "px.display(df.groupby(['service']).agg(n=('time_', px.count)), 'stats')\n"
+    )
+    assert sum(res.table("stats")["n"]) == len(data["service"])
+    assert len(res.table("raw")["time_"]) == len(data["service"])
+
+
+def test_mesh_staged_cache_respects_groupby(mesh):
+    """Two queries with different group keys over the same table version
+    must not share staged gids."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    r1 = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "agg = df.groupby(['service', 'resp_status']).agg(n=('time_', px.count))\n"
+        "px.display(agg, 'o')\n"
+    )
+    r2 = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "agg = df.groupby(['resp_status', 'service']).agg(n=('time_', px.count))\n"
+        "px.display(agg, 'o')\n"
+    )
+    g1 = {
+        (s, int(st)): n
+        for s, st, n in zip(
+            r1.table("o")["service"], r1.table("o")["resp_status"], r1.table("o")["n"]
+        )
+    }
+    g2 = {
+        (s, int(st)): n
+        for st, s, n in zip(
+            r2.table("o")["resp_status"], r2.table("o")["service"], r2.table("o")["n"]
+        )
+    }
+    assert g1 == g2
+
+
+def test_mesh_hll_pmax_merge(mesh):
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    res = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "agg = df.groupby(['service']).agg(u=('upid', px.approx_count_distinct))\n"
+        "px.display(agg, 'out')\n"
+    )
+    rows = res.table("out")
+    assert all(u == 2 for u in rows["u"])
