@@ -149,11 +149,16 @@ class ExecutionGraph:
 
         import jax
 
+        from pixie_tpu.ops import segment
+
         st = self.exec_state
         dev = st.compute_device()
         ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
         order = self.fragment.topo_order()
-        with ctx:
+        hint = segment.platform_hint(
+            dev.platform if dev is not None else None
+        )
+        with ctx, hint:
             for nid in order:
                 self.nodes[nid].prepare(st)
             for nid in order:

@@ -21,23 +21,43 @@ import jax.numpy as jnp
 # >2B rows/s for small segment counts. CPU prefers scatter. Tests can pin a
 # strategy via set_strategy().
 _FORCE: Optional[str] = None
+_PLATFORM_HINT: Optional[str] = None
 MATMUL_MAX_SEGMENTS = 128
 
 
 def set_strategy(s: Optional[str]) -> None:
-    """Force 'matmul' or 'scatter' (None = auto by backend)."""
+    """Force 'matmul' or 'scatter' (None = auto by platform)."""
     global _FORCE
     assert s in (None, "matmul", "scatter")
     _FORCE = s
 
 
+class platform_hint:
+    """Context manager: pin the platform these kernels will execute on.
+    jax.default_backend() is a process-wide default that can differ from
+    the mesh/device a program is traced for (e.g. CPU exec graph on a
+    TPU-attached host), so executors set this around tracing."""
+
+    def __init__(self, platform: Optional[str]):
+        self.platform = platform
+
+    def __enter__(self):
+        global _PLATFORM_HINT
+        self._old = _PLATFORM_HINT
+        _PLATFORM_HINT = self.platform
+        return self
+
+    def __exit__(self, *exc):
+        global _PLATFORM_HINT
+        _PLATFORM_HINT = self._old
+        return False
+
+
 def _use_matmul(num_segments: int) -> bool:
     if _FORCE is not None:
         return _FORCE == "matmul"
-    return (
-        jax.default_backend() != "cpu"
-        and num_segments <= MATMUL_MAX_SEGMENTS
-    )
+    platform = _PLATFORM_HINT or jax.default_backend()
+    return platform != "cpu" and num_segments <= MATMUL_MAX_SEGMENTS
 
 
 def matmul_strategy(num_segments: int) -> bool:

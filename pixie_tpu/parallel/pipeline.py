@@ -35,6 +35,18 @@ try:  # jax>=0.4.35 exposes shard_map at top level
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+# Replication checking kwarg was renamed check_rep -> check_vma across jax
+# versions; probe the actual signature once.
+import inspect as _inspect
+
+_SM_PARAMS = _inspect.signature(shard_map).parameters
+if "check_vma" in _SM_PARAMS:
+    _SM_CHECK_KW = {"check_vma": False}
+elif "check_rep" in _SM_PARAMS:  # pragma: no cover - older jax
+    _SM_CHECK_KW = {"check_rep": False}
+else:  # pragma: no cover
+    _SM_CHECK_KW = {}
+
 from pixie_tpu.compiler.analyzer import substitute
 from pixie_tpu.exec.expression_evaluator import ExpressionEvaluator
 from pixie_tpu.exec.group_encoder import GroupEncoder
@@ -93,6 +105,8 @@ def match_fragment(fragment: PlanFragment, relations) -> Optional[_Match]:
         if len(fragment.children(cur)) != 1:
             return None  # shared with another branch: host engine's job
         if isinstance(op, MemorySourceOp):
+            if op.streaming:
+                return None  # streaming stays with the live host cursor
             source_nid = cur
             break
         if not isinstance(op, (MapOp, FilterOp)):
@@ -155,13 +169,28 @@ class MeshExecutor:
         # directly (the reference's analogue is the compacted Arrow cold
         # store living next to the CPU; ours lives next to the MXU).
         self._staged_cache: dict[tuple, Any] = {}
+        # Host-densified key plans per (table version, key exprs).
+        self._keyplan_cache: dict[tuple, Any] = {}
 
     # -- public -------------------------------------------------------------
     def try_execute_fragment(
         self, fragment: PlanFragment, table_store, registry, func_ctx=None
     ) -> Optional[tuple[int, RowBatch]]:
         """If the fragment contains the hot chain, run it on the mesh and
-        return (agg_node_id, finalized agg RowBatch); else None."""
+        return (agg_node_id, finalized agg RowBatch); else None — including
+        when any stage of device planning/tracing fails (host-untraceable
+        expressions, dictionary edge cases): offload is an optimization,
+        never a correctness cliff."""
+        try:
+            return self._try_execute_fragment(
+                fragment, table_store, registry, func_ctx
+            )
+        except Exception:
+            return None
+
+    def _try_execute_fragment(
+        self, fragment: PlanFragment, table_store, registry, func_ctx=None
+    ) -> Optional[tuple[int, RowBatch]]:
         table_rel = lambda op: table_store.get_relation(op.table_name)
         relations = fragment.resolve_relations(registry, table_rel)
         m = match_fragment(fragment, relations)
@@ -224,10 +253,12 @@ class MeshExecutor:
                 dictionaries=table.dictionaries,
                 block_rows=self.block_rows,
             )
-            # One staged version per table (old versions free their HBM).
+            # Evict only STALE versions of this table (old end_row_id):
+            # concurrent queries with different groupbys/column sets over
+            # the same version keep their HBM residency.
             for k in [
                 k for k in self._staged_cache
-                if k[0] == m.source_op.table_name
+                if k[0] == m.source_op.table_name and k[1] != table.end_row_id()
             ]:
                 del self._staged_cache[k]
             self._staged_cache[cache_key] = staged
@@ -310,8 +341,18 @@ class MeshExecutor:
                         ],
                     )
         # Generic host path: evaluate key exprs over the full columns once,
-        # then densify (ref: the reference hashes RowTuples per batch; we pay
-        # one vectorized pass).
+        # then densify (ref: the reference hashes RowTuples per batch; we
+        # pay one vectorized pass, cached per table version + key exprs).
+        kp_key = (
+            m.source_op.table_name,
+            table.end_row_id(),
+            repr([m.col_exprs[g] for g in groups]),
+            m.source_op.start_time,
+            m.source_op.stop_time,
+        )
+        cached = self._keyplan_cache.get(kp_key)
+        if cached is not None:
+            return cached
         key_refs = set()
         for g in groups:
             key_refs |= referenced_columns(m.col_exprs[g])
@@ -350,9 +391,16 @@ class MeshExecutor:
                 )
             else:
                 key_columns.append(arr)
-        return _KeyPlan(
+        kp = _KeyPlan(
             host_gids=gids, num_groups=enc.num_groups, key_columns=key_columns
         )
+        for k in [
+            k for k in self._keyplan_cache
+            if k[0] == m.source_op.table_name and k[1] != table.end_row_id()
+        ]:
+            del self._keyplan_cache[k]
+        self._keyplan_cache[kp_key] = kp
+        return kp
 
     def _dict_lut_key(self, e, table, registry, func_ctx=None):
         """String key computed by a dict_compatible host func over one string
@@ -556,7 +604,7 @@ class MeshExecutor:
                 mesh=self.mesh,
                 in_specs=in_specs,
                 out_specs=out_specs,
-                check_vma=False,
+                **_SM_CHECK_KW,
             )
         )
 
@@ -609,7 +657,12 @@ class MeshExecutor:
         if isinstance(key_plan.device_expr, tuple):
             args.append(jnp.asarray(key_plan.device_expr[2]))
         args.extend(jnp.asarray(v) for v in aux_vals)
-        fbuf, ibuf = program(*args)
+        # First call traces: pin the kernel strategy to the platform the
+        # MESH runs on (may differ from jax.default_backend()).
+        from pixie_tpu.ops import segment as _segment
+
+        with _segment.platform_hint(self.mesh.devices.flat[0].platform):
+            fbuf, ibuf = program(*args)
         return self._unpack_states(specs, staged.capacity, fbuf, ibuf)  # (states, presence)
 
     # -- finalize -----------------------------------------------------------
