@@ -1,0 +1,26 @@
+"""Distributed planner: split logical plans across agents.
+
+Ref: src/carnot/planner/distributed/ — Splitter::SplitKelvinAndAgents
+(splitter/splitter.h:52,111) cuts the operator graph at blocking operators;
+PartialOperatorMgr (partial_op_mgr/partial_op_mgr.h:36,77,94) rewrites
+blocking aggregates into per-agent partial + merge stages; Coordinator
+(coordinator/coordinator.h:47,86) assigns fragments to Carnot instances from
+DistributedState and prunes agents without the needed tables
+(prune_unavailable_sources_rule); the stitcher wires the GRPCSink→GRPCSource
+bridges (distributed_stitcher_rules).
+
+Two consumers:
+- the multi-agent host path (PEM-role Carnots + a Kelvin-role Carnot over a
+  BridgeRouter), exercised by the control plane in pixie_tpu.vizier;
+- conceptually, the device-mesh pipeline (pixie_tpu.parallel) is this same
+  split collapsed into one SPMD program — partial ≙ per-device scan, merge ≙
+  ICI collective.
+"""
+
+from pixie_tpu.distributed.planner import (
+    AgentInfo,
+    DistributedPlanner,
+    DistributedState,
+)
+
+__all__ = ["AgentInfo", "DistributedPlanner", "DistributedState"]

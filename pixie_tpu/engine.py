@@ -116,7 +116,13 @@ class Carnot:
         result.compile_time_ns = compile_ns
         return result
 
-    def execute_plan(self, plan: Plan, analyze: bool = False) -> QueryResult:
+    def execute_plan(
+        self, plan: Plan, analyze: bool = False, manage_router: bool = True
+    ) -> QueryResult:
+        """manage_router=False when a broker coordinates several engine
+        instances over one shared router: producer registration and query
+        cleanup then happen centrally (ref: the GRPCRouter is owned by the
+        receiving agent, registration by connection)."""
         qid = plan.query_id or str(uuid.uuid4())
         tables: dict[str, list[RowBatch]] = {}
 
@@ -124,11 +130,12 @@ class Carnot:
             tables.setdefault(table_name, []).append(batch)
 
         # Register bridge producers so consumers know their eos counts.
-        for frag in plan.fragments:
-            for nid in frag.nodes():
-                op = frag.node(nid)
-                if isinstance(op, BridgeSinkOp):
-                    self.router.register_producer(qid, op.bridge_id)
+        if manage_router:
+            for frag in plan.fragments:
+                for nid in frag.nodes():
+                    op = frag.node(nid)
+                    if isinstance(op, BridgeSinkOp):
+                        self.router.register_producer(qid, op.bridge_id)
 
         exec_stats: dict[str, dict] = {}
         t0 = time.perf_counter_ns()
@@ -161,7 +168,8 @@ class Carnot:
                     for name, s in graph.stats().items():
                         exec_stats[f"f{frag.fragment_id}/{name}"] = s
         finally:
-            self.router.cleanup_query(qid)
+            if manage_router:
+                self.router.cleanup_query(qid)
         exec_ns = time.perf_counter_ns() - t0
         return QueryResult(
             query_id=qid,

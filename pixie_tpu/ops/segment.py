@@ -20,8 +20,10 @@ import jax.numpy as jnp
 # units (~150M rows/s measured on v5e); a one-hot matvec rides the MXU at
 # >2B rows/s for small segment counts. CPU prefers scatter. Tests can pin a
 # strategy via set_strategy().
+import threading
+
 _FORCE: Optional[str] = None
-_PLATFORM_HINT: Optional[str] = None
+_TLS = threading.local()  # per-thread platform hint: agents run in threads
 MATMUL_MAX_SEGMENTS = 128
 
 
@@ -33,30 +35,29 @@ def set_strategy(s: Optional[str]) -> None:
 
 
 class platform_hint:
-    """Context manager: pin the platform these kernels will execute on.
-    jax.default_backend() is a process-wide default that can differ from
-    the mesh/device a program is traced for (e.g. CPU exec graph on a
-    TPU-attached host), so executors set this around tracing."""
+    """Context manager: pin the platform these kernels will execute on for
+    the CURRENT THREAD. jax.default_backend() is a process-wide default
+    that can differ from the mesh/device a program is traced for (e.g. CPU
+    exec graph on a TPU-attached host); concurrent agent threads each carry
+    their own hint."""
 
     def __init__(self, platform: Optional[str]):
         self.platform = platform
 
     def __enter__(self):
-        global _PLATFORM_HINT
-        self._old = _PLATFORM_HINT
-        _PLATFORM_HINT = self.platform
+        self._old = getattr(_TLS, "hint", None)
+        _TLS.hint = self.platform
         return self
 
     def __exit__(self, *exc):
-        global _PLATFORM_HINT
-        _PLATFORM_HINT = self._old
+        _TLS.hint = self._old
         return False
 
 
 def _use_matmul(num_segments: int) -> bool:
     if _FORCE is not None:
         return _FORCE == "matmul"
-    platform = _PLATFORM_HINT or jax.default_backend()
+    platform = getattr(_TLS, "hint", None) or jax.default_backend()
     return platform != "cpu" and num_segments <= MATMUL_MAX_SEGMENTS
 
 

@@ -168,9 +168,22 @@ class MeshExecutor:
         # table version is staged once and every matching query hits HBM
         # directly (the reference's analogue is the compacted Arrow cold
         # store living next to the CPU; ours lives next to the MXU).
-        self._staged_cache: dict[tuple, Any] = {}
-        # Host-densified key plans per (table version, key exprs).
-        self._keyplan_cache: dict[tuple, Any] = {}
+        # LRU-capped: distinct time windows/column sets each stage a full
+        # copy, so unbounded growth would OOM the device.
+        import collections
+
+        self._staged_cache: "collections.OrderedDict[tuple, Any]" = (
+            collections.OrderedDict()
+        )
+        self._staged_cache_cap = 4
+        # Host-densified key plans per (table version, key exprs), LRU.
+        self._keyplan_cache: "collections.OrderedDict[tuple, Any]" = (
+            collections.OrderedDict()
+        )
+        self._keyplan_cache_cap = 4
+        # Offload is best-effort; failures fall back to the host engine but
+        # must stay observable (one log per distinct error signature).
+        self.fallback_errors: dict[str, str] = {}
 
     # -- public -------------------------------------------------------------
     def try_execute_fragment(
@@ -185,7 +198,17 @@ class MeshExecutor:
             return self._try_execute_fragment(
                 fragment, table_store, registry, func_ctx
             )
-        except Exception:
+        except Exception as e:
+            import logging
+            import traceback
+
+            key = f"{type(e).__name__}: {e}"
+            if key not in self.fallback_errors:
+                self.fallback_errors[key] = traceback.format_exc()
+                logging.getLogger("pixie_tpu.parallel").warning(
+                    "device offload failed, falling back to host engine: %s",
+                    key,
+                )
             return None
 
     def _try_execute_fragment(
@@ -223,9 +246,19 @@ class MeshExecutor:
             ":host" if key_plan.host_gids is not None
             else (":lut" if isinstance(key_plan.device_expr, tuple) else ":dev")
         )
+        # Version = (min_row_id, end_row_id): writes bump end_row_id and
+        # ring-buffer expiry bumps min_row_id, so either invalidates.
+        version = (table.min_row_id(), table.end_row_id())
+        # Staged HOST gids derived from mutable metadata state (needs_ctx
+        # UDFs) must never be cached — pod/service mappings churn without
+        # table writes. The device-LUT key path is safe: staged blocks hold
+        # raw codes and the LUT is recomputed and passed as an argument.
+        cacheable = key_plan.host_gids is None or not any(
+            _uses_ctx_func(m.col_exprs[g], registry) for g in m.agg_op.groups
+        )
         cache_key = (
             m.source_op.table_name,
-            table.end_row_id(),
+            version,
             tuple(sorted(base_cols)),
             m.source_op.start_time,
             m.source_op.stop_time,
@@ -233,8 +266,10 @@ class MeshExecutor:
             key_sig,
             key_plan.num_groups,
         )
-        staged = self._staged_cache.get(cache_key)
-        if staged is None:
+        staged = self._staged_cache.get(cache_key) if cacheable else None
+        if staged is not None:
+            self._staged_cache.move_to_end(cache_key)
+        else:
             cols, n = read_columns(
                 table,
                 sorted(base_cols),
@@ -253,15 +288,16 @@ class MeshExecutor:
                 dictionaries=table.dictionaries,
                 block_rows=self.block_rows,
             )
-            # Evict only STALE versions of this table (old end_row_id):
-            # concurrent queries with different groupbys/column sets over
-            # the same version keep their HBM residency.
-            for k in [
-                k for k in self._staged_cache
-                if k[0] == m.source_op.table_name and k[1] != table.end_row_id()
-            ]:
-                del self._staged_cache[k]
-            self._staged_cache[cache_key] = staged
+            if cacheable:
+                # Evict stale versions of this table, then LRU-cap.
+                for k in [
+                    k for k in self._staged_cache
+                    if k[0] == m.source_op.table_name and k[1] != version
+                ]:
+                    del self._staged_cache[k]
+                self._staged_cache[cache_key] = staged
+                while len(self._staged_cache) > self._staged_cache_cap:
+                    self._staged_cache.popitem(last=False)
         aux = self._build_aux(evaluator, m, key_plan, table)
         merged = self._run_program(m, specs, evaluator, key_plan, staged, aux)
         batch = self._finalize(m, specs, key_plan, staged, merged, registry)
@@ -342,16 +378,21 @@ class MeshExecutor:
                     )
         # Generic host path: evaluate key exprs over the full columns once,
         # then densify (ref: the reference hashes RowTuples per batch; we
-        # pay one vectorized pass, cached per table version + key exprs).
+        # pay one vectorized pass, cached per table version + key exprs —
+        # except when keys depend on mutable metadata state).
+        kp_cacheable = not any(
+            _uses_ctx_func(m.col_exprs[g], registry) for g in groups
+        )
         kp_key = (
             m.source_op.table_name,
-            table.end_row_id(),
+            (table.min_row_id(), table.end_row_id()),
             repr([m.col_exprs[g] for g in groups]),
             m.source_op.start_time,
             m.source_op.stop_time,
         )
-        cached = self._keyplan_cache.get(kp_key)
+        cached = self._keyplan_cache.get(kp_key) if kp_cacheable else None
         if cached is not None:
+            self._keyplan_cache.move_to_end(kp_key)
             return cached
         key_refs = set()
         for g in groups:
@@ -394,12 +435,16 @@ class MeshExecutor:
         kp = _KeyPlan(
             host_gids=gids, num_groups=enc.num_groups, key_columns=key_columns
         )
-        for k in [
-            k for k in self._keyplan_cache
-            if k[0] == m.source_op.table_name and k[1] != table.end_row_id()
-        ]:
-            del self._keyplan_cache[k]
-        self._keyplan_cache[kp_key] = kp
+        if kp_cacheable:
+            version = (table.min_row_id(), table.end_row_id())
+            for k in [
+                k for k in self._keyplan_cache
+                if k[0] == m.source_op.table_name and k[1] != version
+            ]:
+                del self._keyplan_cache[k]
+            self._keyplan_cache[kp_key] = kp
+            while len(self._keyplan_cache) > self._keyplan_cache_cap:
+                self._keyplan_cache.popitem(last=False)
         return kp
 
     def _dict_lut_key(self, e, table, registry, func_ctx=None):
@@ -703,3 +748,14 @@ def _pre_agg_relation(m: _Match, registry):
     return MapOp(
         tuple((name, e) for name, e in m.col_exprs.items())
     ).output_relation([m.source_relation], registry)
+
+
+def _uses_ctx_func(expr, registry) -> bool:
+    """Does the expression call any needs_ctx (metadata-state) UDF? Such
+    results change when k8s metadata churns, with no table write."""
+    if isinstance(expr, FuncCall):
+        for key in list(registry._scalars):
+            if key.name == expr.name and registry._scalars[key].needs_ctx:
+                return True
+        return any(_uses_ctx_func(a, registry) for a in expr.args)
+    return False

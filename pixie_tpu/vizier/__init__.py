@@ -1,0 +1,19 @@
+"""Vizier-equivalent control plane (thin, single-process or multi-thread).
+
+Ref: src/vizier/ — the query broker (services/query_broker/), the agent
+manager runtime (services/agent/manager/), NATS message bus topics
+(utils/messagebus/topic.go), heartbeat-based agent tracking with expiry
+(services/metadata/controllers/agent_topic_listener.go:41,322).
+
+TPU-native scope note (SURVEY.md §2.6): between devices the data plane is
+ICI collectives inside the compiled pipeline; this control plane exists for
+the host-level architecture — multiple engine instances (PEM-role data
+bearers + a Kelvin-role merger) coordinated over an in-process bus that a
+DCN transport can replace one-for-one.
+"""
+
+from pixie_tpu.vizier.agent import Agent
+from pixie_tpu.vizier.broker import QueryBroker
+from pixie_tpu.vizier.bus import MessageBus
+
+__all__ = ["Agent", "MessageBus", "QueryBroker"]

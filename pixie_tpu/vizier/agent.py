@@ -1,0 +1,145 @@
+"""Agent runtime: an engine instance on the bus.
+
+Ref: src/vizier/services/agent/manager/ — Manager (manager.h:102) runs the
+event loop with registered MessageHandlers (:257): registration
+(registration.*), heartbeats every ~5s (heartbeat.{h,cc}), query execution
+(exec.{h,cc} ExecuteQueryMessageHandler). PEM-role agents hold a table
+store fed by ingest; the Kelvin-role agent holds no tables and executes
+merge fragments (pem_main.cc / kelvin_main.cc).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Optional
+
+from pixie_tpu.engine import Carnot
+from pixie_tpu.exec import BridgeRouter
+from pixie_tpu.plan.plan import Plan
+from pixie_tpu.vizier.bus import MessageBus, agent_topic
+
+HEARTBEAT_INTERVAL_S = 0.5  # scaled-down from the reference's ~5s
+AGENT_STATUS_TOPIC = "agent_status"  # ref: agent_topic_listener's channel
+RESULTS_TOPIC_PREFIX = "results/"
+
+
+class Agent:
+    """One engine instance; subscribes to Agent/<id> and executes plan
+    fragments pushed by the broker (launch_query.go:36-82 pattern)."""
+
+    def __init__(
+        self,
+        agent_id: str,
+        bus: MessageBus,
+        router: BridgeRouter,
+        table_store=None,
+        registry=None,
+        metadata_state=None,
+        is_kelvin: bool = False,
+        device_executor=None,
+    ):
+        self.agent_id = agent_id
+        self.bus = bus
+        self.is_kelvin = is_kelvin
+        self.carnot = Carnot(
+            table_store=table_store,
+            registry=registry,
+            metadata_state=metadata_state,
+            router=router,
+            instance=agent_id,
+            device_executor=device_executor,
+        )
+        self._sub = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._sub = self.bus.subscribe(agent_topic(self.agent_id))
+        self._register()
+        t = threading.Thread(target=self._run_loop, daemon=True)
+        hb = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        t.start()
+        hb.start()
+        self._threads = [t, hb]
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        if self._sub is not None:
+            self._sub.unsubscribe()
+
+    # -- registration + heartbeat (registration.*, heartbeat.{h,cc}) --------
+    def _register(self) -> None:
+        self.bus.publish(
+            AGENT_STATUS_TOPIC,
+            {
+                "type": "register",
+                "agent_id": self.agent_id,
+                "is_kelvin": self.is_kelvin,
+                "tables": sorted(self.carnot.table_store.table_names()),
+            },
+        )
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(HEARTBEAT_INTERVAL_S):
+            self.bus.publish(
+                AGENT_STATUS_TOPIC,
+                {
+                    "type": "heartbeat",
+                    "agent_id": self.agent_id,
+                    "is_kelvin": self.is_kelvin,
+                    "tables": sorted(self.carnot.table_store.table_names()),
+                    "ts": time.monotonic(),
+                },
+            )
+
+    # -- query execution (exec.{h,cc}) --------------------------------------
+    def _run_loop(self) -> None:
+        while not self._stop.is_set():
+            msg = self._sub.get(timeout=0.05)
+            if msg is None:
+                continue
+            if msg.get("type") == "execute_fragment":
+                threading.Thread(
+                    target=self._execute_fragment, args=(msg,), daemon=True
+                ).start()
+
+    def _execute_fragment(self, msg: dict) -> None:
+        query_id = msg["query_id"]
+        plan: Plan = msg["plan"]  # in-process handoff; DCN would serialize
+        try:
+            result = self.carnot.execute_plan(
+                plan, analyze=msg.get("analyze", False), manage_router=False
+            )
+            for name, batches in result.tables.items():
+                for b in batches:
+                    self.bus.publish(
+                        RESULTS_TOPIC_PREFIX + query_id,
+                        {
+                            "type": "result_batch",
+                            "agent_id": self.agent_id,
+                            "table": name,
+                            "batch": b,
+                        },
+                    )
+            self.bus.publish(
+                RESULTS_TOPIC_PREFIX + query_id,
+                {
+                    "type": "fragment_done",
+                    "agent_id": self.agent_id,
+                    "exec_stats": result.exec_stats,
+                },
+            )
+        except Exception as e:  # surfaced to the forwarder (ref: error chunks)
+            self.bus.publish(
+                RESULTS_TOPIC_PREFIX + query_id,
+                {
+                    "type": "fragment_error",
+                    "agent_id": self.agent_id,
+                    "error": f"{e}\n{traceback.format_exc()}",
+                },
+            )

@@ -1,0 +1,198 @@
+"""Query broker: compile → distributed plan → launch → forward results.
+
+Ref: src/vizier/services/query_broker/ — Server.ExecuteScript
+(controllers/server.go:308), QueryExecutorImpl.Run (query_executor.go:166),
+LaunchQuery publishing per-agent plans on NATS Agent/<id> topics
+(launch_query.go:36-82), QueryResultForwarder matching agent result streams
+to the client with timeouts/cancellation (query_result_forwarder.go:395,
+502,571), and the heartbeat-expiry agent tracker (tracker/agents.go +
+agent_topic_listener.go:41,322 — 1-minute expiry, scaled down here).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Optional
+
+from pixie_tpu.compiler import Compiler
+from pixie_tpu.distributed import AgentInfo, DistributedPlanner, DistributedState
+from pixie_tpu.engine import QueryResult
+from pixie_tpu.exec import BridgeRouter
+from pixie_tpu.plan.operators import BridgeSinkOp
+from pixie_tpu.plan.plan import Plan
+from pixie_tpu.types import Relation
+from pixie_tpu.vizier.bus import (
+    MessageBus,
+    agent_topic,
+)
+from pixie_tpu.vizier.agent import AGENT_STATUS_TOPIC, RESULTS_TOPIC_PREFIX
+
+AGENT_EXPIRY_S = 2.0  # ref: 1 minute (agent_topic_listener.go:41), scaled
+
+
+class AgentTracker:
+    """Liveness + table topology from register/heartbeat messages."""
+
+    def __init__(self, bus: MessageBus):
+        self._bus = bus
+        self._sub = bus.subscribe(AGENT_STATUS_TOPIC)
+        self._lock = threading.Lock()
+        self._agents: dict[str, dict] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            msg = self._sub.get(timeout=0.05)
+            if msg is None:
+                continue
+            if msg.get("type") in ("register", "heartbeat"):
+                with self._lock:
+                    self._agents[msg["agent_id"]] = {
+                        "is_kelvin": msg["is_kelvin"],
+                        "tables": frozenset(msg.get("tables", ())),
+                        "last_seen": time.monotonic(),
+                    }
+
+    def distributed_state(self) -> DistributedState:
+        now = time.monotonic()
+        with self._lock:
+            # Expire silent agents (ref: agent_topic_listener expiry) so
+            # plans skip them (prune_unavailable_sources_rule behavior).
+            alive = {
+                aid: a
+                for aid, a in self._agents.items()
+                if now - a["last_seen"] < AGENT_EXPIRY_S
+            }
+            self._agents = dict(alive)
+        return DistributedState(
+            agents=[
+                AgentInfo(aid, a["tables"], a["is_kelvin"])
+                for aid, a in sorted(alive.items())
+            ]
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self._sub.unsubscribe()
+
+
+class QueryBroker:
+    def __init__(
+        self,
+        bus: MessageBus,
+        router: BridgeRouter,
+        registry=None,
+        table_relations: Optional[dict[str, Relation]] = None,
+    ):
+        if registry is None:
+            from pixie_tpu.udf.registry import default_registry
+
+            registry = default_registry()
+        self.bus = bus
+        self.router = router
+        self.registry = registry
+        self.compiler = Compiler(registry)
+        self.tracker = AgentTracker(bus)
+        # Schema authority: in the reference the broker gets schemas from
+        # the metadata service; here the caller provides them (or agents'
+        # heartbeats name tables and the caller maps relations).
+        self.table_relations = dict(table_relations or {})
+
+    def execute_script(
+        self,
+        query: str,
+        timeout_s: float = 30.0,
+        now_ns: Optional[int] = None,
+        script_args: Optional[dict] = None,
+        analyze: bool = False,
+    ) -> QueryResult:
+        """The ExecuteScript path (server.go:308 → launch_query.go:36)."""
+        qid = str(uuid.uuid4())
+        t0 = time.perf_counter_ns()
+        logical = self.compiler.compile(
+            query,
+            self.table_relations,
+            now_ns=now_ns,
+            script_args=script_args,
+            query_id=qid,
+        )
+        state = self.tracker.distributed_state()
+        planner = DistributedPlanner(self.registry, self.table_relations)
+        plan = planner.plan(logical, state)
+        compile_ns = time.perf_counter_ns() - t0
+
+        # Central bridge-producer registration over the shared router.
+        for frag in plan.fragments:
+            for nid in frag.nodes():
+                if isinstance(frag.node(nid), BridgeSinkOp):
+                    self.router.register_producer(
+                        qid, frag.node(nid).bridge_id
+                    )
+
+        results_sub = self.bus.subscribe(RESULTS_TOPIC_PREFIX + qid)
+        # Launch per-agent plans (launch_query.go:36-82).
+        by_instance: dict[str, Plan] = {}
+        for frag in plan.fragments:
+            inst = plan.executing_instance[frag.fragment_id]
+            sub = by_instance.setdefault(inst, Plan(qid))
+            sub.fragments.append(frag)
+            sub.executing_instance[frag.fragment_id] = inst
+        t1 = time.perf_counter_ns()
+        for inst, sub_plan in by_instance.items():
+            self.bus.publish(
+                agent_topic(inst),
+                {
+                    "type": "execute_fragment",
+                    "query_id": qid,
+                    "plan": sub_plan,
+                    "analyze": analyze,
+                },
+            )
+
+        # Forward results (query_result_forwarder.go:502,571).
+        tables: dict[str, list] = {}
+        exec_stats: dict[str, dict] = {}
+        pending = len(by_instance)
+        deadline = time.monotonic() + timeout_s
+        errors: list[str] = []
+        try:
+            while pending > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"query {qid}: {pending} agents still running"
+                    )
+                msg = results_sub.get(timeout=min(remaining, 0.1))
+                if msg is None:
+                    continue
+                if msg["type"] == "result_batch":
+                    tables.setdefault(msg["table"], []).append(msg["batch"])
+                elif msg["type"] == "fragment_done":
+                    for k, v in msg.get("exec_stats", {}).items():
+                        exec_stats[f"{msg['agent_id']}/{k}"] = v
+                    pending -= 1
+                elif msg["type"] == "fragment_error":
+                    errors.append(f"{msg['agent_id']}: {msg['error']}")
+                    pending -= 1
+        finally:
+            results_sub.unsubscribe()
+            self.router.cleanup_query(qid)
+        if errors:
+            raise RuntimeError(
+                f"query {qid} failed on agents:\n" + "\n".join(errors)
+            )
+        return QueryResult(
+            query_id=qid,
+            tables=tables,
+            exec_stats=exec_stats,
+            compile_time_ns=compile_ns,
+            exec_time_ns=time.perf_counter_ns() - t1,
+        )
+
+    def stop(self) -> None:
+        self.tracker.stop()

@@ -1,0 +1,57 @@
+"""In-process message bus with NATS-style topics.
+
+Ref: src/common/event/nats.{h,cc} (C++ agent side), src/shared/services/
+msgbus/ (Go side), topic scheme src/vizier/utils/messagebus/topic.go:40-55
+(``Agent/<id>``, ``v2c.*``/``c2v.*``). At-most-once pub/sub to current
+subscribers, like NATS core.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any
+
+
+def agent_topic(agent_id: str) -> str:
+    return f"Agent/{agent_id}"
+
+
+class Subscription:
+    def __init__(self, topic: str, bus: "MessageBus"):
+        self.topic = topic
+        self._bus = bus
+        self._q: "queue.Queue[Any]" = queue.Queue()
+
+    def get(self, timeout: float = None):
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def unsubscribe(self) -> None:
+        self._bus._unsubscribe(self)
+
+
+class MessageBus:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: dict[str, list[Subscription]] = {}
+
+    def subscribe(self, topic: str) -> Subscription:
+        sub = Subscription(topic, self)
+        with self._lock:
+            self._subs.setdefault(topic, []).append(sub)
+        return sub
+
+    def publish(self, topic: str, msg: Any) -> None:
+        with self._lock:
+            subs = list(self._subs.get(topic, ()))
+        for s in subs:
+            s._q.put(msg)
+
+    def _unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            subs = self._subs.get(sub.topic, [])
+            if sub in subs:
+                subs.remove(sub)
