@@ -1,0 +1,36 @@
+"""Telemetry ingest: the Stirling-equivalent collection layer (CPU-side).
+
+Ref: src/stirling/ — Stirling core (stirling.{h,cc}: RegisterDataPushCallback,
+GetPublishProto, Run at :91-193; RunCore poll loop at stirling.cc:802-852),
+SourceConnector lifecycle (core/source_connector.h:43-80:
+Init/InitContext/TransferData/PushData/Stop), per-source sampling/push
+FrequencyManager (core/frequency_manager.*), InfoClassManager schema publish
+(core/info_class_manager.*, core/pub_sub_manager.*), DataTable with
+tabletization (core/data_table.h:51).
+
+BY DESIGN this stays on host CPU (BASELINE: "Stirling's eBPF collection and
+the PEM ingest path stay on CPU"). Real eBPF connectors are out of scope on
+TPU hosts; the interface matches so they can be added, and the shipped
+connectors are the deterministic test source (ref: seq_gen), a synthetic
+protocol-trace generator (the load-gen analogue of the socket tracer's
+http_events output), and process/network stat samplers reading procfs.
+"""
+
+from pixie_tpu.ingest.core import IngestCore
+from pixie_tpu.ingest.source_connector import DataTable, SourceConnector
+from pixie_tpu.ingest.seq_gen import SeqGenConnector
+from pixie_tpu.ingest.http_gen import HTTPEventsConnector
+from pixie_tpu.ingest.proc_stats import (
+    NetworkStatsConnector,
+    ProcessStatsConnector,
+)
+
+__all__ = [
+    "DataTable",
+    "HTTPEventsConnector",
+    "IngestCore",
+    "NetworkStatsConnector",
+    "ProcessStatsConnector",
+    "SeqGenConnector",
+    "SourceConnector",
+]

@@ -1,0 +1,87 @@
+"""IngestCore — the Stirling-equivalent runtime.
+
+Ref: src/stirling/stirling.{h,cc} — Stirling (stirling.h:91): registry of
+SourceConnectors, RegisterDataPushCallback (:109), GetPublishProto/schema
+publish (core/pub_sub_manager.*), RunAsThread (:163), and the RunCore poll
+loop (stirling.cc:802-852): per source, if sampling expired TransferData;
+if push expired PushData; sleep until the next tick.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from pixie_tpu.ingest.source_connector import SourceConnector
+from pixie_tpu.types import Relation
+
+# push_cb(table_name: str, tablet: str, columns: dict) -> None
+DataPushCallback = Callable[[str, str, dict], None]
+
+
+class IngestCore:
+    def __init__(self):
+        self._sources: list[SourceConnector] = []
+        self._push_cb: Optional[DataPushCallback] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._ctx = None
+
+    # -- registration (stirling.h:91-130) -----------------------------------
+    def register_source(self, source: SourceConnector) -> None:
+        self._sources.append(source)
+
+    def register_data_push_callback(self, cb: DataPushCallback) -> None:
+        self._push_cb = cb
+
+    def set_context(self, ctx) -> None:
+        """Connector context (metadata state for PID→pod resolution;
+        ref: InitContext / ConnectorContext)."""
+        self._ctx = ctx
+
+    def publish(self) -> dict[str, Relation]:
+        """Table schemas this core produces (ref: GetPublishProto /
+        InfoClassManager)."""
+        out: dict[str, Relation] = {}
+        for s in self._sources:
+            for dt in s.tables:
+                out[dt.name] = dt.relation
+        return out
+
+    # -- run loop (stirling.cc:802-852) -------------------------------------
+    def run(self) -> None:
+        assert self._push_cb is not None, "no data push callback registered"
+        for s in self._sources:
+            s.init()
+        try:
+            while not self._stop.is_set():
+                now = time.monotonic()
+                for s in self._sources:
+                    if s.sampling_expired(now):
+                        s.transfer_data(self._ctx)
+                        s.reset_sample(now)
+                    if s.push_expired(now):
+                        s.push_data(self._push_cb)
+                        s.reset_push(now)
+                next_tick = min(
+                    (s.next_tick() for s in self._sources),
+                    default=now + 0.1,
+                )
+                self._stop.wait(timeout=max(0.0, next_tick - time.monotonic()))
+        finally:
+            # Final flush so short-lived runs lose nothing.
+            for s in self._sources:
+                s.push_data(self._push_cb)
+                s.stop()
+
+    def run_as_thread(self) -> None:
+        """ref: Stirling::RunAsThread (stirling.h:163)."""
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None

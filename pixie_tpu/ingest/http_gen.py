@@ -1,0 +1,115 @@
+"""Synthetic HTTP/conn telemetry generator.
+
+The load-generation analogue of the socket tracer's output tables
+(ref: src/stirling/source_connectors/socket_tracer/http_table.h,
+conn_stats_table.h): emits `http_events` and `conn_stats` rows with the
+reference's column shapes, at a configurable rate. This is BASELINE
+config 5's data source and the stand-in for eBPF collection on TPU hosts.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from pixie_tpu.ingest.source_connector import DataTable, SourceConnector
+from pixie_tpu.types import DataType, Relation, SemanticType
+
+I, F, S, T = (
+    DataType.INT64,
+    DataType.FLOAT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+# ref: http_table.h column set (trimmed to the queried columns)
+HTTP_EVENTS_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("upid", S, SemanticType.ST_UPID),
+    ("remote_addr", S, SemanticType.ST_IP_ADDRESS),
+    ("remote_port", I),
+    ("req_method", S),
+    ("req_path", S),
+    ("resp_status", I),
+    ("resp_body_size", I, SemanticType.ST_BYTES),
+    ("latency", I, SemanticType.ST_DURATION_NS),
+)
+
+# ref: conn_stats_table.h
+CONN_STATS_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("upid", S, SemanticType.ST_UPID),
+    ("remote_addr", S, SemanticType.ST_IP_ADDRESS),
+    ("remote_port", I),
+    ("protocol", I),
+    ("bytes_sent", I, SemanticType.ST_BYTES),
+    ("bytes_recv", I, SemanticType.ST_BYTES),
+)
+
+METHODS = np.array(["GET", "GET", "GET", "POST", "PUT", "DELETE"], dtype=object)
+
+
+class HTTPEventsConnector(SourceConnector):
+    name = "http_gen"
+    sample_period_s = 0.02
+    push_period_s = 0.1
+
+    def __init__(
+        self,
+        rows_per_sample: int = 1000,
+        n_services: int = 8,
+        n_paths: int = 32,
+        seed: int = 0,
+    ):
+        super().__init__()
+        self.rows_per_sample = rows_per_sample
+        self.rng = np.random.default_rng(seed)
+        self.upids = np.array(
+            [f"1:{i}:{i * 7 + 1}" for i in range(n_services)], dtype=object
+        )
+        self.addrs = np.array(
+            [f"10.0.{i // 256}.{i % 256}" for i in range(n_services)],
+            dtype=object,
+        )
+        self.paths = np.array(
+            [f"/api/v1/ep{i}" for i in range(n_paths)], dtype=object
+        )
+        self.tables = [
+            DataTable("http_events", HTTP_EVENTS_REL),
+            DataTable("conn_stats", CONN_STATS_REL),
+        ]
+
+    def transfer_data_impl(self, ctx) -> None:
+        n = self.rows_per_sample
+        rng = self.rng
+        now = time.time_ns()
+        svc = rng.integers(0, len(self.upids), n)
+        self.tables[0].append_columns(
+            {
+                "time_": now + np.arange(n),
+                "upid": self.upids[svc],
+                "remote_addr": self.addrs[rng.integers(0, len(self.addrs), n)],
+                "remote_port": rng.integers(1024, 65535, n),
+                "req_method": METHODS[rng.integers(0, len(METHODS), n)],
+                "req_path": self.paths[rng.integers(0, len(self.paths), n)],
+                "resp_status": rng.choice(
+                    [200, 200, 200, 200, 301, 404, 500], n
+                ),
+                "resp_body_size": rng.integers(64, 1 << 16, n),
+                "latency": rng.integers(10**5, 10**9, n),
+            }
+        )
+        m = max(n // 10, 1)
+        conn_svc = rng.integers(0, len(self.upids), m)
+        self.tables[1].append_columns(
+            {
+                "time_": now + np.arange(m),
+                "upid": self.upids[conn_svc],
+                "remote_addr": self.addrs[rng.integers(0, len(self.addrs), m)],
+                "remote_port": rng.integers(1024, 65535, m),
+                "protocol": rng.integers(0, 5, m),
+                "bytes_sent": rng.integers(0, 1 << 20, m),
+                "bytes_recv": rng.integers(0, 1 << 20, m),
+            }
+        )

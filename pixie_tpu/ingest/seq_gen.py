@@ -1,0 +1,58 @@
+"""Deterministic sequence-generator connector (for core-loop tests).
+
+Ref: src/stirling/source_connectors/seq_gen/ — produces predictable
+sequences so the Stirling core loop is testable without kernel access
+(used by core/stirling_test.cc).
+"""
+
+from __future__ import annotations
+
+import time
+
+from pixie_tpu.ingest.source_connector import DataTable, SourceConnector
+from pixie_tpu.types import DataType, Relation
+
+I, F, T = DataType.INT64, DataType.FLOAT64, DataType.TIME64NS
+
+SEQ_REL = Relation.of(
+    ("time_", T),
+    ("x", I),          # linear sequence
+    ("xmod10", I),     # x % 10
+    ("xsquared", I),   # x*x
+    ("fibonnaci", I),  # matches the reference's (misspelled) column
+    ("pi", F),
+)
+
+
+def _fib(n: int) -> int:
+    a, b = 0, 1
+    for _ in range(n):
+        a, b = b, a + b
+    return a
+
+
+class SeqGenConnector(SourceConnector):
+    name = "seq_gen"
+    sample_period_s = 0.01
+    push_period_s = 0.05
+
+    def __init__(self, rows_per_sample: int = 10):
+        super().__init__()
+        self.rows_per_sample = rows_per_sample
+        self._x = 0
+        self.tables = [DataTable("sequences", SEQ_REL)]
+
+    def transfer_data_impl(self, ctx) -> None:
+        dt = self.tables[0]
+        now = time.time_ns()
+        for i in range(self.rows_per_sample):
+            x = self._x
+            dt.append_record(
+                time_=now + i,
+                x=x,
+                xmod10=x % 10,
+                xsquared=x * x,
+                fibonnaci=_fib(x % 64),
+                pi=3.141592653589793,
+            )
+            self._x += 1

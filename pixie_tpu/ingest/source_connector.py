@@ -1,0 +1,141 @@
+"""SourceConnector base + DataTable + frequency management.
+
+Ref: src/stirling/core/source_connector.h:43-80 (lifecycle), data_table.h:51
+(DataTable buffers records between transfer and push, with tabletization),
+frequency_manager.* (independent sampling vs push periods per source).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.types import Relation
+
+
+class DataTable:
+    """Buffers appended records between TransferData and PushData
+    (ref: core/data_table.h:51; occupancy-based push thresholds)."""
+
+    def __init__(self, name: str, relation: Relation, tablet: str = ""):
+        self.name = name
+        self.relation = relation
+        self.tablet = tablet
+        self._pending: dict[str, list] = {c.name: [] for c in relation}
+        self._rows = 0
+
+    def append_record(self, **values) -> None:
+        for c in self.relation:
+            self._pending[c.name].append(values[c.name])
+        self._rows += 1
+
+    def append_columns(self, data: dict) -> None:
+        n = len(next(iter(data.values())))
+        for c in self.relation:
+            vals = data[c.name]
+            assert len(vals) == n
+            self._pending[c.name].extend(
+                vals.tolist() if isinstance(vals, np.ndarray) else vals
+            )
+        self._rows += n
+
+    @property
+    def occupancy(self) -> int:
+        return self._rows
+
+    def take(self) -> Optional[dict]:
+        if not self._rows:
+            return None
+        out = {k: v for k, v in self._pending.items()}
+        self._pending = {c.name: [] for c in self.relation}
+        self._rows = 0
+        return out
+
+
+class FrequencyManager:
+    """Tracks next-expiry for a periodic action (core/frequency_manager.*)."""
+
+    def __init__(self, period_s: float):
+        self.period_s = period_s
+        self._next = time.monotonic()
+
+    def expired(self, now: float) -> bool:
+        return now >= self._next
+
+    def reset(self, now: float) -> None:
+        self._next = now + self.period_s
+
+    def next_expiry(self) -> float:
+        return self._next
+
+
+class SourceConnector:
+    """Base connector (ref: core/source_connector.h:43).
+
+    Subclasses define ``tables`` (DataTable list) and implement
+    ``transfer_data_impl(ctx)`` appending records into them.
+    """
+
+    name = "source"
+    sample_period_s = 0.1  # ref: sampling freq per source
+    push_period_s = 0.5    # ref: push freq per source
+
+    def __init__(self):
+        self.tables: list[DataTable] = []
+        self._sample_mgr = FrequencyManager(self.sample_period_s)
+        self._push_mgr = FrequencyManager(self.push_period_s)
+        self._initialized = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def init(self) -> None:
+        """ref: SourceConnector::Init."""
+        self.init_impl()
+        self._initialized = True
+
+    def stop(self) -> None:
+        """ref: SourceConnector::Stop."""
+        self.stop_impl()
+        self._initialized = False
+
+    def init_impl(self) -> None:
+        pass
+
+    def stop_impl(self) -> None:
+        pass
+
+    # -- data path ----------------------------------------------------------
+    def transfer_data(self, ctx=None) -> None:
+        """Sample sources into DataTables (ref: TransferData,
+        stirling.cc:837)."""
+        assert self._initialized, f"{self.name}: transfer before init"
+        self.transfer_data_impl(ctx)
+
+    def transfer_data_impl(self, ctx) -> None:
+        raise NotImplementedError
+
+    def push_data(self, push_cb) -> None:
+        """Flush DataTables through the registered callback (ref: PushData,
+        stirling.cc:841 → DataPushCallback)."""
+        for dt in self.tables:
+            data = dt.take()
+            if data is not None:
+                push_cb(dt.name, dt.tablet, data)
+
+    # -- scheduling ---------------------------------------------------------
+    def sampling_expired(self, now: float) -> bool:
+        return self._sample_mgr.expired(now)
+
+    def push_expired(self, now: float) -> bool:
+        return self._push_mgr.expired(now)
+
+    def reset_sample(self, now: float) -> None:
+        self._sample_mgr.reset(now)
+
+    def reset_push(self, now: float) -> None:
+        self._push_mgr.reset(now)
+
+    def next_tick(self) -> float:
+        return min(self._sample_mgr.next_expiry(), self._push_mgr.next_expiry())
