@@ -47,6 +47,10 @@ class StateBatch:
     group_names: tuple[str, ...]
     eow: bool = False
     eos: bool = False
+    # Producer-side latched dictionaries for string_state UDAs, keyed by the
+    # UDA's output name; the merge stage translates incoming code states
+    # through these into its own latch (codes are agent-local otherwise).
+    arg_dicts: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -112,7 +116,7 @@ class AggNode(ExecNode):
             self._ensure_capacity(self._encoder.num_groups or 1)
             for spec in self._specs:
                 cols = [
-                    self._arg_array(batch, n, s)
+                    self._arg_array(batch, n, s, spec.uda.string_args)
                     for n, s in zip(spec.arg_names, spec.arg_is_string)
                 ]
                 self._states[spec.out_name] = spec.uda.update(
@@ -141,10 +145,16 @@ class AggNode(ExecNode):
         ]
         return self._encoder.encode(key_cols)
 
-    def _arg_array(self, batch: RowBatch, name: str, is_string: bool):
+    def _arg_array(self, batch: RowBatch, name: str, is_string: bool, mode: str):
         col = batch.col(name)
         if isinstance(col, DictColumn):
-            return col.codes  # UDAs over strings see dictionary codes
+            if mode == "hash":
+                # Dictionary-independent identity: hash the (tiny) dictionary
+                # once, gather through codes. int64 view keeps x64 jnp happy.
+                hashes = col.dictionary.content_hashes().view(np.int64)
+                return hashes[col.codes]
+            col = self._latch_key_column(name, col)
+            return col.codes
         return col
 
     def _ensure_capacity(self, needed: int) -> None:
@@ -174,6 +184,10 @@ class AggNode(ExecNode):
         self._ensure_capacity(self._encoder.num_groups or 1)
         for spec in self._specs:
             incoming = sb.states[spec.out_name]
+            if spec.uda.string_state and spec.out_name in sb.arg_dicts:
+                incoming = self._translate_state_codes(
+                    spec.arg_names[0], incoming, sb.arg_dicts[spec.out_name]
+                )
             aligned = jax.tree.map(
                 lambda z, inc: jax.numpy.asarray(z).at[idx].set(
                     jax.numpy.asarray(inc)
@@ -184,6 +198,24 @@ class AggNode(ExecNode):
             self._states[spec.out_name] = spec.uda.merge(
                 self._states[spec.out_name], aligned
             )
+
+    def _translate_state_codes(self, name: str, codes, incoming_dict):
+        """Map a code-valued state from the producer's dictionary into this
+        node's latch (adopting the producer's dictionary when nothing is
+        latched yet). Sentinel/out-of-range codes pass through untouched."""
+        existing = self._key_dicts.get(name)
+        if existing is None:
+            self._key_dicts[name] = incoming_dict
+            return codes
+        if existing is incoming_dict:
+            return codes
+        out = np.asarray(codes).copy()
+        valid = (out >= 0) & (out < len(incoming_dict))
+        if valid.any():
+            out[valid] = existing.encode(
+                incoming_dict.decode(out[valid].astype(np.int32))
+            )
+        return out
 
     # -- emit ---------------------------------------------------------------
     def _num_out_groups(self) -> int:
@@ -200,6 +232,12 @@ class AggNode(ExecNode):
                 )
                 for s in self._specs
             }
+            arg_dicts = {}
+            for s in self._specs:
+                if s.uda.string_state:
+                    d = self._key_dicts.get(s.arg_names[0])
+                    if d is not None:
+                        arg_dicts[s.out_name] = d
             self.send(
                 exec_state,
                 StateBatch(
@@ -209,6 +247,7 @@ class AggNode(ExecNode):
                     group_names=self.op.groups,
                     eow=eow,
                     eos=eos,
+                    arg_dicts=arg_dicts,
                 ),
             )
         else:
@@ -246,7 +285,15 @@ class AggNode(ExecNode):
             out = spec.uda.finalize(state)
             schema = rel.col(spec.out_name)
             if schema.data_type == DataType.STRING:
-                vals = np.asarray(out, dtype=object)
+                if spec.uda.string_state:
+                    latched = self._key_dicts.get(spec.arg_names[0])
+                    codes = np.asarray(out)
+                    if latched is None:
+                        vals = np.full(len(codes), "", dtype=object)
+                    else:
+                        vals = latched.decode(codes)
+                else:
+                    vals = np.asarray(out, dtype=object)
                 d = StringDictionary()
                 out_cols.append(DictColumn(d.encode(vals), d))
             else:

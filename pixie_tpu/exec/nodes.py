@@ -217,9 +217,12 @@ class LimitNode(ExecNode):
 
 
 class UnionNode(ExecNode):
-    """k-way union. With a time_ column, buffers until eos and emits one
-    time-ordered merge (ref: union_node's ordered merge); otherwise batches
-    pass through and eos waits for all parents."""
+    """k-way union. With a time_ column, performs an incremental time-ordered
+    merge: on every batch, rows up to the minimum high-watermark time across
+    still-live parents are merged and emitted (the reference union_node's
+    streaming ordered merge) — so streaming queries make progress and the
+    buffer stays bounded. Without a time column, batches pass through and eos
+    waits for all parents."""
 
     def __init__(self, op: UnionOp, output_relation, node_id):
         super().__init__(op, output_relation, node_id)
@@ -228,30 +231,83 @@ class UnionNode(ExecNode):
         self._eos_seen = 0
         self._buffer: list[RowBatch] = []
         self._ordered = False
+        self._watermarks: list = []
+        self._parent_eos: list = []
 
     def prepare_impl(self, exec_state) -> None:
         self._num_parents = len(getattr(self, "parent_nodes", [None]))
         self._ordered = self.output_relation.has_column(TIME_COLUMN)
+        self._watermarks = [None] * self._num_parents
+        self._parent_eos = [False] * self._num_parents
 
     def consume_next_impl(self, exec_state, batch, parent_index) -> None:
         eos = batch.eos
         if self._ordered:
             if batch.num_rows:
                 self._buffer.append(batch)
-        elif batch.num_rows:
+                times = np.asarray(batch.col(TIME_COLUMN))
+                self._watermarks[parent_index] = (
+                    times.max()
+                    if self._watermarks[parent_index] is None
+                    else max(self._watermarks[parent_index], times.max())
+                )
+            if eos:
+                self._parent_eos[parent_index] = True
+                self._eos_seen += 1
+            if self._eos_seen >= self._num_parents:
+                self._flush(exec_state)
+            else:
+                self._emit_ready(exec_state)
+            return
+        if batch.num_rows:
             self.send(exec_state, batch.with_flags(eow=False, eos=False))
         if eos:
             self._eos_seen += 1
             if self._eos_seen >= self._num_parents:
-                self._flush(exec_state)
+                self.send(
+                    exec_state,
+                    RowBatch.with_zero_rows(
+                        self.output_relation, eow=True, eos=True
+                    ),
+                )
+
+    def _merged_pending(self) -> Optional[RowBatch]:
+        if not self._buffer:
+            return None
+        merged = RowBatch.concat(self._buffer)
+        order = np.argsort(np.asarray(merged.col(TIME_COLUMN)), kind="stable")
+        return merged.take(order)
+
+    def _emit_ready(self, exec_state) -> None:
+        """Emit rows with time strictly below the min watermark of live
+        parents — later rows from those parents can still sort before
+        anything at/after it (per-parent batches arrive time-ordered)."""
+        live = [
+            self._watermarks[i]
+            for i in range(self._num_parents)
+            if not self._parent_eos[i]
+        ]
+        if any(w is None for w in live):
+            return  # a live parent hasn't produced yet: no safe cutoff
+        cutoff = min(live) if live else None
+        merged = self._merged_pending()
+        if merged is None or cutoff is None:
+            return
+        times = np.asarray(merged.col(TIME_COLUMN))
+        n_ready = int(np.searchsorted(times, cutoff, side="left"))
+        if n_ready == 0:
+            return
+        self.send(
+            exec_state,
+            merged.slice(0, n_ready).with_flags(eow=False, eos=False),
+        )
+        rest = merged.slice(n_ready, merged.num_rows)
+        self._buffer = [rest] if rest.num_rows else []
 
     def _flush(self, exec_state) -> None:
-        if self._ordered and self._buffer:
-            merged = RowBatch.concat(self._buffer)
-            order = np.argsort(
-                np.asarray(merged.col(TIME_COLUMN)), kind="stable"
-            )
-            self.send(exec_state, merged.take(order).with_flags(eow=True, eos=True))
+        merged = self._merged_pending()
+        if merged is not None:
+            self.send(exec_state, merged.with_flags(eow=True, eos=True))
         else:
             self.send(
                 exec_state,

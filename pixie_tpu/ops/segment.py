@@ -72,10 +72,44 @@ def _matvec_sum(values_f32, seg_ids, num_segments: int):
     return values_f32 @ oh
 
 
+_F64_CHUNK = 1024  # bounds f32 in-chunk accumulation error to ~1e-8 relative
+
+
+def _matvec_sum_f64(values, seg_ids, num_segments: int):
+    """f64 segment sums that still ride the MXU: split each value into
+    hi/lo float32 parts (exact to ~2^-48 relative), matmul each part in
+    per-chunk batches, and accumulate the chunk partials in float64 — so
+    representation error is ~f64-level and f32 accumulation is bounded to
+    _F64_CHUNK elements, keeping device sums consistent with the f64
+    scatter/host path (they diverged before; ADVICE r1)."""
+    n = values.shape[0]
+    if n == 0:
+        return jnp.zeros((num_segments,), jnp.float64)
+    chunk = min(_F64_CHUNK, n)
+    pad = (-n) % chunk
+    if pad:
+        values = jnp.pad(values, (0, pad))  # pad value 0: no-op in a sum
+        seg_ids = jnp.pad(seg_ids, (0, pad))
+    c = values.shape[0] // chunk
+    hi = values.astype(jnp.float32)
+    lo = (values - hi.astype(jnp.float64)).astype(jnp.float32)
+    oh = jax.nn.one_hot(
+        seg_ids.reshape(c, chunk), num_segments, dtype=jnp.float32
+    )
+    parts_hi = jnp.einsum("ck,cks->cs", hi.reshape(c, chunk), oh)
+    parts_lo = jnp.einsum("ck,cks->cs", lo.reshape(c, chunk), oh)
+    return jnp.sum(
+        parts_hi.astype(jnp.float64) + parts_lo.astype(jnp.float64), axis=0
+    )
+
+
 def seg_sum(values, seg_ids, num_segments: int, mask=None):
     if _use_matmul(num_segments) and jnp.issubdtype(
         values.dtype, jnp.floating
     ):
+        if values.dtype == jnp.float64:
+            v = values if mask is None else jnp.where(mask, values, 0.0)
+            return _matvec_sum_f64(v, seg_ids, num_segments)
         v = values.astype(jnp.float32)
         if mask is not None:
             v = jnp.where(mask, v, 0.0)

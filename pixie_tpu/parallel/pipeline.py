@@ -298,9 +298,11 @@ class MeshExecutor:
                 self._staged_cache[cache_key] = staged
                 while len(self._staged_cache) > self._staged_cache_cap:
                     self._staged_cache.popitem(last=False)
-        aux = self._build_aux(evaluator, m, key_plan, table)
+        aux = self._build_aux(evaluator, m, key_plan, table, specs)
         merged = self._run_program(m, specs, evaluator, key_plan, staged, aux)
-        batch = self._finalize(m, specs, key_plan, staged, merged, registry)
+        batch = self._finalize(
+            m, specs, key_plan, staged, merged, registry, table
+        )
         return m.agg_nid, batch
 
     # -- compile helpers ----------------------------------------------------
@@ -336,6 +338,14 @@ class MeshExecutor:
                 return None
             if len(arg_exprs) != 1:
                 return None  # single-arg UDAs only on the fast path today
+            if types[0] == DataType.STRING and (
+                uda.string_args == "hash" or uda.string_state
+            ):
+                # String identity/decodability requires the table dictionary:
+                # only bare source columns qualify; computed string args fall
+                # back to the host engine (which latches dictionaries).
+                if not isinstance(arg_exprs[0], ColumnRef):
+                    return None
             specs.append((out_name, arg_exprs[0], uda))
         return specs
 
@@ -481,11 +491,21 @@ class MeshExecutor:
         lut_codes = out_dict.encode(per_value)
         return lut_codes.astype(np.int32), out_dict, src
 
-    def _build_aux(self, evaluator, m, key_plan, table) -> dict:
+    def _build_aux(self, evaluator, m, key_plan, table, specs) -> dict:
         # key: exprs are materialized by the key plan (codes / LUT / host
         # gids), never via device_eval aux — only predicates and agg args
         # need LUT/constant-code precomputation.
         aux: dict[str, np.ndarray] = {}
+        # Hash-mode string args (sketch UDAs): ship a per-dictionary-value
+        # content-hash LUT so the device sees the same dictionary-independent
+        # identity the host AggNode does (agg_node._arg_array).
+        for out, arg_e, uda in specs:
+            if uda.string_args == "hash" and isinstance(arg_e, ColumnRef):
+                d = table.dictionaries.get(arg_e.name)
+                if d is not None:
+                    aux[f"arghash:{arg_e.name}"] = (
+                        d.content_hashes().view(np.int64)
+                    )
         for name, e in evaluator.named_exprs:
             if name.startswith("key:"):
                 continue
@@ -581,6 +601,15 @@ class MeshExecutor:
                 new_states = []
                 for (out, arg_e, uda), st in zip(specs, states):
                     col = evaluator.device_eval(arg_e, env, aux)
+                    hkey = (
+                        f"arghash:{arg_e.name}"
+                        if uda.string_args == "hash"
+                        and isinstance(arg_e, ColumnRef)
+                        else None
+                    )
+                    if hkey is not None and hkey in aux:
+                        lut = aux[hkey]
+                        col = lut[jnp.clip(col, 0, lut.shape[0] - 1)]
                     new_states.append(uda.update(st, gids, col, mask=mask))
                 from pixie_tpu.ops import segment as _segment
 
@@ -711,7 +740,9 @@ class MeshExecutor:
         return self._unpack_states(specs, staged.capacity, fbuf, ibuf)  # (states, presence)
 
     # -- finalize -----------------------------------------------------------
-    def _finalize(self, m, specs, key_plan, staged, merged_and_presence, registry):
+    def _finalize(
+        self, m, specs, key_plan, staged, merged_and_presence, registry, table
+    ):
         merged, presence = merged_and_presence
         n = max(key_plan.num_groups, 1) if m.agg_op.groups else 1
         rel = m.agg_op.output_relation([_pre_agg_relation(m, registry)], registry)
@@ -731,12 +762,27 @@ class MeshExecutor:
             )
         from pixie_tpu.types.dtypes import host_dtype
 
-        for (out_name, _, uda), st in zip(specs, merged):
+        for (out_name, arg_e, uda), st in zip(specs, merged):
             sliced = jax.tree.map(lambda a: np.asarray(a)[:n][keep], st)
             out = uda.finalize(sliced)
             schema = rel.col(out_name)
             if schema.data_type == DataType.STRING:
-                vals = np.asarray(out, dtype=object)
+                if uda.string_state:
+                    # Code-valued state (any(STRING)): decode through the
+                    # table dictionary — matches agg_node._finalized_batch.
+                    src_dict = (
+                        table.dictionaries.get(arg_e.name)
+                        if isinstance(arg_e, ColumnRef)
+                        else None
+                    )
+                    codes = np.asarray(out)
+                    vals = (
+                        src_dict.decode(codes)
+                        if src_dict is not None
+                        else np.full(len(codes), "", dtype=object)
+                    )
+                else:
+                    vals = np.asarray(out, dtype=object)
                 d = StringDictionary()
                 out_cols.append(DictColumn(d.encode(vals), d))
             else:

@@ -30,12 +30,13 @@ class StringDictionary:
     only ever grows, and lookups take the lock only on miss.
     """
 
-    __slots__ = ("_values", "_index", "_lock")
+    __slots__ = ("_values", "_index", "_lock", "_hashes")
 
     def __init__(self, values: list[str] | None = None):
         self._values: list[str] = list(values) if values else []
         self._index: dict[str, int] = {v: i for i, v in enumerate(self._values)}
         self._lock = threading.Lock()
+        self._hashes: np.ndarray = np.empty(0, dtype=np.uint64)
 
     def __len__(self) -> int:
         return len(self._values)
@@ -79,6 +80,33 @@ class StringDictionary:
 
     def values(self) -> list[str]:
         return list(self._values)
+
+    def content_hashes(self) -> np.ndarray:
+        """Stable uint64 content hash per dictionary value (FNV-1a over
+        utf-8), incrementally extended as the dictionary grows.
+
+        Gathered through codes, this gives UDAs a dictionary-independent
+        view of string identity — two agents (or two tables in a union)
+        that encode the same string under different codes still agg into
+        the same sketch bucket (ref: the reference hashes the string value
+        itself via RowTuple/absl hash, src/carnot/exec/row_tuple.h)."""
+        n = len(self._values)
+        if len(self._hashes) < n:
+            with self._lock:
+                m = len(self._hashes)
+                if m < n:
+                    new = [_fnv1a64(self._values[i]) for i in range(m, n)]
+                    self._hashes = np.concatenate(
+                        [self._hashes, np.array(new, dtype=np.uint64)]
+                    )
+        return self._hashes
+
+
+def _fnv1a64(s: str) -> np.uint64:
+    h = 0xCBF29CE484222325
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return np.uint64(h)
 
 
 @dataclass

@@ -43,6 +43,10 @@ def register(r: Registry) -> None:
             finalize=fin,
             merge_kind=MergeKind.PMAX,
             out_semantic=lambda sems: sems[0] if sems else None,
+            # String state holds codes that must decode back to the value,
+            # so it rides the latched-dictionary path, not content hashes.
+            string_args="code",
+            string_state=(arg_t == S),
             doc="An arbitrary (deterministic: max) value from the group.",
         )
 

@@ -92,6 +92,19 @@ class UDA:
     out_semantic: SemanticType | Callable | None = None
     # True when finalize output must be produced on host (e.g. JSON strings).
     host_finalize: bool = False
+    # How STRING args are presented to update():
+    #   "hash" — stable uint64 content hashes of the values (dictionary-
+    #            independent; safe across unions and the distributed
+    #            PARTIAL/MERGE split where every agent has its own
+    #            write-side dictionary). Right for sketches.
+    #   "code" — codes re-encoded into the agg node's latched per-column
+    #            dictionary. Right for UDAs whose state/output must remain
+    #            decodable back to the string (e.g. any(STRING)).
+    string_args: str = "hash"
+    # True when the state itself holds codes into the latched dictionary of
+    # arg 0; the partial stage then ships that dictionary in the StateBatch
+    # and the merge stage translates incoming codes into its own latch.
+    string_state: bool = False
     doc: str = ""
 
     @property

@@ -376,3 +376,168 @@ def test_exec_stats(store):
     assert stats["MemorySource[0]"]["rows_out"] == 6
     assert stats["MemorySink[1]"]["rows_in"] == 6
     assert stats["MemorySink[1]"]["total_time_ns"] > 0
+
+
+def test_union_hll_cross_dictionary():
+    """approx_count_distinct over a union of tables with different write-side
+    dictionaries: string identity must survive code collisions (ADVICE r1 —
+    string args now reach sketch UDAs as content hashes, not local codes)."""
+    ts = TableStore()
+    rel = Relation.of(("service", S), ("v", F))  # no time_: passthrough union
+    t1 = ts.create_table("u1", rel)
+    t1.write_pydict({"service": ["x", "y"], "v": [1.0, 2.0]})
+    t1.stop()
+    t2 = ts.create_table("u2", rel)
+    # Different insertion order: "y" is code 0 here but code 1 in u1.
+    t2.write_pydict({"service": ["y", "z"], "v": [3.0, 4.0]})
+    t2.stop()
+
+    f = PlanFragment()
+    a = f.add(MemorySourceOp("u1"))
+    b = f.add(MemorySourceOp("u2"))
+    u = f.add(UnionOp(), [a, b])
+    agg = f.add(
+        AggOp(
+            groups=(),
+            values=(
+                (
+                    "nd",
+                    AggregateExpression(
+                        "approx_count_distinct", (ColumnRef("service"),)
+                    ),
+                ),
+            ),
+        ),
+        [u],
+    )
+    f.add(MemorySinkOp("out"), [agg])
+    rows = sink_rows(run_fragment(f, ts))
+    assert rows["nd"] == [3]  # {x, y, z}; code-collision would give 2
+
+
+def test_partial_merge_any_string():
+    """any(STRING) across the PARTIAL/MERGE split with per-agent
+    dictionaries: code states are translated through the shipped dictionary
+    at merge, and finalize decodes to a real value (ADVICE r1)."""
+    ts = TableStore()
+    rel = Relation.of(("service", S), ("v", F))
+    t1 = ts.create_table("p1", rel)
+    t1.write_pydict({"service": ["x"], "v": [1.0]})
+    t1.stop()
+    t2 = ts.create_table("p2", rel)
+    t2.write_pydict({"service": ["z"], "v": [2.0]})
+    t2.stop()
+
+    router = BridgeRouter()
+    router.register_producer("q1", "b0")
+    router.register_producer("q1", "b0")
+    for tname in ("p1", "p2"):
+        pre = PlanFragment()
+        src = pre.add(MemorySourceOp(tname))
+        part = pre.add(
+            AggOp(
+                groups=(),
+                values=(
+                    ("who", AggregateExpression("any", (ColumnRef("service"),))),
+                ),
+                stage=AggStage.PARTIAL,
+            ),
+            [src],
+        )
+        pre.add(BridgeSinkOp("b0"), [part])
+        run_fragment(pre, ts, router)
+
+    post = PlanFragment()
+    bsrc = post.add(BridgeSourceOp("b0", Relation.of(("who", S))))
+    merge = post.add(
+        AggOp(
+            groups=(),
+            values=(
+                ("who", AggregateExpression("any", (ColumnRef("service"),))),
+            ),
+            stage=AggStage.MERGE,
+            pre_agg_relation=rel,
+        ),
+        [bsrc],
+    )
+    post.add(MemorySinkOp("out"), [merge])
+    state = ExecState("q1", ts, default_registry(), router=router)
+    g = ExecutionGraph(post, state)
+    g.execute()
+    rows = sink_rows(g)
+    assert rows["who"][0] in ("x", "z")
+
+
+def test_union_ordered_incremental():
+    """Ordered union emits incrementally below the min live watermark
+    instead of buffering until global eos (ADVICE r1 — streaming unions
+    previously never emitted)."""
+    from pixie_tpu.exec.nodes import UnionNode
+    from pixie_tpu.plan.operators import UnionOp as UOp
+    from pixie_tpu.table.row_batch import RowBatch
+
+    rel = Relation.of(("time_", T), ("v", F))
+    node = UnionNode(UOp(), rel, 0)
+    node.parent_nodes = [None, None]
+    collected = []
+
+    class FakeChild:
+        stats = type("St", (), {"total_time_ns": 0})()
+
+        def consume_next(self, st, b, idx=0):
+            collected.append(b)
+
+    node.add_child(FakeChild())
+    ts = TableStore()
+    state = ExecState("q", ts, default_registry())
+    node.prepare_impl(state)
+
+    node.consume_next(
+        state, RowBatch.from_pydict(rel, {"time_": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+    )
+    assert not collected  # only one parent has produced: no safe cutoff
+    node.consume_next(
+        state,
+        RowBatch.from_pydict(rel, {"time_": [2, 4], "v": [20.0, 40.0]}),
+        parent_index=1,
+    )
+    # min watermark = 3 -> rows with t < 3 are safe.
+    assert [b.to_pydict()["time_"] for b in collected] == [[1, 2, 2]]
+    assert not collected[0].eos
+    node.consume_next(
+        state,
+        RowBatch.from_pydict(rel, {"time_": [5, 6], "v": [5.0, 6.0]}, eos=True),
+    )
+    node.consume_next(
+        state,
+        RowBatch.from_pydict(rel, {"time_": [5], "v": [50.0]}, eos=True),
+        parent_index=1,
+    )
+    assert collected[-1].eos
+    all_times = [t for b in collected for t in b.to_pydict()["time_"]]
+    assert all_times == [1, 2, 2, 3, 4, 5, 5, 6]
+
+
+def test_seg_sum_f64_matmul_precision():
+    """The MXU matmul path must track f64 scatter sums (ADVICE r1: it used
+    to accumulate in f32, diverging for x64 values)."""
+    import jax.numpy as jnp
+
+    from pixie_tpu.ops import segment
+
+    rng = np.random.default_rng(3)
+    n, s = 50_000, 16
+    vals = rng.exponential(1e9, n) + rng.random(n)  # needs > f32 mantissa
+    gids = rng.integers(0, s, n)
+    expect = np.zeros(s)
+    np.add.at(expect, gids, vals)
+    segment.set_strategy("matmul")
+    try:
+        got = np.asarray(
+            segment.seg_sum(
+                jnp.asarray(vals), jnp.asarray(gids, jnp.int32), s
+            )
+        )
+    finally:
+        segment.set_strategy(None)
+    np.testing.assert_allclose(got, expect, rtol=1e-7)

@@ -243,3 +243,28 @@ def test_mesh_hll_pmax_merge(mesh):
     )
     rows = res.table("out")
     assert all(u == 2 for u in rows["u"])
+
+
+def test_mesh_string_sketches_match_host(mesh):
+    """Device path feeds sketch UDAs content hashes (not local codes) and
+    decodes any(STRING) state through the table dictionary, matching the
+    host AggNode exactly (code-review r2 finding)."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    ch, _ = seed_carnot(None)
+    q = (
+        "df = px.DataFrame(table='http_events')\n"
+        "out = df.groupby(['service']).agg(\n"
+        "    nd=('upid', px.approx_count_distinct),\n"
+        "    who=('upid', px.any),\n"
+        ")\n"
+        "px.display(out, 'out')\n"
+    )
+    rows_d = cd.execute_query(q).table("out")
+    rows_h = ch.execute_query(q).table("out")
+    dd = dict(zip(rows_d["service"], zip(rows_d["nd"], rows_d["who"])))
+    hh = dict(zip(rows_h["service"], zip(rows_h["nd"], rows_h["who"])))
+    assert set(dd) == set(hh) == {"a", "b", "c"}
+    for svc in "abc":
+        # Content-hash identity: device == host estimate exactly.
+        assert dd[svc][0] == hh[svc][0] == 2
+        assert dd[svc][1] in ("1:1:1", "2:2:2")
