@@ -58,7 +58,6 @@ class _AggSpec:
     out_name: str
     uda: Any
     arg_names: tuple[str, ...]
-    arg_is_string: tuple[bool, ...]
 
 
 class AggNode(ExecNode):
@@ -70,6 +69,8 @@ class AggNode(ExecNode):
         self._capacity = INITIAL_CAPACITY if op.groups else 1
         self._states: dict[str, Any] = {}
         self._key_dicts: dict[str, Optional[StringDictionary]] = {}
+        # name -> (live source dictionary, snapshot length at latch time)
+        self._key_dict_sources: dict[str, tuple] = {}
         self._input_relation: Optional[Relation] = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -89,7 +90,7 @@ class AggNode(ExecNode):
                     f"no aggregate {agg.name}"
                     f"({', '.join(t.name for t in arg_types)})"
                 )
-            names, is_str = [], []
+            names = []
             for a in agg.args:
                 if not isinstance(a, ColumnRef):
                     raise ValueError(
@@ -97,8 +98,7 @@ class AggNode(ExecNode):
                         "hoists computed args into a Map)"
                     )
                 names.append(a.name)
-                is_str.append(rel.col(a.name).data_type == DataType.STRING)
-            self._specs.append(_AggSpec(out_name, uda, tuple(names), tuple(is_str)))
+            self._specs.append(_AggSpec(out_name, uda, tuple(names)))
         self._states = {
             s.out_name: s.uda.init(self._capacity) for s in self._specs
         }
@@ -116,8 +116,8 @@ class AggNode(ExecNode):
             self._ensure_capacity(self._encoder.num_groups or 1)
             for spec in self._specs:
                 cols = [
-                    self._arg_array(batch, n, s, spec.uda.string_args)
-                    for n, s in zip(spec.arg_names, spec.arg_is_string)
+                    self._arg_array(batch, n, spec.uda.string_args)
+                    for n in spec.arg_names
                 ]
                 self._states[spec.out_name] = spec.uda.update(
                     self._states[spec.out_name], gids, *cols
@@ -126,14 +126,28 @@ class AggNode(ExecNode):
             self._emit(exec_state, eow=batch.eow, eos=batch.eos)
 
     def _latch_key_column(self, name: str, col):
-        """Latch the first dictionary seen per string key column; re-encode
-        cross-dictionary batches (e.g. across a union) into it so codes stay
-        comparable."""
+        """Latch a PRIVATE snapshot of the first dictionary seen per string
+        key column; re-encode cross-dictionary batches (e.g. across a union)
+        into it so codes stay comparable. Snapshotting keeps encode() from
+        polluting a live table's write-side dictionary with values from
+        other tables/agents (code-review r2). Codes below the snapshot
+        length are stable (dictionaries are append-only), so the common
+        single-table case skips the re-encode."""
         if isinstance(col, DictColumn):
             existing = self._key_dicts.get(name)
             if existing is None:
-                self._key_dicts[name] = col.dictionary
-            elif col.dictionary is not existing:
+                src = col.dictionary
+                existing = StringDictionary(src.values())
+                self._key_dicts[name] = existing
+                self._key_dict_sources[name] = (src, len(existing))
+            src_info = self._key_dict_sources.get(name)
+            if (
+                src_info is not None
+                and col.dictionary is src_info[0]
+                and (len(col.codes) == 0 or int(col.codes.max()) < src_info[1])
+            ):
+                return DictColumn(col.codes, existing)
+            if col.dictionary is not existing:
                 col = DictColumn(existing.encode(col.decode()), existing)
         return col
 
@@ -145,7 +159,7 @@ class AggNode(ExecNode):
         ]
         return self._encoder.encode(key_cols)
 
-    def _arg_array(self, batch: RowBatch, name: str, is_string: bool, mode: str):
+    def _arg_array(self, batch: RowBatch, name: str, mode: str):
         col = batch.col(name)
         if isinstance(col, DictColumn):
             if mode == "hash":
@@ -237,7 +251,9 @@ class AggNode(ExecNode):
                 if s.uda.string_state:
                     d = self._key_dicts.get(s.arg_names[0])
                     if d is not None:
-                        arg_dicts[s.out_name] = d
+                        # Copy: the consumer may encode into this dictionary
+                        # (merge translation); never hand out our latch.
+                        arg_dicts[s.out_name] = StringDictionary(d.values())
             self.send(
                 exec_state,
                 StateBatch(
@@ -307,6 +323,7 @@ class AggNode(ExecNode):
     def _reset_window(self) -> None:
         self._encoder.reset()
         self._key_dicts.clear()
+        self._key_dict_sources.clear()
         self._capacity = INITIAL_CAPACITY if self.op.groups else 1
         self._states = {
             s.out_name: s.uda.init(self._capacity) for s in self._specs

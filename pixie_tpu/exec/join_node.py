@@ -37,7 +37,9 @@ class EquijoinNode(ExecNode):
         self._build_batches: list[RowBatch] = []
         self._build_done = False
         self._build: Optional[RowBatch] = None
-        self._build_rows_by_gid: list[list[int]] = []
+        self._build_counts: np.ndarray = np.empty(0, np.int64)
+        self._build_order: np.ndarray = np.empty(0, np.int64)
+        self._build_starts: np.ndarray = np.zeros(1, np.int64)
         self._build_matched: Optional[np.ndarray] = None
         self._pending_probe: list[RowBatch] = []
         self._probe_eos = False
@@ -78,9 +80,18 @@ class EquijoinNode(ExecNode):
             gids = self._encoder.encode(keys)
         else:
             gids = np.empty(0, np.int32)
-        self._build_rows_by_gid = [[] for _ in range(self._encoder.num_groups)]
-        for row, g in enumerate(gids):
-            self._build_rows_by_gid[g].append(row)
+        # CSR layout over build rows grouped by gid: rows of group g are
+        # _build_order[_build_starts[g] : _build_starts[g+1]], in build
+        # order (stable sort) — the vectorized stand-in for the reference's
+        # per-key bucket vectors (equijoin_node.h:48).
+        n_groups = self._encoder.num_groups
+        self._build_counts = np.bincount(gids, minlength=n_groups).astype(
+            np.int64
+        )
+        self._build_order = np.argsort(gids, kind="stable")
+        self._build_starts = np.concatenate(
+            [[0], np.cumsum(self._build_counts)]
+        )
         self._build_matched = np.zeros(self._build.num_rows, dtype=bool)
 
     # -- probe --------------------------------------------------------------
@@ -113,26 +124,35 @@ class EquijoinNode(ExecNode):
                         build_col.dictionary,
                     )
             keys.append(col)
-        gids = self._encoder.lookup(keys)
-        left_idx: list[int] = []
-        right_idx: list[int] = []
-        unmatched_right: list[int] = []
-        for row, g in enumerate(gids):
-            if g < 0 or not self._build_rows_by_gid[g]:
-                unmatched_right.append(row)
-                continue
-            for brow in self._build_rows_by_gid[g]:
-                left_idx.append(brow)
-                right_idx.append(row)
-            self._build_matched[self._build_rows_by_gid[g]] = True
-        if left_idx:
+        gids = np.asarray(self._encoder.lookup(keys), dtype=np.int64)
+        n_groups = len(self._build_counts)
+        if n_groups == 0:
+            matched = np.zeros(len(gids), dtype=bool)
+            fanout = np.zeros(len(gids), dtype=np.int64)
+        else:
+            g_safe = np.clip(gids, 0, n_groups - 1)
+            matched = gids >= 0
+            fanout = np.where(matched, self._build_counts[g_safe], 0)
+            matched = matched & (fanout > 0)
+            fanout = np.where(matched, fanout, 0)
+        total = int(fanout.sum())
+        if total:
+            # probe row i pairs with build rows order[starts[g_i] + 0..c_i-1]
+            right_idx = np.repeat(np.arange(len(gids)), fanout)
+            run_base = np.repeat(np.cumsum(fanout) - fanout, fanout)
+            ramp = np.arange(total) - run_base
+            left_idx = self._build_order[
+                self._build_starts[g_safe][right_idx] + ramp
+            ]
+            self._build_matched[left_idx] = True
             self._emit_matches(
                 exec_state,
-                self._build.take(np.asarray(left_idx)),
-                batch.take(np.asarray(right_idx)),
+                self._build.take(left_idx),
+                batch.take(right_idx),
             )
-        if unmatched_right and self.op.how in (JoinType.RIGHT, JoinType.OUTER):
-            right_part = batch.take(np.asarray(unmatched_right))
+        unmatched = np.nonzero(~matched)[0]
+        if len(unmatched) and self.op.how in (JoinType.RIGHT, JoinType.OUTER):
+            right_part = batch.take(unmatched)
             self._emit_matches(
                 exec_state,
                 _null_batch(self._left_relation, right_part.num_rows),

@@ -233,6 +233,7 @@ class UnionNode(ExecNode):
         self._ordered = False
         self._watermarks: list = []
         self._parent_eos: list = []
+        self._pending_min = None  # min buffered time: cheap no-op guard
 
     def prepare_impl(self, exec_state) -> None:
         self._num_parents = len(getattr(self, "parent_nodes", [None]))
@@ -250,6 +251,12 @@ class UnionNode(ExecNode):
                     times.max()
                     if self._watermarks[parent_index] is None
                     else max(self._watermarks[parent_index], times.max())
+                )
+                tmin = times.min()
+                self._pending_min = (
+                    tmin
+                    if self._pending_min is None
+                    else min(self._pending_min, tmin)
                 )
             if eos:
                 self._parent_eos[parent_index] = True
@@ -290,8 +297,12 @@ class UnionNode(ExecNode):
         if any(w is None for w in live):
             return  # a live parent hasn't produced yet: no safe cutoff
         cutoff = min(live) if live else None
+        if cutoff is None or (
+            self._pending_min is None or self._pending_min >= cutoff
+        ):
+            return  # nothing can be ready: skip the concat+sort entirely
         merged = self._merged_pending()
-        if merged is None or cutoff is None:
+        if merged is None:
             return
         times = np.asarray(merged.col(TIME_COLUMN))
         n_ready = int(np.searchsorted(times, cutoff, side="left"))
@@ -303,6 +314,7 @@ class UnionNode(ExecNode):
         )
         rest = merged.slice(n_ready, merged.num_rows)
         self._buffer = [rest] if rest.num_rows else []
+        self._pending_min = times[n_ready] if rest.num_rows else None
 
     def _flush(self, exec_state) -> None:
         merged = self._merged_pending()

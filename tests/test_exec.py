@@ -541,3 +541,97 @@ def test_seg_sum_f64_matmul_precision():
     finally:
         segment.set_strategy(None)
     np.testing.assert_allclose(got, expect, rtol=1e-7)
+
+
+def test_join_fanout_and_outer(store):
+    """Duplicate build keys fan out per probe row; OUTER pads both sides
+    (vectorized CSR probe, ref equijoin_node.{h,cc})."""
+    ts = store
+    rel = Relation.of(("service", S), ("tag", I))
+    t = ts.create_table("tags", rel)
+    # 'a' appears twice on the build side -> every probe 'a' row matches 2x.
+    t.write_pydict({"service": ["a", "a", "z"], "tag": [1, 2, 9]})
+    t.stop()
+
+    f = PlanFragment()
+    build = f.add(MemorySourceOp("tags"))
+    probe = f.add(MemorySourceOp("http_events"))
+    join = f.add(
+        JoinOp(
+            how=JoinType.OUTER,
+            left_on=("service",),
+            right_on=("service",),
+            output_columns=(
+                (1, "service", "psvc"),
+                (0, "service", "bsvc"),
+                (0, "tag", "tag"),
+                (1, "latency", "latency"),
+            ),
+        ),
+        [build, probe],
+    )
+    f.add(MemorySinkOp("out"), [join])
+    rows = sink_rows(run_fragment(f, store))
+    # http_events: a,b,a,c,b,a (3x a, 2x b, 1x c). a matches 2 build rows.
+    pairs = list(zip(rows["psvc"], rows["tag"]))
+    assert pairs.count(("a", 1)) == 3 and pairs.count(("a", 2)) == 3
+    # b, c unmatched on build side -> padded build cols (tag=0).
+    assert pairs.count(("b", 0)) == 2 and pairs.count(("c", 0)) == 1
+    # 'z' unmatched on probe side -> padded probe cols.
+    assert ("", 9) in pairs
+    assert len(pairs) == 3 * 2 + 2 + 1 + 1
+
+
+def test_join_vectorized_throughput(store):
+    """The probe path must be columnar, not per-row python (VERDICT r1 #5):
+    1M probe rows against a 1k build table in well under a second."""
+    import time
+
+    from pixie_tpu.exec.join_node import EquijoinNode
+    from pixie_tpu.table.row_batch import RowBatch
+
+    n_build, n_probe = 1_000, 1_000_000
+    lrel = Relation.of(("k", I), ("tag", I))
+    rrel = Relation.of(("k", I), ("v", F))
+    op = JoinOp(
+        how=JoinType.INNER,
+        left_on=("k",),
+        right_on=("k",),
+        output_columns=((0, "tag", "tag"), (1, "v", "v")),
+    )
+    out_rel = Relation.of(("tag", I), ("v", F))
+    node = EquijoinNode(op, out_rel, 0)
+    node.set_input_relations(lrel, rrel)
+    got = []
+
+    class FakeChild:
+        stats = type("St", (), {"total_time_ns": 0})()
+
+        def consume_next(self, st, b, idx=0):
+            got.append(b.num_rows)
+
+    node.add_child(FakeChild())
+    ts = TableStore()
+    state = ExecState("q", ts, default_registry())
+    rng = np.random.default_rng(0)
+    node.consume_next(
+        state,
+        RowBatch.from_pydict(
+            lrel,
+            {"k": np.arange(n_build), "tag": np.arange(n_build)},
+            eos=True,
+        ),
+    )
+    probe = RowBatch.from_pydict(
+        rrel,
+        {
+            "k": rng.integers(0, 2 * n_build, n_probe),
+            "v": rng.random(n_probe),
+        },
+        eos=True,
+    )
+    t0 = time.perf_counter()
+    node.consume_next(state, probe, parent_index=1)
+    dt = time.perf_counter() - t0
+    assert sum(got) == int((np.asarray(probe.col("k")) < n_build).sum())
+    assert dt < 1.0, f"probe took {dt:.2f}s for {n_probe} rows"
