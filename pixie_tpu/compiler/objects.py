@@ -127,6 +127,17 @@ class FuncRef:
     def __call__(self, *args, **kwargs):
         if kwargs:
             raise CompilerError(f"px.{self.name} takes positional args only")
+        # Flatten dict literals (px.script_reference(label, script, {...}))
+        # into alternating key/value args — the reference's compiler does the
+        # same when lowering ScriptReference (objects/pixie_module).
+        flat: list = []
+        for a in args:
+            if isinstance(a, dict):
+                for k, v in a.items():
+                    flat.extend([k, v])
+            else:
+                flat.append(a)
+        args = tuple(flat)
         df = next(
             (a.df for a in args if isinstance(a, ColumnExpr) and a.df), None
         )
@@ -204,6 +215,9 @@ _CTX_FUNCS = {
     "node_name": "upid_to_node_name",
     "pid": "upid_to_pid",
     "asid": "upid_to_asid",
+    "container": "upid_to_container_name",
+    "container_name": "upid_to_container_name",
+    "cmdline": "upid_to_cmdline",
 }
 
 
@@ -277,6 +291,8 @@ class DataFrameObj:
     def __getitem__(self, item):
         if isinstance(item, str):
             return self._col(item)
+        if isinstance(item, tuple) and all(isinstance(n, str) for n in item):
+            item = list(item)  # df['a', 'b', ...] projection sugar
         if isinstance(item, list):
             exprs = tuple((n, ColumnRef(n)) for n in item)
             for n in item:
@@ -481,17 +497,57 @@ class PxModule:
     def days(n):
         return int(n) * 86_400_000_000_000
 
-    def DurationNanos(self, n) -> int:
+    def DurationNanos(self, n):
+        if isinstance(n, ColumnExpr):
+            return ColumnExpr(FuncCall("DurationNanos", (to_expr(n),)), n.df)
         return int(n)
 
-    def Time(self, n) -> int:
+    def Time(self, n):
+        if isinstance(n, ColumnExpr):
+            return ColumnExpr(FuncCall("Time", (to_expr(n),)), n.df)
         return int(n)
+
+    # Semantic type wrappers (px.Service/px.Namespace/... appear both as
+    # parameter annotations and as value casts like px.Node(hostname)).
+    @staticmethod
+    def Service(v=None):
+        return v
+
+    @staticmethod
+    def Namespace(v=None):
+        return v
+
+    @staticmethod
+    def Pod(v=None):
+        return v
+
+    @staticmethod
+    def Node(v=None):
+        return v
+
+    @staticmethod
+    def Container(v=None):
+        return v
+
+    @staticmethod
+    def Bytes(v=None):
+        return v
+
+    @staticmethod
+    def Percent(v=None):
+        return v
+
+    @staticmethod
+    def UPID(v=None):
+        return v
 
     # -- function namespace -------------------------------------------------
     def __getattr__(self, name: str):
         # Fall through to registry functions: px.mean, px.quantiles,
-        # px.upid_to_service_name, px.bin, ...
-        if name.startswith("_"):
+        # px.upid_to_service_name, px.bin, ... Underscore-prefixed names are
+        # allowed only for the _exec_* agent-introspection UDFs
+        # (px._exec_hostname / px._exec_host_num_cpus in perf scripts).
+        if name.startswith("_") and not name.startswith("_exec_"):
             raise AttributeError(name)
         reg = self.__dict__.get("_registry")
         if reg is not None and (reg.has_scalar(name) or reg.has_uda(name)):

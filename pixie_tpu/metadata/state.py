@@ -42,6 +42,9 @@ class MetadataState:
     upid_to_pod: dict = dataclasses.field(default_factory=dict)  # upid str -> pod_id
     ip_to_pod: dict = dataclasses.field(default_factory=dict)  # ip -> pod_id
     dns: dict = dataclasses.field(default_factory=dict)  # ip -> hostname
+    # Per-process attributes (ref: shared/metadata pids.* PIDInfo).
+    upid_to_container: dict = dataclasses.field(default_factory=dict)
+    upid_to_cmdline: dict = dataclasses.field(default_factory=dict)
 
     # -- resolution helpers (the surface metadata UDFs use) ----------------
     def pod_for_upid(self, upid: str) -> Optional[PodInfo]:

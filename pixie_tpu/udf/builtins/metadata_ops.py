@@ -163,6 +163,35 @@ def register(r: Registry) -> None:
         lambda st, ip: st.dns.get(ip, ip),
     )
     reg("_exec_hostname", (), S, lambda st: st.hostname)
+
+    def _num_cpus(st):
+        import os
+
+        return os.cpu_count() or 1
+
+    r.register_scalar(
+        ScalarUDF(
+            "_exec_host_num_cpus",
+            (),
+            I,
+            _lift(lambda st: _num_cpus(st), np.int64),
+            Executor.HOST,
+            dict_compatible=False,
+            needs_ctx=True,
+        )
+    )
+    reg(
+        "upid_to_container_name",
+        (S,),
+        S,
+        lambda st, u: st.upid_to_container.get(u, ""),
+    )
+    reg(
+        "upid_to_cmdline",
+        (S,),
+        S,
+        lambda st, u: st.upid_to_cmdline.get(u, ""),
+    )
     reg("pod_name_to_pod_id", (S,), S,
         lambda st, name: next(
             (p.pod_id for p in st.pods.values() if p.name == name), ""

@@ -32,17 +32,19 @@ def register(r: Registry) -> None:
             )
         )
 
-    # DurationNanos: tag an int64 as a duration (semantic cast).
-    r.register_scalar(
-        ScalarUDF(
-            "DurationNanos",
-            (I,),
-            I,
-            lambda x: x.astype(jnp.int64) if hasattr(x, "astype") else x,
-            Executor.DEVICE,
-            out_semantic=SemanticType.ST_DURATION_NS,
+    # DurationNanos: tag an int64 as a duration (semantic cast). The F
+    # overload truncates (px.DurationNanos(px.floor(...)) in service_stats).
+    for arg_t in (I, F, T):
+        r.register_scalar(
+            ScalarUDF(
+                "DurationNanos",
+                (arg_t,),
+                I,
+                lambda x: x.astype(jnp.int64) if hasattr(x, "astype") else x,
+                Executor.DEVICE,
+                out_semantic=SemanticType.ST_DURATION_NS,
+            )
         )
-    )
     # Time: int64 -> TIME64NS cast.
     r.register_scalar(
         ScalarUDF(
