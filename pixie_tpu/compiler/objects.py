@@ -127,17 +127,19 @@ class FuncRef:
     def __call__(self, *args, **kwargs):
         if kwargs:
             raise CompilerError(f"px.{self.name} takes positional args only")
-        # Flatten dict literals (px.script_reference(label, script, {...}))
-        # into alternating key/value args — the reference's compiler does the
-        # same when lowering ScriptReference (objects/pixie_module).
-        flat: list = []
-        for a in args:
-            if isinstance(a, dict):
-                for k, v in a.items():
-                    flat.extend([k, v])
-            else:
-                flat.append(a)
-        args = tuple(flat)
+        # Flatten dict literals into alternating key/value args, but only for
+        # the functions that take them that way (the reference's compiler does
+        # this when lowering ScriptReference, objects/pixie_module) — a UDF
+        # that legitimately accepts a dict must not be silently exploded.
+        if self.name in ("script_reference",):
+            flat: list = []
+            for a in args:
+                if isinstance(a, dict):
+                    for k, v in a.items():
+                        flat.extend([k, v])
+                else:
+                    flat.append(a)
+            args = tuple(flat)
         df = next(
             (a.df for a in args if isinstance(a, ColumnExpr) and a.df), None
         )

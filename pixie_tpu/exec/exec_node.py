@@ -54,6 +54,11 @@ class ExecNode:
 
     is_source = False
     is_sink = False
+    # Whether this node emits rows in nondecreasing time_ order given
+    # time-ordered inputs. Reordering operators (joins: unmatched rows trail
+    # matched ones) override to False; ordered unions consult their
+    # ancestry's flags to decide if incremental merge-emission is sound.
+    preserves_time_order = True
 
     def __init__(self, op, output_relation: Relation, node_id: int):
         self.op = op

@@ -30,6 +30,10 @@ OUTPUT_CHUNK_ROWS = 1 << 17
 
 
 class EquijoinNode(ExecNode):
+    # Matched probe rows are emitted before (possibly earlier-timed)
+    # RIGHT/OUTER-unmatched rows: output is not time-ordered.
+    preserves_time_order = False
+
     def __init__(self, op: JoinOp, output_relation: Relation, node_id: int):
         super().__init__(op, output_relation, node_id)
         self.op: JoinOp = op

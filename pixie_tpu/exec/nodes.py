@@ -229,8 +229,10 @@ class UnionNode(ExecNode):
         self.op: UnionOp = op
         self._num_parents = 1
         self._eos_seen = 0
-        self._buffer: list[RowBatch] = []
+        self._buffer: list[RowBatch] = []  # new, not-yet-sorted batches
+        self._sorted_rest: Optional[RowBatch] = None  # retained sorted run
         self._ordered = False
+        self._incremental = True
         self._watermarks: list = []
         self._parent_eos: list = []
         self._pending_min = None  # min buffered time: cheap no-op guard
@@ -240,6 +242,31 @@ class UnionNode(ExecNode):
         self._ordered = self.output_relation.has_column(TIME_COLUMN)
         self._watermarks = [None] * self._num_parents
         self._parent_eos = [False] * self._num_parents
+        # Incremental emission is only sound when every parent stream is
+        # time-nondecreasing. That is a *plan* property: decide it up front
+        # by walking each parent's ancestry — joins reorder rows (unmatched
+        # rows trail matched ones), so any union fed by a join buffers until
+        # eos and sorts globally (ADVICE r2 medium: a runtime watermark
+        # check alone cannot restore order once rows have been emitted).
+        self._incremental = self._ancestry_order_preserving()
+
+    def _ancestry_order_preserving(self) -> bool:
+        """True iff every ancestor declares preserves_time_order. Bridge
+        sources have no visible ancestry, but the distributed splitter cuts
+        plans *before* blocking ops (splitter.h:52) so upstream fragments
+        contain only order-preserving ops; the runtime monotonicity guard
+        covers anything that violates that invariant anyway."""
+        stack = list(getattr(self, "parent_nodes", []) or [])
+        seen: set = set()
+        while stack:
+            node = stack.pop()
+            if node is None or id(node) in seen:
+                continue
+            seen.add(id(node))
+            if not getattr(node, "preserves_time_order", True):
+                return False
+            stack.extend(getattr(node, "parent_nodes", []) or [])
+        return True
 
     def consume_next_impl(self, exec_state, batch, parent_index) -> None:
         eos = batch.eos
@@ -247,12 +274,25 @@ class UnionNode(ExecNode):
             if batch.num_rows:
                 self._buffer.append(batch)
                 times = np.asarray(batch.col(TIME_COLUMN))
-                self._watermarks[parent_index] = (
-                    times.max()
-                    if self._watermarks[parent_index] is None
-                    else max(self._watermarks[parent_index], times.max())
-                )
                 tmin = times.min()
+                tmax = times.max()
+                # Defense-in-depth for streams the plan walk can't see
+                # through (e.g. a reordering op beyond a bridge): a batch
+                # that is internally unsorted or starts before its parent's
+                # watermark flips us to the buffer-until-eos global sort.
+                # Best-effort only — it cannot recall rows already emitted.
+                if self._incremental:
+                    prev = self._watermarks[parent_index]
+                    if (prev is not None and tmin < prev) or (
+                        batch.num_rows > 1
+                        and np.any(times[1:] < times[:-1])
+                    ):
+                        self._incremental = False
+                self._watermarks[parent_index] = (
+                    tmax
+                    if self._watermarks[parent_index] is None
+                    else max(self._watermarks[parent_index], tmax)
+                )
                 self._pending_min = (
                     tmin
                     if self._pending_min is None
@@ -263,7 +303,7 @@ class UnionNode(ExecNode):
                 self._eos_seen += 1
             if self._eos_seen >= self._num_parents:
                 self._flush(exec_state)
-            else:
+            elif self._incremental:
                 self._emit_ready(exec_state)
             return
         if batch.num_rows:
@@ -279,11 +319,33 @@ class UnionNode(ExecNode):
                 )
 
     def _merged_pending(self) -> Optional[RowBatch]:
-        if not self._buffer:
-            return None
-        merged = RowBatch.concat(self._buffer)
-        order = np.argsort(np.asarray(merged.col(TIME_COLUMN)), kind="stable")
-        return merged.take(order)
+        """Sort only the new batches, then linear-merge with the retained
+        sorted run — avoids re-sorting the whole buffer per batch when one
+        parent lags (the remainder can grow large)."""
+        new = None
+        if self._buffer:
+            new = RowBatch.concat(self._buffer)
+            order = np.argsort(np.asarray(new.col(TIME_COLUMN)), kind="stable")
+            new = new.take(order)
+        self._buffer = []
+        rest = self._sorted_rest
+        self._sorted_rest = None
+        if rest is None or not rest.num_rows:
+            return new
+        if new is None or not new.num_rows:
+            return rest
+        a = np.asarray(rest.col(TIME_COLUMN))
+        b = np.asarray(new.col(TIME_COLUMN))
+        # Interleave two sorted runs: each b-row lands after the a-rows that
+        # precede it (stable: ties keep rest before new).
+        b_pos = np.searchsorted(a, b, side="right") + np.arange(len(b))
+        total = len(a) + len(b)
+        mask = np.ones(total, dtype=bool)
+        mask[b_pos] = False
+        perm = np.empty(total, dtype=np.int64)
+        perm[np.nonzero(mask)[0]] = np.arange(len(a))
+        perm[b_pos] = len(a) + np.arange(len(b))
+        return RowBatch.concat([rest, new]).take(perm)
 
     def _emit_ready(self, exec_state) -> None:
         """Emit rows with time strictly below the min watermark of live
@@ -307,13 +369,14 @@ class UnionNode(ExecNode):
         times = np.asarray(merged.col(TIME_COLUMN))
         n_ready = int(np.searchsorted(times, cutoff, side="left"))
         if n_ready == 0:
+            self._sorted_rest = merged  # keep the merged run for next time
             return
         self.send(
             exec_state,
             merged.slice(0, n_ready).with_flags(eow=False, eos=False),
         )
         rest = merged.slice(n_ready, merged.num_rows)
-        self._buffer = [rest] if rest.num_rows else []
+        self._sorted_rest = rest if rest.num_rows else None
         self._pending_min = times[n_ready] if rest.num_rows else None
 
     def _flush(self, exec_state) -> None:
@@ -326,6 +389,7 @@ class UnionNode(ExecNode):
                 RowBatch.with_zero_rows(self.output_relation, eow=True, eos=True),
             )
         self._buffer = []
+        self._sorted_rest = None
 
 
 class MemorySinkNode(SinkNode):

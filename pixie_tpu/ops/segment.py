@@ -72,7 +72,7 @@ def _matvec_sum(values_f32, seg_ids, num_segments: int):
     return values_f32 @ oh
 
 
-_F64_CHUNK = 1024  # bounds f32 in-chunk accumulation error to ~1e-8 relative
+_F64_CHUNK = 256  # bounds f32 in-chunk accumulation error (~chunk*eps relative)
 
 
 def _matvec_sum_f64(values, seg_ids, num_segments: int):
