@@ -184,6 +184,8 @@ class MeshExecutor:
         # Offload is best-effort; failures fall back to the host engine but
         # must stay observable (one log per distinct error signature).
         self.fallback_errors: dict[str, str] = {}
+        # (uda set, capacity) -> (finalize modes, packed-output templates).
+        self._finmode_cache: dict[tuple, Any] = {}
 
     # -- public -------------------------------------------------------------
     def try_execute_fragment(
@@ -513,10 +515,50 @@ class MeshExecutor:
         return aux
 
     # -- the program --------------------------------------------------------
+    def _finalize_modes(self, specs, capacity):
+        """Per-spec device-finalization mode + packed-output leaf templates.
+
+        Modes: 'devfin' (UDA supplies a traceable device_finalize — the
+        numeric reduction fuses into the program, host only formats),
+        'fin' (finalize itself traces — fuse it), 'state' (pack raw state,
+        finalize on host). Templates are (treedef, [(shape, dtype)..]) of
+        whatever the program will pack for that spec, so the single fetched
+        buffer can be split back without guessing."""
+        cache_key = (
+            tuple((uda.name, uda.arg_types) for _, _, uda in specs),
+            capacity,
+        )
+        cached = self._finmode_cache.get(cache_key)
+        if cached is not None:
+            return cached
+        modes = []
+        templates = []
+        for _, _, uda in specs:
+            state_aval = jax.eval_shape(lambda u=uda: u.init(capacity))
+            if uda.device_finalize is not None:
+                mode = "devfin"
+                out_aval = jax.eval_shape(uda.device_finalize, state_aval)
+            else:
+                try:
+                    out_aval = jax.eval_shape(uda.finalize, state_aval)
+                    mode = "fin"
+                except Exception:
+                    mode = "state"
+                    out_aval = state_aval
+            leaves, treedef = jax.tree.flatten(out_aval)
+            modes.append(mode)
+            templates.append(
+                (treedef, [(tuple(l.shape), l.dtype) for l in leaves])
+            )
+        self._finmode_cache[cache_key] = (modes, templates)
+        return modes, templates
+
     def _signature(self, m, specs, key_plan, staged, aux_vals) -> str:
         """Structural identity of the compiled program: expressions, UDA
         set, key mode, block geometry, capacity, aux shapes."""
+        modes, _ = self._finalize_modes(specs, staged.capacity)
         parts = [
+            "finmodes:" + ",".join(modes),
             ",".join(f"{n}:{a.shape}:{a.dtype}" for n, a in
                      sorted(staged.blocks.items())),
             f"mask:{staged.mask.shape}",
@@ -543,6 +585,7 @@ class MeshExecutor:
     def _build_program(self, m, specs, evaluator, key_plan, staged, aux_key_order):
         axis = self.mesh.axis_names[0]
         capacity = staged.capacity
+        fin_modes, _ = self._finalize_modes(specs, capacity)
         col_names = sorted(staged.blocks)
         has_host_gids = key_plan.host_gids is not None
         has_key_lut = isinstance(key_plan.device_expr, tuple)
@@ -651,80 +694,95 @@ class MeshExecutor:
                             acc, jax.tree.map(lambda x: x[i2], gathered)
                         )
                     merged.append(acc)
-            # Pack every state leaf into two dtype-segregated buffers so the
-            # host pays TWO device fetches per query, not one per leaf
-            # (each fetch over a remote link costs ~100ms of round trip).
-            # ints keep 32-bit exactness; floats ride f32.
-            fparts, iparts = [], []
-            for x in jax.tree.leaves(tuple(merged)):
-                if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.bool_:
-                    iparts.append(jnp.ravel(x).astype(jnp.int64))
+            # Finalize on device where the UDA allows it, then pack every
+            # output/state leaf into ONE f64 buffer (ints ride exactly via
+            # bitcast) so the host pays a single device fetch per query —
+            # each fetch over a remote link costs ~100ms of round trip, and
+            # fusing finalize also kills the state re-upload the host
+            # quantile computation used to need.
+            outs = []
+            for mode, (_, _, uda), st in zip(fin_modes, specs, merged):
+                if mode == "devfin":
+                    outs.append(uda.device_finalize(st))
+                elif mode == "fin":
+                    outs.append(uda.finalize(st))
                 else:
-                    fparts.append(jnp.ravel(x).astype(jnp.float64))
-            iparts.append(presence)  # always the trailing [capacity] ints
-            fbuf = (
-                jnp.concatenate(fparts) if fparts else jnp.zeros(1, jnp.float64)
-            )
-            ibuf = jnp.concatenate(iparts)
-            return fbuf, ibuf
+                    outs.append(st)
+
+            def pack(x):
+                # int64 must survive exactly (hash codes use all 64 bits)
+                # but TPU bitcast s64<->f64 is broken; split into hi/lo
+                # 32-bit halves, each exactly representable in f64.
+                if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.bool_:
+                    v = jnp.ravel(x).astype(jnp.int64)
+                    hi = jnp.floor_divide(v, 1 << 32)
+                    lo = v - hi * (1 << 32)
+                    return jnp.concatenate(
+                        [hi.astype(jnp.float64), lo.astype(jnp.float64)]
+                    )
+                return jnp.ravel(x).astype(jnp.float64)
+
+            parts = [pack(x) for x in jax.tree.leaves(tuple(outs))]
+            parts.append(pack(presence))
+            return jnp.concatenate(parts)
 
         n_sharded = len(col_names) + 1 + (1 if has_host_gids else 0)
         n_repl = (1 if has_key_lut else 0) + len(aux_key_order)
         in_specs = tuple([P(axis)] * n_sharded + [P()] * n_repl)
-        out_specs = (P(), P())
         return jax.jit(
             shard_map(
                 shard_fn,
                 mesh=self.mesh,
                 in_specs=in_specs,
-                out_specs=out_specs,
+                out_specs=P(),
                 **_SM_CHECK_KW,
             )
         )
 
     @staticmethod
-    def _unpack_states(specs, capacity, fbuf, ibuf):
-        """Rebuild per-UDA state pytrees (np arrays) + the presence counts
-        from the packed buffers."""
-        shapes = jax.eval_shape(
-            lambda: tuple(uda.init(capacity) for _, _, uda in specs)
-        )
-        leaves, treedef = jax.tree.flatten(shapes)
-        fbuf = np.asarray(fbuf)
-        ibuf = np.asarray(ibuf)
-        fo = io = 0
-        out_leaves = []
-        for leaf in leaves:
-            size = int(np.prod(leaf.shape)) if leaf.shape else 1
-            if np.issubdtype(leaf.dtype, np.integer) or leaf.dtype == np.bool_:
-                arr = ibuf[io : io + size].reshape(leaf.shape)
-                io += size
-            else:
-                arr = fbuf[fo : fo + size].reshape(leaf.shape)
-                fo += size
-            out_leaves.append(arr.astype(leaf.dtype))
-        presence = ibuf[io : io + capacity]
-        return jax.tree.unflatten(treedef, out_leaves), presence
+    def _unpack_outputs(templates, capacity, buf):
+        """Split the single fetched f64 buffer back into per-spec values
+        (finalized arrays or raw state pytrees, per the build-time
+        templates) + the presence counts. Integer leaves were bitcast, so
+        the int64 bit patterns round-trip exactly."""
+        buf = np.asarray(buf)
+        off = 0
+
+        def unpack_int(size):
+            nonlocal off
+            hi = buf[off : off + size].astype(np.int64)
+            lo = buf[off + size : off + 2 * size].astype(np.int64)
+            off += 2 * size
+            return (hi << 32) + lo
+
+        values = []
+        for treedef, leaves in templates:
+            out_leaves = []
+            for shape, dtype in leaves:
+                size = int(np.prod(shape)) if shape else 1
+                if np.issubdtype(dtype, np.integer) or dtype == np.bool_:
+                    arr = unpack_int(size).astype(dtype).reshape(shape)
+                else:
+                    arr = buf[off : off + size].astype(dtype).reshape(shape)
+                    off += size
+                out_leaves.append(arr)
+            values.append(jax.tree.unflatten(treedef, out_leaves))
+        presence = unpack_int(capacity)
+        return values, presence
 
     def _run_program(self, m, specs, evaluator, key_plan, staged, aux):
         col_names = sorted(staged.blocks)
         aux_vals = list(aux.values())
         sig = self._signature(m, specs, key_plan, staged, aux_vals)
         entry = self._program_cache.get(sig)
-        if entry is None:
+        if entry is None or entry[1] != len(aux_vals):
             aux_key_order = list(aux.keys())
             program = self._build_program(
                 m, specs, evaluator, key_plan, staged, aux_key_order
             )
-            self._program_cache[sig] = (program, len(aux_key_order))
-        else:
-            program, n_aux = entry
-            if n_aux != len(aux_vals):  # paranoia: rebuild on drift
-                program = self._build_program(
-                    m, specs, evaluator, key_plan, staged, list(aux.keys())
-                )
-                self._program_cache[sig] = (program, len(aux_vals))
-        program = self._program_cache[sig][0]
+            _, templates = self._finalize_modes(specs, staged.capacity)
+            self._program_cache[sig] = (program, len(aux_key_order), templates)
+        program, _, templates = self._program_cache[sig]
         args = [staged.blocks[n] for n in col_names] + [staged.mask]
         if key_plan.host_gids is not None:
             args.append(staged.gids)
@@ -736,14 +794,16 @@ class MeshExecutor:
         from pixie_tpu.ops import segment as _segment
 
         with _segment.platform_hint(self.mesh.devices.flat[0].platform):
-            fbuf, ibuf = program(*args)
-        return self._unpack_states(specs, staged.capacity, fbuf, ibuf)  # (states, presence)
+            buf = program(*args)
+        # ONE blocking fetch: covers compute completion + the transfer.
+        return self._unpack_outputs(templates, staged.capacity, buf)
 
     # -- finalize -----------------------------------------------------------
     def _finalize(
-        self, m, specs, key_plan, staged, merged_and_presence, registry, table
+        self, m, specs, key_plan, staged, outputs_and_presence, registry, table
     ):
-        merged, presence = merged_and_presence
+        values, presence = outputs_and_presence
+        modes, _ = self._finalize_modes(specs, staged.capacity)
         n = max(key_plan.num_groups, 1) if m.agg_op.groups else 1
         rel = m.agg_op.output_relation([_pre_agg_relation(m, registry)], registry)
         # Only observed groups are emitted (host-engine semantics): drop
@@ -762,9 +822,17 @@ class MeshExecutor:
             )
         from pixie_tpu.types.dtypes import host_dtype
 
-        for (out_name, arg_e, uda), st in zip(specs, merged):
-            sliced = jax.tree.map(lambda a: np.asarray(a)[:n][keep], st)
-            out = uda.finalize(sliced)
+        for (out_name, arg_e, uda), mode, val in zip(specs, modes, values):
+            if mode == "state":
+                sliced = jax.tree.map(lambda a: np.asarray(a)[:n][keep], val)
+                out = uda.finalize(sliced)
+            else:
+                arr = np.asarray(val)[:n][keep]
+                out = (
+                    uda.format_output(arr)
+                    if mode == "devfin" and uda.format_output is not None
+                    else arr
+                )
             schema = rel.col(out_name)
             if schema.data_type == DataType.STRING:
                 if uda.string_state:

@@ -66,6 +66,10 @@ def register(r: Registry) -> None:
             finalize=lambda st: _format_quantiles(
                 np.asarray(histogram.quantile_values(st, QUANTILE_QS))
             ),
+            device_finalize=lambda st: histogram.quantile_values(
+                st, QUANTILE_QS
+            ),
+            format_output=_format_quantiles,
             merge_kind=MergeKind.PSUM,
             out_semantic=_quantile_semantic,
             host_finalize=True,
@@ -90,6 +94,10 @@ def register(r: Registry) -> None:
             finalize=lambda st: _format_quantiles(
                 np.asarray(tdigest.quantile_values(st, QUANTILE_QS))
             ),
+            device_finalize=lambda st: tdigest.quantile_values(
+                st, QUANTILE_QS
+            ),
+            format_output=_format_quantiles,
             merge_kind=MergeKind.TREE,
             out_semantic=_quantile_semantic,
             host_finalize=True,
@@ -134,6 +142,10 @@ def register(r: Registry) -> None:
             },
             merge=lambda a, b: {"cm": a["cm"] + b["cm"], "total": a["total"] + b["total"]},
             finalize=lambda st: _format_cm(st),
+            device_finalize=lambda st: jnp.stack(
+                [st["total"], st["cm"].max(axis=(1, 2))], axis=1
+            ),
+            format_output=_format_cm_totals,
             merge_kind=MergeKind.PSUM,
             host_finalize=True,
             doc=(
@@ -150,10 +162,18 @@ def register(r: Registry) -> None:
 def _format_cm(st) -> np.ndarray:
     cm = np.asarray(st["cm"])
     total = np.asarray(st["total"])
-    out = np.empty(cm.shape[0], dtype=object)
-    for g in range(cm.shape[0]):
+    return _format_cm_totals(
+        np.stack([total, cm.max(axis=(1, 2), initial=0)], axis=1)
+    )
+
+
+def _format_cm_totals(arr) -> np.ndarray:
+    """[G, 2] (total, max_est) -> metadata JSON (depth/width are static)."""
+    arr = np.asarray(arr)
+    out = np.empty(arr.shape[0], dtype=object)
+    for g in range(arr.shape[0]):
         out[g] = (
-            f'{{"total":{int(total[g])},"depth":{cm.shape[1]},'
-            f'"width":{cm.shape[2]},"max_est":{int(cm[g].max(initial=0))}}}'
+            f'{{"total":{int(arr[g, 0])},"depth":{countmin.DEFAULT_DEPTH},'
+            f'"width":{countmin.DEFAULT_WIDTH},"max_est":{int(arr[g, 1])}}}'
         )
     return out

@@ -92,6 +92,14 @@ class UDA:
     out_semantic: SemanticType | Callable | None = None
     # True when finalize output must be produced on host (e.g. JSON strings).
     host_finalize: bool = False
+    # Optional split of ``finalize`` for the device pipeline: the numeric
+    # reduction (``device_finalize``: state -> [G]/[G,K] array, traceable)
+    # fuses into the compiled mesh program so the host never re-uploads
+    # state; ``format_output`` (host) turns that array into the output
+    # column. When set, ``finalize`` must equal
+    # format_output(device_finalize(state)) for host-path parity.
+    device_finalize: Callable[[Any], Any] | None = None
+    format_output: Callable[[Any], Any] | None = None
     # How STRING args are presented to update():
     #   "hash" — stable uint64 content hashes of the values (dictionary-
     #            independent; safe across unions and the distributed
