@@ -7,16 +7,41 @@ plan emission.
 
 from __future__ import annotations
 
+import ast
+import dataclasses
 from typing import Optional
 
 from pixie_tpu.compiler import analyzer
-from pixie_tpu.compiler.ast_visitor import ASTVisitor
+from pixie_tpu.compiler.ast_visitor import ASTVisitor, _UserFunc
 from pixie_tpu.compiler.ir import IRGraph
-from pixie_tpu.compiler.objects import CompilerError, PxModule
+from pixie_tpu.compiler.objects import CompilerError, DataFrameObj, PxModule
 from pixie_tpu.plan.plan import Plan
 from pixie_tpu.types import Relation
 
-__all__ = ["Compiler", "CompilerError"]
+__all__ = ["Compiler", "CompilerError", "FuncToExecute"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FuncToExecute:
+    """One vis-spec function invocation (ref: QueryRequest.FuncToExecute in
+    src/api/proto/vizierpb — name + string arg values + output table)."""
+
+    name: str
+    args: dict
+    output_table: str
+
+
+def _cast_arg(annotation, value):
+    """Arg values arrive as strings (vis.json); cast per the function's
+    parameter annotation (int/float; px.* semantic wrappers are strings)."""
+    if isinstance(annotation, ast.Name):
+        if annotation.id == "int":
+            return int(value)
+        if annotation.id == "float":
+            return float(value)
+        if annotation.id == "bool":
+            return value in (True, "true", "True", "1")
+    return value
 
 
 class Compiler:
@@ -33,11 +58,34 @@ class Compiler:
         table_relations: dict[str, Relation],
         now_ns: Optional[int] = None,
         script_args: Optional[dict] = None,
+        exec_funcs: Optional[list[FuncToExecute]] = None,
     ) -> IRGraph:
         ir = IRGraph(self.registry, table_relations)
         px = PxModule(ir, self.registry, now_ns)
         visitor = ASTVisitor(px, globals_=script_args)
         visitor.run(query)
+        for ef in exec_funcs or []:
+            fn = visitor.env.get(ef.name)
+            if not isinstance(fn, _UserFunc):
+                raise CompilerError(
+                    f"exec func {ef.name!r} is not defined by the script"
+                )
+            annotations = {
+                a.arg: a.annotation for a in fn.node.args.args
+            }
+            kwargs = {}
+            for k, v in ef.args.items():
+                if k not in annotations:
+                    raise CompilerError(
+                        f"{ef.name}() has no parameter {k!r}"
+                    )
+                kwargs[k] = _cast_arg(annotations[k], v)
+            df = fn(**kwargs)
+            if not isinstance(df, DataFrameObj):
+                raise CompilerError(
+                    f"exec func {ef.name!r} must return a DataFrame"
+                )
+            px.display(df, ef.output_table)
         if not px.display_calls:
             raise CompilerError(
                 "script produced no output — call px.display(df, name)"
@@ -52,6 +100,9 @@ class Compiler:
         now_ns: Optional[int] = None,
         script_args: Optional[dict] = None,
         query_id: str = "",
+        exec_funcs: Optional[list[FuncToExecute]] = None,
     ) -> Plan:
-        ir = self.compile_to_ir(query, table_relations, now_ns, script_args)
+        ir = self.compile_to_ir(
+            query, table_relations, now_ns, script_args, exec_funcs
+        )
         return ir.to_plan(query_id)

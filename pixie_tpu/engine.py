@@ -101,6 +101,7 @@ class Carnot:
         analyze: bool = False,
         now_ns: Optional[int] = None,
         script_args: Optional[dict] = None,
+        exec_funcs=None,
     ) -> QueryResult:
         qid = query_id or str(uuid.uuid4())
         t0 = time.perf_counter_ns()
@@ -110,6 +111,7 @@ class Carnot:
             now_ns=now_ns,
             script_args=script_args,
             query_id=qid,
+            exec_funcs=exec_funcs,
         )
         compile_ns = time.perf_counter_ns() - t0
         result = self.execute_plan(plan, analyze=analyze)

@@ -272,12 +272,23 @@ class AggNode(ExecNode):
             self._reset_window()
 
     def _key_columns(self) -> list:
-        cols = []
         arrays = self._encoder.key_arrays()
+        if not arrays and self.op.groups:
+            # Zero rows ever consumed: the encoder latched nothing, but the
+            # output relation still has one (empty) column per group key.
+            arrays = [np.empty(0, np.int64) for _ in self.op.groups]
+        cols = []
         for g, arr in zip(self.op.groups, arrays):
             d = self._key_dicts.get(g)
             if d is not None:
                 cols.append(DictColumn(arr.astype(np.int32), d))
+            elif (
+                self.output_relation.has_column(g)
+                and self.output_relation.col(g).data_type == DataType.STRING
+            ):
+                cols.append(
+                    DictColumn(arr.astype(np.int32), StringDictionary())
+                )
             else:
                 cols.append(arr)
         return cols

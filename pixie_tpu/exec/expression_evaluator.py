@@ -94,6 +94,12 @@ class ExpressionEvaluator:
 
     def _to_column(self, v, data_type: DataType, num_rows: int):
         if isinstance(v, DictColumn):
+            if len(v) == 1 and num_rows != 1:
+                # Zero-arg funcs (px._exec_hostname) produce one value for
+                # the whole batch: broadcast the code, keep the dictionary.
+                return DictColumn(
+                    np.broadcast_to(v.codes, (num_rows,)).copy(), v.dictionary
+                )
             return v
         if data_type == DataType.STRING:
             if np.ndim(v) == 0:
@@ -103,6 +109,8 @@ class ExpressionEvaluator:
         arr = np.asarray(v, dtype=host_dtype(data_type))
         if arr.ndim == 0:
             arr = np.full(num_rows, arr, dtype=host_dtype(data_type))
+        elif arr.shape == (1,) and num_rows != 1:
+            arr = np.broadcast_to(arr, (num_rows,)).copy()
         return arr
 
     def _eval(self, expr, env: dict, num_rows: int):

@@ -49,6 +49,28 @@ class IngestCore:
                 out[dt.name] = dt.relation
         return out
 
+    def wire_to_table_store(self, store) -> None:
+        """Create the published tables in a TableStore and point the push
+        callback at it — the PEM wiring (ref: pem_manager registers
+        Stirling's DataPushCallback to TableStore::WriteHot). Tablet tables
+        are created on first push (the reference creates tablets on
+        demand)."""
+        from pixie_tpu.table.table import Table
+
+        relations = self.publish()
+        for name, rel in relations.items():
+            if store.get_table(name) is None:
+                store.create_table(name, rel)
+
+        def push(table_name: str, tablet: str, columns: dict) -> None:
+            t = store.get_table(table_name, tablet or "")
+            if t is None:
+                t = Table(relations[table_name], name=table_name)
+                store.add_table(table_name, t, tablet_id=tablet or "")
+            t.write_pydict(columns)
+
+        self.register_data_push_callback(push)
+
     # -- run loop (stirling.cc:802-852) -------------------------------------
     def run(self) -> None:
         assert self._push_cb is not None, "no data push callback registered"

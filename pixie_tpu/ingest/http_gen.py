@@ -1,10 +1,14 @@
 """Synthetic HTTP/conn telemetry generator.
 
 The load-generation analogue of the socket tracer's output tables
-(ref: src/stirling/source_connectors/socket_tracer/http_table.h,
-conn_stats_table.h): emits `http_events` and `conn_stats` rows with the
-reference's column shapes, at a configurable rate. This is BASELINE
+(ref: src/stirling/source_connectors/socket_tracer/http_table.h:41,
+conn_stats_table.h:29): emits `http_events` and `conn_stats` rows with the
+reference's full column shapes, at a configurable rate. This is BASELINE
 config 5's data source and the stand-in for eBPF collection on TPU hosts.
+
+conn_stats semantics match the reference's: per-(upid, remote) rows carry
+MONOTONIC counters (bytes_sent/recv, conn_open/close) sampled periodically,
+so consumers take max-min deltas (px/net_flow_graph does exactly that).
 """
 
 from __future__ import annotations
@@ -16,38 +20,57 @@ import numpy as np
 from pixie_tpu.ingest.source_connector import DataTable, SourceConnector
 from pixie_tpu.types import DataType, Relation, SemanticType
 
-I, F, S, T = (
+I, F, S, T, B = (
     DataType.INT64,
     DataType.FLOAT64,
     DataType.STRING,
     DataType.TIME64NS,
+    DataType.BOOLEAN,
 )
 
-# ref: http_table.h column set (trimmed to the queried columns)
+# ref: http_table.h kHTTPElements (full column set)
 HTTP_EVENTS_REL = Relation.of(
     ("time_", T, SemanticType.ST_TIME_NS),
     ("upid", S, SemanticType.ST_UPID),
     ("remote_addr", S, SemanticType.ST_IP_ADDRESS),
     ("remote_port", I),
-    ("req_method", S),
+    ("trace_role", I),
+    ("major_version", I),
+    ("minor_version", I),
+    ("content_type", I),
+    ("req_headers", S),
+    ("req_method", S, SemanticType.ST_HTTP_REQ_METHOD),
     ("req_path", S),
-    ("resp_status", I),
+    ("req_body", S),
+    ("req_body_size", I, SemanticType.ST_BYTES),
+    ("resp_headers", S),
+    ("resp_status", I, SemanticType.ST_HTTP_RESP_STATUS),
+    ("resp_message", S, SemanticType.ST_HTTP_RESP_MESSAGE),
+    ("resp_body", S),
     ("resp_body_size", I, SemanticType.ST_BYTES),
     ("latency", I, SemanticType.ST_DURATION_NS),
 )
 
-# ref: conn_stats_table.h
+# ref: conn_stats_table.h kConnStatsElements
 CONN_STATS_REL = Relation.of(
     ("time_", T, SemanticType.ST_TIME_NS),
     ("upid", S, SemanticType.ST_UPID),
     ("remote_addr", S, SemanticType.ST_IP_ADDRESS),
     ("remote_port", I),
+    ("trace_role", I),
+    ("addr_family", I),
     ("protocol", I),
+    ("ssl", B),
+    ("conn_open", I),
+    ("conn_close", I),
+    ("conn_active", I),
     ("bytes_sent", I, SemanticType.ST_BYTES),
     ("bytes_recv", I, SemanticType.ST_BYTES),
 )
 
 METHODS = np.array(["GET", "GET", "GET", "POST", "PUT", "DELETE"], dtype=object)
+MESSAGES = {200: "OK", 301: "Moved Permanently", 404: "Not Found",
+            500: "Internal Server Error"}
 
 
 class HTTPEventsConnector(SourceConnector):
@@ -75,6 +98,15 @@ class HTTPEventsConnector(SourceConnector):
         self.paths = np.array(
             [f"/api/v1/ep{i}" for i in range(n_paths)], dtype=object
         )
+        # Monotonic per-(upid, remote) counters for conn_stats: one logical
+        # connection pair per (service i -> addr of service (i+1) % n) edge.
+        n_pairs = n_services
+        self._pair_upid = self.upids
+        self._pair_addr = self.addrs[(np.arange(n_pairs) + 1) % n_services]
+        self._bytes_sent = np.zeros(n_pairs, np.int64)
+        self._bytes_recv = np.zeros(n_pairs, np.int64)
+        self._conn_open = np.zeros(n_pairs, np.int64)
+        self._conn_close = np.zeros(n_pairs, np.int64)
         self.tables = [
             DataTable("http_events", HTTP_EVENTS_REL),
             DataTable("conn_stats", CONN_STATS_REL),
@@ -85,31 +117,57 @@ class HTTPEventsConnector(SourceConnector):
         rng = self.rng
         now = time.time_ns()
         svc = rng.integers(0, len(self.upids), n)
+        status = rng.choice([200, 200, 200, 200, 301, 404, 500], n)
         self.tables[0].append_columns(
             {
                 "time_": now + np.arange(n),
                 "upid": self.upids[svc],
                 "remote_addr": self.addrs[rng.integers(0, len(self.addrs), n)],
                 "remote_port": rng.integers(1024, 65535, n),
+                "trace_role": rng.choice([1, 2], n, p=[0.2, 0.8]),
+                "major_version": rng.choice([1, 2], n, p=[0.8, 0.2]),
+                "minor_version": np.ones(n, np.int64),
+                "content_type": rng.integers(0, 3, n),
+                "req_headers": np.full(n, '{"Host":"svc"}', dtype=object),
                 "req_method": METHODS[rng.integers(0, len(METHODS), n)],
                 "req_path": self.paths[rng.integers(0, len(self.paths), n)],
-                "resp_status": rng.choice(
-                    [200, 200, 200, 200, 301, 404, 500], n
+                "req_body": np.full(n, "", dtype=object),
+                "req_body_size": rng.integers(32, 1 << 10, n),
+                "resp_headers": np.full(
+                    n, '{"Content-Type":"application/json"}', dtype=object
                 ),
+                "resp_status": status,
+                "resp_message": np.array(
+                    [MESSAGES.get(s, "") for s in status], dtype=object
+                ),
+                "resp_body": np.full(n, "{}", dtype=object),
                 "resp_body_size": rng.integers(64, 1 << 16, n),
                 "latency": rng.integers(10**5, 10**9, n),
             }
         )
-        m = max(n // 10, 1)
-        conn_svc = rng.integers(0, len(self.upids), m)
+        # conn_stats: advance every pair's counters, emit one sample per
+        # pair per tick (client side, trace_role=1).
+        m = len(self._pair_upid)
+        self._bytes_sent += rng.integers(1 << 8, 1 << 16, m)
+        self._bytes_recv += rng.integers(1 << 8, 1 << 16, m)
+        self._conn_open += rng.integers(0, 3, m)
+        self._conn_close += np.minimum(
+            rng.integers(0, 2, m), self._conn_open - self._conn_close
+        )
         self.tables[1].append_columns(
             {
                 "time_": now + np.arange(m),
-                "upid": self.upids[conn_svc],
-                "remote_addr": self.addrs[rng.integers(0, len(self.addrs), m)],
-                "remote_port": rng.integers(1024, 65535, m),
+                "upid": self._pair_upid,
+                "remote_addr": self._pair_addr,
+                "remote_port": np.full(m, 8080, np.int64),
+                "trace_role": np.ones(m, np.int64),
+                "addr_family": np.full(m, 2, np.int64),  # AF_INET
                 "protocol": rng.integers(0, 5, m),
-                "bytes_sent": rng.integers(0, 1 << 20, m),
-                "bytes_recv": rng.integers(0, 1 << 20, m),
+                "ssl": rng.integers(0, 2, m).astype(bool),
+                "conn_open": self._conn_open.copy(),
+                "conn_close": self._conn_close.copy(),
+                "conn_active": self._conn_open - self._conn_close,
+                "bytes_sent": self._bytes_sent.copy(),
+                "bytes_recv": self._bytes_recv.copy(),
             }
         )

@@ -1,0 +1,179 @@
+"""Script library: load and run bundled PxL scripts (manifest + vis.json).
+
+Ref: src/cloud/scriptmgr/ (serves the script bundle) +
+src/vizier/services/query_broker's exec_funcs execution of vis.json specs —
+the UI resolves a script's `variables` against user-supplied args, then asks
+the compiler to execute the vis spec's functions
+(`QueryRequest.exec_funcs`). Here the whole path is in-process: resolve
+variables, build FuncToExecute list, hand it to the engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+from pixie_tpu.compiler.compiler import FuncToExecute
+from pixie_tpu.compiler.objects import CompilerError
+
+_BUNDLED_ROOT = os.path.join(os.path.dirname(__file__))
+
+
+@dataclasses.dataclass
+class Script:
+    name: str  # e.g. "px/service_stats"
+    pxl: str
+    vis: dict
+    manifest: dict
+
+    @property
+    def variables(self) -> list[dict]:
+        return list(self.vis.get("variables", []))
+
+    def resolve_variables(self, args: Optional[dict] = None) -> dict:
+        """User args + vis.json defaults -> variable values (strings; the
+        exec-func layer casts per function annotation)."""
+        args = dict(args or {})
+        values: dict[str, str] = {}
+        for v in self.variables:
+            name = v["name"]
+            if name in args:
+                values[name] = args.pop(name)
+            elif "defaultValue" in v:
+                values[name] = v["defaultValue"]
+            else:
+                raise CompilerError(
+                    f"script {self.name}: missing required arg {name!r}"
+                )
+            valid = v.get("validValues")
+            if valid and values[name] not in valid:
+                raise CompilerError(
+                    f"script {self.name}: {name}={values[name]!r} not in "
+                    f"{valid}"
+                )
+        if args:
+            raise CompilerError(
+                f"script {self.name}: unknown args {sorted(args)}"
+            )
+        return values
+
+    def exec_funcs(self, args: Optional[dict] = None) -> list[FuncToExecute]:
+        """The vis spec's function invocations with variables bound:
+        every globalFunc (output = its outputName) and every widget that
+        carries its own func (output = widget name)."""
+        values = self.resolve_variables(args)
+
+        def bind(func: dict) -> dict:
+            bound = {}
+            for a in func.get("args", []):
+                if "variable" in a:
+                    bound[a["name"]] = values[a["variable"]]
+                else:
+                    bound[a["name"]] = a.get("value", "")
+            return bound
+
+        out: list[FuncToExecute] = []
+        for gf in self.vis.get("globalFuncs", []):
+            out.append(
+                FuncToExecute(
+                    name=gf["func"]["name"],
+                    args=bind(gf["func"]),
+                    output_table=gf["outputName"],
+                )
+            )
+        for w in self.vis.get("widgets", []):
+            func = w.get("func")
+            if func:
+                out.append(
+                    FuncToExecute(
+                        name=func["name"],
+                        args=bind(func),
+                        output_table=w.get("name", func["name"]),
+                    )
+                )
+        if not out:
+            raise CompilerError(
+                f"script {self.name}: vis.json declares no functions"
+            )
+        return out
+
+
+def _parse_manifest(text: str) -> dict:
+    """Minimal YAML subset: 'key: value' + folded blocks ('key: >')."""
+    out: dict = {}
+    key = None
+    folded: list[str] = []
+    for line in text.splitlines():
+        if line.startswith("#") or line.strip() == "---":
+            continue
+        if line[:1].isspace():
+            if key is not None:
+                folded.append(line.strip())
+            continue
+        if key is not None and folded:
+            out[key] = " ".join(folded)
+        key = None
+        folded = []
+        if ":" in line:
+            k, _, v = line.partition(":")
+            v = v.strip()
+            if v in (">", "|", ""):
+                key = k.strip()
+            else:
+                out[k.strip()] = v
+    if key is not None and folded:
+        out[key] = " ".join(folded)
+    return out
+
+
+class ScriptLibrary:
+    """Loads bundled scripts (and optional extra roots) by name."""
+
+    def __init__(self, roots: Optional[list[str]] = None):
+        self.roots = list(roots or []) + [_BUNDLED_ROOT]
+
+    def names(self) -> list[str]:
+        found = set()
+        for root in self.roots:
+            for prefix in sorted(os.listdir(root)):
+                pdir = os.path.join(root, prefix)
+                if not os.path.isdir(pdir):
+                    continue
+                for s in sorted(os.listdir(pdir)):
+                    if os.path.isdir(os.path.join(pdir, s)):
+                        found.add(f"{prefix}/{s}")
+        return sorted(found)
+
+    def load(self, name: str) -> Script:
+        for root in self.roots:
+            d = os.path.join(root, *name.split("/"))
+            if not os.path.isdir(d):
+                continue
+            pxl_files = [f for f in os.listdir(d) if f.endswith(".pxl")]
+            if len(pxl_files) != 1:
+                raise CompilerError(
+                    f"script {name}: expected one .pxl, found {pxl_files}"
+                )
+            with open(os.path.join(d, pxl_files[0])) as f:
+                pxl = f.read()
+            vis = {}
+            vis_path = os.path.join(d, "vis.json")
+            if os.path.exists(vis_path):
+                with open(vis_path) as f:
+                    vis = json.load(f)
+            manifest = {}
+            mpath = os.path.join(d, "manifest.yaml")
+            if os.path.exists(mpath):
+                with open(mpath) as f:
+                    manifest = _parse_manifest(f.read())
+            return Script(name=name, pxl=pxl, vis=vis, manifest=manifest)
+        raise KeyError(f"no script named {name!r}")
+
+    def run(self, carnot, name: str, args: Optional[dict] = None, **kwargs):
+        """Execute a named script end to end on an engine instance."""
+        script = self.load(name)
+        return carnot.execute_query(
+            script.pxl, exec_funcs=script.exec_funcs(args), **kwargs
+        )
