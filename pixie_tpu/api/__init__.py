@@ -1,0 +1,5 @@
+"""Client API (ref: src/api/python/pxapi/)."""
+
+from pixie_tpu.api.client import Client, Conn, Row, ScriptExecutor
+
+__all__ = ["Client", "Conn", "Row", "ScriptExecutor"]

@@ -1,0 +1,184 @@
+"""pxapi-style client.
+
+Ref: src/api/python/pxapi/client.py:100 (Client), :154 (ScriptExecutor) —
+connect to a cluster, prepare a script, subscribe to result tables, stream
+rows. The reference speaks gRPC to the cloud/vizier; here a Conn wraps
+either an in-process QueryBroker (a vizier cluster) or a bare Carnot
+engine, and the streaming surface is the same: per-table row iterators fed
+as batches arrive.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+
+class Row:
+    """One result row (ref: pxapi data.Row — column access by name)."""
+
+    def __init__(self, relation, values: tuple):
+        self._names = relation.col_names()
+        self._values = values
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return self._values[key]
+        return self._values[self._names.index(key)]
+
+    def keys(self):
+        return list(self._names)
+
+    def __repr__(self):
+        return (
+            "Row("
+            + ", ".join(f"{n}={v!r}" for n, v in zip(self._names, self._values))
+            + ")"
+        )
+
+
+class _TableSub:
+    """Iterator over one output table's rows."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._batches: list = []
+        self._done = False
+        self._cv = threading.Condition()
+
+    def _push(self, batch) -> None:
+        with self._cv:
+            self._batches.append(batch)
+            if batch.eos:
+                self._done = True
+            self._cv.notify()
+
+    def _finish(self) -> None:
+        with self._cv:
+            self._done = True
+            self._cv.notify()
+
+    def __iter__(self) -> Iterator[Row]:
+        i = 0
+        while True:
+            with self._cv:
+                while i >= len(self._batches) and not self._done:
+                    self._cv.wait(timeout=0.1)
+                if i >= len(self._batches):
+                    return
+                batch = self._batches[i]
+                i += 1
+            d = batch.to_pydict()
+            names = batch.relation.col_names()
+            for row in zip(*(d[n] for n in names)):
+                yield Row(batch.relation, row)
+
+
+class ScriptExecutor:
+    """Prepared script + table subscriptions (pxapi client.py:154)."""
+
+    def __init__(self, conn: "Conn", pxl: str, args: Optional[dict] = None):
+        self._conn = conn
+        self._pxl = pxl
+        self._args = args
+        self._subs: dict[str, _TableSub] = {}
+        self._callbacks: list[tuple[str, Callable]] = []
+        self._ran = False
+
+    def subscribe(self, table_name: str) -> _TableSub:
+        if self._ran and table_name not in self._subs:
+            # Batches were already routed to the subs that existed at
+            # run(); a fresh sub would wait forever on data that will
+            # never arrive.
+            raise RuntimeError(
+                "subscribe() after run(); subscribe before running or use "
+                "results()"
+            )
+        sub = self._subs.setdefault(table_name, _TableSub(table_name))
+        return sub
+
+    def add_callback(self, table_name: str, fn: Callable[[Row], None]) -> None:
+        self._callbacks.append((table_name, fn))
+
+    def results(self, table_name: str) -> Iterator[Row]:
+        """Run (if needed) and iterate one table's rows (pxapi shorthand)."""
+        sub = self.subscribe(table_name)
+        self.run()
+        return iter(sub)
+
+    def run(self) -> None:
+        if self._ran:
+            return
+        self._ran = True
+        result = self._conn._execute(self._pxl, self._args)
+        for name, batches in result.tables.items():
+            sub = self._subs.get(name)
+            for b in batches:
+                if sub is not None:
+                    sub._push(b)
+                for cb_name, fn in self._callbacks:
+                    if cb_name == name:
+                        d = b.to_pydict()
+                        names = b.relation.col_names()
+                        for row in zip(*(d[n] for n in names)):
+                            fn(Row(b.relation, row))
+        for sub in self._subs.values():
+            sub._finish()
+        self.tables = sorted(result.tables)
+
+
+class Conn:
+    """A connection to one cluster (pxapi client.py Conn)."""
+
+    def __init__(self, broker=None, carnot=None, name: str = "local"):
+        if (broker is None) == (carnot is None):
+            raise ValueError("pass exactly one of broker=, carnot=")
+        self._broker = broker
+        self._carnot = carnot
+        self.name = name
+
+    def prepare_script(
+        self, pxl: str, args: Optional[dict] = None
+    ) -> ScriptExecutor:
+        return ScriptExecutor(self, pxl, args)
+
+    def run_script(self, name: str, args: Optional[dict] = None):
+        """Run a bundled library script by name; returns the QueryResult."""
+        from pixie_tpu.scripts.library import ScriptLibrary
+
+        lib = ScriptLibrary()
+        script = lib.load(name)
+        return self._execute(
+            script.pxl, None, exec_funcs=script.exec_funcs(args)
+        )
+
+    def _execute(self, pxl: str, args, exec_funcs=None):
+        if self._broker is not None:
+            return self._broker.execute_script(
+                pxl, script_args=args, exec_funcs=exec_funcs
+            )
+        return self._carnot.execute_query(
+            pxl, script_args=args, exec_funcs=exec_funcs
+        )
+
+
+class Client:
+    """Entry point (pxapi client.py:100). The reference authenticates
+    against the cloud and lists viziers; in-process there is one 'cluster'
+    per broker/engine handed to connect()."""
+
+    def __init__(self):
+        self._conns: dict[str, Conn] = {}
+
+    def connect_to_cluster(self, cluster, name: str = "local") -> Conn:
+        from pixie_tpu.engine import Carnot
+
+        if isinstance(cluster, Carnot):
+            conn = Conn(carnot=cluster, name=name)
+        else:
+            conn = Conn(broker=cluster, name=name)
+        self._conns[name] = conn
+        return conn
+
+    def list_healthy_clusters(self) -> list[str]:
+        return sorted(self._conns)

@@ -1,0 +1,176 @@
+"""`px` CLI: run PxL scripts against a live engine and print tables.
+
+Ref: src/pixie_cli/px.go:44 + pkg/cmd/root.go:193 — the reference CLI's
+core loop is `px run <script> [-- --arg val]` streaming rendered tables;
+`px scripts list` lists the bundle. Cloud auth/deploy subcommands are
+cloud-control-plane surface; here the cluster is in-process: by default
+`run` boots a demo cluster (synthetic socket-tracer + profiler connectors
+feeding the table store, synthetic k8s metadata) so every bundled script
+has data to chew on.
+
+Usage:
+  python -m pixie_tpu.cli scripts list
+  python -m pixie_tpu.cli run px/service_stats
+  python -m pixie_tpu.cli run px/http_data --arg max_num_records=20
+  python -m pixie_tpu.cli run my_query.pxl --warm 2.0
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _build_demo_cluster(warm_s: float):
+    """A single-process 'cluster': engine + ingest + synthetic metadata."""
+    from pixie_tpu.engine import Carnot
+    from pixie_tpu.ingest.core import IngestCore
+    from pixie_tpu.ingest.http_gen import HTTPEventsConnector
+    from pixie_tpu.ingest.perf_profiler import PerfProfilerConnector
+    from pixie_tpu.metadata.state import (
+        MetadataState,
+        PodInfo,
+        ServiceInfo,
+    )
+
+    n = 8
+    pods, services, upid_to_pod, ip_to_pod = {}, {}, {}, {}
+    for i in range(n):
+        sid = f"s{i}"
+        services[sid] = ServiceInfo(sid, f"default/svc-{i}", "default")
+        pid = f"p{i}"
+        ip = f"10.0.{i // 256}.{i % 256}"
+        pods[pid] = PodInfo(
+            pid, f"default/svc-{i}-pod", "default", sid, f"node-{i % 2}", ip
+        )
+        ip_to_pod[ip] = pid
+        upid_to_pod[f"1:{i}:{i * 7 + 1}"] = pid  # http_gen upids
+        upid_to_pod[f"1:{100 + i}:{i * 13 + 5}"] = pid  # profiler upids
+    md = MetadataState(
+        pods=pods,
+        services=services,
+        upid_to_pod=upid_to_pod,
+        ip_to_pod=ip_to_pod,
+    )
+    carnot = Carnot(metadata_state=md)
+    core = IngestCore()
+    core.register_source(HTTPEventsConnector(rows_per_sample=500))
+    core.register_source(PerfProfilerConnector())
+    core.wire_to_table_store(carnot.table_store)
+    core.set_context(md)
+    core.run_as_thread()
+    time.sleep(warm_s)
+    core.stop()
+    return carnot
+
+
+def _render_table(name: str, batches, limit: int = 50) -> None:
+    from pixie_tpu.table.row_batch import RowBatch
+
+    batches = [b for b in batches if b.num_rows]
+    print(f"\n== {name} ==")
+    if not batches:
+        print("(empty)")
+        return
+    merged = RowBatch.concat(batches)
+    d = merged.to_pydict()
+    cols = list(d)
+    rows = list(zip(*(d[c] for c in cols)))
+    shown = rows[:limit]
+    cells = [[_fmt(v) for v in row] for row in shown]
+    widths = [
+        max(len(c), *(len(r[i]) for r in cells)) if cells else len(c)
+        for i, c in enumerate(cols)
+    ]
+    print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    for r in cells:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    if len(rows) > limit:
+        print(f"... ({len(rows) - limit} more rows)")
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    s = str(v)
+    return s if len(s) <= 48 else s[:45] + "..."
+
+
+def cmd_scripts_list(_args) -> int:
+    from pixie_tpu.scripts.library import ScriptLibrary
+
+    lib = ScriptLibrary()
+    for name in lib.names():
+        script = lib.load(name)
+        print(f"{name:28s} {script.manifest.get('short', '')}")
+    return 0
+
+
+def cmd_run(args) -> int:
+    from pixie_tpu.api import Client
+    from pixie_tpu.scripts.library import ScriptLibrary
+
+    script_args = {}
+    for kv in args.arg or []:
+        if "=" not in kv:
+            print(f"--arg wants key=value, got {kv!r}", file=sys.stderr)
+            return 2
+        k, _, v = kv.partition("=")
+        script_args[k] = v
+
+    carnot = _build_demo_cluster(args.warm)
+    conn = Client().connect_to_cluster(carnot)
+
+    t0 = time.perf_counter()
+    if os.path.exists(args.script) and args.script.endswith(".pxl"):
+        with open(args.script) as f:
+            pxl = f.read()
+        result = conn._execute(pxl, script_args or None)
+    else:
+        if args.script not in ScriptLibrary().names():
+            print(
+                f"unknown script {args.script!r}; "
+                f"try: {', '.join(ScriptLibrary().names())}",
+                file=sys.stderr,
+            )
+            return 2
+        result = conn.run_script(args.script, script_args)
+    dt = time.perf_counter() - t0
+    for name in sorted(result.tables):
+        _render_table(name, result.tables[name], limit=args.limit)
+    print(f"\n[{dt * 1e3:.0f} ms]")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="px", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("scripts", help="script bundle operations")
+    pssub = ps.add_subparsers(dest="scripts_cmd", required=True)
+    pssub.add_parser("list", help="list bundled scripts").set_defaults(
+        fn=cmd_scripts_list
+    )
+
+    pr = sub.add_parser("run", help="run a bundled script or .pxl file")
+    pr.add_argument("script", help="script name (px/...) or path to .pxl")
+    pr.add_argument(
+        "--arg", action="append", help="script arg key=value", default=[]
+    )
+    pr.add_argument(
+        "--warm",
+        type=float,
+        default=1.5,
+        help="seconds of synthetic telemetry to collect first",
+    )
+    pr.add_argument("--limit", type=int, default=50, help="max rows printed")
+    pr.set_defaults(fn=cmd_run)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

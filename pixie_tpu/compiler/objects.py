@@ -31,6 +31,7 @@ from pixie_tpu.plan.operators import (
     MapOp,
     MemorySourceOp,
     ResultSinkOp,
+    UDTFSourceOp,
     UnionOp,
 )
 from pixie_tpu.types import DataType, SemanticType
@@ -552,6 +553,36 @@ class PxModule:
         if name.startswith("_") and not name.startswith("_exec_"):
             raise AttributeError(name)
         reg = self.__dict__.get("_registry")
+        if reg is not None and reg.lookup_udtf(name) is not None:
+            # UDTF call produces a DataFrame (ref: the compiler lowers
+            # px.GetAgentStatus() to a UDTFSourceOperator).
+            udtf = reg.lookup_udtf(name)
+
+            def make_udtf_source(*args, **kwargs):
+                params = list(udtf.arg_spec)
+                if len(args) > len(params):
+                    raise CompilerError(
+                        f"px.{name}() takes {len(params)} positional "
+                        f"args, got {len(args)}"
+                    )
+                for p, a in zip(params, args):
+                    kwargs.setdefault(p, a)
+                unknown = set(kwargs) - set(params)
+                if unknown:
+                    raise CompilerError(
+                        f"px.{name}() has no args {sorted(unknown)}"
+                    )
+                nid = self._ir.add(
+                    UDTFSourceOp(
+                        udtf_name=name,
+                        arg_values=tuple(
+                            (p, kwargs[p]) for p in params if p in kwargs
+                        ),
+                    )
+                )
+                return DataFrameObj(self._ir, nid)
+
+            return make_udtf_source
         if reg is not None and (reg.has_scalar(name) or reg.has_uda(name)):
             return FuncRef(name, reg)
         raise CompilerError(f"px has no attribute or function {name!r}")

@@ -77,8 +77,10 @@ class Carnot:
         router: Optional[BridgeRouter] = None,
         instance: str = "local",
         device_executor=None,
+        vizier_ctx=None,
     ):
         self.table_store = table_store or TableStore()
+        self.vizier_ctx = vizier_ctx
         if registry is None:
             from pixie_tpu.udf.registry import default_registry
 
@@ -154,6 +156,7 @@ class Carnot:
                     metadata_state=self.metadata_state,
                     result_callback=on_result,
                     instance=self.instance,
+                    vizier_ctx=self.vizier_ctx,
                 )
                 if self.device_executor is not None:
                     offloaded = self.device_executor.try_execute_fragment(

@@ -14,9 +14,17 @@ from typing import Any, Callable, Optional
 @dataclasses.dataclass
 class FunctionContext:
     """Passed to UDFs with ``needs_ctx`` (ref: udf.h FunctionContext) —
-    carries the agent's metadata state for k8s entity lookups."""
+    carries the agent's metadata state for k8s entity lookups, plus the
+    introspection surfaces UDTFs read (ref: vizier/funcs/md_udtfs serves
+    GetAgentStatus/table info from the service context)."""
 
     metadata_state: Any = None
+    table_store: Any = None
+    registry: Any = None
+    # Cluster view for agent-status UDTFs: an object exposing
+    # ``agents() -> list[dict]`` (the broker's tracker) and/or
+    # ``self_info: dict`` (this agent). None outside a vizier deployment.
+    vizier_ctx: Any = None
 
 
 class ExecState:
@@ -30,12 +38,18 @@ class ExecState:
         result_callback: Optional[Callable] = None,
         instance: str = "local",
         compute_backend: str = "cpu",
+        vizier_ctx: Any = None,
     ):
         self.query_id = query_id
         self.table_store = table_store
         self.registry = registry
         self.router = router
-        self.func_ctx = FunctionContext(metadata_state)
+        self.func_ctx = FunctionContext(
+            metadata_state,
+            table_store=table_store,
+            registry=registry,
+            vizier_ctx=vizier_ctx,
+        )
         # result_callback(table_name, row_batch) receives ResultSink output
         # (ref: Carnot's result destination / TransferResultChunk stream).
         self.result_callback = result_callback

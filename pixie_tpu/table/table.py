@@ -75,6 +75,8 @@ class Table:
         self.size_limit = size_limit
         self.compacted_rows = compacted_rows
         self._lock = threading.RLock()
+        self._metric_bytes = None  # bound lazily: name may be set later
+        self._metric_batches = None
         self._segments: list[_Segment] = []
         self._next_row_id = 0
         self._bytes = 0
@@ -113,6 +115,19 @@ class Table:
             self._stats.batches_added += 1
             self._stats.bytes_added += nbytes
             self._expire_locked()
+            if self.name:  # occupancy gauges (ref: table_metrics.h)
+                if self._metric_bytes is None:
+                    from pixie_tpu.utils import metrics_registry
+
+                    m = metrics_registry()
+                    self._metric_bytes = m.gauge(
+                        "table_bytes", "Resident bytes per table."
+                    ).labels(table=self.name)
+                    self._metric_batches = m.gauge(
+                        "table_batches", "Resident batches per table."
+                    ).labels(table=self.name)
+                self._metric_bytes.set(self._bytes)
+                self._metric_batches.set(len(self._segments))
 
     def write_pydict(self, data: dict, eow=False, eos=False) -> None:
         self.write(
@@ -232,6 +247,13 @@ class Table:
             s.bytes = self._bytes
             s.min_time = self._segments[0].min_time if self._segments else -1
             return s
+
+    def time_bounds(self) -> tuple[Optional[int], Optional[int]]:
+        """(min, max) time currently resident, or (None, None) if empty."""
+        with self._lock:
+            if not self._segments:
+                return None, None
+            return self._segments[0].min_time, self._segments[-1].max_time
 
     def cursor(
         self,

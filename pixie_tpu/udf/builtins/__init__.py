@@ -10,6 +10,7 @@ def register_all(registry: Registry) -> None:
         conditionals,
         json_ops,
         math_ops,
+        md_udtfs,
         metadata_ops,
         sketch_ops,
         string_ops,
@@ -24,3 +25,4 @@ def register_all(registry: Registry) -> None:
     time_ops.register(registry)
     collections.register(registry)
     metadata_ops.register(registry)
+    md_udtfs.register(registry)

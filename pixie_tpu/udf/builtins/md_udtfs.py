@@ -1,0 +1,165 @@
+"""Metadata/introspection UDTFs.
+
+Ref: src/vizier/funcs/md_udtfs/md_udtfs.h — GetAgentStatus, table info,
+and UDF-list UDTFs served from the vizier service context; here they read
+the FunctionContext's vizier_ctx / table_store / registry
+(exec/exec_state.py). PxL usage is unchanged:
+``px.display(px.GetAgentStatus())``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from pixie_tpu.types import DataType, Relation
+from pixie_tpu.udf.registry import Registry
+from pixie_tpu.udf.udf import UDTF
+
+S = DataType.STRING
+I = DataType.INT64
+B = DataType.BOOLEAN
+T = DataType.TIME64NS
+
+
+def _agent_rows(ctx) -> list[dict]:
+    vc = ctx.vizier_ctx
+    if vc is not None and hasattr(vc, "agents"):
+        return list(vc.agents())
+    # Standalone engine: report the single local instance.
+    md = ctx.metadata_state
+    return [
+        {
+            "agent_id": "local",
+            "asid": getattr(md, "asid", 0) if md is not None else 0,
+            "hostname": (
+                getattr(md, "hostname", "localhost")
+                if md is not None
+                else "localhost"
+            ),
+            "agent_state": "AGENT_STATE_HEALTHY",
+            "last_heartbeat_ns": 0,
+            "kelvin": False,
+        }
+    ]
+
+
+def register(r: Registry) -> None:
+    def get_agent_status(ctx):
+        rows = _agent_rows(ctx)
+        now = time.time_ns()
+        return {
+            "agent_id": [a.get("agent_id", "") for a in rows],
+            "asid": [int(a.get("asid", 0)) for a in rows],
+            "hostname": [a.get("hostname", "") for a in rows],
+            "agent_state": [
+                a.get("agent_state", "AGENT_STATE_HEALTHY") for a in rows
+            ],
+            "last_heartbeat_ns": [
+                int(a.get("last_heartbeat_ns", now)) for a in rows
+            ],
+            "kelvin": [bool(a.get("kelvin", False)) for a in rows],
+        }
+
+    r.register_udtf(
+        UDTF(
+            name="GetAgentStatus",
+            arg_spec={},
+            fn=get_agent_status,
+            output_relation=Relation.of(
+                ("agent_id", S),
+                ("asid", I),
+                ("hostname", S),
+                ("agent_state", S),
+                ("last_heartbeat_ns", I),
+                ("kelvin", B),
+            ),
+            doc="Status of every agent in the cluster (md_udtfs.h "
+            "GetAgentStatus).",
+        )
+    )
+
+    def get_table_status(ctx):
+        names, batches, rows, bytes_, min_t, max_t = [], [], [], [], [], []
+        store = ctx.table_store
+        for name in sorted(store.table_names()) if store else []:
+            t = store.get_table(name)
+            st = t.stats()
+            names.append(name)
+            batches.append(int(st.num_batches))
+            rows.append(int(st.num_rows))
+            bytes_.append(int(st.bytes))
+            tmin, tmax = t.time_bounds()
+            min_t.append(int(tmin if tmin is not None else 0))
+            max_t.append(int(tmax if tmax is not None else 0))
+        return {
+            "table_name": names,
+            "num_batches": batches,
+            "num_rows": rows,
+            "size_bytes": bytes_,
+            "min_time": min_t,
+            "max_time": max_t,
+        }
+
+    r.register_udtf(
+        UDTF(
+            name="GetTableStatus",
+            arg_spec={},
+            fn=get_table_status,
+            output_relation=Relation.of(
+                ("table_name", S),
+                ("num_batches", I),
+                ("num_rows", I),
+                ("size_bytes", I),
+                ("min_time", T),
+                ("max_time", T),
+            ),
+            doc="Occupancy of every table in this agent's table store "
+            "(md_udtfs table-info UDTF).",
+        )
+    )
+
+    def get_udf_list(ctx):
+        reg = ctx.registry
+        names, kinds, args, rets = [], [], [], []
+        if reg is not None:
+            for key, udf in sorted(
+                reg._scalars.items(), key=lambda kv: kv[0].name
+            ):
+                names.append(key.name)
+                kinds.append("scalar")
+                args.append(",".join(t.name for t in key.arg_types))
+                rets.append(udf.out_type.name)
+            for key, uda in sorted(
+                reg._udas.items(), key=lambda kv: kv[0].name
+            ):
+                names.append(key.name)
+                kinds.append("uda")
+                args.append(",".join(t.name for t in key.arg_types))
+                rets.append(uda.out_type.name)
+            for name, udtf in sorted(reg._udtfs.items()):
+                names.append(name)
+                kinds.append("udtf")
+                args.append(",".join(udtf.arg_spec))
+                rets.append("table")
+        return {
+            "name": names,
+            "kind": kinds,
+            "arg_types": args,
+            "return_type": rets,
+        }
+
+    r.register_udtf(
+        UDTF(
+            name="GetUDFList",
+            arg_spec={},
+            fn=get_udf_list,
+            output_relation=Relation.of(
+                ("name", S),
+                ("kind", S),
+                ("arg_types", S),
+                ("return_type", S),
+            ),
+            doc="Every registered scalar/UDA/UDTF with its signature "
+            "(md_udtfs GetUDFList/GetUDAList collapsed).",
+        )
+    )

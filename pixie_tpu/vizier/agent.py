@@ -20,7 +20,10 @@ from pixie_tpu.exec import BridgeRouter
 from pixie_tpu.plan.plan import Plan
 from pixie_tpu.vizier.bus import MessageBus, agent_topic
 
-HEARTBEAT_INTERVAL_S = 0.5  # scaled-down from the reference's ~5s
+from pixie_tpu.utils import flags
+
+# scaled-down from the reference's ~5s; PIXIE_TPU_AGENT_HEARTBEAT_INTERVAL_S.
+HEARTBEAT_INTERVAL_S = flags.agent_heartbeat_interval_s
 AGENT_STATUS_TOPIC = "agent_status"  # ref: agent_topic_listener's channel
 RESULTS_TOPIC_PREFIX = "results/"
 
@@ -39,6 +42,7 @@ class Agent:
         metadata_state=None,
         is_kelvin: bool = False,
         device_executor=None,
+        vizier_ctx=None,
     ):
         self.agent_id = agent_id
         self.bus = bus
@@ -50,6 +54,7 @@ class Agent:
             router=router,
             instance=agent_id,
             device_executor=device_executor,
+            vizier_ctx=vizier_ctx,
         )
         self._sub = None
         self._threads: list[threading.Thread] = []

@@ -1,0 +1,119 @@
+"""Client API, UDTF, and CLI tests.
+
+Ref: src/api/python/pxapi/client.py:100,154 (Client/ScriptExecutor),
+src/vizier/funcs/md_udtfs/md_udtfs.h (GetAgentStatus etc.),
+src/pixie_cli/px.go:44 (`px run`)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pixie_tpu.api import Client
+from pixie_tpu.engine import Carnot
+from pixie_tpu.metadata.state import make_synthetic_state
+from pixie_tpu.types import DataType, Relation
+
+F, I, S, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+
+def _engine() -> Carnot:
+    carnot = Carnot(metadata_state=make_synthetic_state(2, 1))
+    rel = Relation.of(("time_", T), ("svc", S), ("latency", F))
+    t = carnot.table_store.create_table("events", rel)
+    t.write_pydict(
+        {
+            "time_": np.arange(100),
+            "svc": np.array(
+                ["a" if i % 2 else "b" for i in range(100)], dtype=object
+            ),
+            "latency": np.linspace(1.0, 100.0, 100),
+        }
+    )
+    t.compact()
+    t.stop()
+    return carnot
+
+
+def test_udtf_agent_status_standalone():
+    res = _engine().execute_query(
+        "px.display(px.GetAgentStatus(), 'agents')\n"
+    )
+    d = res.table("agents")
+    assert d["agent_id"] == ["local"]
+    assert d["agent_state"] == ["AGENT_STATE_HEALTHY"]
+    assert d["kelvin"] == [False]
+
+
+def test_udtf_table_status_and_udf_list():
+    carnot = _engine()
+    res = carnot.execute_query(
+        "px.display(px.GetTableStatus(), 'tables')\n"
+        "px.display(px.GetUDFList(), 'udfs')\n"
+    )
+    tables = res.table("tables")
+    assert "events" in tables["table_name"]
+    i = tables["table_name"].index("events")
+    assert tables["num_rows"][i] == 100
+    assert tables["min_time"][i] == 0
+    assert tables["max_time"][i] == 99
+    udfs = res.table("udfs")
+    assert "mean" in udfs["name"]
+    assert "GetAgentStatus" in udfs["name"]
+    kinds = dict(zip(udfs["name"], udfs["kind"]))
+    assert kinds["GetAgentStatus"] == "udtf"
+
+
+def test_udtf_composes_with_operators():
+    """UDTF output is a real DataFrame: filters/projections apply."""
+    res = _engine().execute_query(
+        "df = px.GetUDFList()\n"
+        "df = df[df.kind == 'udtf']\n"
+        "px.display(df[['name']], 'out')\n"
+    )
+    names = res.table("out")["name"]
+    assert "GetTableStatus" in names and "mean" not in names
+
+
+def test_client_script_executor_streams_rows():
+    conn = Client().connect_to_cluster(_engine())
+    ex = conn.prepare_script(
+        "df = px.DataFrame(table='events')\n"
+        "s = df.groupby(['svc']).agg(n=('time_', px.count),\n"
+        "                            avg=('latency', px.mean))\n"
+        "px.display(s, 'stats')\n"
+    )
+    rows = {r["svc"]: (r["n"], r["avg"]) for r in ex.results("stats")}
+    assert rows["a"][0] == 50 and rows["b"][0] == 50
+    assert rows["a"][1] + rows["b"][1] == 101.0  # means of odd/even split
+
+
+def test_client_runs_bundled_script_by_name():
+    from pixie_tpu.cli import _build_demo_cluster
+
+    carnot = _build_demo_cluster(warm_s=0.4)
+    conn = Client().connect_to_cluster(carnot)
+    res = conn.run_script("px/http_data", {"max_num_records": "25"})
+    assert sum(b.num_rows for b in res.tables["http_data"]) == 25
+
+
+def test_cli_scripts_list_and_run(capsys, tmp_path):
+    from pixie_tpu import cli
+
+    assert cli.main(["scripts", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "px/service_stats" in out
+
+    pxl = tmp_path / "q.pxl"
+    pxl.write_text(
+        "df = px.DataFrame(table='http_events')\n"
+        "s = df.groupby(['req_method']).agg(n=('time_', px.count))\n"
+        "px.display(s, 'by_method')\n"
+    )
+    assert cli.main(["run", str(pxl), "--warm", "0.3", "--limit", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "by_method" in out and "req_method" in out
