@@ -52,6 +52,123 @@ class StateBatch:
     # through these into its own latch (codes are agent-local otherwise).
     arg_dicts: dict = dataclasses.field(default_factory=dict)
 
+    # -- wire format (PEM→Kelvin partial-agg transfer over DCN; ref: the
+    # serialized partial aggregates of partial_op_mgr.h:94 riding
+    # TransferResultChunk) -------------------------------------------------
+    def to_bytes(self) -> bytes:
+        import io
+
+        arrays: dict[str, np.ndarray] = {}
+        counter = iter(range(1 << 30))
+
+        def attach(arr: np.ndarray) -> str:
+            # Opaque names: path-derived keys can collide (a dotted user
+            # column name vs a nested state key), silently overwriting
+            # leaves in the npz payload.
+            name = f"a{next(counter)}"
+            arrays[name] = arr
+            return name
+
+        def enc(obj):
+            """Pytree -> JSON-able descriptor + numpy attachments."""
+            if isinstance(obj, np.ndarray):
+                return {"arr": attach(obj)}
+            if isinstance(obj, dict):
+                return {"dict": {k: enc(v) for k, v in obj.items()}}
+            if isinstance(obj, (tuple, list)):
+                return {
+                    "seq": [enc(v) for v in obj],
+                    "tuple": isinstance(obj, tuple),
+                }
+            if hasattr(obj, "__array__"):  # jax arrays and scalars
+                return {"arr": attach(np.asarray(obj))}
+            return {"val": obj}
+
+        keys = []
+        for i, col in enumerate(self.key_columns):
+            if isinstance(col, DictColumn):
+                arrays[f"k{i}"] = np.asarray(col.decode().tolist(), dtype="U")
+                keys.append({"kind": "str", "arr": f"k{i}"})
+            else:
+                arrays[f"k{i}"] = np.asarray(col)
+                keys.append({"kind": "plain", "arr": f"k{i}"})
+        dicts = {}
+        for name, d in self.arg_dicts.items():
+            arrays[f"d:{name}"] = np.asarray(
+                list(d.values()), dtype="U"
+            )
+            dicts[name] = f"d:{name}"
+        meta = {
+            "num_groups": int(self.num_groups),
+            "group_names": list(self.group_names),
+            "eow": bool(self.eow),
+            "eos": bool(self.eos),
+            "keys": keys,
+            "states": {
+                name: enc(tree) for name, tree in self.states.items()
+            },
+            "arg_dicts": dicts,
+        }
+        buf = io.BytesIO()
+        np.savez_compressed(
+            buf,
+            __meta__=np.frombuffer(repr(meta).encode(), dtype=np.uint8),
+            **arrays,
+        )
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "StateBatch":
+        import ast
+        import io
+
+        with np.load(io.BytesIO(data), allow_pickle=False) as npz:
+            meta = ast.literal_eval(bytes(npz["__meta__"]).decode())
+
+            def dec(node):
+                if "arr" in node:
+                    return npz[node["arr"]]
+                if "dict" in node:
+                    return {k: dec(v) for k, v in node["dict"].items()}
+                if "seq" in node:
+                    seq = [dec(v) for v in node["seq"]]
+                    return tuple(seq) if node["tuple"] else seq
+                return node["val"]
+
+            key_columns = []
+            for k in meta["keys"]:
+                arr = npz[k["arr"]]
+                if k["kind"] == "str":
+                    d = StringDictionary()
+                    key_columns.append(
+                        DictColumn(d.encode(arr.astype(object)), d)
+                    )
+                else:
+                    key_columns.append(arr)
+            arg_dicts = {
+                name: StringDictionary(
+                    list(npz[path].astype(object))
+                )
+                for name, path in meta["arg_dicts"].items()
+            }
+            return cls(
+                key_columns=key_columns,
+                states={
+                    name: dec(node) for name, node in meta["states"].items()
+                },
+                num_groups=meta["num_groups"],
+                group_names=tuple(meta["group_names"]),
+                eow=meta["eow"],
+                eos=meta["eos"],
+                arg_dicts=arg_dicts,
+            )
+
+    def __reduce__(self):
+        # Pickling rides the explicit wire format: a cross-process transport
+        # that pickles bus messages moves no live object graphs, only the
+        # same bytes a proto-based data plane would.
+        return (StateBatch.from_bytes, (self.to_bytes(),))
+
 
 @dataclasses.dataclass
 class _AggSpec:

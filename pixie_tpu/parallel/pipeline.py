@@ -69,6 +69,27 @@ from pixie_tpu.table.column import DictColumn, StringDictionary
 from pixie_tpu.table.row_batch import RowBatch
 from pixie_tpu.types import DataType
 from pixie_tpu.udf.udf import Executor, MergeKind
+from pixie_tpu.utils import flags, metrics_registry
+
+_M = metrics_registry()
+_OFFLOAD_HITS = _M.counter(
+    "device_offload_total", "Fragments executed on the device mesh."
+)
+_OFFLOAD_MISS = _M.counter(
+    "device_offload_unmatched_total",
+    "Fragments that did not match the device-offloadable shape.",
+)
+_OFFLOAD_FALLBACKS = _M.counter(
+    "device_offload_fallback_total",
+    "Device offload attempts that failed and fell back to the host engine.",
+)
+_STAGED_EVICTIONS = _M.counter(
+    "device_staged_cache_evictions_total",
+    "HBM staged-table cache evictions (LRU cap or version change).",
+)
+_PROGRAMS = _M.gauge(
+    "device_program_cache_size", "Compiled shard_map programs cached."
+)
 
 
 @dataclasses.dataclass
@@ -153,13 +174,17 @@ class MeshExecutor:
     def __init__(
         self,
         mesh: Optional[Mesh] = None,
-        block_rows: int = DEFAULT_BLOCK_ROWS,
+        block_rows: Optional[int] = None,
     ):
         if mesh is None:
             devs = np.array(jax.devices())
             mesh = Mesh(devs, ("d",))
         self.mesh = mesh
-        self.block_rows = block_rows
+        # PIXIE_TPU_DEVICE_BLOCK_ROWS overrides; staging.DEFAULT_BLOCK_ROWS
+        # is the built-in default.
+        self.block_rows = (
+            block_rows if block_rows is not None else flags.device_block_rows
+        )
         # Compiled-program cache: structurally identical queries reuse the
         # traced+compiled shard_map (aux LUTs/constants are ARGUMENTS, so
         # dictionary growth does not invalidate the executable).
@@ -175,12 +200,12 @@ class MeshExecutor:
         self._staged_cache: "collections.OrderedDict[tuple, Any]" = (
             collections.OrderedDict()
         )
-        self._staged_cache_cap = 4
+        self._staged_cache_cap = flags.staged_cache_cap
         # Host-densified key plans per (table version, key exprs), LRU.
         self._keyplan_cache: "collections.OrderedDict[tuple, Any]" = (
             collections.OrderedDict()
         )
-        self._keyplan_cache_cap = 4
+        self._keyplan_cache_cap = flags.keyplan_cache_cap
         # Offload is best-effort; failures fall back to the host engine but
         # must stay observable (one log per distinct error signature).
         self.fallback_errors: dict[str, str] = {}
@@ -197,13 +222,16 @@ class MeshExecutor:
         expressions, dictionary edge cases): offload is an optimization,
         never a correctness cliff."""
         try:
-            return self._try_execute_fragment(
+            out = self._try_execute_fragment(
                 fragment, table_store, registry, func_ctx
             )
+            (_OFFLOAD_HITS if out is not None else _OFFLOAD_MISS).inc()
+            return out
         except Exception as e:
             import logging
             import traceback
 
+            _OFFLOAD_FALLBACKS.inc()
             key = f"{type(e).__name__}: {e}"
             if key not in self.fallback_errors:
                 self.fallback_errors[key] = traceback.format_exc()
@@ -297,9 +325,11 @@ class MeshExecutor:
                     if k[0] == m.source_op.table_name and k[1] != version
                 ]:
                     del self._staged_cache[k]
+                    _STAGED_EVICTIONS.inc(reason="version")
                 self._staged_cache[cache_key] = staged
                 while len(self._staged_cache) > self._staged_cache_cap:
                     self._staged_cache.popitem(last=False)
+                    _STAGED_EVICTIONS.inc(reason="lru")
         aux = self._build_aux(evaluator, m, key_plan, table, specs)
         merged = self._run_program(m, specs, evaluator, key_plan, staged, aux)
         batch = self._finalize(
@@ -667,33 +697,38 @@ class MeshExecutor:
                 gids_all if gids_all is not None else mask_all,
             )
             (states, presence), _ = jax.lax.scan(body, init_states, xs)
-            presence = jax.lax.psum(presence, axis)
 
-            # ICI merge: one collective per UDA (the Kelvin step).
-            merged = []
-            for (out, _, uda), st in zip(specs, states):
-                if uda.merge_kind == MergeKind.PSUM:
-                    merged.append(jax.tree.map(
-                        lambda x: jax.lax.psum(x, axis), st
-                    ))
-                elif uda.merge_kind == MergeKind.PMAX:
-                    merged.append(jax.tree.map(
-                        lambda x: jax.lax.pmax(x, axis), st
-                    ))
-                elif uda.merge_kind == MergeKind.PMIN:
-                    merged.append(jax.tree.map(
-                        lambda x: jax.lax.pmin(x, axis), st
-                    ))
-                else:  # TREE: all_gather states, fold pairwise
-                    gathered = jax.tree.map(
-                        lambda x: jax.lax.all_gather(x, axis), st
-                    )
-                    acc = jax.tree.map(lambda x: x[0], gathered)
-                    for i2 in range(1, ndev):
-                        acc = uda.merge(
-                            acc, jax.tree.map(lambda x: x[i2], gathered)
+            # ICI merge: one collective per UDA (the Kelvin step). On a
+            # 1-device mesh every collective is the identity — skip them
+            # (some PJRT backends only lower Sum all-reduces anyway).
+            if ndev == 1:
+                merged = list(states)
+            else:
+                presence = jax.lax.psum(presence, axis)
+                merged = []
+                for (out, _, uda), st in zip(specs, states):
+                    if uda.merge_kind == MergeKind.PSUM:
+                        merged.append(jax.tree.map(
+                            lambda x: jax.lax.psum(x, axis), st
+                        ))
+                    elif uda.merge_kind == MergeKind.PMAX:
+                        merged.append(jax.tree.map(
+                            lambda x: jax.lax.pmax(x, axis), st
+                        ))
+                    elif uda.merge_kind == MergeKind.PMIN:
+                        merged.append(jax.tree.map(
+                            lambda x: jax.lax.pmin(x, axis), st
+                        ))
+                    else:  # TREE: all_gather states, fold pairwise
+                        gathered = jax.tree.map(
+                            lambda x: jax.lax.all_gather(x, axis), st
                         )
-                    merged.append(acc)
+                        acc = jax.tree.map(lambda x: x[0], gathered)
+                        for i2 in range(1, ndev):
+                            acc = uda.merge(
+                                acc, jax.tree.map(lambda x: x[i2], gathered)
+                            )
+                        merged.append(acc)
             # Finalize on device where the UDA allows it, then pack every
             # output/state leaf into ONE f64 buffer (ints ride exactly via
             # bitcast) so the host pays a single device fetch per query —
@@ -782,6 +817,7 @@ class MeshExecutor:
             )
             _, templates = self._finalize_modes(specs, staged.capacity)
             self._program_cache[sig] = (program, len(aux_key_order), templates)
+            _PROGRAMS.set(len(self._program_cache))
         program, _, templates = self._program_cache[sig]
         args = [staged.blocks[n] for n in col_names] + [staged.mask]
         if key_plan.host_gids is not None:

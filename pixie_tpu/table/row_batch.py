@@ -205,6 +205,11 @@ class RowBatch:
                     cols.append(arr.astype(host_dtype(schema.data_type)))
             return cls(rel, cols, eow=bool(meta["eow"]), eos=bool(meta["eos"]))
 
+    def __reduce__(self):
+        # Pickling rides the explicit wire format (to_bytes/from_bytes), so
+        # cross-process transports move bytes, not live object graphs.
+        return (RowBatch.from_bytes, (self.to_bytes(),))
+
     def __repr__(self) -> str:
         flags = (" eow" if self.eow else "") + (" eos" if self.eos else "")
         return f"RowBatch({self.num_rows} rows, {self.relation}{flags})"

@@ -1,0 +1,6 @@
+"""Shared runtime utilities: metrics registry + flag/config system."""
+
+from pixie_tpu.utils.config import define_flag, flags
+from pixie_tpu.utils.metrics import Counter, Gauge, metrics_registry
+
+__all__ = ["Counter", "Gauge", "metrics_registry", "define_flag", "flags"]

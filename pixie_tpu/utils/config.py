@@ -1,0 +1,133 @@
+"""Flag/config system.
+
+Ref: the reference's C++ gflags-with-env-defaults pattern
+(pem_main.cc:28-36, DECLARE_int32(table_store_table_size_limit)
+table.h:51) and Go pflag+viper. Flags are declared where they are used
+(``define_flag``), read env overrides ``PIXIE_TPU_<UPPER_NAME>`` at first
+access, and can be set programmatically (tests, embedders) via
+``flags.set(name, value)``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Optional
+
+
+class _Flags:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._defs: dict[str, tuple[Any, Callable, str]] = {}
+        self._values: dict[str, Any] = {}
+
+    def define(
+        self,
+        name: str,
+        default: Any,
+        parser: Optional[Callable] = None,
+        help_: str = "",
+    ) -> None:
+        with self._lock:
+            if name in self._defs:
+                return  # first definition wins (idempotent imports)
+            if parser is None:
+                if isinstance(default, bool):
+                    parser = lambda s: s in (True, "1", "true", "True")
+                elif isinstance(default, int):
+                    parser = int
+                elif isinstance(default, float):
+                    parser = float
+                else:
+                    parser = str
+            self._defs[name] = (default, parser, help_)
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            if name in self._values:
+                return self._values[name]
+            if name not in self._defs:
+                raise KeyError(f"flag {name!r} is not defined")
+            default, parser, _ = self._defs[name]
+            env = os.environ.get(f"PIXIE_TPU_{name.upper()}")
+            value = parser(env) if env is not None else default
+            self._values[name] = value
+            return value
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            if name not in self._defs:
+                raise KeyError(f"flag {name!r} is not defined")
+            self._values[name] = value
+
+    def reset(self, name: str) -> None:
+        """Forget a cached/overridden value (re-reads env on next get)."""
+        with self._lock:
+            self._values.pop(name, None)
+
+    def describe(self) -> dict[str, tuple[Any, str]]:
+        with self._lock:
+            return {
+                name: (self._values.get(name, d[0]), d[2])
+                for name, d in sorted(self._defs.items())
+            }
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.get(name)
+
+
+flags = _Flags()
+
+
+def define_flag(
+    name: str,
+    default: Any,
+    parser: Optional[Callable] = None,
+    help_: str = "",
+) -> None:
+    flags.define(name, default, parser, help_)
+
+
+# -- engine-wide knobs (declared centrally; component-local flags are
+#    declared next to their use) -------------------------------------------
+define_flag(
+    "device_block_rows",
+    1 << 17,
+    help_="Rows per staged device block (parallel/staging.py).",
+)
+define_flag(
+    "staged_cache_cap",
+    4,
+    help_="LRU capacity of HBM-resident staged tables (MeshExecutor).",
+)
+define_flag(
+    "keyplan_cache_cap",
+    4,
+    help_="LRU capacity of host-densified group-key plans (MeshExecutor).",
+)
+define_flag(
+    "broker_max_pending",
+    256,
+    help_="Bound on buffered result messages per query at the broker; "
+    "producers block when full (flow control, ref: "
+    "query_result_forwarder.go:502).",
+)
+define_flag(
+    "broker_publish_timeout_s",
+    10.0,
+    help_="How long a producer blocks on a full result queue before the "
+    "message is dropped and counted (bus_publish_dropped_total).",
+)
+define_flag(
+    "agent_expiry_s",
+    2.0,
+    help_="Heartbeat silence before an agent is pruned from plans "
+    "(ref: 1 minute, agent_topic_listener.go:41; scaled down).",
+)
+define_flag(
+    "agent_heartbeat_interval_s",
+    0.5,
+    help_="Agent heartbeat period (ref: ~5s, scaled down).",
+)

@@ -1,0 +1,130 @@
+"""Self-observability metrics registry.
+
+Ref: src/common/metrics/metrics.h (prometheus-cpp registry shared by engine
+components; e.g. table_store/table/table_metrics.h gauges,
+socket_tracer/metrics.{h,cc} counters). Same shape here: process-global
+registry of named counters/gauges with optional label sets, rendered in
+Prometheus text exposition format for scraping/debugging.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = {}
+
+    def _key(self, labels: Optional[dict]) -> tuple:
+        return tuple(sorted((labels or {}).items()))
+
+    def labels(self, **labels) -> "_Bound":
+        return _Bound(self, self._key(labels))
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def samples(self) -> list[tuple[tuple, float]]:
+        with self._lock:
+            return sorted(self._values.items())
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+
+class _Bound:
+    def __init__(self, metric: _Metric, key: tuple):
+        self._metric = metric
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._metric._lock:
+            self._metric._values[self._key] = (
+                self._metric._values.get(self._key, 0.0) + amount
+            )
+
+    def set(self, value: float) -> None:
+        with self._metric._lock:
+            self._metric._values[self._key] = float(value)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(name, help_, Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_create(name, help_, Gauge)
+
+    def _get_or_create(self, name: str, help_: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}"
+                )
+            return m
+
+    def collect(self) -> dict[str, dict]:
+        """{name: {labels-tuple: value}} snapshot (for tests/inspection)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: dict(m.samples()) for m in metrics}
+
+    def render_text(self) -> str:
+        """Prometheus text exposition format (the /metrics payload)."""
+        out = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if m.help:
+                out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.kind}")
+            for key, val in m.samples():
+                if key:
+                    lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                    out.append(f"{m.name}{{{lbl}}} {val:g}")
+                else:
+                    out.append(f"{m.name} {val:g}")
+        return "\n".join(out) + "\n"
+
+
+_registry = MetricsRegistry()
+
+
+def metrics_registry() -> MetricsRegistry:
+    return _registry

@@ -27,9 +27,13 @@ from pixie_tpu.vizier.bus import (
     MessageBus,
     agent_topic,
 )
+from pixie_tpu.utils import flags
 from pixie_tpu.vizier.agent import AGENT_STATUS_TOPIC, RESULTS_TOPIC_PREFIX
 
-AGENT_EXPIRY_S = 2.0  # ref: 1 minute (agent_topic_listener.go:41), scaled
+
+# ref: 1 minute (agent_topic_listener.go:41), scaled; env-overridable via
+# PIXIE_TPU_AGENT_EXPIRY_S (read once at import).
+AGENT_EXPIRY_S = flags.agent_expiry_s
 
 
 class AgentTracker:
@@ -75,10 +79,43 @@ class AgentTracker:
             ]
         )
 
+    def agents_snapshot(self) -> list[dict]:
+        """Rows for the GetAgentStatus UDTF (ref: md_udtfs.h reads the
+        agent manager's registry)."""
+        now = time.monotonic()
+        now_ns = time.time_ns()
+        with self._lock:
+            return [
+                {
+                    "agent_id": aid,
+                    "asid": i + 1,
+                    "hostname": aid,
+                    "agent_state": (
+                        "AGENT_STATE_HEALTHY"
+                        if now - a["last_seen"] < AGENT_EXPIRY_S
+                        else "AGENT_STATE_UNRESPONSIVE"
+                    ),
+                    "last_heartbeat_ns": now_ns
+                    - int((now - a["last_seen"]) * 1e9),
+                    "kelvin": a["is_kelvin"],
+                }
+                for i, (aid, a) in enumerate(sorted(self._agents.items()))
+            ]
+
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=2)
         self._sub.unsubscribe()
+
+
+class TrackerVizierCtx:
+    """FunctionContext.vizier_ctx backed by the broker's agent tracker."""
+
+    def __init__(self, tracker: AgentTracker):
+        self._tracker = tracker
+
+    def agents(self) -> list[dict]:
+        return self._tracker.agents_snapshot()
 
 
 class QueryBroker:
@@ -98,6 +135,7 @@ class QueryBroker:
         self.registry = registry
         self.compiler = Compiler(registry)
         self.tracker = AgentTracker(bus)
+        self.vizier_ctx = TrackerVizierCtx(self.tracker)
         # Schema authority: in the reference the broker gets schemas from
         # the metadata service; here the caller provides them (or agents'
         # heartbeats name tables and the caller maps relations).
@@ -110,8 +148,17 @@ class QueryBroker:
         now_ns: Optional[int] = None,
         script_args: Optional[dict] = None,
         analyze: bool = False,
+        exec_funcs=None,
+        on_batch=None,
     ) -> QueryResult:
-        """The ExecuteScript path (server.go:308 → launch_query.go:36)."""
+        """The ExecuteScript path (server.go:308 → launch_query.go:36).
+
+        Flow control (ref: query_result_forwarder.go:502,571): the result
+        subscription is bounded (flags.broker_max_pending); agents
+        publishing into a full queue block up to the publish timeout, so a
+        slow consumer backpressures producers instead of growing broker
+        memory. Pass ``on_batch(table_name, row_batch)`` to stream batches
+        to the consumer as they arrive instead of accumulating them."""
         qid = str(uuid.uuid4())
         t0 = time.perf_counter_ns()
         logical = self.compiler.compile(
@@ -120,6 +167,7 @@ class QueryBroker:
             now_ns=now_ns,
             script_args=script_args,
             query_id=qid,
+            exec_funcs=exec_funcs,
         )
         state = self.tracker.distributed_state()
         planner = DistributedPlanner(self.registry, self.table_relations)
@@ -134,7 +182,9 @@ class QueryBroker:
                         qid, frag.node(nid).bridge_id
                     )
 
-        results_sub = self.bus.subscribe(RESULTS_TOPIC_PREFIX + qid)
+        results_sub = self.bus.subscribe(
+            RESULTS_TOPIC_PREFIX + qid, maxsize=flags.broker_max_pending
+        )
         # Launch per-agent plans (launch_query.go:36-82).
         by_instance: dict[str, Plan] = {}
         for frag in plan.fragments:
@@ -171,7 +221,12 @@ class QueryBroker:
                 if msg is None:
                     continue
                 if msg["type"] == "result_batch":
-                    tables.setdefault(msg["table"], []).append(msg["batch"])
+                    if on_batch is not None:
+                        on_batch(msg["table"], msg["batch"])
+                    else:
+                        tables.setdefault(msg["table"], []).append(
+                            msg["batch"]
+                        )
                 elif msg["type"] == "fragment_done":
                     for k, v in msg.get("exec_stats", {}).items():
                         exec_stats[f"{msg['agent_id']}/{k}"] = v

@@ -10,36 +10,73 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Any
+from typing import Any, Optional
+
+from pixie_tpu.utils import metrics_registry
+
+_DROPPED = metrics_registry().counter(
+    "bus_publish_dropped_total",
+    "Messages dropped after blocking on a full bounded subscription.",
+)
+_DEPTH = metrics_registry().gauge(
+    "bus_subscription_depth", "Queued messages per topic (max across subs)."
+)
 
 
 def agent_topic(agent_id: str) -> str:
     return f"Agent/{agent_id}"
 
 
+def _topic_label(topic: str) -> str:
+    """Metrics label for a topic: per-query/per-agent topics collapse to
+    their prefix so the process-global registry stays bounded (per-UUID
+    labels would leak one entry per query forever)."""
+    return topic.split("/", 1)[0] if "/" in topic else topic
+
+
 class Subscription:
-    def __init__(self, topic: str, bus: "MessageBus"):
+    """Optionally bounded (maxsize): a full queue blocks publishers up to
+    the bus's publish timeout, then drops — flow control for result
+    streams (ref: query_result_forwarder.go:502's bounded channels), NATS
+    at-most-once drop semantics past the deadline."""
+
+    def __init__(
+        self, topic: str, bus: "MessageBus", maxsize: int = 0
+    ):
         self.topic = topic
         self._bus = bus
-        self._q: "queue.Queue[Any]" = queue.Queue()
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=maxsize)
 
     def get(self, timeout: float = None):
         try:
-            return self._q.get(timeout=timeout)
+            msg = self._q.get(timeout=timeout)
         except queue.Empty:
             return None
+        _DEPTH.set(self._q.qsize(), topic=_topic_label(self.topic))
+        return msg
+
+    def depth(self) -> int:
+        return self._q.qsize()
 
     def unsubscribe(self) -> None:
         self._bus._unsubscribe(self)
 
 
 class MessageBus:
-    def __init__(self):
+    def __init__(self, publish_timeout_s: Optional[float] = None):
         self._lock = threading.Lock()
         self._subs: dict[str, list[Subscription]] = {}
+        self._publish_timeout_s = publish_timeout_s
 
-    def subscribe(self, topic: str) -> Subscription:
-        sub = Subscription(topic, self)
+    def _timeout(self) -> float:
+        if self._publish_timeout_s is not None:
+            return self._publish_timeout_s
+        from pixie_tpu.utils import flags
+
+        return flags.broker_publish_timeout_s
+
+    def subscribe(self, topic: str, maxsize: int = 0) -> Subscription:
+        sub = Subscription(topic, self, maxsize=maxsize)
         with self._lock:
             self._subs.setdefault(topic, []).append(sub)
         return sub
@@ -48,7 +85,12 @@ class MessageBus:
         with self._lock:
             subs = list(self._subs.get(topic, ()))
         for s in subs:
-            s._q.put(msg)
+            try:
+                s._q.put(msg, timeout=self._timeout())
+            except queue.Full:
+                _DROPPED.inc(topic=_topic_label(topic))
+                continue
+            _DEPTH.set(s._q.qsize(), topic=_topic_label(topic))
 
     def _unsubscribe(self, sub: Subscription) -> None:
         with self._lock:

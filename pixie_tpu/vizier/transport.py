@@ -1,0 +1,279 @@
+"""TCP transport: the cross-process/cross-host control bus + data plane.
+
+Ref: the reference runs NATS for control (src/common/event/nats.{h,cc},
+messagebus/topic.go) and gRPC TransferResultChunk streams for data
+(src/carnot/exec/grpc_router.h:53, carnotpb/carnot.proto:99). Here one
+framed TCP connection per remote agent carries both: bus publishes /
+subscriptions (control) and bridge register/push frames (data). Row/state
+batches cross as their explicit wire format (RowBatch.to_bytes /
+StateBatch.to_bytes via __reduce__); control messages are structural
+pickles of plain dataclasses — a trusted-cluster assumption the reference
+makes of its NATS bus too.
+
+Topology: the broker process runs a BusTransportServer bound to its local
+MessageBus + BridgeRouter; each remote agent process connects a RemoteBus
+(+ RemoteRouter on the same connection). PEM-side fragments only *push*
+to bridges (the splitter cuts before blocking ops), so RemoteRouter is
+send-only; merge-side consumption happens in the broker process's router.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+from pixie_tpu.exec.router import BridgeRouter
+from pixie_tpu.vizier.bus import MessageBus
+
+_LEN = struct.Struct(">Q")
+
+
+def _send_frame(sock: socket.socket, obj: dict) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Optional[dict]:
+    hdr = _recv_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def _close(sock: socket.socket) -> None:
+    """shutdown() before close(): a reader blocked in recv on either end
+    only wakes on FIN, which close() alone does not send while another
+    thread holds the fd open."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class BusTransportServer:
+    """Accepts remote-agent connections; bridges them onto the local
+    MessageBus and BridgeRouter (the broker side)."""
+
+    def __init__(
+        self,
+        bus: MessageBus,
+        router: BridgeRouter,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.bus = bus
+        self.router = router
+        self._srv = socket.create_server((host, port))
+        self.address = self._srv.getsockname()
+        self._stop = threading.Event()
+        self._conns: list[socket.socket] = []
+        self._threads: list[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            t = threading.Thread(
+                target=self._conn_loop, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        conn_dead = threading.Event()  # per-connection: stops forwarders
+        subs = []
+        try:
+            while not self._stop.is_set():
+                frame = _recv_frame(conn)
+                if frame is None:
+                    return
+                kind = frame["kind"]
+                if kind == "publish":
+                    self.bus.publish(frame["topic"], frame["msg"])
+                elif kind == "subscribe":
+                    sub = self.bus.subscribe(frame["topic"])
+                    subs.append(sub)
+
+                    def forward(sub=sub, topic=frame["topic"]):
+                        while not (
+                            self._stop.is_set() or conn_dead.is_set()
+                        ):
+                            msg = sub.get(timeout=0.05)
+                            if msg is None:
+                                continue
+                            try:
+                                with send_lock:
+                                    _send_frame(
+                                        conn,
+                                        {
+                                            "kind": "message",
+                                            "topic": topic,
+                                            "msg": msg,
+                                        },
+                                    )
+                            except OSError:
+                                return
+
+                    ft = threading.Thread(target=forward, daemon=True)
+                    ft.start()
+                elif kind == "bridge_register":
+                    self.router.register_producer(
+                        frame["query_id"], frame["bridge_id"]
+                    )
+                elif kind == "bridge_push":
+                    self.router.push(
+                        frame["query_id"], frame["bridge_id"], frame["item"]
+                    )
+        finally:
+            conn_dead.set()
+            for sub in subs:
+                sub.unsubscribe()
+            _close(conn)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._srv.close()
+        for c in self._conns:
+            _close(c)
+
+
+class _RemoteSubscription:
+    def __init__(self, topic: str, bus: "RemoteBus"):
+        self.topic = topic
+        self._bus = bus
+        import collections
+
+        self._q: "collections.deque" = collections.deque()
+        self._cv = threading.Condition()
+
+    def _deliver(self, msg: Any) -> None:
+        with self._cv:
+            self._q.append(msg)
+            self._cv.notify()
+
+    def get(self, timeout: float = None):
+        with self._cv:
+            if not self._q:
+                self._cv.wait(timeout=timeout)
+            return self._q.popleft() if self._q else None
+
+    def unsubscribe(self) -> None:
+        self._bus._drop(self)
+
+
+class RemoteBus:
+    """MessageBus facade over one framed TCP connection (the agent side)."""
+
+    def __init__(self, address):
+        self._sock = socket.create_connection(tuple(address))
+        self._send_lock = threading.Lock()
+        self._subs_lock = threading.Lock()
+        self._subs: dict[str, list[_RemoteSubscription]] = {}
+        self._stop = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                frame = _recv_frame(self._sock)
+            except OSError:
+                return
+            if frame is None:
+                return
+            if frame.get("kind") == "message":
+                with self._subs_lock:
+                    targets = list(self._subs.get(frame["topic"], ()))
+                for sub in targets:
+                    sub._deliver(frame["msg"])
+
+    def _send(self, obj: dict) -> None:
+        with self._send_lock:
+            _send_frame(self._sock, obj)
+
+    def publish(self, topic: str, msg: Any) -> None:
+        self._send({"kind": "publish", "topic": topic, "msg": msg})
+
+    def subscribe(self, topic: str) -> _RemoteSubscription:
+        sub = _RemoteSubscription(topic, self)
+        with self._subs_lock:
+            first = topic not in self._subs
+            self._subs.setdefault(topic, []).append(sub)
+        if first:
+            self._send({"kind": "subscribe", "topic": topic})
+        return sub
+
+    def _drop(self, sub: _RemoteSubscription) -> None:
+        with self._subs_lock:
+            if sub.topic in self._subs and sub in self._subs[sub.topic]:
+                self._subs[sub.topic].remove(sub)
+
+    def close(self) -> None:
+        self._stop.set()
+        _close(self._sock)
+
+
+class RemoteRouter(BridgeRouter):
+    """Send-only bridge router riding the agent's RemoteBus connection:
+    pushes and producer registrations go to the broker-process router
+    (ref: GRPCSinkNode streaming TransferResultChunk to the remote
+    GRPCRouter). PEM fragments never consume bridges — the splitter cuts
+    plans before blocking ops — so poll() on a remote bridge is a plan
+    error, not a transport feature."""
+
+    def __init__(self, bus: RemoteBus):
+        super().__init__()
+        self._bus = bus
+
+    def register_producer(self, query_id: str, bridge_id: str) -> None:
+        self._bus._send(
+            {
+                "kind": "bridge_register",
+                "query_id": query_id,
+                "bridge_id": bridge_id,
+            }
+        )
+
+    def push(self, query_id: str, bridge_id: str, item: Any) -> None:
+        self._bus._send(
+            {
+                "kind": "bridge_push",
+                "query_id": query_id,
+                "bridge_id": bridge_id,
+                "item": item,
+            }
+        )
+
+    def poll(self, query_id: str, bridge_id: str):
+        raise NotImplementedError(
+            "remote agents only produce into bridges; merge fragments run "
+            "in the broker process (splitter invariant)"
+        )

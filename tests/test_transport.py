@@ -1,0 +1,182 @@
+"""Cross-process cluster tests: real TCP transport between OS processes.
+
+Ref: the reference's PEM→Kelvin data plane is a network stream
+(src/carnot/exec/grpc_router.h:53, carnotpb TransferResultChunk) and its
+control plane is NATS. Here two PEM processes connect to the broker
+process over framed TCP (pixie_tpu/vizier/transport.py); a distributed
+groupby must produce the same result as computing on the union locally.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import time
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec.agg_node import StateBatch
+from pixie_tpu.exec.router import BridgeRouter
+from pixie_tpu.table.column import DictColumn, StringDictionary
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.types import DataType, Relation, SemanticType
+from pixie_tpu.vizier.agent import Agent
+from pixie_tpu.vizier.broker import QueryBroker
+from pixie_tpu.vizier.bus import MessageBus
+from pixie_tpu.vizier.transport import BusTransportServer, RemoteBus, RemoteRouter
+
+F, I, S, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+SEQ_REL_COLS = (
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("service", S),
+    ("value", F),
+)
+
+
+def _seq_rel() -> Relation:
+    return Relation.of(*SEQ_REL_COLS)
+
+
+def _shard(shard_idx: int, n: int = 500):
+    """Deterministic per-shard data, reproducible in parent and child."""
+    rng = np.random.default_rng(100 + shard_idx)
+    return {
+        "time_": (np.arange(n) * 10 + shard_idx).astype(np.int64),
+        "service": np.array(
+            [f"svc-{i % 4}" for i in rng.integers(0, 1000, n)], dtype=object
+        ),
+        "value": rng.normal(100.0, 10.0, n),
+    }
+
+
+def _child_pem(address, agent_id: str, shard_idx: int) -> None:
+    """Runs in a separate OS process: a PEM agent over TCP."""
+    from pixie_tpu.table.table_store import TableStore
+
+    store = TableStore()
+    t = store.create_table("seq", _seq_rel())
+    t.write_pydict(_shard(shard_idx))
+    t.compact()
+    t.stop()
+    bus = RemoteBus(address)
+    router = RemoteRouter(bus)
+    agent = Agent(agent_id, bus, router, table_store=store, is_kelvin=False)
+    agent.start()
+    time.sleep(30)  # parent terminates us well before this
+
+
+def test_statebatch_wire_roundtrip():
+    d = StringDictionary()
+    codes = d.encode(np.array(["a", "b", "a"], dtype=object))
+    sb = StateBatch(
+        key_columns=[DictColumn(codes, d), np.array([1, 2, 3], np.int64)],
+        states={
+            "s": {
+                "sum": np.array([1.5, 2.5, 3.5]),
+                "count": np.array([1, 2, 3], np.int64),
+            },
+            "t": (np.array([[1.0, 2.0]]), np.array([True, False])),
+        },
+        num_groups=3,
+        group_names=("k1", "k2"),
+        eow=True,
+        eos=True,
+        arg_dicts={"s": StringDictionary(["x", "y"])},
+    )
+    back = StateBatch.from_bytes(sb.to_bytes())
+    assert back.num_groups == 3
+    assert back.group_names == ("k1", "k2")
+    assert back.eos and back.eow
+    assert list(back.key_columns[0].decode()) == ["a", "b", "a"]
+    np.testing.assert_array_equal(back.key_columns[1], [1, 2, 3])
+    np.testing.assert_allclose(back.states["s"]["sum"], [1.5, 2.5, 3.5])
+    np.testing.assert_array_equal(back.states["s"]["count"], [1, 2, 3])
+    assert isinstance(back.states["t"], tuple)
+    np.testing.assert_array_equal(back.states["t"][1], [True, False])
+    assert list(back.arg_dicts["s"].values()) == ["x", "y"]
+
+
+def test_rowbatch_pickle_rides_wire_format():
+    import pickle
+
+    rel = _seq_rel()
+    rb = RowBatch.from_pydict(
+        rel,
+        {"time_": [1, 2], "service": ["a", "b"], "value": [0.5, 1.5]},
+        eos=True,
+    )
+    back = pickle.loads(pickle.dumps(rb))
+    assert back.to_pydict() == rb.to_pydict()
+    assert back.eos
+
+
+def test_two_process_cluster_matches_local():
+    # Bounded internally: registration waits 60s, execute_script 60s.
+    ctx = mp.get_context("spawn")
+    bus = MessageBus()
+    router = BridgeRouter()
+    server = BusTransportServer(bus, router)
+    kelvin = Agent("kelvin", bus, router, is_kelvin=True)
+    kelvin.start()
+    broker = QueryBroker(
+        bus, router, table_relations={"seq": _seq_rel()}
+    )
+    procs = [
+        ctx.Process(
+            target=_child_pem, args=(server.address, f"pem{i}", i), daemon=True
+        )
+        for i in range(2)
+    ]
+    try:
+        for p in procs:
+            p.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            state = broker.tracker.distributed_state()
+            if len(state.agents) >= 3:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("agents never registered over transport")
+
+        res = broker.execute_script(
+            "df = px.DataFrame(table='seq')\n"
+            "s = df.groupby(['service']).agg(\n"
+            "    n=('time_', px.count),\n"
+            "    total=('value', px.sum),\n"
+            "    avg=('value', px.mean),\n"
+            ")\n"
+            "px.display(s, 'out')\n",
+            timeout_s=60,
+        )
+        got = RowBatch.concat(
+            [b for b in res.tables["out"] if b.num_rows]
+        ).to_pydict()
+
+        # Truth: the union of both shards, computed directly.
+        svc = np.concatenate(
+            [_shard(0)["service"], _shard(1)["service"]]
+        )
+        val = np.concatenate([_shard(0)["value"], _shard(1)["value"]])
+        by = dict(zip(got["service"], zip(got["n"], got["total"], got["avg"])))
+        names = sorted(set(svc.tolist()))
+        assert sorted(by) == names
+        for name in names:
+            sel = svc == name
+            n, total, avg = by[name]
+            assert n == sel.sum()
+            assert total == pytest.approx(val[sel].sum(), rel=1e-12)
+            assert avg == pytest.approx(val[sel].mean(), rel=1e-12)
+    finally:
+        for p in procs:
+            p.terminate()
+            p.join(timeout=5)
+        broker.stop()
+        kelvin.stop()
+        server.stop()
