@@ -52,6 +52,7 @@ from pixie_tpu.exec.expression_evaluator import ExpressionEvaluator
 from pixie_tpu.exec.group_encoder import GroupEncoder
 from pixie_tpu.parallel.staging import (
     DEFAULT_BLOCK_ROWS,
+    _pow2_at_least,
     read_columns,
     stage_columns,
 )
@@ -583,16 +584,39 @@ class MeshExecutor:
         self._finmode_cache[cache_key] = (modes, templates)
         return modes, templates
 
-    def _signature(self, m, specs, key_plan, staged, aux_vals) -> str:
+    def _pass_plan(self, specs, num_groups: int) -> tuple[int, int]:
+        """(per-pass capacity, n_passes): bound state memory for
+        high-cardinality group-bys. Sketch UDAs cost KBs per group slot, so
+        1e6 distinct keys would OOM a single-pass program; instead the SAME
+        compiled program runs n_passes times over the staged (resident)
+        blocks, each pass masking to a contiguous gid range via a gid_base
+        argument, and the host concatenates the per-pass outputs (the
+        spill/recombine strategy for SURVEY 'Hard parts' #1)."""
+        per_group = 8  # presence counter
+        for _, _, uda in specs:
+            st = jax.eval_shape(lambda u=uda: u.init(1))
+            per_group += sum(
+                int(np.prod(l.shape)) * l.dtype.itemsize
+                for l in jax.tree.leaves(st)
+            )
+        budget = flags.device_group_state_budget_mb * (1 << 20)
+        cap_full = _pow2_at_least(max(num_groups, 1))
+        fit = max(budget // per_group, 1)
+        max_cap = max(1 << (fit.bit_length() - 1), 8)  # largest pow2 <= fit
+        capacity = min(cap_full, max_cap)
+        n_passes = (max(num_groups, 1) + capacity - 1) // capacity
+        return capacity, n_passes
+
+    def _signature(self, m, specs, key_plan, staged, aux_vals, capacity) -> str:
         """Structural identity of the compiled program: expressions, UDA
         set, key mode, block geometry, capacity, aux shapes."""
-        modes, _ = self._finalize_modes(specs, staged.capacity)
+        modes, _ = self._finalize_modes(specs, capacity)
         parts = [
             "finmodes:" + ",".join(modes),
             ",".join(f"{n}:{a.shape}:{a.dtype}" for n, a in
                      sorted(staged.blocks.items())),
             f"mask:{staged.mask.shape}",
-            f"cap:{staged.capacity}",
+            f"cap:{capacity}",
             f"hostgids:{key_plan.host_gids is not None}",
             "preds:" + ";".join(repr(p) for p in m.predicates),
             "aggs:" + ";".join(
@@ -612,9 +636,10 @@ class MeshExecutor:
         ]
         return "|".join(parts)
 
-    def _build_program(self, m, specs, evaluator, key_plan, staged, aux_key_order):
+    def _build_program(
+        self, m, specs, evaluator, key_plan, staged, aux_key_order, capacity
+    ):
         axis = self.mesh.axis_names[0]
-        capacity = staged.capacity
         fin_modes, _ = self._finalize_modes(specs, capacity)
         col_names = sorted(staged.blocks)
         has_host_gids = key_plan.host_gids is not None
@@ -626,8 +651,10 @@ class MeshExecutor:
         ]
 
         def shard_fn(*arrs):
-            # Layout: cols..., mask, [gids], [key_lut], aux...
-            # Sharded args arrive as [1, nblk, B]; aux is replicated.
+            # Layout: cols..., mask, [gids], [key_lut], aux..., gid_base.
+            # Sharded args arrive as [1, nblk, B]; aux + gid_base are
+            # replicated; gid_base selects this pass's group window for
+            # high-cardinality multi-pass execution.
             i = len(col_names)
             cols = {n: a[0] for n, a in zip(col_names, arrs[:i])}
             mask_all = arrs[i][0]
@@ -640,7 +667,8 @@ class MeshExecutor:
             if has_key_lut:
                 key_lut = arrs[i]
                 i += 1
-            aux = dict(zip(aux_key_order, arrs[i:]))
+            gid_base = arrs[-1]
+            aux = dict(zip(aux_key_order, arrs[i:-1]))
 
             def eval_gids(env):
                 if device_key is None:
@@ -670,6 +698,11 @@ class MeshExecutor:
                 for p in preds:
                     mask = mask & evaluator.device_eval(p, env, aux)
                 gids = blk_gids if gids_all is not None else eval_gids(env)
+                # This pass owns groups [gid_base, gid_base + capacity);
+                # rows outside it are masked and their updates land on a
+                # clipped (masked-out) slot.
+                gids = gids.astype(jnp.int32) - gid_base
+                mask = mask & (gids >= 0) & (gids < capacity)
                 gids = jnp.clip(gids, 0, capacity - 1)
                 new_states = []
                 for (out, arg_e, uda), st in zip(specs, states):
@@ -762,7 +795,7 @@ class MeshExecutor:
             return jnp.concatenate(parts)
 
         n_sharded = len(col_names) + 1 + (1 if has_host_gids else 0)
-        n_repl = (1 if has_key_lut else 0) + len(aux_key_order)
+        n_repl = (1 if has_key_lut else 0) + len(aux_key_order) + 1  # +gid_base
         in_specs = tuple([P(axis)] * n_sharded + [P()] * n_repl)
         return jax.jit(
             shard_map(
@@ -808,14 +841,15 @@ class MeshExecutor:
     def _run_program(self, m, specs, evaluator, key_plan, staged, aux):
         col_names = sorted(staged.blocks)
         aux_vals = list(aux.values())
-        sig = self._signature(m, specs, key_plan, staged, aux_vals)
+        capacity, n_passes = self._pass_plan(specs, key_plan.num_groups)
+        sig = self._signature(m, specs, key_plan, staged, aux_vals, capacity)
         entry = self._program_cache.get(sig)
         if entry is None or entry[1] != len(aux_vals):
             aux_key_order = list(aux.keys())
             program = self._build_program(
-                m, specs, evaluator, key_plan, staged, aux_key_order
+                m, specs, evaluator, key_plan, staged, aux_key_order, capacity
             )
-            _, templates = self._finalize_modes(specs, staged.capacity)
+            _, templates = self._finalize_modes(specs, capacity)
             self._program_cache[sig] = (program, len(aux_key_order), templates)
             _PROGRAMS.set(len(self._program_cache))
         program, _, templates = self._program_cache[sig]
@@ -829,10 +863,28 @@ class MeshExecutor:
         # MESH runs on (may differ from jax.default_backend()).
         from pixie_tpu.ops import segment as _segment
 
+        per_pass = []
         with _segment.platform_hint(self.mesh.devices.flat[0].platform):
-            buf = program(*args)
-        # ONE blocking fetch: covers compute completion + the transfer.
-        return self._unpack_outputs(templates, staged.capacity, buf)
+            for p in range(n_passes):
+                buf = program(*args, jnp.int32(p * capacity))
+                # ONE blocking fetch per pass: completion + transfer.
+                per_pass.append(
+                    self._unpack_outputs(templates, capacity, buf)
+                )
+        if n_passes == 1:
+            return per_pass[0]
+        # Recombine: every leaf (finalized output or state) and the
+        # presence counts carry a leading group axis — concatenation
+        # reassembles the full gid space across pass windows.
+        values = [
+            jax.tree.map(
+                lambda *leaves: np.concatenate(leaves, axis=0),
+                *(vp[0][i] for vp in per_pass),
+            )
+            for i in range(len(specs))
+        ]
+        presence = np.concatenate([vp[1] for vp in per_pass])
+        return values, presence
 
     # -- finalize -----------------------------------------------------------
     def _finalize(

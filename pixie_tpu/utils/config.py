@@ -121,6 +121,13 @@ define_flag(
     "message is dropped and counted (bus_publish_dropped_total).",
 )
 define_flag(
+    "device_group_state_budget_mb",
+    512,
+    help_="Memory budget for per-group UDA state on device; group-bys "
+    "whose state would exceed it run in multiple gid-window passes "
+    "(high-cardinality spill/recombine).",
+)
+define_flag(
     "agent_expiry_s",
     2.0,
     help_="Heartbeat silence before an agent is pruned from plans "

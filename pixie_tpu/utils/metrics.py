@@ -110,13 +110,22 @@ class MetricsRegistry:
         out = []
         with self._lock:
             metrics = list(self._metrics.values())
+        def esc(v) -> str:
+            # Exposition-format label escaping: backslash, quote, newline.
+            return (
+                str(v)
+                .replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
+
         for m in metrics:
             if m.help:
                 out.append(f"# HELP {m.name} {m.help}")
             out.append(f"# TYPE {m.name} {m.kind}")
             for key, val in m.samples():
                 if key:
-                    lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                    lbl = ",".join(f'{k}="{esc(v)}"' for k, v in key)
                     out.append(f"{m.name}{{{lbl}}} {val:g}")
                 else:
                     out.append(f"{m.name} {val:g}")

@@ -237,6 +237,16 @@ class QueryBroker:
         finally:
             results_sub.unsubscribe()
             self.router.cleanup_query(qid)
+        if results_sub.dropped:
+            # Result messages were dropped after the flow-control timeout:
+            # the stream is incomplete — fail loudly rather than return
+            # partial data as success (ref: the forwarder cancels the
+            # query, query_result_forwarder.go:571).
+            raise RuntimeError(
+                f"query {qid}: consumer too slow — {results_sub.dropped} "
+                "result messages dropped after "
+                f"{flags.broker_publish_timeout_s}s of backpressure"
+            )
         if errors:
             raise RuntimeError(
                 f"query {qid} failed on agents:\n" + "\n".join(errors)

@@ -46,6 +46,11 @@ class Subscription:
         self.topic = topic
         self._bus = bus
         self._q: "queue.Queue[Any]" = queue.Queue(maxsize=maxsize)
+        # Messages dropped on THIS subscription after a full-queue timeout.
+        # Consumers whose stream must be lossless (the broker's result
+        # forwarder) check it and fail the query instead of silently
+        # returning partial data.
+        self.dropped = 0
 
     def get(self, timeout: float = None):
         try:
@@ -88,6 +93,7 @@ class MessageBus:
             try:
                 s._q.put(msg, timeout=self._timeout())
             except queue.Full:
+                s.dropped += 1
                 _DROPPED.inc(topic=_topic_label(topic))
                 continue
             _DEPTH.set(s._q.qsize(), topic=_topic_label(topic))

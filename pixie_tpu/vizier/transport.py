@@ -37,13 +37,17 @@ def _send_frame(sock: socket.socket, obj: dict) -> None:
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    # recv_into a preallocated buffer: O(n), not the quadratic bytes+=
+    # (row-batch frames reach hundreds of MB).
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
             return None
-        buf += chunk
-    return buf
+        got += r
+    return bytes(buf)
 
 
 def _recv_frame(sock: socket.socket) -> Optional[dict]:
@@ -109,7 +113,7 @@ class BusTransportServer:
     def _conn_loop(self, conn: socket.socket) -> None:
         send_lock = threading.Lock()
         conn_dead = threading.Event()  # per-connection: stops forwarders
-        subs = []
+        subs: dict[str, tuple] = {}  # topic -> (bus sub, stop event)
         try:
             while not self._stop.is_set():
                 frame = _recv_frame(conn)
@@ -117,14 +121,23 @@ class BusTransportServer:
                     return
                 kind = frame["kind"]
                 if kind == "publish":
+                    # May block on a full bounded subscription — that is
+                    # the flow control. Agents ship a separate control
+                    # connection for heartbeats (RemoteBus), so blocking a
+                    # data connection cannot starve liveness.
                     self.bus.publish(frame["topic"], frame["msg"])
                 elif kind == "subscribe":
+                    if frame["topic"] in subs:
+                        continue
                     sub = self.bus.subscribe(frame["topic"])
-                    subs.append(sub)
+                    stop = threading.Event()
+                    subs[frame["topic"]] = (sub, stop)
 
-                    def forward(sub=sub, topic=frame["topic"]):
+                    def forward(sub=sub, stop=stop, topic=frame["topic"]):
                         while not (
-                            self._stop.is_set() or conn_dead.is_set()
+                            self._stop.is_set()
+                            or conn_dead.is_set()
+                            or stop.is_set()
                         ):
                             msg = sub.get(timeout=0.05)
                             if msg is None:
@@ -144,6 +157,11 @@ class BusTransportServer:
 
                     ft = threading.Thread(target=forward, daemon=True)
                     ft.start()
+                elif kind == "unsubscribe":
+                    entry = subs.pop(frame["topic"], None)
+                    if entry is not None:
+                        entry[1].set()
+                        entry[0].unsubscribe()
                 elif kind == "bridge_register":
                     self.router.register_producer(
                         frame["query_id"], frame["bridge_id"]
@@ -154,7 +172,8 @@ class BusTransportServer:
                     )
         finally:
             conn_dead.set()
-            for sub in subs:
+            for sub, stop in subs.values():
+                stop.set()
                 sub.unsubscribe()
             _close(conn)
 
@@ -190,11 +209,22 @@ class _RemoteSubscription:
 
 
 class RemoteBus:
-    """MessageBus facade over one framed TCP connection (the agent side)."""
+    """MessageBus facade over framed TCP (the agent side).
+
+    Two connections, mirroring the reference's split planes (NATS control
+    vs gRPC data streams): result-stream publishes and bridge pushes ride
+    a DATA connection that may block under broker flow control; heartbeats,
+    registration, and subscriptions ride the CONTROL connection so
+    backpressure can never starve liveness and get the agent pruned."""
+
+    DATA_TOPIC_PREFIXES = ("results/",)
 
     def __init__(self, address):
-        self._sock = socket.create_connection(tuple(address))
+        self._address = tuple(address)
+        self._sock = socket.create_connection(self._address)
         self._send_lock = threading.Lock()
+        self._data_sock = None  # opened on first data-plane send
+        self._data_lock = threading.Lock()
         self._subs_lock = threading.Lock()
         self._subs: dict[str, list[_RemoteSubscription]] = {}
         self._stop = threading.Event()
@@ -219,8 +249,18 @@ class RemoteBus:
         with self._send_lock:
             _send_frame(self._sock, obj)
 
+    def _send_data(self, obj: dict) -> None:
+        with self._data_lock:
+            if self._data_sock is None:
+                self._data_sock = socket.create_connection(self._address)
+            _send_frame(self._data_sock, obj)
+
     def publish(self, topic: str, msg: Any) -> None:
-        self._send({"kind": "publish", "topic": topic, "msg": msg})
+        frame = {"kind": "publish", "topic": topic, "msg": msg}
+        if topic.startswith(self.DATA_TOPIC_PREFIXES):
+            self._send_data(frame)
+        else:
+            self._send(frame)
 
     def subscribe(self, topic: str) -> _RemoteSubscription:
         sub = _RemoteSubscription(topic, self)
@@ -232,13 +272,27 @@ class RemoteBus:
         return sub
 
     def _drop(self, sub: _RemoteSubscription) -> None:
+        last = False
         with self._subs_lock:
             if sub.topic in self._subs and sub in self._subs[sub.topic]:
                 self._subs[sub.topic].remove(sub)
+                if not self._subs[sub.topic]:
+                    del self._subs[sub.topic]
+                    last = True
+        if last and not self._stop.is_set():
+            # Tell the server so its forwarder thread + bus subscription
+            # are released (they otherwise live until the conn closes).
+            try:
+                self._send({"kind": "unsubscribe", "topic": sub.topic})
+            except OSError:
+                pass
 
     def close(self) -> None:
         self._stop.set()
         _close(self._sock)
+        with self._data_lock:
+            if self._data_sock is not None:
+                _close(self._data_sock)
 
 
 class RemoteRouter(BridgeRouter):
@@ -254,7 +308,7 @@ class RemoteRouter(BridgeRouter):
         self._bus = bus
 
     def register_producer(self, query_id: str, bridge_id: str) -> None:
-        self._bus._send(
+        self._bus._send_data(
             {
                 "kind": "bridge_register",
                 "query_id": query_id,
@@ -263,7 +317,9 @@ class RemoteRouter(BridgeRouter):
         )
 
     def push(self, query_id: str, bridge_id: str, item: Any) -> None:
-        self._bus._send(
+        # Data plane: may block under flow control without starving the
+        # control connection's heartbeats.
+        self._bus._send_data(
             {
                 "kind": "bridge_push",
                 "query_id": query_id,

@@ -136,8 +136,14 @@ def test_two_process_cluster_matches_local():
     try:
         for p in procs:
             p.start()
-        deadline = time.monotonic() + 60
+        # Generous: spawned children cold-import jax, which can take tens
+        # of seconds on a loaded CI host.
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
+            for p in procs:
+                assert p.is_alive() or p.exitcode in (None, 0), (
+                    f"child PEM died with exit code {p.exitcode}"
+                )
             state = broker.tracker.distributed_state()
             if len(state.agents) >= 3:
                 break
