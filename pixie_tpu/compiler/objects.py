@@ -354,22 +354,25 @@ class DataFrameObj:
     def _agg(self, groups: tuple[str, ...], kwargs: dict) -> "DataFrameObj":
         values = []
         for out_name, spec in kwargs.items():
-            if (
-                not isinstance(spec, tuple)
-                or len(spec) != 2
-            ):
+            if not isinstance(spec, tuple) or len(spec) < 2:
                 raise CompilerError(
-                    f"agg {out_name}=... must be a (column, px.fn) tuple"
+                    f"agg {out_name}=... must be a (columns..., px.fn) tuple"
                 )
-            col, fn = spec
+            *cols, fn = spec
             fn_name = fn.name if isinstance(fn, FuncRef) else str(fn)
-            if not self.relation.has_column(col):
-                raise CompilerError(
-                    f"agg over unknown column {col!r}; have "
-                    f"{self.relation.col_names()}"
-                )
+            for col in cols:
+                if not self.relation.has_column(col):
+                    raise CompilerError(
+                        f"agg over unknown column {col!r}; have "
+                        f"{self.relation.col_names()}"
+                    )
             values.append(
-                (out_name, AggregateExpression(fn_name, (ColumnRef(col),)))
+                (
+                    out_name,
+                    AggregateExpression(
+                        fn_name, tuple(ColumnRef(c) for c in cols)
+                    ),
+                )
             )
         nid = self._ir.add(
             AggOp(groups=groups, values=tuple(values)), [self._id]

@@ -284,6 +284,11 @@ class AggNode(ExecNode):
                 # once, gather through codes. int64 view keeps x64 jnp happy.
                 hashes = col.dictionary.content_hashes().view(np.int64)
                 return hashes[col.codes]
+            if mode == "values":
+                # Decoded string values (host-only UDAs that must parse
+                # content, e.g. kmeans over JSON embeddings — the device
+                # matcher rejects this mode so it never ships to HBM).
+                return col.decode()
             col = self._latch_key_column(name, col)
             return col.codes
         return col

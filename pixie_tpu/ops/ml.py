@@ -1,0 +1,167 @@
+"""ML runtime ops: static-shape reservoir sampling + k-means.
+
+Ref: src/carnot/exec/ml/{kmeans,coreset}.{h,cc} and
+src/carnot/funcs/builtins/ml_ops.h:88 (KMeansUDA: streaming coreset →
+Lloyd's at finalize), :145 (ReservoirSampleUDA). TPU re-design: the
+pointer-based coreset tree becomes a fixed-size priority reservoir — each
+item gets a deterministic hash priority, a reservoir is the top-K
+priorities per group, and merge is concat + top-K again, which is
+associative and static-shape (so it vectorizes over groups and
+all-gathers across shards). Uniform sampling by max-priority is the
+classic A-Res construction. K-means itself is a vmapped Lloyd iteration
+over [G, S, d] sample tensors at finalize time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pixie_tpu.ops import hashing
+
+DEFAULT_RESERVOIR = 64
+
+
+# -- priority reservoir (device: jnp; also exact under np on host) ----------
+def reservoir_init(num_groups: int, k: int = DEFAULT_RESERVOIR):
+    return {
+        "values": jnp.zeros((num_groups, k), jnp.float64),
+        "priority": jnp.full((num_groups, k), -jnp.inf, jnp.float64),
+        "count": jnp.zeros((num_groups,), jnp.int64),
+    }
+
+
+def _priorities(values, count_salt):
+    """Deterministic uniform (0,1) priority per row: hash of the value bits
+    mixed with a per-call salt (row position within the stream), so repeated
+    values get distinct priorities."""
+    n = values.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64) + count_salt
+    h = hashing.combine(hashing.hash64(values), hashing.hash64(idx))
+    return (h >> np.uint64(11)).astype(jnp.float64) / float(1 << 53)
+
+
+def reservoir_update(state, gids, values, mask=None):
+    """Fold a batch into per-group top-K-by-priority reservoirs."""
+    num_groups, k = state["values"].shape
+    v = values.astype(jnp.float64)
+    n = v.shape[0]
+    if mask is None:
+        mask = jnp.ones((n,), jnp.bool_)
+    pri = jnp.where(mask, _priorities(v, state["count"].sum()), -jnp.inf)
+    g = jnp.where(mask, gids.astype(jnp.int32), num_groups)
+    # Rank rows within each group by priority (desc): sort by (g, -pri),
+    # rank = position - group start; rows with rank >= k can never enter.
+    g_s, negp_s, v_s = jax.lax.sort((g, -pri, v), num_keys=2)
+    counts = jnp.bincount(g_s, length=num_groups + 1).astype(jnp.int32)
+    starts = jnp.cumsum(counts) - counts
+    rank = jnp.arange(n, dtype=jnp.int32) - starts[g_s]
+    keep = (g_s < num_groups) & (rank < k)
+    slot = jnp.where(keep, g_s * k + rank, num_groups * k)
+    # Scatter the block's per-group top-k into a [G, k] candidate buffer.
+    cand_v = jnp.zeros((num_groups * k + 1,), v.dtype).at[slot].set(v_s)
+    cand_p = (
+        jnp.full((num_groups * k + 1,), -jnp.inf, jnp.float64)
+        .at[slot]
+        .set(-negp_s)
+    )
+    cand = {
+        "values": cand_v[:-1].reshape(num_groups, k),
+        "priority": cand_p[:-1].reshape(num_groups, k),
+        "count": jnp.bincount(
+            jnp.where(mask, gids.astype(jnp.int32), num_groups),
+            length=num_groups + 1,
+        )[:-1].astype(jnp.int64),
+    }
+    return reservoir_merge(state, cand)
+
+
+def topk_by_priority(vals_a, vals_b, pri_a, pri_b, k):
+    """Per-group top-k selection over concatenated candidates — the shared
+    reservoir-merge core. vals may carry trailing dims ([G, S] or
+    [G, S, d]); priorities are [G, S]."""
+    vals = jnp.concatenate([vals_a, vals_b], axis=1)
+    pris = jnp.concatenate([pri_a, pri_b], axis=1)
+    order = jnp.argsort(-pris, axis=1)[:, :k]
+    vorder = order.reshape(order.shape + (1,) * (vals.ndim - 2))
+    return (
+        jnp.take_along_axis(vals, vorder, axis=1),
+        jnp.take_along_axis(pris, order, axis=1),
+    )
+
+
+def reservoir_merge(a, b):
+    """Concat candidates and keep the top-K priorities per group."""
+    k = a["values"].shape[1]
+    vals, pris = topk_by_priority(
+        a["values"], b["values"], a["priority"], b["priority"], k
+    )
+    return {
+        "values": vals,
+        "priority": pris,
+        "count": a["count"] + b["count"],
+    }
+
+
+def reservoir_finalize(state) -> np.ndarray:
+    """[G] JSON strings: {"count": N, "sample": [..]} (live slots only)."""
+    vals = np.asarray(state["values"])
+    pris = np.asarray(state["priority"])
+    counts = np.asarray(state["count"])
+    out = np.empty(vals.shape[0], dtype=object)
+    for gid in range(vals.shape[0]):
+        live = vals[gid][np.isfinite(pris[gid])]
+        live = live[np.isfinite(live)]  # NaN/inf render invalid JSON
+        out[gid] = (
+            '{"count":%d,"sample":[%s]}'
+            % (int(counts[gid]), ",".join(f"{x:.6g}" for x in live))
+        )
+    return out
+
+
+# -- k-means (vmapped Lloyd's over per-group samples) -----------------------
+def kmeans_fit(points, weights, k: int, iters: int = 10):
+    """points [S, d], weights [S] (0 = empty slot) -> centers [k, d].
+    Greedy farthest-point init then Lloyd iterations; empty clusters stay
+    on their seed."""
+    S, d = points.shape
+    live = weights > 0
+
+    # Farthest-point seeding (deterministic): start from the first live
+    # point, repeatedly take the point farthest from chosen centers.
+    first = jnp.argmax(live)
+    centers0 = jnp.zeros((k, d), points.dtype).at[0].set(points[first])
+
+    def seed_step(i, centers):
+        d2 = jnp.sum(
+            (points[:, None, :] - centers[None, :, :]) ** 2, axis=-1
+        )  # [S, k]
+        masked = jnp.where(
+            jnp.arange(k)[None, :] < i, d2, jnp.inf
+        )
+        mind = jnp.min(masked, axis=1)
+        mind = jnp.where(live, mind, -jnp.inf)
+        nxt = jnp.argmax(mind)
+        return centers.at[i].set(points[nxt])
+
+    centers = jax.lax.fori_loop(1, min(k, S), seed_step, centers0)
+
+    def lloyd(_, centers):
+        d2 = jnp.sum(
+            (points[:, None, :] - centers[None, :, :]) ** 2, axis=-1
+        )
+        assign = jnp.argmin(d2, axis=1)  # [S]
+        onehot = jax.nn.one_hot(assign, k, dtype=points.dtype) * weights[:, None]
+        sums = onehot.T @ points  # [k, d]
+        wsum = onehot.sum(axis=0)  # [k]
+        return jnp.where(
+            (wsum > 0)[:, None], sums / jnp.maximum(wsum, 1e-9)[:, None], centers
+        )
+
+    return jax.lax.fori_loop(0, iters, lloyd, centers)
+
+
+def kmeans_assign(point, centers):
+    """Nearest-center index for one point [d] against centers [k, d]."""
+    return int(np.argmin(np.sum((centers - point[None, :]) ** 2, axis=1)))

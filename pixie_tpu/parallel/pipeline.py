@@ -371,6 +371,10 @@ class MeshExecutor:
                 return None
             if len(arg_exprs) != 1:
                 return None  # single-arg UDAs only on the fast path today
+            if any(t == DataType.STRING for t in types) and (
+                uda.string_args == "values"
+            ):
+                return None  # needs decoded strings: host engine only
             if types[0] == DataType.STRING and (
                 uda.string_args == "hash" or uda.string_state
             ):

@@ -12,6 +12,7 @@ def register_all(registry: Registry) -> None:
         math_ops,
         md_udtfs,
         metadata_ops,
+        ml_ops,
         sketch_ops,
         string_ops,
         time_ops,
@@ -26,3 +27,4 @@ def register_all(registry: Registry) -> None:
     collections.register(registry)
     metadata_ops.register(registry)
     md_udtfs.register(registry)
+    ml_ops.register(registry)

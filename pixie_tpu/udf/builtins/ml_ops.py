@@ -1,0 +1,212 @@
+"""ML UDAs/UDFs: reservoir sampling + streaming k-means.
+
+Ref: src/carnot/funcs/builtins/ml_ops.h:88 (KMeansUDA — streaming coreset,
+Lloyd's at finalize, JSON centers out), :145 (ReservoirSampleUDA), and the
+KMeansUDF transform (:123). TPU re-design per pixie_tpu/ops/ml.py: fixed-
+size priority reservoirs instead of pointer coresets. reservoir_sample
+runs fully on device (static-shape, TREE merge); kmeans parses JSON
+embeddings so it is a HOST UDA (string_args="values" keeps it off the
+device matcher).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pixie_tpu.ops import hashing, ml
+from pixie_tpu.types import DataType
+from pixie_tpu.udf.registry import Registry
+from pixie_tpu.udf.udf import UDA, Executor, MergeKind, ScalarUDF
+
+F = DataType.FLOAT64
+I = DataType.INT64
+S = DataType.STRING
+
+KMEANS_SAMPLE = 128  # per-group reservoir feeding Lloyd's
+KMEANS_MAX_D = 64  # reference KMeansUDA default dimensionality
+
+
+def register(r: Registry) -> None:
+    def reservoir_uda(arg_t):
+        return UDA(
+            name="reservoir_sample",
+            arg_types=(arg_t,),
+            out_type=S,
+            init=lambda g: ml.reservoir_init(g),
+            update=lambda st, gids, col, mask=None: ml.reservoir_update(
+                st, gids, col, mask
+            ),
+            merge=ml.reservoir_merge,
+            finalize=ml.reservoir_finalize,
+            merge_kind=MergeKind.TREE,
+            host_finalize=True,
+            doc=(
+                "Uniform sample of up to 64 values per group "
+                "(ml_ops.h:145 ReservoirSampleUDA; priority-reservoir "
+                "re-design, device-resident)."
+            ),
+        )
+
+    for t in (I, F):
+        r.register_uda(reservoir_uda(t))
+
+    # -- kmeans: host UDA over JSON embedding strings -----------------------
+    def km_init(g: int):
+        return {
+            "pts": np.zeros((g, KMEANS_SAMPLE, KMEANS_MAX_D), np.float32),
+            "pri": np.full((g, KMEANS_SAMPLE), -np.inf, np.float64),
+            "count": np.zeros((g,), np.int64),
+            "k": np.full((g,), -1, np.int64),
+            "d": np.full((g,), -1, np.int64),
+        }
+
+    def km_update(st, gids, emb_col, k_col, mask=None):
+        st = {key: np.asarray(v).copy() for key, v in st.items()}
+        embs = np.atleast_1d(np.asarray(emb_col, dtype=object))
+        gids = np.asarray(gids)
+        ks = np.asarray(k_col)
+        n = len(embs)
+        # Priorities mix the embedding CONTENT with a monotonically
+        # advancing stream index (count counts every row, like the
+        # reference's Update which increments before parsing): either
+        # alone can repeat across batches/values and bias the sample.
+        from pixie_tpu.table.column import _fnv1a64
+
+        salt = int(st["count"].sum())
+        idx_h = np.asarray(
+            hashing.hash64(jnp.arange(salt, salt + n, dtype=jnp.int64))
+        ).astype(np.uint64)
+        pri = np.empty(n, np.float64)
+        for i in range(n):
+            pri[i] = float(
+                (_fnv1a64(str(embs[i])) ^ idx_h[i]) >> np.uint64(11)
+            ) / float(1 << 53)
+        for i in range(n):
+            if mask is not None and not mask[i]:
+                continue
+            g = int(gids[i])
+            st["count"][g] += 1
+            try:
+                vec = np.asarray(json.loads(embs[i]), np.float32)
+            except (ValueError, TypeError):
+                continue
+            d = min(len(vec), KMEANS_MAX_D)
+            if st["k"][g] == -1:
+                st["k"][g] = int(ks[i]) if np.ndim(ks) else int(ks)
+                st["d"][g] = d
+            slot = int(np.argmin(st["pri"][g]))
+            if pri[i] > st["pri"][g][slot]:
+                st["pri"][g][slot] = pri[i]
+                st["pts"][g][slot] = 0.0
+                st["pts"][g][slot, :d] = vec[:d]
+        return st
+
+    def km_merge(a, b):
+        a = {key: np.asarray(v) for key, v in a.items()}
+        b = {key: np.asarray(v) for key, v in b.items()}
+        pts, pri = ml.topk_by_priority(
+            a["pts"], b["pts"], a["pri"], b["pri"], KMEANS_SAMPLE
+        )
+        return {
+            "pts": np.asarray(pts),
+            "pri": np.asarray(pri),
+            "count": a["count"] + b["count"],
+            "k": np.where(a["k"] >= 0, a["k"], b["k"]),
+            "d": np.where(a["d"] >= 0, a["d"], b["d"]),
+        }
+
+    def km_finalize(st) -> np.ndarray:
+        pts = np.asarray(st["pts"])
+        pri = np.asarray(st["pri"])
+        karr = np.asarray(st["k"])
+        darr = np.asarray(st["d"])
+        out = np.full(pts.shape[0], '{"k":0,"centers":[]}', dtype=object)
+        # One vmapped Lloyd run per distinct k (k is static in the jit):
+        # groups batch together instead of one compile + dispatch each.
+        w = np.isfinite(pri).astype(np.float32)
+        fit = jax.jit(
+            jax.vmap(ml.kmeans_fit, in_axes=(0, 0, None)),
+            static_argnums=2,
+        )
+        for k in np.unique(karr[karr > 0]):
+            sel = np.nonzero(karr == k)[0]
+            centers = np.asarray(
+                fit(jnp.asarray(pts[sel]), jnp.asarray(w[sel]), int(k))
+            )
+            for j, g in enumerate(sel):
+                d = int(darr[g])
+                out[g] = json.dumps(
+                    {
+                        "k": int(k),
+                        "centers": [
+                            [round(float(x), 6) for x in c]
+                            for c in centers[j][:, :d]
+                        ],
+                    }
+                )
+        return out
+
+    r.register_uda(
+        UDA(
+            name="kmeans",
+            arg_types=(S, I),
+            out_type=S,
+            init=km_init,
+            update=km_update,
+            merge=km_merge,
+            finalize=km_finalize,
+            merge_kind=MergeKind.TREE,
+            host_finalize=True,
+            string_args="values",
+            doc=(
+                "Streaming k-means over JSON float-array embeddings "
+                "(ml_ops.h:88 KMeansUDA): reservoir-sampled points, "
+                "Lloyd's at finalize, JSON centers out."
+            ),
+        )
+    )
+
+    # -- kmeans transform (ml_ops.h:123 KMeansUDF) -------------------------
+    def kmeans_predict(emb, model_json):
+        embs = np.atleast_1d(np.asarray(emb, dtype=object))
+        models = np.atleast_1d(np.asarray(model_json, dtype=object))
+        out = np.empty(len(embs), np.int64)
+        cache: dict = {}
+        for i in range(len(embs)):
+            m = models[i] if len(models) > 1 else models[0]
+            if m not in cache:
+                try:
+                    cache[m] = np.asarray(
+                        json.loads(m)["centers"], np.float32
+                    )
+                except (ValueError, TypeError, KeyError):
+                    cache[m] = None  # malformed model: same -1 sentinel
+            centers = cache[m]
+            if centers is None or centers.size == 0:
+                out[i] = -1
+                continue
+            try:
+                vec = np.asarray(json.loads(embs[i]), np.float32)
+            except (ValueError, TypeError):
+                out[i] = -1
+                continue
+            d = min(vec.shape[0], centers.shape[1])
+            out[i] = ml.kmeans_assign(vec[:d], centers[:, :d])
+        return out
+
+    r.register_scalar(
+        ScalarUDF(
+            "kmeans_predict",
+            (S, S),
+            I,
+            kmeans_predict,
+            Executor.HOST,
+            dict_compatible=False,
+            doc="Nearest kmeans-center index for a JSON embedding "
+            "(ml_ops.h KMeansUDF::Transform).",
+        )
+    )

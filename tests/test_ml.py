@@ -1,0 +1,144 @@
+"""ML runtime tests: reservoir sampling + k-means through PxL.
+
+Ref: src/carnot/funcs/builtins/ml_ops.h:88,145 and exec/ml/{kmeans,
+coreset} — re-designed as static-shape priority reservoirs
+(pixie_tpu/ops/ml.py)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from pixie_tpu.engine import Carnot
+from pixie_tpu.ops import ml
+from pixie_tpu.types import DataType, Relation
+
+F, I, S, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+
+def test_reservoir_uniformity_and_merge():
+    import jax.numpy as jnp
+
+    st = ml.reservoir_init(2, k=16)
+    rng = np.random.default_rng(0)
+    n = 5000
+    gids = jnp.asarray(rng.integers(0, 2, n), jnp.int32)
+    vals = jnp.asarray(np.arange(n, dtype=np.float64))
+    st = ml.reservoir_update(st, gids, vals)
+    counts = np.asarray(st["count"])
+    assert counts.sum() == n
+    live = np.isfinite(np.asarray(st["priority"]))
+    assert live.sum(axis=1).tolist() == [16, 16]
+    # Sampled values must come from the right group's rows.
+    g0_rows = set(np.arange(n)[np.asarray(gids) == 0].tolist())
+    assert all(int(v) in g0_rows for v in np.asarray(st["values"])[0])
+    # Merge keeps the global top-k priorities.
+    st2 = ml.reservoir_update(ml.reservoir_init(2, k=16), gids, vals + n)
+    merged = ml.reservoir_merge(st, st2)
+    assert np.asarray(merged["count"]).sum() == 2 * n
+    top = np.asarray(merged["priority"])
+    both = np.concatenate(
+        [np.asarray(st["priority"]), np.asarray(st2["priority"])], axis=1
+    )
+    want = -np.sort(-both, axis=1)[:, :16]
+    np.testing.assert_allclose(top, want)
+
+
+def test_kmeans_fit_separated_clusters():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    truth = np.array([[0.0, 0.0], [10.0, 0.0], [0.0, 10.0]], np.float32)
+    pts = np.concatenate(
+        [truth[i] + 0.3 * rng.standard_normal((40, 2)) for i in range(3)]
+    ).astype(np.float32)
+    centers = np.asarray(
+        ml.kmeans_fit(jnp.asarray(pts), jnp.ones(120, jnp.float32), 3)
+    )
+    # Each true center has a fitted center within 0.5.
+    for t in truth:
+        assert np.min(np.linalg.norm(centers - t, axis=1)) < 0.5
+
+
+def _ml_engine(n=600):
+    carnot = Carnot()
+    rel = Relation.of(("time_", T), ("svc", S), ("emb", S), ("v", F))
+    t = carnot.table_store.create_table("events", rel)
+    rng = np.random.default_rng(2)
+    cl = rng.integers(0, 2, n)
+    embs = np.array(
+        [
+            json.dumps(
+                [float(10 * c + rng.normal(0, 0.2)),
+                 float(-5 * c + rng.normal(0, 0.2))]
+            )
+            for c in cl
+        ],
+        dtype=object,
+    )
+    t.write_pydict({
+        "time_": np.arange(n),
+        "svc": np.array(["a" if i % 2 else "b" for i in range(n)], dtype=object),
+        "emb": embs,
+        "v": rng.normal(50, 5, n),
+    })
+    t.compact()
+    t.stop()
+    return carnot, cl
+
+
+def test_kmeans_uda_through_pxl():
+    carnot, cl = _ml_engine()
+    res = carnot.execute_query(
+        "df = px.DataFrame(table='events')\n"
+        "df.k = 2\n"
+        "m = df.agg(model=('emb', 'k', px.kmeans))\n"
+        "px.display(m, 'model')\n"
+    )
+    model = json.loads(res.table("model")["model"][0])
+    assert model["k"] == 2
+    centers = np.asarray(model["centers"])
+    assert centers.shape == (2, 2)
+    # True cluster centers ~ (0, 0) and (10, -5).
+    for t in ([0.0, 0.0], [10.0, -5.0]):
+        assert np.min(np.linalg.norm(centers - np.asarray(t), axis=1)) < 1.0
+
+
+def test_reservoir_sample_through_pxl():
+    carnot, _ = _ml_engine()
+    res = carnot.execute_query(
+        "df = px.DataFrame(table='events')\n"
+        "s = df.groupby(['svc']).agg(sample=('v', px.reservoir_sample))\n"
+        "px.display(s, 'out')\n"
+    )
+    d = res.table("out")
+    assert sorted(d["svc"]) == ["a", "b"]
+    for js in d["sample"]:
+        obj = json.loads(js)
+        assert obj["count"] == 300
+        assert len(obj["sample"]) == 64
+        assert all(30 < x < 70 for x in obj["sample"])
+
+
+def test_kmeans_predict_udf():
+    model = json.dumps(
+        {"k": 2, "centers": [[0.0, 0.0], [10.0, -5.0]]}
+    )
+    carnot, cl = _ml_engine(200)
+    res = carnot.execute_query(
+        "df = px.DataFrame(table='events')\n"
+        f"df.cluster = px.kmeans_predict(df.emb, '{model}')\n"
+        "s = df.groupby(['cluster']).agg(n=('time_', px.count))\n"
+        "px.display(s, 'out')\n"
+    )
+    d = res.table("out")
+    by = dict(zip(d["cluster"], d["n"]))
+    want = {0: int((cl[:200] == 0).sum()), 1: int((cl[:200] == 1).sum())}
+    assert by == want
