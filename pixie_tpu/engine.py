@@ -43,7 +43,7 @@ class QueryResult:
 
 
 def _splice_inline_source(
-    fragment: PlanFragment, agg_nid: int, key: str, batch
+    fragment: PlanFragment, agg_nid: int, key: str, relation
 ) -> PlanFragment:
     """Replace the device-executed prefix (agg + its ancestors) with an
     InlineSource emitting the computed aggregate, keeping the suffix."""
@@ -56,7 +56,7 @@ def _splice_inline_source(
             stack.extend(fragment.parents(p))
     new = PlanFragment(fragment.fragment_id)
     mapping: dict[int, int] = {}
-    mapping[agg_nid] = new.add(InlineSourceOp(key=key, relation=batch.relation))
+    mapping[agg_nid] = new.add(InlineSourceOp(key=key, relation=relation))
     for nid in fragment.topo_order():
         if nid == agg_nid or nid in ancestors:
             continue
@@ -166,7 +166,17 @@ class Carnot:
                         agg_nid, batch = offloaded
                         key = f"device:{frag.fragment_id}:{agg_nid}"
                         state.inline_batches[key] = [batch]
-                        frag = _splice_inline_source(frag, agg_nid, key, batch)
+                        # StateBatches (PARTIAL offload) carry no relation;
+                        # resolve the agg op's declared output instead.
+                        rel = getattr(batch, "relation", None)
+                        if rel is None:
+                            rel = frag.resolve_relations(
+                                self.registry,
+                                lambda op: self.table_store.get_relation(
+                                    op.table_name
+                                ),
+                            )[agg_nid]
+                        frag = _splice_inline_source(frag, agg_nid, key, rel)
                 graph = ExecutionGraph(frag, state)
                 graph.execute()
                 if analyze:

@@ -24,9 +24,11 @@ DEFAULT_RESERVOIR = 64
 
 
 # -- priority reservoir (device: jnp; also exact under np on host) ----------
-def reservoir_init(num_groups: int, k: int = DEFAULT_RESERVOIR):
+def reservoir_init(num_groups: int, k: int = DEFAULT_RESERVOIR, dtype=jnp.float64):
+    # dtype follows the argument column: int64 samples must round-trip
+    # exactly (timestamps/ids exceed f64's 2^53 integer range).
     return {
-        "values": jnp.zeros((num_groups, k), jnp.float64),
+        "values": jnp.zeros((num_groups, k), dtype),
         "priority": jnp.full((num_groups, k), -jnp.inf, jnp.float64),
         "count": jnp.zeros((num_groups,), jnp.int64),
     }
@@ -45,7 +47,7 @@ def _priorities(values, count_salt):
 def reservoir_update(state, gids, values, mask=None):
     """Fold a batch into per-group top-K-by-priority reservoirs."""
     num_groups, k = state["values"].shape
-    v = values.astype(jnp.float64)
+    v = values.astype(state["values"].dtype)
     n = v.shape[0]
     if mask is None:
         mask = jnp.ones((n,), jnp.bool_)
@@ -69,10 +71,8 @@ def reservoir_update(state, gids, values, mask=None):
     cand = {
         "values": cand_v[:-1].reshape(num_groups, k),
         "priority": cand_p[:-1].reshape(num_groups, k),
-        "count": jnp.bincount(
-            jnp.where(mask, gids.astype(jnp.int32), num_groups),
-            length=num_groups + 1,
-        )[:-1].astype(jnp.int64),
+        # counts already tallies the same masked multiset (sorted copy).
+        "count": counts[:-1].astype(jnp.int64),
     }
     return reservoir_merge(state, cand)
 
@@ -109,13 +109,17 @@ def reservoir_finalize(state) -> np.ndarray:
     vals = np.asarray(state["values"])
     pris = np.asarray(state["priority"])
     counts = np.asarray(state["count"])
+    import json
+
+    is_int = np.issubdtype(vals.dtype, np.integer)
     out = np.empty(vals.shape[0], dtype=object)
     for gid in range(vals.shape[0]):
         live = vals[gid][np.isfinite(pris[gid])]
-        live = live[np.isfinite(live)]  # NaN/inf render invalid JSON
-        out[gid] = (
-            '{"count":%d,"sample":[%s]}'
-            % (int(counts[gid]), ",".join(f"{x:.6g}" for x in live))
+        if not is_int:
+            live = live[np.isfinite(live)]  # NaN/inf render invalid JSON
+        sample = [int(x) if is_int else float(x) for x in live]
+        out[gid] = json.dumps(
+            {"count": int(counts[gid]), "sample": sample}
         )
     return out
 

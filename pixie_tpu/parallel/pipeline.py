@@ -110,7 +110,13 @@ def match_fragment(fragment: PlanFragment, relations) -> Optional[_Match]:
     agg_nid = None
     for nid in fragment.topo_order():
         op = fragment.node(nid)
-        if isinstance(op, AggOp) and op.stage == AggStage.FULL and not op.windowed:
+        # FULL aggs finalize on device; PARTIAL aggs (the PEM side of a
+        # distributed split) ship raw states to the merge stage instead.
+        if (
+            isinstance(op, AggOp)
+            and op.stage in (AggStage.FULL, AggStage.PARTIAL)
+            and not op.windowed
+        ):
             agg_nid = nid
             break
     if agg_nid is None:
@@ -333,9 +339,12 @@ class MeshExecutor:
                     _STAGED_EVICTIONS.inc(reason="lru")
         aux = self._build_aux(evaluator, m, key_plan, table, specs)
         merged = self._run_program(m, specs, evaluator, key_plan, staged, aux)
-        batch = self._finalize(
-            m, specs, key_plan, staged, merged, registry, table
-        )
+        if m.agg_op.stage == AggStage.PARTIAL:
+            batch = self._partial_state_batch(m, specs, key_plan, merged, table)
+        else:
+            batch = self._finalize(
+                m, specs, key_plan, staged, merged, registry, table
+            )
         return m.agg_nid, batch
 
     # -- compile helpers ----------------------------------------------------
@@ -550,7 +559,7 @@ class MeshExecutor:
         return aux
 
     # -- the program --------------------------------------------------------
-    def _finalize_modes(self, specs, capacity):
+    def _finalize_modes(self, specs, capacity, force_state: bool = False):
         """Per-spec device-finalization mode + packed-output leaf templates.
 
         Modes: 'devfin' (UDA supplies a traceable device_finalize — the
@@ -562,6 +571,7 @@ class MeshExecutor:
         cache_key = (
             tuple((uda.name, uda.arg_types) for _, _, uda in specs),
             capacity,
+            force_state,
         )
         cached = self._finmode_cache.get(cache_key)
         if cached is not None:
@@ -570,7 +580,10 @@ class MeshExecutor:
         templates = []
         for _, _, uda in specs:
             state_aval = jax.eval_shape(lambda u=uda: u.init(capacity))
-            if uda.device_finalize is not None:
+            if force_state:  # PARTIAL stage: raw states cross the bridge
+                mode = "state"
+                out_aval = state_aval
+            elif uda.device_finalize is not None:
                 mode = "devfin"
                 out_aval = jax.eval_shape(uda.device_finalize, state_aval)
             else:
@@ -614,9 +627,12 @@ class MeshExecutor:
     def _signature(self, m, specs, key_plan, staged, aux_vals, capacity) -> str:
         """Structural identity of the compiled program: expressions, UDA
         set, key mode, block geometry, capacity, aux shapes."""
-        modes, _ = self._finalize_modes(specs, capacity)
+        modes, _ = self._finalize_modes(
+            specs, capacity, m.agg_op.stage == AggStage.PARTIAL
+        )
         parts = [
             "finmodes:" + ",".join(modes),
+            f"stage:{m.agg_op.stage.value}",
             ",".join(f"{n}:{a.shape}:{a.dtype}" for n, a in
                      sorted(staged.blocks.items())),
             f"mask:{staged.mask.shape}",
@@ -644,7 +660,9 @@ class MeshExecutor:
         self, m, specs, evaluator, key_plan, staged, aux_key_order, capacity
     ):
         axis = self.mesh.axis_names[0]
-        fin_modes, _ = self._finalize_modes(specs, capacity)
+        fin_modes, _ = self._finalize_modes(
+            specs, capacity, m.agg_op.stage == AggStage.PARTIAL
+        )
         col_names = sorted(staged.blocks)
         has_host_gids = key_plan.host_gids is not None
         has_key_lut = isinstance(key_plan.device_expr, tuple)
@@ -853,7 +871,9 @@ class MeshExecutor:
             program = self._build_program(
                 m, specs, evaluator, key_plan, staged, aux_key_order, capacity
             )
-            _, templates = self._finalize_modes(specs, capacity)
+            _, templates = self._finalize_modes(
+                specs, capacity, m.agg_op.stage == AggStage.PARTIAL
+            )
             self._program_cache[sig] = (program, len(aux_key_order), templates)
             _PROGRAMS.set(len(self._program_cache))
         program, _, templates = self._program_cache[sig]
@@ -891,6 +911,47 @@ class MeshExecutor:
         return values, presence
 
     # -- finalize -----------------------------------------------------------
+    def _partial_state_batch(self, m, specs, key_plan, outputs_and_presence, table):
+        """PARTIAL stage: wrap the device-computed states as the StateBatch
+        the downstream MERGE agg consumes (ref: the PEM side of
+        partial_op_mgr.h:94 serializing partial aggregates). Only observed
+        groups ship — a dictionary-keyed plan may carry unobserved slots."""
+        from pixie_tpu.exec.agg_node import StateBatch
+
+        values, presence = outputs_and_presence
+        n = max(key_plan.num_groups, 1) if m.agg_op.groups else 1
+        if m.agg_op.groups:
+            keep = np.asarray(presence[:n]) > 0
+        else:
+            keep = np.ones(1, dtype=bool)
+        idx = np.nonzero(keep)[0]
+        key_columns = [
+            col.take(idx) if isinstance(col, DictColumn)
+            else np.asarray(col)[idx]
+            for col in key_plan.key_columns
+        ]
+        states = {}
+        arg_dicts = {}
+        for (out_name, arg_e, uda), st in zip(specs, values):
+            states[out_name] = jax.tree.map(
+                lambda a: np.asarray(a)[:n][keep], st
+            )
+            if uda.string_state and isinstance(arg_e, ColumnRef):
+                d = table.dictionaries.get(arg_e.name)
+                if d is not None:
+                    # Snapshot: device states hold codes into the table's
+                    # dictionary; the merge stage translates through this.
+                    arg_dicts[out_name] = StringDictionary(list(d.values()))
+        return StateBatch(
+            key_columns=key_columns,
+            states=states,
+            num_groups=int(keep.sum()),
+            group_names=m.agg_op.groups,
+            eow=True,
+            eos=True,
+            arg_dicts=arg_dicts,
+        )
+
     def _finalize(
         self, m, specs, key_plan, staged, outputs_and_presence, registry, table
     ):

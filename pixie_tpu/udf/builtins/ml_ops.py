@@ -32,11 +32,12 @@ KMEANS_MAX_D = 64  # reference KMeansUDA default dimensionality
 
 def register(r: Registry) -> None:
     def reservoir_uda(arg_t):
+        dtype = jnp.int64 if arg_t == I else jnp.float64
         return UDA(
             name="reservoir_sample",
             arg_types=(arg_t,),
             out_type=S,
-            init=lambda g: ml.reservoir_init(g),
+            init=lambda g: ml.reservoir_init(g, dtype=dtype),
             update=lambda st, gids, col, mask=None: ml.reservoir_update(
                 st, gids, col, mask
             ),
@@ -65,7 +66,11 @@ def register(r: Registry) -> None:
         }
 
     def km_update(st, gids, emb_col, k_col, mask=None):
-        st = {key: np.asarray(v).copy() for key, v in st.items()}
+        # Host-only UDA: the AggNode rebinds its state to the return value
+        # and nothing else aliases it, so in-place mutation is safe — a
+        # defensive deep copy of [G, 128, 64] per (possibly 1-row) batch
+        # would dominate streaming updates.
+        st = {key: np.asarray(v) for key, v in st.items()}
         embs = np.atleast_1d(np.asarray(emb_col, dtype=object))
         gids = np.asarray(gids)
         ks = np.asarray(k_col)
@@ -195,6 +200,9 @@ def register(r: Registry) -> None:
                 out[i] = -1
                 continue
             d = min(vec.shape[0], centers.shape[1])
+            if d == 0:  # '[]' parses but carries no information
+                out[i] = -1
+                continue
             out[i] = ml.kmeans_assign(vec[:d], centers[:, :d])
         return out
 

@@ -343,3 +343,94 @@ def test_mesh_pass_plan_budget():
         flags.reset("device_group_state_budget_mb")
     cap2, passes2 = ex._pass_plan([("q", None, uda)], 100)
     assert passes2 == 1 and cap2 >= 100
+
+
+def test_mesh_partial_stage_offload_in_cluster(mesh):
+    """Distributed PEM fragments (PARTIAL aggs) run on the device mesh and
+    ship StateBatches to the Kelvin merge — the clustered path uses the
+    TPU, not just single-engine queries (ref: partial_op_mgr.h:94)."""
+    import json as _json
+    import time as _time
+
+    from pixie_tpu.exec.router import BridgeRouter
+    from pixie_tpu.table.table_store import TableStore
+    from pixie_tpu.utils import metrics_registry
+    from pixie_tpu.vizier.agent import Agent
+    from pixie_tpu.vizier.broker import QueryBroker
+    from pixie_tpu.vizier.bus import MessageBus
+
+    rel = Relation.of(("time_", T), ("svc", S), ("latency", F))
+    rng = np.random.default_rng(9)
+    shards = []
+    stores = []
+    for i in range(2):
+        n = 3000
+        data = {
+            "time_": np.arange(n) + i,
+            "svc": rng.choice(["a", "b", "c"], n).astype(object),
+            "latency": rng.exponential(30.0, n),
+        }
+        shards.append(data)
+        store = TableStore()
+        t = store.create_table("events", rel)
+        t.write_pydict(data)
+        t.compact()
+        t.stop()
+        stores.append(store)
+
+    bus, router = MessageBus(), BridgeRouter()
+    pems = [
+        Agent(
+            f"pem{i}",
+            bus,
+            router,
+            table_store=stores[i],
+            device_executor=MeshExecutor(mesh=mesh, block_rows=1024),
+        )
+        for i in range(2)
+    ]
+    kelvin = Agent("kelvin", bus, router, is_kelvin=True)
+    for a in pems + [kelvin]:
+        a.start()
+    broker = QueryBroker(bus, router, table_relations={"events": rel})
+    try:
+        deadline = _time.monotonic() + 10
+        while (
+            _time.monotonic() < deadline
+            and len(broker.tracker.distributed_state().agents) < 3
+        ):
+            _time.sleep(0.05)
+        hits_before = metrics_registry().counter(
+            "device_offload_total"
+        ).value()
+        res = broker.execute_script(
+            "df = px.DataFrame(table='events')\n"
+            "s = df.groupby(['svc']).agg(n=('time_', px.count),\n"
+            "    total=('latency', px.sum), q=('latency', px.quantiles))\n"
+            "px.display(s, 'out')\n",
+            timeout_s=30,
+        )
+        hits = metrics_registry().counter("device_offload_total").value()
+        assert hits - hits_before >= 2, "PEM partial fragments not offloaded"
+        from pixie_tpu.table.row_batch import RowBatch
+
+        d = RowBatch.concat(
+            [b for b in res.tables["out"] if b.num_rows]
+        ).to_pydict()
+        svc = np.concatenate([s["svc"] for s in shards])
+        lat = np.concatenate([s["latency"] for s in shards])
+        by = dict(zip(d["svc"], zip(d["n"], d["total"], d["q"])))
+        assert sorted(by) == ["a", "b", "c"]
+        for name in "abc":
+            sel = svc == name
+            n_got, total_got, q_got = by[name]
+            assert n_got == sel.sum()
+            assert total_got == pytest.approx(lat[sel].sum(), rel=1e-9)
+            p50 = _json.loads(q_got)["p50"]
+            assert p50 == pytest.approx(
+                float(np.quantile(lat[sel], 0.5)), rel=0.05
+            )
+    finally:
+        broker.stop()
+        for a in pems + [kelvin]:
+            a.stop()
