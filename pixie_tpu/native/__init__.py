@@ -1,0 +1,12 @@
+"""Native host runtime loader.
+
+Compiles host_runtime.cc with the system toolchain on first import
+(cached as a .so next to the source, keyed by a source hash) and exposes
+it via ctypes. Importers must tolerate ImportError: every native entry
+point has a pure-numpy fallback, so a missing compiler only costs speed
+(the reference hard-requires its C++ runtime; ours degrades).
+"""
+
+from pixie_tpu.native import host_runtime
+
+__all__ = ["host_runtime"]

@@ -1,0 +1,103 @@
+// Native host runtime: the CPU-side hot loops that the reference
+// implements in C++ (table_store write-side encoding, row hashing —
+// src/table_store/, src/carnot/exec/row_tuple.h). The TPU build keeps
+// JAX/XLA for device compute; this library serves the ingest path, where
+// dictionary-encoding telemetry strings per batch dominates table writes.
+//
+// C ABI only (loaded via ctypes — no pybind11 in the image). All buffers
+// are caller-allocated numpy arrays.
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kFnvOffset = 0xCBF29CE484222325ULL;
+constexpr uint64_t kFnvPrime = 0x100000001B3ULL;
+
+inline uint64_t fnv1a(const uint8_t* p, int64_t len) {
+  uint64_t h = kFnvOffset;
+  for (int64_t i = 0; i < len; ++i) {
+    h = (h ^ p[i]) * kFnvPrime;
+  }
+  return h;
+}
+
+inline bool row_eq(const uint8_t* a, const uint8_t* b, int64_t itemsize) {
+  for (int64_t i = 0; i < itemsize; ++i) {
+    if (a[i] != b[i]) return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// FNV-1a over variable-length utf-8 slices (bit-identical to the Python
+// fallback pixie_tpu/table/column.py:_fnv1a64). offsets has n+1 entries.
+void fnv1a64_batch(const uint8_t* buf, const int64_t* offsets, int64_t n,
+                   uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = fnv1a(buf + offsets[i], offsets[i + 1] - offsets[i]);
+  }
+}
+
+// Dictionary-encode n fixed-width rows against an existing dictionary of
+// dict_n fixed-width rows (same itemsize; numpy "U" layout — equality on
+// raw bytes is equality on strings since widths match). Existing values
+// keep their codes; unseen values get dict_n, dict_n+1, ... in
+// first-occurrence order. out_codes[n]; out_new_rows receives the data-row
+// index of each new value's first occurrence. Returns the new-value count.
+int64_t dict_encode_fixed(const uint8_t* data, int64_t n, int64_t itemsize,
+                          const uint8_t* dict_data, int64_t dict_n,
+                          int32_t* out_codes, int64_t* out_new_rows) {
+  // Open-addressed table of codes, sized for dict + worst-case all-new.
+  int64_t cap = 16;
+  while (cap < (n + dict_n + 1) * 2) cap <<= 1;
+  const uint64_t mask = static_cast<uint64_t>(cap - 1);
+  std::vector<int32_t> slots(static_cast<size_t>(cap), -1);
+
+  auto row_of = [&](int32_t code) -> const uint8_t* {
+    return code < dict_n
+               ? dict_data + static_cast<int64_t>(code) * itemsize
+               : data + out_new_rows[code - dict_n] * itemsize;
+  };
+
+  // Seed with the existing dictionary (codes 0..dict_n-1).
+  for (int64_t d = 0; d < dict_n; ++d) {
+    const uint8_t* p = dict_data + d * itemsize;
+    uint64_t h = fnv1a(p, itemsize) & mask;
+    while (slots[h] >= 0) {
+      if (row_eq(row_of(slots[h]), p, itemsize)) break;  // dup in dict
+      h = (h + 1) & mask;
+    }
+    if (slots[h] < 0) slots[h] = static_cast<int32_t>(d);
+  }
+
+  int64_t n_new = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* p = data + i * itemsize;
+    uint64_t h = fnv1a(p, itemsize) & mask;
+    int32_t code = -1;
+    while (true) {
+      int32_t cur = slots[h];
+      if (cur < 0) {
+        code = static_cast<int32_t>(dict_n + n_new);
+        out_new_rows[n_new++] = i;
+        slots[h] = code;
+        break;
+      }
+      if (row_eq(row_of(cur), p, itemsize)) {
+        code = cur;
+        break;
+      }
+      h = (h + 1) & mask;
+    }
+    out_codes[i] = code;
+  }
+  return n_new;
+}
+
+}  // extern "C"

@@ -61,6 +61,27 @@ class StringDictionary:
     def encode(self, values) -> np.ndarray:
         """Vectorized encode of an array/sequence of strings -> int32 codes."""
         arr = np.asarray(values, dtype=object)
+        if _native is not None and len(arr) >= 1024:
+            # numpy's fixed-width U layout cannot represent trailing NULs;
+            # such values (rare in telemetry) take the object-array path so
+            # encode semantics never depend on batch size.
+            u = arr.astype("U")
+            total = int(np.fromiter(map(len, arr), np.int64, len(arr)).sum())
+            u_ok = total == int(np.char.str_len(u).sum())
+            if u_ok:
+                # Native O(n) hash-map pass (the reference's write-side C++
+                # analogue); appends unseen values under the lock so codes
+                # stay dense + stable.
+                with self._lock:
+                    codes, new_values = _native.encode_with_dict(
+                        arr, self._values, u=u
+                    )
+                    for v in new_values:
+                        # Append BEFORE indexing: lock-free readers must
+                        # never see a code whose value isn't there yet.
+                        self._values.append(v)
+                        self._index[v] = len(self._values) - 1
+                return codes
         # Encode the unique values only, then broadcast back: telemetry string
         # columns (service/pod names, methods, paths) are extremely low-
         # cardinality relative to row count.
@@ -95,10 +116,14 @@ class StringDictionary:
             with self._lock:
                 m = len(self._hashes)
                 if m < n:
-                    new = [_fnv1a64(self._values[i]) for i in range(m, n)]
-                    self._hashes = np.concatenate(
-                        [self._hashes, np.array(new, dtype=np.uint64)]
-                    )
+                    fresh = self._values[m:n]
+                    if _native is not None:
+                        new = _native.fnv1a64_batch(fresh)
+                    else:
+                        new = np.array(
+                            [_fnv1a64(v) for v in fresh], dtype=np.uint64
+                        )
+                    self._hashes = np.concatenate([self._hashes, new])
         return self._hashes
 
 
