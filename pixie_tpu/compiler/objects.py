@@ -550,12 +550,23 @@ class PxModule:
     # -- function namespace -------------------------------------------------
     def __getattr__(self, name: str):
         # Fall through to registry functions: px.mean, px.quantiles,
-        # px.upid_to_service_name, px.bin, ... Underscore-prefixed names are
-        # allowed only for the _exec_* agent-introspection UDFs
-        # (px._exec_hostname / px._exec_host_num_cpus in perf scripts).
-        if name.startswith("_") and not name.startswith("_exec_"):
-            raise AttributeError(name)
+        # px.upid_to_service_name, px.bin, ... Underscore-prefixed names
+        # resolve only when registered (the reference ships _exec_*,
+        # _predict_request_path_cluster, etc.); dunders never do — Python
+        # protocol probes (__deepcopy__ and friends) must raise cleanly.
         reg = self.__dict__.get("_registry")
+        if name.startswith("__") or (
+            name.startswith("_")
+            and not (
+                reg is not None
+                and (
+                    reg.has_scalar(name)
+                    or reg.has_uda(name)
+                    or reg.lookup_udtf(name) is not None
+                )
+            )
+        ):
+            raise AttributeError(name)
         if reg is not None and reg.lookup_udtf(name) is not None:
             # UDTF call produces a DataFrame (ref: the compiler lowers
             # px.GetAgentStatus() to a UDTFSourceOperator).

@@ -428,8 +428,10 @@ class AggNode(ExecNode):
 
                 out_cols.append(np.asarray(col, dtype=host_dtype(schema.data_type)))
         for spec in self._specs:
+            # np, not jnp: object-dtype leaves (string-bearing host UDAs
+            # like _build_request_path_clusters) are not jax arrays.
             state = jax.tree.map(
-                lambda a: jax.numpy.asarray(a)[:n], self._states[spec.out_name]
+                lambda a: np.asarray(a)[:n], self._states[spec.out_name]
             )
             out = spec.uda.finalize(state)
             schema = rel.col(spec.out_name)

@@ -51,7 +51,16 @@ from pixie_tpu.plan.plan import PlanFragment
 
 CONSECUTIVE_GENERATE_CALLS_PER_SOURCE = 8  # ref: exec_graph.cc source fairness
 DEFAULT_YIELD_S = 0.001
-DEFAULT_TIMEOUT_S = 30.0
+
+from pixie_tpu.utils import define_flag, flags as _flags  # noqa: E402
+
+define_flag(
+    "exec_source_stall_s",
+    30.0,
+    help_="Seconds a fragment waits on stalled sources (bridge data / "
+    "table activity) before failing the query (ref: exec_graph.cc "
+    "source health checks).",
+)
 
 _NODE_TYPES = {
     MemorySourceOp: MemorySourceNode,
@@ -142,9 +151,13 @@ class ExecutionGraph:
     # -- execute (ref: ExecutionGraph::Execute, exec_graph.cc:295) ----------
     def execute(
         self,
-        timeout_s: float = DEFAULT_TIMEOUT_S,
+        timeout_s: Optional[float] = None,
         yield_fn: Optional[Callable[[], None]] = None,
     ) -> None:
+        if timeout_s is None:
+            # Read at call time so flags.set()/env changes after import
+            # still apply.
+            timeout_s = _flags.exec_source_stall_s
         import contextlib
 
         import jax

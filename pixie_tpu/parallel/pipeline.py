@@ -304,6 +304,21 @@ class MeshExecutor:
             key_plan.num_groups,
         )
         staged = self._staged_cache.get(cache_key) if cacheable else None
+        if staged is None and cacheable:
+            # Superset reuse: an entry staged for a wider column set of the
+            # SAME table version/window/key plan serves this query directly
+            # (the program reads the columns it needs) — re-staging
+            # gigabytes for a subset risks doubling HBM residency.
+            for k, v in self._staged_cache.items():
+                if (
+                    k[0] == cache_key[0]
+                    and k[1] == cache_key[1]
+                    and set(k[2]) >= set(cache_key[2])
+                    and k[3:] == cache_key[3:]
+                ):
+                    cache_key = k
+                    staged = v
+                    break
         if staged is not None:
             self._staged_cache.move_to_end(cache_key)
         else:
@@ -315,16 +330,20 @@ class MeshExecutor:
             )
             if key_plan.host_gids is not None and len(key_plan.host_gids) != n:
                 return None  # table moved under us; fall back
-            staged = stage_columns(
-                self.mesh,
-                cols,
-                n,
-                gids=key_plan.host_gids,
-                num_groups=max(key_plan.num_groups, 1),
-                key_columns=key_plan.key_columns,
-                dictionaries=table.dictionaries,
-                block_rows=self.block_rows,
-            )
+            try:
+                staged = self._stage(cols, n, key_plan, table)
+            except Exception:
+                # Likely device OOM: drop every cached staging and retry
+                # once — better than falling back to the host engine for a
+                # gigarow table.
+                self._staged_cache.clear()
+                _STAGED_EVICTIONS.inc(reason="oom")
+                staged = None
+            if staged is None:
+                # Retry OUTSIDE the except block: the in-flight exception's
+                # traceback pins the failed attempt's partially allocated
+                # device buffers until the handler exits.
+                staged = self._stage(cols, n, key_plan, table)
             if cacheable:
                 # Evict stale versions of this table, then LRU-cap.
                 for k in [
@@ -346,6 +365,18 @@ class MeshExecutor:
                 m, specs, key_plan, staged, merged, registry, table
             )
         return m.agg_nid, batch
+
+    def _stage(self, cols, n, key_plan, table):
+        return stage_columns(
+            self.mesh,
+            cols,
+            n,
+            gids=key_plan.host_gids,
+            num_groups=max(key_plan.num_groups, 1),
+            key_columns=key_plan.key_columns,
+            dictionaries=table.dictionaries,
+            block_rows=self.block_rows,
+        )
 
     # -- compile helpers ----------------------------------------------------
     def _make_evaluator(self, m: _Match, registry, func_ctx):

@@ -30,13 +30,17 @@ class StringDictionary:
     only ever grows, and lookups take the lock only on miss.
     """
 
-    __slots__ = ("_values", "_index", "_lock", "_hashes")
+    __slots__ = ("_values", "_index", "_lock", "_hashes", "_has_nul")
 
     def __init__(self, values: list[str] | None = None):
         self._values: list[str] = list(values) if values else []
         self._index: dict[str, int] = {v: i for i, v in enumerate(self._values)}
         self._lock = threading.Lock()
         self._hashes: np.ndarray = np.empty(0, dtype=np.uint64)
+        # numpy's fixed-width U layout drops trailing NULs; once any such
+        # value enters the dictionary, the native fast path would alias its
+        # prefix — route around it permanently.
+        self._has_nul = any("\x00" in v for v in self._values)
 
     def __len__(self) -> int:
         return len(self._values)
@@ -50,6 +54,8 @@ class StringDictionary:
             code = self._index.get(value)
             if code is None:
                 code = len(self._values)
+                if "\x00" in value:
+                    self._has_nul = True
                 self._values.append(value)
                 self._index[value] = code
             return code
@@ -61,7 +67,7 @@ class StringDictionary:
     def encode(self, values) -> np.ndarray:
         """Vectorized encode of an array/sequence of strings -> int32 codes."""
         arr = np.asarray(values, dtype=object)
-        if _native is not None and len(arr) >= 1024:
+        if _native is not None and len(arr) >= 1024 and not self._has_nul:
             # numpy's fixed-width U layout cannot represent trailing NULs;
             # such values (rare in telemetry) take the object-array path so
             # encode semantics never depend on batch size.

@@ -13,6 +13,7 @@ def register_all(registry: Registry) -> None:
         md_udtfs,
         metadata_ops,
         ml_ops,
+        security_ops,
         sketch_ops,
         string_ops,
         time_ops,
@@ -28,3 +29,4 @@ def register_all(registry: Registry) -> None:
     metadata_ops.register(registry)
     md_udtfs.register(registry)
     ml_ops.register(registry)
+    security_ops.register(registry)

@@ -1,0 +1,291 @@
+"""PII redaction, SQL normalization, URI, request-path clustering, CIDR.
+
+Ref: src/carnot/funcs/builtins/pii_ops.{h,cc} (redact_pii_best_effort —
+'<REDACTED_$TYPE>' substitution for IPs, emails, MACs, CC numbers, IMEI,
+SSNs), sql_ops.{h,cc} (normalize_mysql / normalize_pgsql — literals out,
+params captured, JSON result), uri_ops.h (uri_parse / uri_recompose),
+request_path_ops.{h,cc}:230 (_build_request_path_clusters /
+_predict_request_path_cluster / _match_endpoint), net/net_ops.cc
+(cidrs_contain_ip). All host UDFs: string content work stays off the
+device (scalar_udfs_run_on_executor precedent)."""
+
+from __future__ import annotations
+
+import ipaddress
+import json
+import re
+
+import numpy as np
+
+from pixie_tpu.types import DataType
+from pixie_tpu.udf.registry import Registry
+from pixie_tpu.udf.udf import UDA, Executor, MergeKind, ScalarUDF
+
+S = DataType.STRING
+I = DataType.INT64
+B = DataType.BOOLEAN
+
+# Order matters: longer/stricter patterns first so e.g. IPv4 inside an
+# IPv6-mapped literal or an email's host part redacts coherently.
+_PII_PATTERNS = [
+    ("EMAIL", re.compile(r"[A-Za-z0-9._%+-]+@[A-Za-z0-9.-]+\.[A-Za-z]{2,}")),
+    (
+        # Before IPv6: six colon-separated 2-hex groups parse as both.
+        "MAC_ADDR",
+        re.compile(r"\b(?:[0-9A-Fa-f]{2}[:-]){5}[0-9A-Fa-f]{2}\b"),
+    ),
+    (
+        # Full 8-group form or a compressed '::' form only — a looser
+        # colon-hex run would wipe hh:mm:ss timestamps in log text.
+        "IPv6",
+        re.compile(
+            r"\b(?:(?:[0-9A-Fa-f]{1,4}:){7}[0-9A-Fa-f]{1,4}"
+            r"|(?:[0-9A-Fa-f]{1,4}:)+:(?:[0-9A-Fa-f]{1,4}(?::[0-9A-Fa-f]{1,4})*)?)\b"
+        ),
+    ),
+    (
+        "IPv4",
+        re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b"),
+    ),
+    # IMEI before CC_NUMBER: a dashed IMEI is 15 digits and would
+    # otherwise always be swallowed by the credit-card pattern.
+    ("IMEI", re.compile(r"\b\d{2}-\d{6}-\d{6}-\d\b")),
+    (
+        "CC_NUMBER",
+        re.compile(r"\b(?:\d[ -]?){13,19}\b"),
+    ),
+    ("SSN", re.compile(r"\b\d{3}-\d{2}-\d{4}\b")),
+]
+
+
+def _redact_one(s: str) -> str:
+    for tag, pat in _PII_PATTERNS:
+        s = pat.sub(f"<REDACTED_{tag}>", s)
+    return s
+
+
+# SQL literal patterns shared by both dialects; ONE left-to-right pass so
+# params stay in query order (two passes would list all strings before any
+# number regardless of position).
+_SQL_LITERAL = re.compile(
+    r"'(?:[^'\\]|\\.|'')*'"
+    r"|(?<![\w$])[-+]?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?\b"
+)
+
+
+def _normalize_sql(query: str, placeholder) -> str:
+    """Replace literals with placeholders; JSON result mirrors the
+    reference's {query, params, error} shape."""
+    params: list[str] = []
+
+    def repl(m):
+        params.append(m.group(0))
+        return placeholder(len(params))
+
+    try:
+        out = _SQL_LITERAL.sub(repl, query)
+        return json.dumps({"query": out, "params": params, "error": ""})
+    except Exception as e:  # pragma: no cover - defensive
+        return json.dumps({"query": "", "params": [], "error": str(e)})
+
+
+_PATH_ID_SEGMENT = re.compile(
+    r"^(?:\d+|0[xX][0-9a-fA-F]+|[0-9a-fA-F]{8,}|"
+    r"[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-"
+    r"[0-9a-fA-F]{12})$"
+)
+
+
+def _path_template(path: str) -> str:
+    """Template a request path: id-like segments (numbers, hex, uuids)
+    become '*' (the reference clusters paths by similarity; id-segment
+    generalization is the shape its clusters converge to)."""
+    base = path.split("?", 1)[0]
+    segs = base.split("/")
+    out = [
+        "*" if _PATH_ID_SEGMENT.match(seg) else seg for seg in segs
+    ]
+    return "/".join(out)
+
+
+def _lift(fn, out_dtype=object):
+    def wrapper(*cols):
+        arrs = [np.atleast_1d(np.asarray(c, dtype=object)) for c in cols]
+        n = max(len(a) for a in arrs)
+        out = np.empty(n, dtype=out_dtype)
+        for i in range(n):
+            out[i] = fn(*(a[i] if len(a) > 1 else a[0] for a in arrs))
+        return out
+
+    return wrapper
+
+
+def register(r: Registry) -> None:
+    def reg(name, args, out, fn, out_dtype=object, doc=""):
+        r.register_scalar(
+            ScalarUDF(
+                name, args, out, _lift(fn, out_dtype), Executor.HOST,
+                dict_compatible=True, doc=doc,
+            )
+        )
+
+    reg(
+        "redact_pii_best_effort", (S,), S, _redact_one,
+        doc="Best-effort PII redaction: '<REDACTED_$TYPE>' for emails, "
+        "IPs, MACs, CC numbers, IMEI, SSNs (pii_ops.h RedactPIIUDF).",
+    )
+    reg(
+        "normalize_mysql", (S,), S,
+        lambda q: _normalize_sql(q, lambda i: "?"),
+        doc="MySQL query normalization: literals -> '?', params captured "
+        "(sql_ops.h NormalizeMySQLUDF).",
+    )
+    reg(
+        "normalize_pgsql", (S,), S,
+        lambda q: _normalize_sql(q, lambda i: f"${i}"),
+        doc="PostgreSQL query normalization: literals -> $N "
+        "(sql_ops.h NormalizePostgresSQLUDF).",
+    )
+
+    def uri_parse(uri: str) -> str:
+        from urllib.parse import urlsplit
+
+        try:
+            p = urlsplit(uri)
+        except ValueError:
+            return "Failed to parse URI"
+        out = {}
+        if p.scheme:
+            out["scheme"] = p.scheme
+        if p.username:
+            out["userInfo"] = p.username + (
+                f":{p.password}" if p.password else ""
+            )
+        if p.hostname:
+            out["host"] = p.hostname
+        try:
+            if p.port is not None:
+                out["port"] = str(p.port)
+        except ValueError:
+            pass
+        if p.path:
+            out["path"] = p.path
+        if p.query:
+            out["query"] = p.query
+        if p.fragment:
+            out["fragment"] = p.fragment
+        return json.dumps(out)
+
+    reg("uri_parse", (S,), S, uri_parse,
+        doc="URI -> JSON {scheme,userInfo,host,port,path,query,fragment} "
+        "(uri_ops.h URIParseUDF).")
+
+    def uri_recompose(scheme, user_info, host, port, path, query, fragment):
+        try:
+            port = int(port)
+        except (TypeError, ValueError):
+            return "Failed to recompose URI"
+        if port < 0:
+            return "Failed to recompose URI"
+        out = ""
+        if scheme:
+            out += f"{scheme}://"
+        if user_info:
+            out += f"{user_info}@"
+        out += str(host)
+        if port:
+            out += f":{port}"
+        out += str(path)
+        if query:
+            out += f"?{query}"
+        if fragment:
+            out += f"#{fragment}"
+        return out
+
+    r.register_scalar(
+        ScalarUDF(
+            "uri_recompose", (S, S, S, I, S, S, S), S,
+            _lift(uri_recompose), Executor.HOST, dict_compatible=False,
+            doc="Recompose a URI from parts (uri_ops.h URIRecomposeUDF).",
+        )
+    )
+
+    def cidrs_contain_ip(cidrs_json: str, ip: str) -> bool:
+        try:
+            cidrs = json.loads(cidrs_json)
+            addr = ipaddress.ip_address(ip)
+        except ValueError:
+            return False
+        for c in cidrs if isinstance(cidrs, list) else [cidrs]:
+            try:
+                if addr in ipaddress.ip_network(c, strict=False):
+                    return True
+            except ValueError:
+                continue
+        return False
+
+    reg("cidrs_contain_ip", (S, S), B, cidrs_contain_ip, out_dtype=bool,
+        doc="True if the IP is inside any CIDR of the JSON list "
+        "(net/net_ops.cc CIDRsContainIPUDF).")
+
+    reg(
+        "_predict_request_path_cluster", (S,), S, _path_template,
+        doc="Cluster template for a request path: id-like segments -> '*' "
+        "(request_path_ops.h RequestPathClusteringPredictUDF).",
+    )
+
+    def match_endpoint(path: str, template: str) -> bool:
+        return _path_template(path) == template or path == template
+
+    reg("_match_endpoint", (S, S), B, match_endpoint, out_dtype=bool,
+        doc="Does the path belong to the endpoint template? "
+        "(request_path_ops.h RequestPathEndpointMatcherUDF).")
+
+    # -- clustering UDA (request_path_ops.h:230) ---------------------------
+    def rpc_init(g: int):
+        return {"templates": np.full((g,), "[]", dtype=object)}
+
+    def rpc_update(st, gids, paths, mask=None):
+        st = {"templates": np.asarray(st["templates"], dtype=object).copy()}
+        paths = np.atleast_1d(np.asarray(paths, dtype=object))
+        gids = np.asarray(gids)
+        per_group: dict[int, set] = {}
+        for i in range(len(paths)):
+            if mask is not None and not mask[i]:
+                continue
+            per_group.setdefault(int(gids[i]), set()).add(
+                _path_template(str(paths[i]))
+            )
+        for g, fresh in per_group.items():
+            cur = set(json.loads(st["templates"][g]))
+            st["templates"][g] = json.dumps(sorted(cur | fresh))
+        return st
+
+    def rpc_merge(a, b):
+        ta = np.asarray(a["templates"], dtype=object)
+        tb = np.asarray(b["templates"], dtype=object)
+        out = np.empty(len(ta), dtype=object)
+        for g in range(len(ta)):
+            out[g] = json.dumps(
+                sorted(set(json.loads(ta[g])) | set(json.loads(tb[g])))
+            )
+        return {"templates": out}
+
+    r.register_uda(
+        UDA(
+            name="_build_request_path_clusters",
+            arg_types=(S,),
+            out_type=S,
+            init=rpc_init,
+            update=rpc_update,
+            merge=rpc_merge,
+            finalize=lambda st: np.asarray(st["templates"], dtype=object),
+            merge_kind=MergeKind.TREE,
+            host_finalize=True,
+            string_args="values",
+            doc="Endpoint templates observed per group, as a JSON list "
+            "(request_path_ops.h RequestPathClusteringFitUDA; id-segment "
+            "generalization instead of the reference's online "
+            "similarity clustering).",
+        )
+    )

@@ -16,6 +16,10 @@ Two traps this guards against (this image routes JAX through the remote
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# CI hosts can be saturated by a concurrent benchmark; give stalled-source
+# detection generous headroom so cross-process tests don't time out while
+# the machine is merely slow (children inherit this through spawn).
+os.environ.setdefault("PIXIE_TPU_EXEC_SOURCE_STALL_S", "180")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

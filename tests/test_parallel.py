@@ -434,3 +434,23 @@ def test_mesh_partial_stage_offload_in_cluster(mesh):
         broker.stop()
         for a in pems + [kelvin]:
             a.stop()
+
+
+def test_mesh_staged_superset_reuse(mesh):
+    """A query needing a subset of an already-staged column set reuses the
+    resident staging instead of doubling HBM (the OOM-at-256M fix)."""
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    cd, data = seed_carnot(ex)
+    cd.execute_query(SERVICE_STATS_PXL)  # stages time_+status+latency+service
+    n_staged = len(ex._staged_cache)
+    res = cd.execute_query(  # needs only latency+service: subset
+        "df = px.DataFrame(table='http_events')\n"
+        "s = df.groupby(['service']).agg(total=('latency', px.sum))\n"
+        "px.display(s, 'out')\n"
+    )
+    assert len(ex._staged_cache) == n_staged  # no second staging
+    rows = res.table("out")
+    for svc, total in zip(rows["service"], rows["total"]):
+        assert total == pytest.approx(
+            float(data["latency"][data["service"] == svc].sum()), rel=1e-9
+        )

@@ -1,0 +1,123 @@
+"""PII/SQL/URI/request-path/CIDR builtin tests (ref:
+src/carnot/funcs/builtins/{pii,sql,uri,request_path}_ops.*, net/net_ops)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from pixie_tpu.engine import Carnot
+from pixie_tpu.types import DataType, Relation
+
+F, I, S, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+
+def _engine(col_values):
+    carnot = Carnot()
+    rel = Relation.of(("time_", T), ("s", S))
+    t = carnot.table_store.create_table("rows", rel)
+    t.write_pydict({
+        "time_": np.arange(len(col_values)),
+        "s": np.array(col_values, dtype=object),
+    })
+    t.compact()
+    t.stop()
+    return carnot
+
+
+def run_map(col_values, expr):
+    carnot = _engine(col_values)
+    res = carnot.execute_query(
+        "df = px.DataFrame(table='rows')\n"
+        f"df.out = {expr}\n"
+        "px.display(df[['out']], 'out')\n"
+    )
+    return res.table("out")["out"]
+
+
+def test_redact_pii():
+    out = run_map(
+        [
+            "user bob@corp.example logged in from 10.1.2.3",
+            "mac 00:1A:2B:3C:4D:5E ssn 123-45-6789",
+            "clean text",
+        ],
+        "px.redact_pii_best_effort(df.s)",
+    )
+    assert out[0] == (
+        "user <REDACTED_EMAIL> logged in from <REDACTED_IPv4>"
+    )
+    assert "<REDACTED_MAC_ADDR>" in out[1] and "<REDACTED_SSN>" in out[1]
+    assert out[2] == "clean text"
+
+
+def test_normalize_sql_dialects():
+    q = "SELECT * FROM users WHERE name = 'bob' AND age > 30"
+    my = json.loads(run_map([q], "px.normalize_mysql(df.s)")[0])
+    assert my["query"] == "SELECT * FROM users WHERE name = ? AND age > ?"
+    assert my["params"] == ["'bob'", "30"] and my["error"] == ""
+    pg = json.loads(run_map([q], "px.normalize_pgsql(df.s)")[0])
+    assert pg["query"] == "SELECT * FROM users WHERE name = $1 AND age > $2"
+
+
+def test_uri_parse_and_recompose():
+    parsed = json.loads(
+        run_map(
+            ["https://u:p@api.example.com:8443/v1/items?q=1#frag"],
+            "px.uri_parse(df.s)",
+        )[0]
+    )
+    assert parsed["scheme"] == "https"
+    assert parsed["host"] == "api.example.com"
+    assert parsed["port"] == "8443"
+    assert parsed["path"] == "/v1/items"
+    assert parsed["query"] == "q=1" and parsed["fragment"] == "frag"
+    out = run_map(
+        ["x"],
+        "px.uri_recompose('https', 'u', 'api.example.com', 8443,"
+        " '/v1/items', 'q=1', 'frag')",
+    )
+    assert out[0] == "https://u@api.example.com:8443/v1/items?q=1#frag"
+
+
+def test_cidrs_contain_ip():
+    out = run_map(
+        ["10.0.1.7", "192.168.1.1", "bad"],
+        "px.cidrs_contain_ip('[\"10.0.0.0/16\", \"172.16.0.0/12\"]', df.s)",
+    )
+    assert list(out) == [True, False, False]
+
+
+def test_request_path_clustering():
+    paths = [
+        "/api/v1/users/12345",
+        "/api/v1/users/99999",
+        "/api/v1/users/12345/orders/0xdeadbeef",
+        "/healthz",
+    ]
+    out = run_map(paths, "px._predict_request_path_cluster(df.s)")
+    assert out[0] == out[1] == "/api/v1/users/*"
+    assert out[2] == "/api/v1/users/*/orders/*"
+    assert out[3] == "/healthz"
+
+    carnot = _engine(paths)
+    res = carnot.execute_query(
+        "df = px.DataFrame(table='rows')\n"
+        "c = df.agg(clusters=('s', px._build_request_path_clusters))\n"
+        "px.display(c, 'out')\n"
+    )
+    clusters = json.loads(res.table("out")["clusters"][0])
+    assert clusters == [
+        "/api/v1/users/*",
+        "/api/v1/users/*/orders/*",
+        "/healthz",
+    ]
+
+    match = run_map(paths, "px._match_endpoint(df.s, '/api/v1/users/*')")
+    assert list(match) == [True, True, False, False]

@@ -68,7 +68,7 @@ def _child_pem(address, agent_id: str, shard_idx: int) -> None:
     router = RemoteRouter(bus)
     agent = Agent(agent_id, bus, router, table_store=store, is_kelvin=False)
     agent.start()
-    time.sleep(30)  # parent terminates us well before this
+    time.sleep(600)  # parent terminates us; must outlive its deadlines
 
 
 def test_statebatch_wire_roundtrip():
@@ -117,7 +117,7 @@ def test_rowbatch_pickle_rides_wire_format():
 
 
 def test_two_process_cluster_matches_local():
-    # Bounded internally: registration waits 60s, execute_script 60s.
+    # Bounded internally: registration waits 300s, execute_script 120s.
     ctx = mp.get_context("spawn")
     bus = MessageBus()
     router = BridgeRouter()
@@ -136,9 +136,9 @@ def test_two_process_cluster_matches_local():
     try:
         for p in procs:
             p.start()
-        # Generous: spawned children cold-import jax, which can take tens
-        # of seconds on a loaded CI host.
-        deadline = time.monotonic() + 120
+        # Generous: spawned children cold-import jax, which can take
+        # minutes when a concurrent benchmark saturates the host.
+        deadline = time.monotonic() + 300
         while time.monotonic() < deadline:
             for p in procs:
                 assert p.is_alive() or p.exitcode in (None, 0), (
@@ -159,7 +159,7 @@ def test_two_process_cluster_matches_local():
             "    avg=('value', px.mean),\n"
             ")\n"
             "px.display(s, 'out')\n",
-            timeout_s=60,
+            timeout_s=120,
         )
         got = RowBatch.concat(
             [b for b in res.tables["out"] if b.num_rows]
