@@ -133,7 +133,11 @@ class ASTVisitor:
         elif isinstance(stmt, ast.Expr):
             self._eval(stmt.value, scope)
         elif isinstance(stmt, ast.FunctionDef):
-            scope[stmt.name] = _UserFunc(self, stmt, scope)
+            fn = _UserFunc(self, stmt, scope)
+            # Apply decorators innermost-first (@pxtrace.probe('Func')).
+            for deco in reversed(stmt.decorator_list):
+                fn = self._eval(deco, scope)(fn)
+            scope[stmt.name] = fn
         elif isinstance(stmt, ast.Return):
             raise _Return(
                 self._eval(stmt.value, scope) if stmt.value else None

@@ -117,13 +117,17 @@ class IRGraph:
         from pixie_tpu.plan.operators import (
             BridgeSinkOp,
             MemorySinkOp,
+            OTelExportSinkOp,
             ResultSinkOp,
         )
 
         keep = set(keep or ())
         live = set(keep)
         for n, op in self._ops.items():
-            if isinstance(op, (ResultSinkOp, MemorySinkOp, BridgeSinkOp)):
+            if isinstance(
+                op,
+                (ResultSinkOp, MemorySinkOp, BridgeSinkOp, OTelExportSinkOp),
+            ):
                 live.add(n)
         # Walk ancestors of live nodes.
         stack = list(live)

@@ -30,6 +30,7 @@ from pixie_tpu.plan.operators import (
     LimitOp,
     MapOp,
     MemorySourceOp,
+    OTelExportSinkOp,
     ResultSinkOp,
     UDTFSourceOp,
     UnionOp,
@@ -431,6 +432,134 @@ class DataFrameObj:
         return f"DataFrame({self.relation!r})"
 
 
+def _col_name(v, what: str) -> str:
+    """Column name from a ColumnExpr used in an OTel spec."""
+    if isinstance(v, ColumnExpr) and isinstance(v.expr, ColumnRef):
+        return v.expr.name
+    raise CompilerError(
+        f"px.otel {what} must be a plain DataFrame column reference"
+    )
+
+
+class _OTelData:
+    def __init__(self, resource: dict, data: list, endpoint=None):
+        if "service.name" not in resource:
+            raise CompilerError(
+                "px.otel.Data resource must include 'service.name'"
+            )
+        self.resource = resource
+        self.data = data if isinstance(data, (list, tuple)) else [data]
+        self.endpoint = endpoint
+
+    def to_op(self, df: "DataFrameObj"):
+        if any(s["kind"] == "gauge" for s in self.data) and not (
+            df.relation.has_column("time_")
+        ):
+            # Ref: otel.h Gauge doc — "The source DataFrame must have a
+            # `time_` column ... or the compiler will throw an error."
+            raise CompilerError(
+                "px.otel.metric.Gauge requires a time_ column on the "
+                "exported DataFrame"
+            )
+        # Every referenced column must exist in the EXPORTED frame — a
+        # typo or a column from another DataFrame must fail at compile
+        # time, not as a KeyError mid-query.
+        refs = [
+            v for _, v in (
+                (k, v) for k, v in self.resource.items()
+                if isinstance(v, ColumnExpr)
+            )
+        ]
+        for spec in self.data:
+            f = spec["fields"]
+            refs += [f[k] for k in ("value_column", "time_column",
+                                    "start_time_column", "end_time_column",
+                                    "name_column") if f.get(k)]
+            refs += [c for _, c in f.get("attributes", ())]
+        for r in refs:
+            name = r.expr.name if isinstance(r, ColumnExpr) else r
+            if not df.relation.has_column(name):
+                raise CompilerError(
+                    f"px.otel spec references column {name!r} not present "
+                    f"in the exported DataFrame "
+                    f"(have {df.relation.col_names()})"
+                )
+        resource = tuple(
+            (
+                (k, _col_name(v, "resource"), True)
+                if isinstance(v, ColumnExpr)
+                else (k, str(v), False)
+            )
+            for k, v in self.resource.items()
+        )
+        metrics, spans = [], []
+        for spec in self.data:
+            if spec["kind"] == "gauge":
+                metrics.append(tuple(sorted(spec["fields"].items())))
+            else:
+                spans.append(tuple(sorted(spec["fields"].items())))
+        return OTelExportSinkOp(
+            resource=resource,
+            metrics=tuple(metrics),
+            spans=tuple(spans),
+            endpoint=self.endpoint,
+        )
+
+
+class _OTelMetricNS:
+    @staticmethod
+    def Gauge(name, value, description="", attributes=None, unit=""):
+        return {
+            "kind": "gauge",
+            "fields": {
+                "name": str(name),
+                "value_column": _col_name(value, "Gauge value"),
+                "time_column": "time_",
+                "description": description,
+                "unit": unit,
+                "attributes": tuple(
+                    (k, _col_name(v, "attribute"))
+                    for k, v in (attributes or {}).items()
+                ),
+            },
+        }
+
+
+class _OTelTraceNS:
+    @staticmethod
+    def Span(name, start_time, end_time, attributes=None):
+        fields = {
+            "start_time_column": _col_name(start_time, "Span start_time"),
+            "end_time_column": _col_name(end_time, "Span end_time"),
+            "attributes": tuple(
+                (k, _col_name(v, "attribute"))
+                for k, v in (attributes or {}).items()
+            ),
+        }
+        if isinstance(name, ColumnExpr):
+            fields["name_column"] = _col_name(name, "Span name")
+            fields["name"] = ""
+        else:
+            fields["name_column"] = ""
+            fields["name"] = str(name)
+        return {"kind": "span", "fields": fields}
+
+
+class _OTelModule:
+    """px.otel namespace (ref: planner/objects/otel.h OTelModule)."""
+
+    metric = _OTelMetricNS()
+    trace = _OTelTraceNS()
+
+    @staticmethod
+    def Data(resource: dict, data, endpoint=None) -> _OTelData:
+        return _OTelData(resource, data, endpoint)
+
+    @staticmethod
+    def Endpoint(url: str, headers=None, insecure: bool = False) -> str:
+        return str(url)
+
+
 class PxModule:
     """The ``px`` module object (ref: objects/pixie_module.*)."""
 
@@ -470,6 +599,23 @@ class PxModule:
             raise CompilerError("px.display takes a DataFrame")
         nid = self._ir.add(ResultSinkOp(name), [df._id])
         self.display_calls.append((nid, name))
+
+    # -- OTel export (ref: planner/objects/otel.h px.otel module +
+    #    px.export lowering to OTelExportSinkOperator) ---------------------
+    @property
+    def otel(self) -> "_OTelModule":
+        return _OTelModule()
+
+    def export(self, df: DataFrameObj, data: "_OTelData") -> None:
+        if not isinstance(df, DataFrameObj):
+            raise CompilerError("px.export takes a DataFrame")
+        if not isinstance(data, _OTelData):
+            raise CompilerError(
+                "px.export takes a px.otel.Data(...) config"
+            )
+        nid = self._ir.add(data.to_op(df), [df._id])
+        # Exports are sinks: they keep the query alive like a display.
+        self.display_calls.append((nid, "__otel__"))
 
     # -- time helpers -------------------------------------------------------
     def now(self) -> int:

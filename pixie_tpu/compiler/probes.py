@@ -1,0 +1,199 @@
+"""pxtrace — the dynamic-trace mutation compiler.
+
+Ref: src/carnot/planner/probes/probes.h:213 (MutationsIR),
+tracepoint_generator.* — PxL programs importing ``pxtrace`` define probes
+(@pxtrace.probe('Func') functions returning output-column specs built
+from ArgExpr/RetExpr/FunctionLatency) and deploy them with
+UpsertTracepoint(name, table, probe_fn, target, ttl). Compilation
+produces TracepointDeployment mutations, not a query plan
+(LogicalPlanner::CompileTrace, logical_planner.h:61).
+
+The reference lowers deployments through a DWARF-resolving dwarvifier
+into BCC uprobes (dynamic_tracer.{h,cc}); this build's agents install a
+synthetic DynamicTraceConnector with the same table schema instead —
+kernel probing is out of scope on TPU hosts (BASELINE.md), the
+compile/registry/deploy/table lifecycle is the parity surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+from pixie_tpu.compiler.errors import CompilerError
+from pixie_tpu.types import DataType, Relation, SemanticType
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceColumn:
+    name: str
+    kind: str  # "arg" | "ret" | "latency"
+    expr: str  # arg name / return path ('$0.a') / "" for latency
+
+    @property
+    def data_type(self) -> DataType:
+        # Without DWARF type resolution, args/returns surface as strings;
+        # latency is always ns (the dwarvifier would refine these).
+        return DataType.INT64 if self.kind == "latency" else DataType.STRING
+
+
+@dataclasses.dataclass(frozen=True)
+class TracepointDeployment:
+    name: str
+    table_name: str
+    target_fn: str  # the traced symbol (@pxtrace.probe arg)
+    target: str = ""  # process selector (PodProcess/SharedObject/upid)
+    ttl_ns: int = 300_000_000_000
+    columns: tuple = ()  # TraceColumn
+
+    def output_relation(self) -> Relation:
+        cols = [
+            ("time_", DataType.TIME64NS, SemanticType.ST_TIME_NS),
+            ("upid", DataType.STRING, SemanticType.ST_UPID),
+        ]
+        cols += [
+            (
+                c.name,
+                c.data_type,
+                SemanticType.ST_DURATION_NS
+                if c.kind == "latency"
+                else SemanticType.ST_NONE,
+            )
+            for c in self.columns
+        ]
+        return Relation.of(*cols)
+
+
+class MutationsIR:
+    """Compiled mutations (ref: probes.h:213)."""
+
+    def __init__(self):
+        self.deployments: list[TracepointDeployment] = []
+        self.deletions: list[str] = []
+
+
+class _TraceExpr:
+    def __init__(self, kind: str, expr: str = ""):
+        self.kind = kind
+        self.expr = expr
+
+
+class _ProbeFn:
+    def __init__(self, fn, target_fn: str):
+        self.fn = fn
+        self.target_fn = target_fn
+
+
+_TTL_RE = re.compile(r"^(\d+)(ns|us|ms|s|m|h)$")
+_TTL_NS = {"ns": 1, "us": 10**3, "ms": 10**6, "s": 10**9,
+           "m": 60 * 10**9, "h": 3600 * 10**9}
+
+
+def parse_ttl(ttl) -> int:
+    if isinstance(ttl, (int, float)):
+        return int(ttl)
+    m = _TTL_RE.match(str(ttl))
+    if not m:
+        raise CompilerError(f"bad tracepoint TTL {ttl!r} (want e.g. '5m')")
+    return int(m.group(1)) * _TTL_NS[m.group(2)]
+
+
+class PxTraceModule:
+    """The ``pxtrace`` module object bound into mutation scripts."""
+
+    def __init__(self, mutations: MutationsIR):
+        self._mutations = mutations
+
+    # -- probe definition ---------------------------------------------------
+    def probe(self, target_fn: str):
+        def deco(fn):
+            return _ProbeFn(fn, target_fn)
+
+        return deco
+
+    @staticmethod
+    def ArgExpr(expr: str) -> _TraceExpr:
+        return _TraceExpr("arg", str(expr))
+
+    @staticmethod
+    def RetExpr(expr: str) -> _TraceExpr:
+        return _TraceExpr("ret", str(expr))
+
+    @staticmethod
+    def FunctionLatency() -> _TraceExpr:
+        return _TraceExpr("latency")
+
+    # -- target selectors ---------------------------------------------------
+    @staticmethod
+    def PodProcess(pod: str, container: str = "") -> str:
+        return f"pod:{pod}" + (f"/{container}" if container else "")
+
+    @staticmethod
+    def SharedObject(name: str, upid=None) -> str:
+        return f"so:{name}"
+
+    # -- mutations ----------------------------------------------------------
+    def UpsertTracepoint(
+        self, name: str, table_name: str, probe_fn, target, ttl
+    ) -> None:
+        if not isinstance(probe_fn, _ProbeFn):
+            raise CompilerError(
+                "UpsertTracepoint needs a @pxtrace.probe(...) function"
+            )
+        out = probe_fn.fn()
+        if out is None:
+            raise CompilerError(
+                "Improper probe definition: missing output spec of probe, "
+                "add a return statement"
+            )
+        columns = []
+        for item in out if isinstance(out, (list, tuple)) else [out]:
+            if not isinstance(item, dict) or len(item) != 1:
+                raise CompilerError(
+                    "probe output entries must be single-key dicts"
+                )
+            ((col, spec),) = item.items()
+            if not isinstance(spec, _TraceExpr):
+                raise CompilerError(
+                    f"probe output {col!r} must be an ArgExpr/RetExpr/"
+                    "FunctionLatency"
+                )
+            columns.append(TraceColumn(col, spec.kind, spec.expr))
+        self._mutations.deployments.append(
+            TracepointDeployment(
+                name=name,
+                table_name=table_name,
+                target_fn=probe_fn.target_fn,
+                target=str(target),
+                ttl_ns=parse_ttl(ttl),
+                columns=tuple(columns),
+            )
+        )
+
+    def DeleteTracepoint(self, name: str) -> None:
+        self._mutations.deletions.append(name)
+
+
+def is_mutation_script(query: str) -> bool:
+    return bool(re.search(r"^\s*import\s+pxtrace\s*$", query, re.M))
+
+
+def compile_trace(query: str, registry=None) -> MutationsIR:
+    """PxL mutation script -> MutationsIR (LogicalPlanner::CompileTrace)."""
+    from pixie_tpu.compiler.ast_visitor import ASTVisitor
+    from pixie_tpu.compiler.ir import IRGraph
+    from pixie_tpu.compiler.objects import PxModule
+
+    if registry is None:
+        from pixie_tpu.udf.registry import default_registry
+
+        registry = default_registry()
+    mutations = MutationsIR()
+    ir = IRGraph(registry, {})
+    px = PxModule(ir, registry)
+    visitor = ASTVisitor(
+        px, globals_={"pxtrace": PxTraceModule(mutations)}
+    )
+    visitor.run(query)
+    return mutations

@@ -78,9 +78,19 @@ class Carnot:
         instance: str = "local",
         device_executor=None,
         vizier_ctx=None,
+        otel_exporter=None,
     ):
         self.table_store = table_store or TableStore()
         self.vizier_ctx = vizier_ctx
+        # Default exporter: BOUNDED in-memory collector (zero-egress
+        # default; long-lived engines with recurring exports must not leak
+        # — swap in an OTLP/HTTP callable for a real collector).
+        import collections
+
+        self.otel_payloads: "collections.deque" = collections.deque(
+            maxlen=1024
+        )
+        self.otel_exporter = otel_exporter or self.otel_payloads.append
         if registry is None:
             from pixie_tpu.udf.registry import default_registry
 
@@ -157,6 +167,7 @@ class Carnot:
                     result_callback=on_result,
                     instance=self.instance,
                     vizier_ctx=self.vizier_ctx,
+                    otel_exporter=self.otel_exporter,
                 )
                 if self.device_executor is not None:
                     offloaded = self.device_executor.try_execute_fragment(

@@ -31,6 +31,7 @@ from pixie_tpu.exec.nodes import (
     UDTFSourceNode,
     UnionNode,
 )
+from pixie_tpu.exec.otel_sink_node import OTelExportSinkNode
 from pixie_tpu.plan.operators import (
     AggOp,
     BridgeSinkOp,
@@ -43,6 +44,7 @@ from pixie_tpu.plan.operators import (
     MapOp,
     MemorySinkOp,
     MemorySourceOp,
+    OTelExportSinkOp,
     ResultSinkOp,
     UDTFSourceOp,
     UnionOp,
@@ -77,6 +79,7 @@ _NODE_TYPES = {
     MemorySinkOp: MemorySinkNode,
     ResultSinkOp: ResultSinkNode,
     BridgeSinkOp: BridgeSinkNode,
+    OTelExportSinkOp: OTelExportSinkNode,
 }
 
 

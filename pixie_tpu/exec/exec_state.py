@@ -39,6 +39,7 @@ class ExecState:
         instance: str = "local",
         compute_backend: str = "cpu",
         vizier_ctx: Any = None,
+        otel_exporter: Any = None,
     ):
         self.query_id = query_id
         self.table_store = table_store
@@ -53,6 +54,9 @@ class ExecState:
         # result_callback(table_name, row_batch) receives ResultSink output
         # (ref: Carnot's result destination / TransferResultChunk stream).
         self.result_callback = result_callback
+        # OTel payload consumer (ref: the OTLP gRPC stub in the reference's
+        # otel_export_sink_node); None drops exports.
+        self.otel_exporter = otel_exporter
         self.instance = instance
         # The exec-graph is the host-side (PEM-role) engine: its eager jax
         # ops run on CPU so a remote-TPU default backend never sees per-op

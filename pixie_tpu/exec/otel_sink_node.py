@@ -1,0 +1,136 @@
+"""OTel export sink node.
+
+Ref: src/carnot/exec/otel_export_sink_node.{h,cc} — converts row batches
+into OpenTelemetry metrics/spans and ships them over OTLP gRPC. Here the
+conversion targets the OTLP/JSON data model (resourceMetrics /
+resourceSpans payload dicts) and hands each payload to the engine's
+pluggable exporter (``exec_state.otel_exporter``) — an in-memory
+collector by default; a network OTLP/HTTP exporter is a drop-in callable
+(zero-egress environments keep the collector).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pixie_tpu.exec.exec_node import SinkNode
+from pixie_tpu.plan.operators import OTelExportSinkOp
+from pixie_tpu.table.row_batch import RowBatch
+
+
+def _attr_list(pairs) -> list:
+    return [
+        {"key": k, "value": {"stringValue": str(v)}} for k, v in pairs
+    ]
+
+
+class OTelExportSinkNode(SinkNode):
+    def __init__(self, op: OTelExportSinkOp, output_relation, node_id):
+        super().__init__(op, output_relation, node_id)
+        self.op: OTelExportSinkOp = op
+
+    def consume_next_impl(self, exec_state, batch, parent_index) -> None:
+        if not isinstance(batch, RowBatch) or not batch.num_rows:
+            return
+        exporter = getattr(exec_state, "otel_exporter", None)
+        if exporter is None:
+            return
+        d = batch.to_pydict()
+        n = batch.num_rows
+
+        def col(name):
+            return d[name]
+
+        # Rows group by their RESOURCE identity (column-valued resource
+        # attributes vary per row — the reference emits one resource entry
+        # per distinct value, never the first row's value for all).
+        res_cols = [(k, v) for k, v, is_col in self.op.resource if is_col]
+        res_consts = [
+            (k, v) for k, v, is_col in self.op.resource if not is_col
+        ]
+        groups: dict[tuple, list[int]] = {}
+        for i in range(n):
+            key = tuple(col(c)[i] for _, c in res_cols)
+            groups.setdefault(key, []).append(i)
+
+        payload: dict = {}
+        res_metrics, res_spans = [], []
+        for key, rows in groups.items():
+            resource_attrs = _attr_list(
+                [(k, v) for (k, _), v in zip(res_cols, key)] + res_consts
+            )
+            if self.op.metrics:
+                metrics = []
+                for spec in self.op.metrics:
+                    spec = dict(spec)
+                    points = []
+                    times = col(spec["time_column"])
+                    values = col(spec["value_column"])
+                    attrs = spec.get("attributes", ())
+                    for i in rows:
+                        dp = {
+                            "timeUnixNano": str(int(times[i])),
+                            "attributes": _attr_list(
+                                (k, col(c)[i]) for k, c in attrs
+                            ),
+                        }
+                        v = values[i]
+                        if isinstance(v, (int, np.integer)):
+                            dp["asInt"] = str(int(v))
+                        else:
+                            dp["asDouble"] = float(v)
+                        points.append(dp)
+                    metrics.append(
+                        {
+                            "name": spec["name"],
+                            "description": spec.get("description", ""),
+                            "unit": spec.get("unit", ""),
+                            "gauge": {"dataPoints": points},
+                        }
+                    )
+                res_metrics.append(
+                    {
+                        "resource": {"attributes": resource_attrs},
+                        "scopeMetrics": [{"metrics": metrics}],
+                    }
+                )
+            if self.op.spans:
+                spans = []
+                for spec in self.op.spans:
+                    spec = dict(spec)
+                    starts = col(spec["start_time_column"])
+                    ends = col(spec["end_time_column"])
+                    names = (
+                        col(spec["name_column"])
+                        if spec.get("name_column")
+                        else None
+                    )
+                    attrs = spec.get("attributes", ())
+                    for i in rows:
+                        spans.append(
+                            {
+                                "name": str(
+                                    names[i]
+                                    if names is not None
+                                    else spec.get("name", "span")
+                                ),
+                                "startTimeUnixNano": str(int(starts[i])),
+                                "endTimeUnixNano": str(int(ends[i])),
+                                "attributes": _attr_list(
+                                    (k, col(c)[i]) for k, c in attrs
+                                ),
+                            }
+                        )
+                res_spans.append(
+                    {
+                        "resource": {"attributes": resource_attrs},
+                        "scopeSpans": [{"spans": spans}],
+                    }
+                )
+        if res_metrics:
+            payload["resourceMetrics"] = res_metrics
+        if res_spans:
+            payload["resourceSpans"] = res_spans
+        if payload:
+            payload["endpoint"] = self.op.endpoint
+            exporter(payload)

@@ -32,6 +32,14 @@ class IngestCore:
     def register_source(self, source: SourceConnector) -> None:
         self._sources.append(source)
 
+    def deregister_source(self, source: SourceConnector) -> None:
+        """Remove a source (dynamic tracepoint deletion). Safe while the
+        run loop is live: the loop iterates over a snapshot."""
+        try:
+            self._sources.remove(source)
+        except ValueError:
+            pass
+
     def register_data_push_callback(self, cb: DataPushCallback) -> None:
         self._push_cb = cb
 
@@ -79,7 +87,7 @@ class IngestCore:
         try:
             while not self._stop.is_set():
                 now = time.monotonic()
-                for s in self._sources:
+                for s in list(self._sources):
                     if s.sampling_expired(now):
                         s.transfer_data(self._ctx)
                         s.reset_sample(now)
@@ -87,13 +95,13 @@ class IngestCore:
                         s.push_data(self._push_cb)
                         s.reset_push(now)
                 next_tick = min(
-                    (s.next_tick() for s in self._sources),
+                    (s.next_tick() for s in list(self._sources)),
                     default=now + 0.1,
                 )
                 self._stop.wait(timeout=max(0.0, next_tick - time.monotonic()))
         finally:
             # Final flush so short-lived runs lose nothing.
-            for s in self._sources:
+            for s in list(self._sources):
                 s.push_data(self._push_cb)
                 s.stop()
 

@@ -1,0 +1,175 @@
+"""Metadata service: watch k8s, persist entities, broadcast to agents.
+
+Ref: src/vizier/services/metadata/controllers/k8smeta/
+k8s_metadata_{controller,handler,store}.go — a controller watches the k8s
+API (pods/services/endpoints/...), a handler turns watch events into
+updates persisted in the datastore, and agents receive incremental
+updates over NATS (here: the in-proc/TCP bus, topic
+``metadata_updates``). On restart the service REHYDRATES its world from
+the datastore — the reference's "resume" story (SURVEY §5: durable state
+= metadata KV; telemetry is ephemeral).
+
+The watcher is pluggable: production would wrap a real k8s client;
+tests/demos drive ``emit_pod``/``emit_service`` by hand (the reference
+tests its handler exactly this way, with fake watch events).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from typing import Optional
+
+from pixie_tpu.metadata.state import (
+    MetadataState,
+    MetadataStateManager,
+    PodInfo,
+    ServiceInfo,
+)
+from pixie_tpu.vizier.datastore import Datastore
+
+METADATA_UPDATES_TOPIC = "metadata_updates"
+
+_POD_PREFIX = "/md/pod/"
+_SVC_PREFIX = "/md/service/"
+_UPID_PREFIX = "/md/upid/"
+
+
+class MetadataService:
+    """Persists entity updates and broadcasts them (k8smeta controller +
+    handler + store, collapsed to one in-process service)."""
+
+    def __init__(self, datastore: Datastore, bus=None):
+        self.store = datastore
+        self.bus = bus
+        self._lock = threading.Lock()
+
+    # -- rehydration (restart/resume path) ----------------------------------
+    def snapshot(self) -> MetadataState:
+        pods = {}
+        ip_to_pod = {}
+        for _, raw in self.store.get_prefix(_POD_PREFIX):
+            p = PodInfo(**json.loads(raw))
+            pods[p.pod_id] = p
+            if p.ip:
+                ip_to_pod[p.ip] = p.pod_id
+        services = {}
+        for _, raw in self.store.get_prefix(_SVC_PREFIX):
+            s = ServiceInfo(**json.loads(raw))
+            services[s.service_id] = s
+        upid_to_pod = {
+            k[len(_UPID_PREFIX):]: raw.decode()
+            for k, raw in self.store.get_prefix(_UPID_PREFIX)
+        }
+        return MetadataState(
+            pods=pods,
+            services=services,
+            upid_to_pod=upid_to_pod,
+            ip_to_pod=ip_to_pod,
+        )
+
+    # -- watch-event ingestion (the k8s handler surface) --------------------
+    def handle_pod_update(self, pod: PodInfo, deleted: bool = False) -> None:
+        with self._lock:
+            key = _POD_PREFIX + pod.pod_id
+            if deleted:
+                self.store.delete(key)
+            else:
+                self.store.set(
+                    key, json.dumps(dataclasses.asdict(pod)).encode()
+                )
+        self._broadcast(
+            {"type": "pod", "deleted": deleted,
+             "pod": dataclasses.asdict(pod)}
+        )
+
+    def handle_service_update(
+        self, svc: ServiceInfo, deleted: bool = False
+    ) -> None:
+        with self._lock:
+            key = _SVC_PREFIX + svc.service_id
+            if deleted:
+                self.store.delete(key)
+            else:
+                self.store.set(
+                    key, json.dumps(dataclasses.asdict(svc)).encode()
+                )
+        self._broadcast(
+            {"type": "service", "deleted": deleted,
+             "service": dataclasses.asdict(svc)}
+        )
+
+    def handle_upid(self, upid: str, pod_id: str) -> None:
+        with self._lock:
+            self.store.set(_UPID_PREFIX + upid, pod_id.encode())
+        self._broadcast({"type": "upid", "upid": upid, "pod_id": pod_id})
+
+    def _broadcast(self, msg: dict) -> None:
+        if self.bus is not None:
+            self.bus.publish(METADATA_UPDATES_TOPIC, msg)
+
+
+class MetadataUpdateListener:
+    """Agent-side consumer: applies broadcast updates into the agent's
+    MetadataStateManager (ref: the agent manager's k8s-update message
+    handler feeding AgentMetadataStateManager)."""
+
+    def __init__(self, bus, manager: MetadataStateManager):
+        self.manager = manager
+        self._sub = bus.subscribe(METADATA_UPDATES_TOPIC)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            msg = self._sub.get(timeout=0.05)
+            if msg is None:
+                continue
+            self.apply(msg)
+
+    def apply(self, msg: dict) -> None:
+        kind = msg.get("type")
+        if kind == "pod" and not msg.get("deleted"):
+            self.manager.apply_update(pods=[PodInfo(**msg["pod"])])
+        elif kind == "service" and not msg.get("deleted"):
+            self.manager.apply_update(
+                services=[ServiceInfo(**msg["service"])]
+            )
+        elif kind == "upid":
+            self.manager.apply_update(upids={msg["upid"]: msg["pod_id"]})
+        elif kind == "pod" and msg.get("deleted"):
+            st = self.manager.current()
+            pods = dict(st.pods)
+            pods.pop(msg["pod"]["pod_id"], None)
+            ip_to_pod = {
+                ip: pid
+                for ip, pid in st.ip_to_pod.items()
+                if pid != msg["pod"]["pod_id"]
+            }
+            self.manager.set_state(
+                dataclasses.replace(st, pods=pods, ip_to_pod=ip_to_pod)
+            )
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self._sub.unsubscribe()
+
+
+class FakeK8sWatcher:
+    """Test/demo watcher: hand-driven watch events (the reference unit-
+    tests its handler with fake informer events the same way)."""
+
+    def __init__(self, service: MetadataService):
+        self.service = service
+
+    def emit_pod(self, pod: PodInfo, deleted: bool = False) -> None:
+        self.service.handle_pod_update(pod, deleted)
+
+    def emit_service(self, svc: ServiceInfo, deleted: bool = False) -> None:
+        self.service.handle_service_update(svc, deleted)
+
+    def emit_process(self, upid: str, pod_id: str) -> None:
+        self.service.handle_upid(upid, pod_id)

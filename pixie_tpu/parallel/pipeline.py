@@ -332,9 +332,13 @@ class MeshExecutor:
                 return None  # table moved under us; fall back
             try:
                 staged = self._stage(cols, n, key_plan, table)
-            except Exception:
-                # Likely device OOM: drop every cached staging and retry
-                # once — better than falling back to the host engine for a
+            except Exception as e:
+                if "RESOURCE_EXHAUSTED" not in str(e) and (
+                    "Out of memory" not in str(e)
+                ):
+                    raise  # deterministic failures must not nuke the cache
+                # Device OOM: drop every cached staging and retry once —
+                # better than falling back to the host engine for a
                 # gigarow table.
                 self._staged_cache.clear()
                 _STAGED_EVICTIONS.inc(reason="oom")

@@ -280,6 +280,24 @@ class ResultSinkOp(Operator):
 
 
 @dataclasses.dataclass(frozen=True, repr=False)
+class OTelExportSinkOp(Operator):
+    """Export row batches as OpenTelemetry metrics/spans
+    (ref: src/carnot/exec/otel_export_sink_node.h:40 + the px.otel PxL
+    module, planner/objects/otel.h). Column references are names into the
+    input relation; ``metrics``/``spans`` are spec dicts built by the
+    compiler's px.otel objects."""
+
+    resource: tuple  # ((attr name, column-or-value, is_column), ...)
+    metrics: tuple = ()  # Gauge/Summary spec dicts (frozen as tuples)
+    spans: tuple = ()
+    endpoint: Optional[str] = None
+
+    def output_relation(self, inputs, registry) -> Relation:
+        (rel,) = inputs
+        return rel
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
 class BridgeSinkOp(Operator):
     """Send batches to another fragment (ref: grpc_sink_node.h:54 in
     internal mode)."""

@@ -77,17 +77,23 @@ class StringDictionary:
             if u_ok:
                 # Native O(n) hash-map pass (the reference's write-side C++
                 # analogue); appends unseen values under the lock so codes
-                # stay dense + stable.
+                # stay dense + stable. _has_nul re-checked under the lock:
+                # a concurrent get_code() may have admitted a NUL value
+                # after the unlocked check above.
                 with self._lock:
-                    codes, new_values = _native.encode_with_dict(
-                        arr, self._values, u=u
-                    )
-                    for v in new_values:
-                        # Append BEFORE indexing: lock-free readers must
-                        # never see a code whose value isn't there yet.
-                        self._values.append(v)
-                        self._index[v] = len(self._values) - 1
-                return codes
+                    if self._has_nul:
+                        u_ok = False
+                    else:
+                        codes, new_values = _native.encode_with_dict(
+                            arr, self._values, u=u
+                        )
+                        for v in new_values:
+                            # Append BEFORE indexing: lock-free readers
+                            # must never see a code without its value.
+                            self._values.append(v)
+                            self._index[v] = len(self._values) - 1
+                if u_ok:
+                    return codes
         # Encode the unique values only, then broadcast back: telemetry string
         # columns (service/pod names, methods, paths) are extremely low-
         # cardinality relative to row count.

@@ -1,0 +1,122 @@
+"""Pluggable KV datastore.
+
+Ref: src/vizier/utils/datastore/datastore.go — a small Get/Set/Delete/
+GetWithPrefix interface with pebble (default), etcd, badger, buntdb
+backends. Here: an in-memory store and a file-backed store whose
+append-only JSON-lines log with periodic compaction fills pebble's role
+(durable metadata that survives agent restarts) without a native KV
+dependency. Values are bytes; keys are '/'-scoped strings.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+from typing import Optional
+
+
+class Datastore:
+    """In-memory backend (and the interface contract)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: dict[str, bytes] = {}
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._data[key] = bytes(value)
+            self._on_write(key, value)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+            self._on_write(key, None)
+
+    def delete_prefix(self, prefix: str) -> None:
+        with self._lock:
+            for k in [k for k in self._data if k.startswith(prefix)]:
+                del self._data[k]
+                self._on_write(k, None)
+
+    def get_prefix(self, prefix: str) -> list[tuple[str, bytes]]:
+        with self._lock:
+            return sorted(
+                (k, v) for k, v in self._data.items() if k.startswith(prefix)
+            )
+
+    def keys(self, prefix: str = "") -> list[str]:
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+    def close(self) -> None:
+        pass
+
+    # backend hook
+    def _on_write(self, key: str, value: Optional[bytes]) -> None:
+        pass
+
+
+class FileDatastore(Datastore):
+    """Durable backend: JSON-lines write-ahead log, replayed at open,
+    compacted when the log grows past ``compact_every`` records (the role
+    pebble plays for the reference's metadata service)."""
+
+    def __init__(self, path: str, compact_every: int = 4096):
+        super().__init__()
+        self.path = path
+        self.compact_every = compact_every
+        self._writes_since_compact = 0
+        self._f = None
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    if rec.get("v") is None:
+                        self._data.pop(rec["k"], None)
+                    else:
+                        self._data[rec["k"]] = base64.b64decode(rec["v"])
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a")
+
+    def _on_write(self, key: str, value: Optional[bytes]) -> None:
+        if self._f is None:
+            return
+        rec = {
+            "k": key,
+            "v": base64.b64encode(value).decode() if value is not None else None,
+        }
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+        self._writes_since_compact += 1
+        if self._writes_since_compact >= self.compact_every:
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        tmp = self.path + ".compact"
+        with open(tmp, "w") as f:
+            for k, v in sorted(self._data.items()):
+                f.write(
+                    json.dumps(
+                        {"k": k, "v": base64.b64encode(v).decode()}
+                    )
+                    + "\n"
+                )
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "a")
+        self._writes_since_compact = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

@@ -1,0 +1,188 @@
+"""Tracepoint mutation path: registry + deploy + agent-side manager.
+
+Ref: the px.DeployTracepoint call stack (SURVEY §3.4) —
+query_broker/controllers/mutation_executor.go compiles pxtrace programs,
+the metadata service's tracepoint registry persists them
+(metadata/controllers/tracepoint/tracepoint.go), agents' PEM
+TracepointManager (agent/pem/tracepoint_manager.{h,cc}) deploys into
+Stirling via RegisterTracepoint, and the new table schema becomes
+queryable. Here the deploy lands a synthetic DynamicTraceConnector in
+the agent's IngestCore (kernel uprobes are out of scope on TPU hosts;
+the compile→registry→deploy→table lifecycle is the parity surface).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from pixie_tpu.compiler.probes import (
+    MutationsIR,
+    TracepointDeployment,
+    compile_trace,
+)
+from pixie_tpu.ingest.source_connector import DataTable, SourceConnector
+from pixie_tpu.vizier.datastore import Datastore
+
+TRACEPOINT_TOPIC = "tracepoint_updates"
+_TP_PREFIX = "/tracepoint/"
+
+
+class DynamicTraceConnector(SourceConnector):
+    """Stands in for the BCC-deployed uprobe: emits synthetic events with
+    the tracepoint's schema at the sampling cadence (ref: the
+    dynamic_tracer's deployed probe filling its DataTable)."""
+
+    sample_period_s = 0.02
+    push_period_s = 0.05
+
+    def __init__(self, deployment: TracepointDeployment, rows_per_sample=8):
+        super().__init__()
+        self.name = f"dynamic:{deployment.name}"
+        self.deployment = deployment
+        self.rows_per_sample = rows_per_sample
+        self._rng = np.random.default_rng(abs(hash(deployment.name)) % 2**32)
+        self._deadline = time.time_ns() + deployment.ttl_ns
+        self.tables = [
+            DataTable(deployment.table_name, deployment.output_relation())
+        ]
+
+    def transfer_data_impl(self, ctx) -> None:
+        if time.time_ns() > self._deadline:
+            return  # TTL expired: the probe stops producing
+        n = self.rows_per_sample
+        now = time.time_ns()
+        data = {
+            "time_": now + np.arange(n),
+            "upid": np.array(
+                [f"1:{100 + i % 4}:{i % 4 + 1}" for i in range(n)],
+                dtype=object,
+            ),
+        }
+        for c in self.deployment.columns:
+            if c.kind == "latency":
+                data[c.name] = self._rng.integers(10**3, 10**7, n)
+            else:
+                data[c.name] = np.array(
+                    [f"{c.expr}={i}" for i in self._rng.integers(0, 50, n)],
+                    dtype=object,
+                )
+        self.tables[0].append_columns(data)
+
+
+class TracepointRegistry:
+    """Durable tracepoint specs (metadata/controllers/tracepoint)."""
+
+    def __init__(self, store: Datastore):
+        self.store = store
+
+    def upsert(self, dep: TracepointDeployment) -> None:
+        self.store.set(
+            _TP_PREFIX + dep.name,
+            json.dumps(dataclasses.asdict(dep)).encode(),
+        )
+
+    def delete(self, name: str) -> None:
+        self.store.delete(_TP_PREFIX + name)
+
+    def get(self, name: str) -> Optional[TracepointDeployment]:
+        raw = self.store.get(_TP_PREFIX + name)
+        return _dep_from_json(raw) if raw is not None else None
+
+    def list(self) -> list[TracepointDeployment]:
+        return [
+            _dep_from_json(raw)
+            for _, raw in self.store.get_prefix(_TP_PREFIX)
+        ]
+
+
+def _dep_from_json(raw: bytes) -> TracepointDeployment:
+    from pixie_tpu.compiler.probes import TraceColumn
+
+    d = json.loads(raw)
+    d["columns"] = tuple(TraceColumn(**c) for c in d["columns"])
+    return TracepointDeployment(**d)
+
+
+class MutationExecutor:
+    """Broker-side: compile pxtrace -> persist -> broadcast deploys
+    (ref: mutation_executor.go + CompileMutations)."""
+
+    def __init__(self, registry: TracepointRegistry, bus=None):
+        self.registry = registry
+        self.bus = bus
+
+    def execute(self, query: str) -> MutationsIR:
+        mutations = compile_trace(query)
+        for dep in mutations.deployments:
+            self.registry.upsert(dep)
+            self._broadcast(
+                {"type": "tracepoint_deploy",
+                 "deployment": dataclasses.asdict(dep)}
+            )
+        for name in mutations.deletions:
+            self.registry.delete(name)
+            self._broadcast({"type": "tracepoint_delete", "name": name})
+        return mutations
+
+    def _broadcast(self, msg: dict) -> None:
+        if self.bus is not None:
+            self.bus.publish(TRACEPOINT_TOPIC, msg)
+
+
+class TracepointManager:
+    """Agent-side: applies deploy/delete messages to the agent's
+    IngestCore + table store (ref: pem/tracepoint_manager.{h,cc} →
+    Stirling::RegisterTracepoint, stirling.h:114)."""
+
+    def __init__(self, bus, ingest_core, table_store):
+        self.core = ingest_core
+        self.table_store = table_store
+        self._connectors: dict[str, DynamicTraceConnector] = {}
+        self._sub = bus.subscribe(TRACEPOINT_TOPIC)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            msg = self._sub.get(timeout=0.05)
+            if msg is None:
+                continue
+            if msg["type"] == "tracepoint_deploy":
+                self.deploy(_dep_from_json(json.dumps(msg["deployment"]).encode()))
+            elif msg["type"] == "tracepoint_delete":
+                self.remove(msg["name"])
+
+    def deploy(self, dep: TracepointDeployment) -> None:
+        if dep.name in self._connectors:
+            # UPSERT semantics: replace the running connector so schema/
+            # target/TTL changes take effect (the registry already holds
+            # the new spec).
+            self.remove(dep.name)
+        conn = DynamicTraceConnector(dep)
+        conn.init()
+        self._connectors[dep.name] = conn
+        self.core.register_source(conn)
+        # Publish the new table schema (ref: new schema published after
+        # RegisterTracepoint so PxL can query it).
+        if self.table_store.get_table(dep.table_name) is None:
+            self.table_store.create_table(
+                dep.table_name, dep.output_relation()
+            )
+
+    def remove(self, name: str) -> None:
+        conn = self._connectors.pop(name, None)
+        if conn is not None:
+            conn.stop()
+            self.core.deregister_source(conn)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self._sub.unsubscribe()

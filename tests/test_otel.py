@@ -1,0 +1,113 @@
+"""OTel export sink tests (ref: src/carnot/exec/otel_export_sink_node.h:40
++ the px.otel PxL module, planner/objects/otel.h)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pixie_tpu.engine import Carnot
+from pixie_tpu.types import DataType, Relation
+
+F, I, S, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+
+def _engine():
+    carnot = Carnot()
+    rel = Relation.of(
+        ("time_", T), ("svc", S), ("latency", F), ("code", I)
+    )
+    t = carnot.table_store.create_table("events", rel)
+    t.write_pydict({
+        "time_": np.array([100, 200, 300]),
+        "svc": np.array(["a", "b", "a"], dtype=object),
+        "latency": np.array([1.5, 2.5, 3.5]),
+        "code": np.array([200, 500, 200]),
+    })
+    t.compact()
+    t.stop()
+    return carnot
+
+
+def test_export_gauge_metrics():
+    carnot = _engine()
+    carnot.execute_query(
+        "df = px.DataFrame(table='events')\n"
+        "px.export(df, px.otel.Data(\n"
+        "    resource={'service.name': df.svc, 'cluster': 'test'},\n"
+        "    data=[px.otel.metric.Gauge(name='http.latency',\n"
+        "                               value=df.latency,\n"
+        "                               attributes={'code': df.code})],\n"
+        "))\n"
+    )
+    assert len(carnot.otel_payloads) == 1
+    rms = carnot.otel_payloads[0]["resourceMetrics"]
+    # One resource entry per distinct service.name value, not first-row.
+    by_svc = {}
+    for rm in rms:
+        attrs = {
+            a["key"]: a["value"]["stringValue"]
+            for a in rm["resource"]["attributes"]
+        }
+        assert attrs["cluster"] == "test"
+        by_svc[attrs["service.name"]] = rm["scopeMetrics"][0]["metrics"][0]
+    assert set(by_svc) == {"a", "b"}
+    assert by_svc["a"]["name"] == "http.latency"
+    pts_a = by_svc["a"]["gauge"]["dataPoints"]
+    assert [p["asDouble"] for p in pts_a] == [1.5, 3.5]
+    assert pts_a[0]["timeUnixNano"] == "100"
+    pts_b = by_svc["b"]["gauge"]["dataPoints"]
+    assert [p["asDouble"] for p in pts_b] == [2.5]
+    assert pts_b[0]["attributes"][0]["value"]["stringValue"] == "500"
+
+
+def test_export_spans_and_custom_exporter():
+    sent = []
+    carnot = Carnot(otel_exporter=sent.append)
+    rel = Relation.of(("time_", T), ("svc", S), ("end", T))
+    t = carnot.table_store.create_table("spans", rel)
+    t.write_pydict({
+        "time_": np.array([10, 20]),
+        "svc": np.array(["x", "y"], dtype=object),
+        "end": np.array([15, 29]),
+    })
+    t.compact()
+    t.stop()
+    carnot.execute_query(
+        "df = px.DataFrame(table='spans')\n"
+        "px.export(df, px.otel.Data(\n"
+        "    resource={'service.name': df.svc},\n"
+        "    data=[px.otel.trace.Span(name=df.svc, start_time=df.time_,\n"
+        "                             end_time=df.end)],\n"
+        "    endpoint=px.otel.Endpoint('collector:4317'),\n"
+        "))\n"
+    )
+    assert len(sent) == 1
+    assert sent[0]["endpoint"] == "collector:4317"
+    # One resource group per service value.
+    by_svc = {}
+    for rs in sent[0]["resourceSpans"]:
+        svc = rs["resource"]["attributes"][0]["value"]["stringValue"]
+        by_svc[svc] = rs["scopeSpans"][0]["spans"]
+    assert set(by_svc) == {"x", "y"}
+    assert by_svc["y"][0]["name"] == "y"
+    assert by_svc["y"][0]["startTimeUnixNano"] == "20"
+    assert by_svc["y"][0]["endTimeUnixNano"] == "29"
+
+
+def test_export_requires_service_name():
+    import pytest
+
+    from pixie_tpu.compiler.objects import CompilerError
+
+    carnot = _engine()
+    with pytest.raises(CompilerError):
+        carnot.execute_query(
+            "df = px.DataFrame(table='events')\n"
+            "px.export(df, px.otel.Data(resource={'cluster': 'c'},\n"
+            "    data=[px.otel.metric.Gauge(name='m', value=df.latency)]))\n"
+        )

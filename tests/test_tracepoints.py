@@ -1,0 +1,117 @@
+"""Dynamic-trace mutation tests: pxtrace compile -> registry -> deploy ->
+queryable table (ref: SURVEY §3.4 call stack; probes.h:213,
+mutation_executor.go, pem/tracepoint_manager)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from pixie_tpu.compiler.errors import CompilerError
+from pixie_tpu.compiler.probes import compile_trace, is_mutation_script, parse_ttl
+from pixie_tpu.vizier.bus import MessageBus
+from pixie_tpu.vizier.datastore import Datastore
+from pixie_tpu.vizier.mutation import (
+    MutationExecutor,
+    TracepointManager,
+    TracepointRegistry,
+)
+
+PROBE_PXL = """
+import pxtrace
+import px
+
+@pxtrace.probe("MyFunc")
+def probe_func():
+    return [{'id': pxtrace.ArgExpr('id')},
+            {'err': pxtrace.RetExpr('$0.a')},
+            {'latency': pxtrace.FunctionLatency()}]
+
+pxtrace.UpsertTracepoint('p1',
+                    'my_func_table',
+                    probe_func,
+                    pxtrace.PodProcess('pl/querybroker'),
+                    "5m")
+"""
+
+
+def test_compile_trace_produces_deployment():
+    assert is_mutation_script(PROBE_PXL)
+    m = compile_trace(PROBE_PXL)
+    assert len(m.deployments) == 1
+    dep = m.deployments[0]
+    assert dep.name == "p1"
+    assert dep.table_name == "my_func_table"
+    assert dep.target_fn == "MyFunc"
+    assert dep.target == "pod:pl/querybroker"
+    assert dep.ttl_ns == 5 * 60 * 10**9
+    assert [(c.name, c.kind) for c in dep.columns] == [
+        ("id", "arg"), ("err", "ret"), ("latency", "latency"),
+    ]
+    rel = dep.output_relation()
+    assert rel.col_names() == ["time_", "upid", "id", "err", "latency"]
+
+
+def test_probe_without_return_errors():
+    bad = (
+        "import pxtrace\n"
+        "@pxtrace.probe('F')\n"
+        "def p():\n"
+        "    x = 1\n"
+        "pxtrace.UpsertTracepoint('t', 'tb', p, 'target', '1m')\n"
+    )
+    with pytest.raises(CompilerError, match="missing output spec"):
+        compile_trace(bad)
+
+
+def test_parse_ttl():
+    assert parse_ttl("5m") == 300 * 10**9
+    assert parse_ttl("10s") == 10 * 10**9
+    with pytest.raises(CompilerError):
+        parse_ttl("abc")
+
+
+def test_deploy_makes_table_queryable():
+    """End to end: mutation script -> executor -> agent tracepoint manager
+    -> synthetic events flow -> PxL query over the new table."""
+    from pixie_tpu.engine import Carnot
+    from pixie_tpu.ingest.core import IngestCore
+
+    bus = MessageBus()
+    registry = TracepointRegistry(Datastore())
+    executor = MutationExecutor(registry, bus)
+    carnot = Carnot()
+    core = IngestCore()
+    core.wire_to_table_store(carnot.table_store)
+    mgr = TracepointManager(bus, core, carnot.table_store)
+
+    try:
+        m = executor.execute(PROBE_PXL)
+        assert registry.get("p1") is not None
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and "p1" not in mgr._connectors:
+            time.sleep(0.02)
+        assert "p1" in mgr._connectors
+        core.run_as_thread()
+        time.sleep(0.5)
+        core.stop()
+
+        res = carnot.execute_query(
+            "df = px.DataFrame(table='my_func_table')\n"
+            "s = df.agg(n=('time_', px.count),\n"
+            "           lat=('latency', px.quantiles))\n"
+            "px.display(s, 'out')\n"
+        )
+        d = res.table("out")
+        assert d["n"][0] > 0
+
+        # Delete: the connector stops and the registry forgets it.
+        executor.execute("import pxtrace\npxtrace.DeleteTracepoint('p1')\n")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and "p1" in mgr._connectors:
+            time.sleep(0.02)
+        assert "p1" not in mgr._connectors
+        assert registry.get("p1") is None
+    finally:
+        mgr.stop()
