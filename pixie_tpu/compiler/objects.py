@@ -477,7 +477,10 @@ class _OTelData:
                                     "name_column") if f.get(k)]
             refs += [c for _, c in f.get("attributes", ())]
         for r in refs:
-            name = r.expr.name if isinstance(r, ColumnExpr) else r
+            # _col_name first: computed expressions must get the accurate
+            # "must be a plain column reference" error, not a bogus
+            # missing-column complaint about the function name.
+            name = _col_name(r, "spec") if isinstance(r, ColumnExpr) else r
             if not df.relation.has_column(name):
                 raise CompilerError(
                     f"px.otel spec references column {name!r} not present "
@@ -557,6 +560,19 @@ class _OTelModule:
 
     @staticmethod
     def Endpoint(url: str, headers=None, insecure: bool = False) -> str:
+        # Full connection config rides as JSON when more than a URL is
+        # given — silently dropping auth headers would surface as baffling
+        # 401s at the collector.
+        if headers or insecure:
+            import json as _json
+
+            return _json.dumps(
+                {
+                    "url": str(url),
+                    "headers": dict(headers or {}),
+                    "insecure": bool(insecure),
+                }
+            )
         return str(url)
 
 

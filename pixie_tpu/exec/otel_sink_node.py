@@ -132,5 +132,19 @@ class OTelExportSinkNode(SinkNode):
         if res_spans:
             payload["resourceSpans"] = res_spans
         if payload:
-            payload["endpoint"] = self.op.endpoint
-            exporter(payload)
+            # Endpoint travels OUT-OF-BAND: the payload stays a valid OTLP
+            # ExportServiceRequest so `lambda p: post(url, json=p)` is a
+            # drop-in exporter. Exporters that take a second parameter
+            # receive the endpoint config.
+            import inspect
+
+            try:
+                two_arg = (
+                    len(inspect.signature(exporter).parameters) >= 2
+                )
+            except (TypeError, ValueError):  # builtins like deque.append
+                two_arg = False
+            if two_arg:
+                exporter(payload, self.op.endpoint)
+            else:
+                exporter(payload)

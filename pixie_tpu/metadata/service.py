@@ -75,6 +75,12 @@ class MetadataService:
             key = _POD_PREFIX + pod.pod_id
             if deleted:
                 self.store.delete(key)
+                # Processes of a deleted pod are gone too — without this,
+                # historical upids accumulate in the store (and every
+                # rehydrated snapshot) forever.
+                for k, raw in self.store.get_prefix(_UPID_PREFIX):
+                    if raw.decode() == pod.pod_id:
+                        self.store.delete(k)
             else:
                 self.store.set(
                     key, json.dumps(dataclasses.asdict(pod)).encode()
@@ -141,15 +147,26 @@ class MetadataUpdateListener:
             self.manager.apply_update(upids={msg["upid"]: msg["pod_id"]})
         elif kind == "pod" and msg.get("deleted"):
             st = self.manager.current()
+            pod_id = msg["pod"]["pod_id"]
             pods = dict(st.pods)
-            pods.pop(msg["pod"]["pod_id"], None)
+            pods.pop(pod_id, None)
             ip_to_pod = {
                 ip: pid
                 for ip, pid in st.ip_to_pod.items()
-                if pid != msg["pod"]["pod_id"]
+                if pid != pod_id
+            }
+            upid_to_pod = {
+                u: pid
+                for u, pid in st.upid_to_pod.items()
+                if pid != pod_id
             }
             self.manager.set_state(
-                dataclasses.replace(st, pods=pods, ip_to_pod=ip_to_pod)
+                dataclasses.replace(
+                    st,
+                    pods=pods,
+                    ip_to_pod=ip_to_pod,
+                    upid_to_pod=upid_to_pod,
+                )
             )
 
     def stop(self) -> None:

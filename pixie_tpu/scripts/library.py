@@ -75,6 +75,13 @@ class Script:
             return bound
 
         out: list[FuncToExecute] = []
+        if not self.vis.get("globalFuncs") and not any(
+            w.get("func") for w in self.vis.get("widgets", [])
+        ):
+            # Display-only scripts (px/agent_status): the module body calls
+            # px.display itself; nothing to invoke (args were validated by
+            # resolve_variables above).
+            return []
         for gf in self.vis.get("globalFuncs", []):
             out.append(
                 FuncToExecute(
@@ -93,10 +100,6 @@ class Script:
                         output_table=w.get("name", func["name"]),
                     )
                 )
-        if not out:
-            raise CompilerError(
-                f"script {self.name}: vis.json declares no functions"
-            )
         return out
 
 

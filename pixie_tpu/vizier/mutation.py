@@ -52,8 +52,12 @@ class DynamicTraceConnector(SourceConnector):
             DataTable(deployment.table_name, deployment.output_relation())
         ]
 
+    @property
+    def expired(self) -> bool:
+        return time.time_ns() > self._deadline
+
     def transfer_data_impl(self, ctx) -> None:
-        if time.time_ns() > self._deadline:
+        if self.expired:
             return  # TTL expired: the probe stops producing
         n = self.rows_per_sample
         now = time.time_ns()
@@ -101,12 +105,16 @@ class TracepointRegistry:
         ]
 
 
-def _dep_from_json(raw: bytes) -> TracepointDeployment:
+def _dep_from_dict(d: dict) -> TracepointDeployment:
     from pixie_tpu.compiler.probes import TraceColumn
 
-    d = json.loads(raw)
+    d = dict(d)
     d["columns"] = tuple(TraceColumn(**c) for c in d["columns"])
     return TracepointDeployment(**d)
+
+
+def _dep_from_json(raw: bytes) -> TracepointDeployment:
+    return _dep_from_dict(json.loads(raw))
 
 
 class MutationExecutor:
@@ -152,10 +160,15 @@ class TracepointManager:
     def _loop(self) -> None:
         while not self._stop.is_set():
             msg = self._sub.get(timeout=0.05)
+            # Sweep TTL-expired probes: a dead tracepoint must not keep
+            # ticking the ingest loop (the reference expires + removes).
+            for name, conn in list(self._connectors.items()):
+                if conn.expired:
+                    self.remove(name)
             if msg is None:
                 continue
             if msg["type"] == "tracepoint_deploy":
-                self.deploy(_dep_from_json(json.dumps(msg["deployment"]).encode()))
+                self.deploy(_dep_from_dict(msg["deployment"]))
             elif msg["type"] == "tracepoint_delete":
                 self.remove(msg["name"])
 
@@ -170,11 +183,13 @@ class TracepointManager:
         self._connectors[dep.name] = conn
         self.core.register_source(conn)
         # Publish the new table schema (ref: new schema published after
-        # RegisterTracepoint so PxL can query it).
-        if self.table_store.get_table(dep.table_name) is None:
-            self.table_store.create_table(
-                dep.table_name, dep.output_relation()
-            )
+        # RegisterTracepoint so PxL can query it). A re-upsert that CHANGED
+        # the schema must replace the table, or pushes built from the old
+        # relation would KeyError and kill the ingest loop.
+        rel = dep.output_relation()
+        existing = self.table_store.get_table(dep.table_name)
+        if existing is None or existing.relation != rel:
+            self.table_store.create_table(dep.table_name, rel)
 
     def remove(self, name: str) -> None:
         conn = self._connectors.pop(name, None)

@@ -117,3 +117,12 @@ def test_cli_scripts_list_and_run(capsys, tmp_path):
     assert cli.main(["run", str(pxl), "--warm", "0.3", "--limit", "5"]) == 0
     out = capsys.readouterr().out
     assert "by_method" in out and "req_method" in out
+
+
+def test_agent_status_script_runs():
+    """px/agent_status is a display-only bundled script (no vis funcs)."""
+    from pixie_tpu.scripts.library import ScriptLibrary
+
+    res = ScriptLibrary().run(_engine(), "px/agent_status")
+    d = res.table()
+    assert d["agent_id"] == ["local"]

@@ -101,7 +101,8 @@ def test_watch_persist_broadcast_rehydrate(tmp_path):
     st2 = svc2.snapshot()
     assert "p1" not in st2.pods  # deleted stayed deleted
     assert st2.services["s1"].name == "default/web"
-    assert st2.upid_to_pod["1:42:7"] == "p1"
+    # The deleted pod's processes were reaped with it.
+    assert "1:42:7" not in st2.upid_to_pod
     svc2.store.close()
 
 

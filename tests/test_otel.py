@@ -67,7 +67,11 @@ def test_export_gauge_metrics():
 
 def test_export_spans_and_custom_exporter():
     sent = []
-    carnot = Carnot(otel_exporter=sent.append)
+
+    def exporter(payload, endpoint):  # 2-arg: receives endpoint config
+        sent.append((payload, endpoint))
+
+    carnot = Carnot(otel_exporter=exporter)
     rel = Relation.of(("time_", T), ("svc", S), ("end", T))
     t = carnot.table_store.create_table("spans", rel)
     t.write_pydict({
@@ -87,10 +91,12 @@ def test_export_spans_and_custom_exporter():
         "))\n"
     )
     assert len(sent) == 1
-    assert sent[0]["endpoint"] == "collector:4317"
+    payload, endpoint = sent[0]
+    assert endpoint == "collector:4317"
+    assert "endpoint" not in payload  # payload stays pure OTLP
     # One resource group per service value.
     by_svc = {}
-    for rs in sent[0]["resourceSpans"]:
+    for rs in payload["resourceSpans"]:
         svc = rs["resource"]["attributes"][0]["value"]["stringValue"]
         by_svc[svc] = rs["scopeSpans"][0]["spans"]
     assert set(by_svc) == {"x", "y"}
