@@ -454,3 +454,46 @@ def test_mesh_staged_superset_reuse(mesh):
         assert total == pytest.approx(
             float(data["latency"][data["service"] == svc].sum()), rel=1e-9
         )
+
+
+def test_stage_oom_retry_policy(mesh):
+    """Only resource-exhausted staging failures clear the cache and retry;
+    deterministic errors propagate without nuking other tables' staging."""
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    cd, data = seed_carnot(ex)
+    cd.execute_query(SERVICE_STATS_PXL)
+    assert len(ex._staged_cache) == 1
+
+    calls = []
+    orig = ex._stage
+
+    def oom_once(cols, n, key_plan, table):
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of HBM")
+        return orig(cols, n, key_plan, table)
+
+    ex._stage = oom_once
+    # Different time window -> cache miss -> staging path runs.
+    res = cd.execute_query(
+        "df = px.DataFrame(table='http_events', start_time=1)\n"
+        "s = df.groupby(['service']).agg(n=('time_', px.count))\n"
+        "px.display(s, 'out')\n"
+    )
+    assert len(calls) == 2  # failed once, retried once
+    # The OOM handler dropped the pre-existing staged entry before retry.
+    assert len(ex._staged_cache) == 1
+    assert sum(res.table("out")["n"]) > 0
+    assert not ex.fallback_errors
+
+    # Deterministic failure: re-raises into fallback, cache intact.
+    cache_before = len(ex._staged_cache)
+    ex._stage = lambda *a: (_ for _ in ()).throw(ValueError("shape bug"))
+    res2 = cd.execute_query(
+        "df = px.DataFrame(table='http_events', start_time=2)\n"
+        "s = df.groupby(['service']).agg(n=('time_', px.count))\n"
+        "px.display(s, 'out')\n"
+    )
+    assert any("shape bug" in k for k in ex.fallback_errors)
+    assert len(ex._staged_cache) == cache_before  # cache NOT cleared
+    assert sum(res2.table("out")["n"]) > 0  # host engine answered
