@@ -260,17 +260,22 @@ class MeshExecutor:
         if table is None:
             return None
 
-        evaluator = self._make_evaluator(m, registry, func_ctx)
-        if evaluator is None:
-            return None
         specs = self._agg_specs(m, registry)
         if specs is None:
             return None
+        evaluator = self._make_evaluator(m, specs, registry, func_ctx)
+        if evaluator is None:
+            return None
 
-        # Host: read needed source columns.
+        # Host: read needed source columns. UDAs that never read their
+        # column (count) contribute nothing — staging their arg would ship
+        # gigabytes of unread data to HBM.
         base_cols = set()
-        for e in list(m.predicates) + [e for _, e, _ in specs]:
+        for e in m.predicates:
             base_cols |= referenced_columns(e)
+        for _, e, uda in specs:
+            if uda.reads_args:
+                base_cols |= referenced_columns(e)
         key_plan = self._plan_keys(m, table, registry, func_ctx, base_cols)
         if key_plan is None:
             return None
@@ -383,11 +388,12 @@ class MeshExecutor:
         )
 
     # -- compile helpers ----------------------------------------------------
-    def _make_evaluator(self, m: _Match, registry, func_ctx):
+    def _make_evaluator(self, m: _Match, specs, registry, func_ctx):
         named = [(f"pred{i}", p) for i, p in enumerate(m.predicates)]
-        for out_name, agg in m.agg_op.values:
-            for j, a in enumerate(agg.args):
-                named.append((f"arg:{out_name}:{j}", substitute(a, m.col_exprs)))
+        for out_name, arg_e, uda in specs:
+            if not uda.reads_args:
+                continue  # column never read: don't evaluate it either
+            named.append((f"arg:{out_name}:0", arg_e))
         for g in m.agg_op.groups:
             named.append((f"key:{g}", m.col_exprs[g]))
         try:
@@ -413,6 +419,10 @@ class MeshExecutor:
             uda = registry.lookup_uda(agg.name, types)
             if uda is None:
                 return None
+            if not uda.reads_args:
+                # Column never read (count): no arg constraints apply.
+                specs.append((out_name, arg_exprs[0], uda))
+                continue
             if len(arg_exprs) != 1:
                 return None  # single-arg UDAs only on the fast path today
             if any(t == DataType.STRING for t in types) and (
@@ -581,7 +591,11 @@ class MeshExecutor:
         # content-hash LUT so the device sees the same dictionary-independent
         # identity the host AggNode does (agg_node._arg_array).
         for out, arg_e, uda in specs:
-            if uda.string_args == "hash" and isinstance(arg_e, ColumnRef):
+            if (
+                uda.reads_args
+                and uda.string_args == "hash"
+                and isinstance(arg_e, ColumnRef)
+            ):
                 d = table.dictionaries.get(arg_e.name)
                 if d is not None:
                     aux[f"arghash:{arg_e.name}"] = (
@@ -727,11 +741,11 @@ class MeshExecutor:
             gid_base = arrs[-1]
             aux = dict(zip(aux_key_order, arrs[i:-1]))
 
-            def eval_gids(env):
+            def eval_gids(env, blk_mask):
                 if device_key is None:
-                    return jnp.zeros_like(
-                        env[col_names[0]], dtype=jnp.int32
-                    )
+                    # mask always exists; a count-only query may stage NO
+                    # value columns at all.
+                    return jnp.zeros_like(blk_mask, dtype=jnp.int32)
                 if has_key_lut:
                     _, src_col, _ = device_key
                     return key_lut[jnp.maximum(env[src_col], 0)]
@@ -754,7 +768,10 @@ class MeshExecutor:
                 mask = blk_mask
                 for p in preds:
                     mask = mask & evaluator.device_eval(p, env, aux)
-                gids = blk_gids if gids_all is not None else eval_gids(env)
+                gids = (
+                    blk_gids if gids_all is not None
+                    else eval_gids(env, blk_mask)
+                )
                 # This pass owns groups [gid_base, gid_base + capacity);
                 # rows outside it are masked and their updates land on a
                 # clipped (masked-out) slot.
@@ -763,6 +780,12 @@ class MeshExecutor:
                 gids = jnp.clip(gids, 0, capacity - 1)
                 new_states = []
                 for (out, arg_e, uda), st in zip(specs, states):
+                    if not uda.reads_args:
+                        # Column never read; gids is a shape-correct dummy.
+                        new_states.append(
+                            uda.update(st, gids, gids, mask=mask)
+                        )
+                        continue
                     col = evaluator.device_eval(arg_e, env, aux)
                     hkey = (
                         f"arghash:{arg_e.name}"

@@ -107,6 +107,7 @@ def register(r: Registry) -> None:
             merge=lambda a, b: a + b,
             finalize=lambda st: st,
             merge_kind=MergeKind.PSUM,
+            reads_args=False,  # counts rows; never reads the column
             doc="Number of rows in the group.",
         )
 

@@ -92,6 +92,10 @@ class UDA:
     out_semantic: SemanticType | Callable | None = None
     # True when finalize output must be produced on host (e.g. JSON strings).
     host_finalize: bool = False
+    # False when update() ignores its value column (count): the device
+    # pipeline then skips staging/evaluating that column entirely — at
+    # bench scale the count arg is gigabytes of HBM and upload time.
+    reads_args: bool = True
     # Optional split of ``finalize`` for the device pipeline: the numeric
     # reduction (``device_finalize``: state -> [G]/[G,K] array, traceable)
     # fuses into the compiled mesh program so the host never re-uploads

@@ -497,3 +497,38 @@ def test_stage_oom_retry_policy(mesh):
     assert any("shape bug" in k for k in ex.fallback_errors)
     assert len(ex._staged_cache) == cache_before  # cache NOT cleared
     assert sum(res2.table("out")["n"]) > 0  # host engine answered
+
+
+def test_mesh_count_only_ungrouped_offloads(mesh):
+    """count's arg column is never staged (reads_args=False) — and the
+    degenerate count-only, no-groupby, no-filter query (which then stages
+    ZERO value columns) must still offload, deriving shapes from the
+    mask."""
+    from pixie_tpu.utils import metrics_registry
+
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    cd, data = seed_carnot(ex)
+    hits0 = metrics_registry().counter("device_offload_total").value()
+    res = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "s = df.agg(n=('time_', px.count))\n"
+        "px.display(s, 'out')\n"
+    )
+    assert res.table("out")["n"] == [len(data["time_"])]
+    assert not ex.fallback_errors, ex.fallback_errors
+    assert metrics_registry().counter("device_offload_total").value() > hits0
+    # The count arg (time_) was not staged.
+    staged = next(iter(ex._staged_cache.values()))
+    assert "time_" not in staged.blocks
+    # count over a computed STRING arg is fine too (never read).
+    res2 = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "df.skey = df.service + '!'\n"
+        "s = df.groupby(['service']).agg(n=('skey', px.count))\n"
+        "px.display(s, 'out')\n"
+    )
+    assert not ex.fallback_errors, ex.fallback_errors
+    by = dict(zip(res2.table("out")["service"], res2.table("out")["n"]))
+    import collections
+
+    assert by == dict(collections.Counter(data["service"].tolist()))
