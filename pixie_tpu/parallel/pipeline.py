@@ -296,7 +296,8 @@ class MeshExecutor:
         # table writes. The device-LUT key path is safe: staged blocks hold
         # raw codes and the LUT is recomputed and passed as an argument.
         cacheable = key_plan.host_gids is None or not any(
-            _uses_ctx_func(m.col_exprs[g], registry) for g in m.agg_op.groups
+            _uses_ctx_func(m.col_exprs[g], m.source_relation, registry)
+            for g in m.agg_op.groups
         )
         cache_key = (
             m.source_op.table_name,
@@ -366,12 +367,14 @@ class MeshExecutor:
                     self._staged_cache.popitem(last=False)
                     _STAGED_EVICTIONS.inc(reason="lru")
         aux = self._build_aux(evaluator, m, key_plan, table, specs)
-        merged = self._run_program(m, specs, evaluator, key_plan, staged, aux)
+        merged, capacity = self._run_program(
+            m, specs, evaluator, key_plan, staged, aux
+        )
         if m.agg_op.stage == AggStage.PARTIAL:
             batch = self._partial_state_batch(m, specs, key_plan, merged, table)
         else:
             batch = self._finalize(
-                m, specs, key_plan, staged, merged, registry, table
+                m, specs, key_plan, capacity, merged, registry, table
             )
         return m.agg_nid, batch
 
@@ -482,7 +485,8 @@ class MeshExecutor:
         # pay one vectorized pass, cached per table version + key exprs —
         # except when keys depend on mutable metadata state).
         kp_cacheable = not any(
-            _uses_ctx_func(m.col_exprs[g], registry) for g in groups
+            _uses_ctx_func(m.col_exprs[g], m.source_relation, registry)
+            for g in groups
         )
         kp_key = (
             m.source_op.table_name,
@@ -954,7 +958,7 @@ class MeshExecutor:
                     self._unpack_outputs(templates, capacity, buf)
                 )
         if n_passes == 1:
-            return per_pass[0]
+            return per_pass[0], capacity
         # Recombine: every leaf (finalized output or state) and the
         # presence counts carry a leading group axis — concatenation
         # reassembles the full gid space across pass windows.
@@ -966,7 +970,7 @@ class MeshExecutor:
             for i in range(len(specs))
         ]
         presence = np.concatenate([vp[1] for vp in per_pass])
-        return values, presence
+        return (values, presence), capacity
 
     # -- finalize -----------------------------------------------------------
     def _partial_state_batch(self, m, specs, key_plan, outputs_and_presence, table):
@@ -1011,10 +1015,13 @@ class MeshExecutor:
         )
 
     def _finalize(
-        self, m, specs, key_plan, staged, outputs_and_presence, registry, table
+        self, m, specs, key_plan, capacity, outputs_and_presence, registry, table
     ):
         values, presence = outputs_and_presence
-        modes, _ = self._finalize_modes(specs, staged.capacity)
+        # Use the SAME per-pass capacity the program was compiled with —
+        # recomputing modes at staged.capacity could disagree with the
+        # packed buffer layout when _pass_plan shrank the window (ADVICE r3).
+        modes, _ = self._finalize_modes(specs, capacity)
         n = max(key_plan.num_groups, 1) if m.agg_op.groups else 1
         rel = m.agg_op.output_relation([_pre_agg_relation(m, registry)], registry)
         # Only observed groups are emitted (host-engine semantics): drop
@@ -1075,12 +1082,25 @@ def _pre_agg_relation(m: _Match, registry):
     ).output_relation([m.source_relation], registry)
 
 
-def _uses_ctx_func(expr, registry) -> bool:
-    """Does the expression call any needs_ctx (metadata-state) UDF? Such
-    results change when k8s metadata churns, with no table write."""
+def _uses_ctx_func(expr, relation, registry) -> bool:
+    """Does the expression call a needs_ctx (metadata-state) UDF? Such
+    results change when k8s metadata churns, with no table write. Resolves
+    the actual overload by argument types; only when typing fails does it
+    fall back to any-overload (conservative: may disable caching, never
+    enables stale results)."""
     if isinstance(expr, FuncCall):
-        for key in list(registry._scalars):
-            if key.name == expr.name and registry._scalars[key].needs_ctx:
+        udf = None
+        try:
+            types = [expr_data_type(a, relation, registry) for a in expr.args]
+            udf = registry.lookup_scalar(expr.name, types)
+        except (KeyError, ValueError):
+            pass
+        if udf is not None:
+            if udf.needs_ctx:
                 return True
-        return any(_uses_ctx_func(a, registry) for a in expr.args)
+        elif any(
+            f.needs_ctx for f in registry.scalar_overloads(expr.name)
+        ):
+            return True
+        return any(_uses_ctx_func(a, relation, registry) for a in expr.args)
     return False

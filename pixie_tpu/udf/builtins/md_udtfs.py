@@ -9,7 +9,6 @@ the FunctionContext's vizier_ctx / table_store / registry
 
 from __future__ import annotations
 
-import time
 
 from pixie_tpu.types import DataType, Relation
 from pixie_tpu.udf.registry import Registry
@@ -46,7 +45,6 @@ def _agent_rows(ctx) -> list[dict]:
 def register(r: Registry) -> None:
     def get_agent_status(ctx):
         rows = _agent_rows(ctx)
-        now = time.time_ns()
         return {
             "agent_id": [a.get("agent_id", "") for a in rows],
             "asid": [int(a.get("asid", 0)) for a in rows],
@@ -55,7 +53,8 @@ def register(r: Registry) -> None:
                 a.get("agent_state", "AGENT_STATE_HEALTHY") for a in rows
             ],
             "last_heartbeat_ns": [
-                int(a.get("last_heartbeat_ns", now)) for a in rows
+                # elapsed ns since heartbeat (duration, not wall clock)
+                int(a.get("last_heartbeat_ns", 0)) for a in rows
             ],
             "kelvin": [bool(a.get("kelvin", False)) for a in rows],
         }

@@ -30,26 +30,37 @@ B = DataType.BOOLEAN
 _PII_PATTERNS = [
     ("EMAIL", re.compile(r"[A-Za-z0-9._%+-]+@[A-Za-z0-9.-]+\.[A-Za-z]{2,}")),
     (
-        # Before IPv6: six colon-separated 2-hex groups parse as both.
+        # Before IPV6: six colon-separated 2-hex groups parse as both.
         "MAC_ADDR",
         re.compile(r"\b(?:[0-9A-Fa-f]{2}[:-]){5}[0-9A-Fa-f]{2}\b"),
     ),
     (
         # Full 8-group form or a compressed '::' form only — a looser
         # colon-hex run would wipe hh:mm:ss timestamps in log text.
-        "IPv6",
+        # Uppercase tags match the reference's emitted format
+        # (pii_ops.cc:123,139 '<REDACTED_IPV4>'/'<REDACTED_IPV6>'; ADVICE r3).
+        "IPV6",
         re.compile(
             r"\b(?:(?:[0-9A-Fa-f]{1,4}:){7}[0-9A-Fa-f]{1,4}"
             r"|(?:[0-9A-Fa-f]{1,4}:)+:(?:[0-9A-Fa-f]{1,4}(?::[0-9A-Fa-f]{1,4})*)?)\b"
         ),
     ),
     (
-        "IPv4",
+        "IPV4",
         re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b"),
     ),
     # IMEI before CC_NUMBER: a dashed IMEI is 15 digits and would
     # otherwise always be swallowed by the credit-card pattern.
     ("IMEI", re.compile(r"\b\d{2}-\d{6}-\d{6}-\d\b")),
+    (
+        # Before CC_NUMBER, whose digit-run pattern would eat an IBAN's
+        # tail (reference parity: pii_ops.cc IBAN rule). Country code +
+        # 2 check digits + 11-30 BBAN chars, optionally space-grouped;
+        # candidates must then pass the ISO 13616 mod-97 check (see
+        # _valid_iban) so uppercase build ids don't get redacted.
+        "IBAN",
+        re.compile(r"\b[A-Z]{2}\d{2}(?: ?[A-Z0-9]{4}){2,7}(?: ?[A-Z0-9]{1,4})?\b"),
+    ),
     (
         "CC_NUMBER",
         re.compile(r"\b(?:\d[ -]?){13,19}\b"),
@@ -58,9 +69,30 @@ _PII_PATTERNS = [
 ]
 
 
+def _valid_iban(candidate: str) -> bool:
+    """ISO 13616 validation: length 15-34 and mod-97 == 1 (letters map to
+    10..35 after rotating the first four chars to the end)."""
+    s = candidate.replace(" ", "")
+    if not 15 <= len(s) <= 34:
+        return False
+    rotated = s[4:] + s[:4]
+    digits = "".join(
+        str(ord(ch) - 55) if ch.isalpha() else ch for ch in rotated
+    )
+    return int(digits) % 97 == 1
+
+
 def _redact_one(s: str) -> str:
     for tag, pat in _PII_PATTERNS:
-        s = pat.sub(f"<REDACTED_{tag}>", s)
+        if tag == "IBAN":
+            s = pat.sub(
+                lambda m: (
+                    "<REDACTED_IBAN>" if _valid_iban(m.group(0)) else m.group(0)
+                ),
+                s,
+            )
+        else:
+            s = pat.sub(f"<REDACTED_{tag}>", s)
     return s
 
 

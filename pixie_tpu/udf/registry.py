@@ -100,6 +100,11 @@ class Registry:
     def scalar_names(self) -> set[str]:
         return {k.name for k in self._scalars}
 
+    def scalar_overloads(self, name: str):
+        """All registered overloads for a scalar name (public accessor so
+        callers never reach into _scalars)."""
+        return [f for k, f in self._scalars.items() if k.name == name]
+
     def uda_names(self) -> set[str]:
         return {k.name for k in self._udas}
 

@@ -83,7 +83,6 @@ class AgentTracker:
         """Rows for the GetAgentStatus UDTF (ref: md_udtfs.h reads the
         agent manager's registry)."""
         now = time.monotonic()
-        now_ns = time.time_ns()
         with self._lock:
             return [
                 {
@@ -95,8 +94,11 @@ class AgentTracker:
                         if now - a["last_seen"] < AGENT_EXPIRY_S
                         else "AGENT_STATE_UNRESPONSIVE"
                     ),
-                    "last_heartbeat_ns": now_ns
-                    - int((now - a["last_seen"]) * 1e9),
+                    # ns SINCE the last heartbeat (elapsed duration), matching
+                    # the reference's ns_since_last_heartbeat column
+                    # (src/vizier/funcs/md_udtfs/md_udtfs_impl.h) and the
+                    # standalone fallback in md_udtfs.py (ADVICE r3).
+                    "last_heartbeat_ns": int((now - a["last_seen"]) * 1e9),
                     "kelvin": a["is_kelvin"],
                 }
                 for i, (aid, a) in enumerate(sorted(self._agents.items()))

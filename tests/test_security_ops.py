@@ -47,14 +47,17 @@ def test_redact_pii():
             "user bob@corp.example logged in from 10.1.2.3",
             "mac 00:1A:2B:3C:4D:5E ssn 123-45-6789",
             "clean text",
+            "iban DE44 5001 0517 5407 3249 31 and fe80::1 done",
         ],
         "px.redact_pii_best_effort(df.s)",
     )
     assert out[0] == (
-        "user <REDACTED_EMAIL> logged in from <REDACTED_IPv4>"
+        # Uppercase tags = the reference's emitted format (pii_ops.cc:123).
+        "user <REDACTED_EMAIL> logged in from <REDACTED_IPV4>"
     )
     assert "<REDACTED_MAC_ADDR>" in out[1] and "<REDACTED_SSN>" in out[1]
     assert out[2] == "clean text"
+    assert "<REDACTED_IBAN>" in out[3] and "<REDACTED_IPV6>" in out[3]
 
 
 def test_normalize_sql_dialects():
