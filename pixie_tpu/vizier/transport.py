@@ -2,13 +2,16 @@
 
 Ref: the reference runs NATS for control (src/common/event/nats.{h,cc},
 messagebus/topic.go) and gRPC TransferResultChunk streams for data
-(src/carnot/exec/grpc_router.h:53, carnotpb/carnot.proto:99). Here one
-framed TCP connection per remote agent carries both: bus publishes /
-subscriptions (control) and bridge register/push frames (data). Row/state
-batches cross as their explicit wire format (RowBatch.to_bytes /
-StateBatch.to_bytes via __reduce__); control messages are structural
-pickles of plain dataclasses — a trusted-cluster assumption the reference
-makes of its NATS bus too.
+(src/carnot/exec/grpc_router.h:53, carnotpb/carnot.proto:99) — both
+TLS-authenticated protobuf planes (src/shared/services/). Here one framed
+TCP connection per remote agent carries both: bus publishes /
+subscriptions (control) and bridge register/push frames (data). Every
+frame crosses as the typed wire format (pixie_tpu/vizier/wire.py — the
+planpb-equivalent closed schema); network bytes are NEVER unpickled.
+Connections start with a mutual HMAC-SHA256 challenge/response over the
+pre-shared ``cluster_secret`` flag — the trusted-cluster floor standing in
+for the reference's TLS+JWT bootstrap. Without a secret configured, only
+loopback binds/connects are allowed.
 
 Topology: the broker process runs a BusTransportServer bound to its local
 MessageBus + BridgeRouter; each remote agent process connects a RemoteBus
@@ -19,20 +22,50 @@ send-only; merge-side consumption happens in the broker process's router.
 
 from __future__ import annotations
 
-import pickle
+import hmac
+import ipaddress
+import logging
+import os
 import socket
 import struct
 import threading
 from typing import Any, Optional
 
 from pixie_tpu.exec.router import BridgeRouter
+from pixie_tpu.utils import flags
+from pixie_tpu.utils.config import define_flag
+from pixie_tpu.vizier import wire
 from pixie_tpu.vizier.bus import MessageBus
 
+define_flag(
+    "cluster_secret",
+    "",
+    help_="Pre-shared secret authenticating transport connections "
+    "(HMAC-SHA256 challenge/response). Empty restricts the transport to "
+    "loopback (ref posture: src/shared/services/ TLS+JWT bootstrap).",
+)
+
 _LEN = struct.Struct(">Q")
+_NONCE_BYTES = 16
+_log = logging.getLogger("pixie_tpu.transport")
+
+
+def _is_loopback(host: str) -> bool:
+    # NOTE: '' binds INADDR_ANY for servers — it is NOT loopback.
+    if host == "localhost":
+        return True
+    try:
+        return ipaddress.ip_address(host).is_loopback
+    except ValueError:
+        return False
+
+
+def _mac(secret: str, nonce: bytes) -> bytes:
+    return hmac.new(secret.encode(), nonce, "sha256").digest()
 
 
 def _send_frame(sock: socket.socket, obj: dict) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = wire.encode(obj)
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
@@ -50,15 +83,76 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return bytes(buf)
 
 
-def _recv_frame(sock: socket.socket) -> Optional[dict]:
+_HANDSHAKE_MAX_FRAME = 1 << 12  # hello/challenge are ~100 bytes
+
+
+def _recv_frame(
+    sock: socket.socket, max_len: Optional[int] = None
+) -> Optional[dict]:
+    """Next decoded frame, or None on EOF. Raises wire.WireError (or a
+    ValueError subclass) on malformed content — callers treat that as a
+    hostile/broken peer and drop the connection. ``max_len`` caps the
+    attacker-controlled length word BEFORE allocation — mandatory for
+    pre-authentication reads, where an 8-byte header could otherwise force
+    a multi-GB bytearray per connection."""
     hdr = _recv_exact(sock, _LEN.size)
     if hdr is None:
         return None
     (n,) = _LEN.unpack(hdr)
+    if max_len is not None and n > max_len:
+        raise wire.WireError(f"frame length {n} exceeds cap {max_len}")
     payload = _recv_exact(sock, n)
     if payload is None:
         return None
-    return pickle.loads(payload)
+    try:
+        frame = wire.decode(payload)
+    except wire.WireError:
+        raise
+    except Exception as e:  # unhashable map keys, bad npy, ...
+        raise wire.WireError(f"malformed frame: {e}") from None
+    if not isinstance(frame, dict) or not isinstance(frame.get("kind"), str):
+        raise wire.WireError("frame is not a kind-tagged message")
+    return frame
+
+
+def _server_handshake(conn: socket.socket, secret: str) -> bool:
+    """Mutual challenge/response (server side). Server challenges first;
+    the client's response proves it holds the secret before any frame is
+    acted on; the server's counter-MAC proves the same to the client."""
+    nonce = os.urandom(_NONCE_BYTES)
+    _send_frame(conn, {"kind": "challenge", "nonce": nonce})
+    frame = _recv_frame(conn, max_len=_HANDSHAKE_MAX_FRAME)
+    if (
+        frame is None
+        or frame.get("kind") != "hello"
+        or not isinstance(frame.get("mac"), bytes)
+        or not isinstance(frame.get("nonce"), bytes)
+        or not hmac.compare_digest(frame["mac"], _mac(secret, nonce))
+    ):
+        return False
+    _send_frame(conn, {"kind": "welcome", "mac": _mac(secret, frame["nonce"])})
+    return True
+
+
+def _client_handshake(sock: socket.socket, secret: str) -> None:
+    frame = _recv_frame(sock, max_len=_HANDSHAKE_MAX_FRAME)
+    if frame is None or frame.get("kind") != "challenge" or not isinstance(
+        frame.get("nonce"), bytes
+    ):
+        raise ConnectionError("transport handshake: no challenge from server")
+    nonce = os.urandom(_NONCE_BYTES)
+    _send_frame(
+        sock,
+        {"kind": "hello", "mac": _mac(secret, frame["nonce"]), "nonce": nonce},
+    )
+    resp = _recv_frame(sock, max_len=_HANDSHAKE_MAX_FRAME)
+    if (
+        resp is None
+        or resp.get("kind") != "welcome"
+        or not isinstance(resp.get("mac"), bytes)
+        or not hmac.compare_digest(resp["mac"], _mac(secret, nonce))
+    ):
+        raise ConnectionError("transport handshake: server failed to authenticate")
 
 
 def _close(sock: socket.socket) -> None:
@@ -88,6 +182,12 @@ class BusTransportServer:
     ):
         self.bus = bus
         self.router = router
+        self._secret = flags.cluster_secret
+        if not self._secret and not _is_loopback(host):
+            raise ValueError(
+                f"refusing to bind transport on non-loopback {host!r} "
+                "without a cluster_secret (set PIXIE_TPU_CLUSTER_SECRET)"
+            )
         self._srv = socket.create_server((host, port))
         self.address = self._srv.getsockname()
         self._stop = threading.Event()
@@ -115,67 +215,107 @@ class BusTransportServer:
         conn_dead = threading.Event()  # per-connection: stops forwarders
         subs: dict[str, tuple] = {}  # topic -> (bus sub, stop event)
         try:
+            try:
+                # Bounded pre-auth hold time: a silent peer must not pin
+                # this thread forever. Cleared once authenticated.
+                conn.settimeout(10.0)
+                if not _server_handshake(conn, self._secret):
+                    _log.warning("transport: rejecting unauthenticated peer")
+                    return
+                conn.settimeout(None)
+            except (wire.WireError, OSError, ConnectionError) as e:
+                _log.warning("transport: handshake failed: %s", e)
+                return
             while not self._stop.is_set():
-                frame = _recv_frame(conn)
+                try:
+                    frame = _recv_frame(conn)
+                except wire.WireError as e:
+                    # Hostile or corrupted peer: drop just this connection.
+                    _log.warning("transport: dropping connection: %s", e)
+                    return
+                except OSError:
+                    return  # closed under us (shutdown or peer reset)
                 if frame is None:
                     return
-                kind = frame["kind"]
-                if kind == "publish":
-                    # May block on a full bounded subscription — that is
-                    # the flow control. Agents ship a separate control
-                    # connection for heartbeats (RemoteBus), so blocking a
-                    # data connection cannot starve liveness.
-                    self.bus.publish(frame["topic"], frame["msg"])
-                elif kind == "subscribe":
-                    if frame["topic"] in subs:
-                        continue
-                    sub = self.bus.subscribe(frame["topic"])
-                    stop = threading.Event()
-                    subs[frame["topic"]] = (sub, stop)
-
-                    def forward(sub=sub, stop=stop, topic=frame["topic"]):
-                        while not (
-                            self._stop.is_set()
-                            or conn_dead.is_set()
-                            or stop.is_set()
-                        ):
-                            msg = sub.get(timeout=0.05)
-                            if msg is None:
-                                continue
-                            try:
-                                with send_lock:
-                                    _send_frame(
-                                        conn,
-                                        {
-                                            "kind": "message",
-                                            "topic": topic,
-                                            "msg": msg,
-                                        },
-                                    )
-                            except OSError:
-                                return
-
-                    ft = threading.Thread(target=forward, daemon=True)
-                    ft.start()
-                elif kind == "unsubscribe":
-                    entry = subs.pop(frame["topic"], None)
-                    if entry is not None:
-                        entry[1].set()
-                        entry[0].unsubscribe()
-                elif kind == "bridge_register":
-                    self.router.register_producer(
-                        frame["query_id"], frame["bridge_id"]
+                try:
+                    self._dispatch(frame, conn, send_lock, conn_dead, subs)
+                except (KeyError, TypeError) as e:
+                    # Wire-valid but schema-invalid (missing/mis-typed
+                    # fields): same hostile-peer treatment as WireError.
+                    _log.warning(
+                        "transport: dropping connection on bad frame: %s", e
                     )
-                elif kind == "bridge_push":
-                    self.router.push(
-                        frame["query_id"], frame["bridge_id"], frame["item"]
-                    )
+                    return
         finally:
             conn_dead.set()
             for sub, stop in subs.values():
                 stop.set()
                 sub.unsubscribe()
             _close(conn)
+
+    def _dispatch(self, frame, conn, send_lock, conn_dead, subs) -> None:
+        kind = frame["kind"]
+        if kind == "publish":
+            # May block on a full bounded subscription — that is
+            # the flow control. Agents ship a separate control
+            # connection for heartbeats (RemoteBus), so blocking a
+            # data connection cannot starve liveness.
+            self.bus.publish(frame["topic"], frame["msg"])
+        elif kind == "subscribe":
+            if frame["topic"] in subs:
+                return
+            sub = self.bus.subscribe(frame["topic"])
+            stop = threading.Event()
+            subs[frame["topic"]] = (sub, stop)
+
+            def forward(sub=sub, stop=stop, topic=frame["topic"]):
+                while not (
+                    self._stop.is_set()
+                    or conn_dead.is_set()
+                    or stop.is_set()
+                ):
+                    msg = sub.get(timeout=0.05)
+                    if msg is None:
+                        continue
+                    try:
+                        with send_lock:
+                            _send_frame(
+                                conn,
+                                {
+                                    "kind": "message",
+                                    "topic": topic,
+                                    "msg": msg,
+                                },
+                            )
+                    except OSError:
+                        return
+                    except wire.WireError as e:
+                        # Local publisher handed the bus a non-encodable
+                        # message (programming error, not a peer issue):
+                        # count it as dropped so lossless consumers fail
+                        # loudly, keep the subscription alive.
+                        sub.dropped += 1
+                        _log.error(
+                            "transport: cannot forward message on %s: %s",
+                            topic,
+                            e,
+                        )
+
+            ft = threading.Thread(target=forward, daemon=True)
+            ft.start()
+        elif kind == "unsubscribe":
+            entry = subs.pop(frame["topic"], None)
+            if entry is not None:
+                entry[1].set()
+                entry[0].unsubscribe()
+        elif kind == "bridge_register":
+            self.router.register_producer(
+                frame["query_id"], frame["bridge_id"]
+            )
+        elif kind == "bridge_push":
+            self.router.push(
+                frame["query_id"], frame["bridge_id"], frame["item"]
+            )
 
     def stop(self) -> None:
         self._stop.set()
@@ -221,7 +361,13 @@ class RemoteBus:
 
     def __init__(self, address):
         self._address = tuple(address)
-        self._sock = socket.create_connection(self._address)
+        self._secret = flags.cluster_secret
+        if not self._secret and not _is_loopback(self._address[0]):
+            raise ValueError(
+                f"refusing to connect to non-loopback {self._address[0]!r} "
+                "without a cluster_secret (set PIXIE_TPU_CLUSTER_SECRET)"
+            )
+        self._sock = self._connect()
         self._send_lock = threading.Lock()
         self._data_sock = None  # opened on first data-plane send
         self._data_lock = threading.Lock()
@@ -231,11 +377,28 @@ class RemoteBus:
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self._address)
+        try:
+            _client_handshake(sock, self._secret)
+        except Exception:
+            _close(sock)
+            raise
+        return sock
+
     def _read_loop(self) -> None:
         while not self._stop.is_set():
             try:
                 frame = _recv_frame(self._sock)
             except OSError:
+                return
+            except wire.WireError as e:
+                # Desynced/corrupt stream: close the socket so the agent's
+                # next operation fails loudly (and the server's forwarders
+                # stop writing into a deaf connection) instead of leaving a
+                # live-looking connection with dead subscriptions.
+                _log.warning("transport: closing desynced connection: %s", e)
+                _close(self._sock)
                 return
             if frame is None:
                 return
@@ -252,7 +415,7 @@ class RemoteBus:
     def _send_data(self, obj: dict) -> None:
         with self._data_lock:
             if self._data_sock is None:
-                self._data_sock = socket.create_connection(self._address)
+                self._data_sock = self._connect()
             _send_frame(self._data_sock, obj)
 
     def publish(self, topic: str, msg: Any) -> None:
