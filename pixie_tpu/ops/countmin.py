@@ -1,13 +1,23 @@
 """Count-min frequency sketch, vectorized over groups.
 
 Net-new UDA (not in the reference — SURVEY.md §6): state is a dense
-[num_groups, depth, width] int64 tensor; update is depth masked segment-sums;
-merge is elementwise add — cross-device merge is a single `lax.psum`.
-Point queries take the min over depth rows (classic CM upper bound).
+[num_groups, depth, width] int64 tensor; update adds per-depth bucket
+counts; merge is elementwise add — cross-device merge is a single
+`lax.psum`. Point queries take the min over depth rows (classic CM upper
+bound).
+
+Update strategy (r4 redesign): bucket pairs come from two native-u32
+hashes (Kirsch–Mitzenmacher double hashing; the old u64 multiply path was
+~5x dearer on TPU), and on TPU each depth's counts are computed
+SORT-BASED — radix-sort the flat (group, bucket) ids, run-length count
+via a reverse cumulative min of run-start indices, and scatter only the
+unique run starts. The scalar unit then touches ~min(n, cells) elements
+instead of n. CPU keeps the direct scatter.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -27,29 +37,34 @@ def init(num_groups: int, depth: int = DEFAULT_DEPTH, width: int = DEFAULT_WIDTH
 
 
 def _buckets(values, depth: int, width: int):
-    """Kirsch–Mitzenmacher double hashing: ONE u64 hash (u64 multiplies are
-    ~3x-emulated on TPU), then bucket_d = (h_lo + d*h_hi) & (width-1) in
-    cheap 32-bit VPU arithmetic. Preserves the CM guarantees to within the
-    usual double-hashing analysis."""
-    h = hashing.hash64(values, seed=1)
-    lo = (h & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
-    hi = (h >> np.uint64(32)).astype(jnp.uint32)
+    """Kirsch–Mitzenmacher double hashing from two u32 hashes:
+    bucket_d = (h1 + d*h2) & (width-1), all in native 32-bit VPU ops."""
+    h1, h2 = hashing.hash32_pair(values, seed=1)
     return [
-        ((lo + jnp.uint32(d) * hi) & jnp.uint32(width - 1)).astype(jnp.int32)
+        ((h1 + jnp.uint32(d) * h2) & jnp.uint32(width - 1)).astype(jnp.int32)
         for d in range(depth)
     ]
 
 
 def update(state, gids, values, mask=None):
     num_groups, depth, width = state.shape
+    nseg = num_groups * width
     outs = []
+    # The sort amortizes only on big blocks: below SORTED_MIN_ROWS the
+    # direct scatter's ~7ns/element beats sort+run-length (r4 measured the
+    # crossover between 2M and 8M rows).
+    use_sorted = (
+        segment.sorted_strategy()
+        and nseg < (1 << 31) - 1
+        and values.shape[0] >= segment.SORTED_MIN_ROWS
+    )
     for bucket in _buckets(values, depth, width):
         flat = segment.flat_segment_ids(gids, bucket, width)
-        outs.append(
-            segment.seg_count(flat, num_groups * width, mask).reshape(
-                num_groups, width
-            )
-        )
+        if use_sorted:
+            counts = segment.sorted_segment_counts(flat, nseg, mask)
+        else:
+            counts = segment.seg_count(flat, nseg, mask)
+        outs.append(counts.reshape(num_groups, width))
     return state + jnp.stack(outs, axis=1)
 
 

@@ -60,3 +60,60 @@ def clz64(x: jax.Array) -> jax.Array:
     for s in (1, 2, 4, 8, 16, 32):
         x = x | (x >> _u64(s))
     return (64 - jax.lax.population_count(x).astype(jnp.int32)).astype(jnp.int32)
+
+
+# -- 32-bit path -------------------------------------------------------------
+# TPU has no native 64-bit integer multiply: every u64 mix above is emulated
+# as several u32 multiplies/adds (~3x). Sketch updates (HLL registers, CM
+# buckets) only need 32 bits of well-mixed entropy per use, so they ride
+# this native-u32 pipeline instead (measured ~5x cheaper per block).
+
+_U32 = jnp.uint32
+
+
+def _u32(c: int):
+    return np.uint32(c)
+
+
+def u32_words(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(lo, hi) uint32 words of any column, via bitcast (no 64-bit ALU)."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float64)
+        w = jax.lax.bitcast_convert_type(x, _U32)  # [..., 2]
+        return w[..., 0], w[..., 1]
+    if x.dtype in (jnp.int64, jnp.uint64):
+        w = jax.lax.bitcast_convert_type(x, _U32)
+        return w[..., 0], w[..., 1]
+    if x.dtype == jnp.bool_:
+        x = x.astype(_U32)
+    return x.astype(_U32), jnp.zeros_like(x, _U32)
+
+
+def mix32(x: jax.Array, seed: int = 0) -> jax.Array:
+    """murmur3 fmix32 — full-avalanche 32-bit mix on the VPU."""
+    x = x.astype(_U32) ^ _u32(seed & 0xFFFFFFFF)
+    x = (x ^ (x >> _u32(16))) * _u32(0x85EBCA6B)
+    x = (x ^ (x >> _u32(13))) * _u32(0xC2B2AE35)
+    return x ^ (x >> _u32(16))
+
+
+def hash32(x: jax.Array, seed: int = 0) -> jax.Array:
+    """Hash any column to uint32 using only native 32-bit ops."""
+    lo, hi = u32_words(x)
+    return mix32(lo ^ mix32(hi, 0x9E3779B9 ^ seed), 0x85EBCA77 ^ seed)
+
+
+def hash32_pair(x: jax.Array, seed: int = 0) -> tuple[jax.Array, jax.Array]:
+    """Two independent uint32 hashes (Kirsch–Mitzenmacher base pair)."""
+    lo, hi = u32_words(x)
+    a = mix32(lo ^ mix32(hi, 0x9E3779B9 ^ seed), 0x85EBCA77 ^ seed)
+    b = mix32(hi ^ mix32(lo, 0xC2B2AE35 ^ seed), 0x27D4EB2F ^ seed)
+    return a, b
+
+
+def clz32(x: jax.Array) -> jax.Array:
+    """Count leading zeros of uint32."""
+    x = x.astype(_U32)
+    for s in (1, 2, 4, 8, 16):
+        x = x | (x >> _u32(s))
+    return (32 - jax.lax.population_count(x).astype(jnp.int32)).astype(jnp.int32)

@@ -17,14 +17,16 @@ import jax
 import jax.numpy as jnp
 
 # Strategy selection: XLA's scatter-add lowers to the TPU's scalar scatter
-# units (~150M rows/s measured on v5e); a one-hot matvec rides the MXU at
-# >2B rows/s for small segment counts. CPU prefers scatter. Tests can pin a
-# strategy via set_strategy().
+# unit (~160M rows/s measured on v5e — and int64 scatter is ~12x worse at
+# ~13M rows/s, the dominant cost of integer group-by sums in round 3); a
+# one-hot matvec/einsum rides the MXU at ~240M rows/s up to a few thousand
+# segments, with cost scaling ~n*num_segments beyond. CPU prefers scatter.
+# Tests can pin a strategy via set_strategy().
 import threading
 
 _FORCE: Optional[str] = None
 _TLS = threading.local()  # per-thread platform hint: agents run in threads
-MATMUL_MAX_SEGMENTS = 128
+MATMUL_MAX_SEGMENTS = 8192
 
 
 def set_strategy(s: Optional[str]) -> None:
@@ -66,6 +68,25 @@ def matmul_strategy(num_segments: int) -> bool:
     return _use_matmul(num_segments)
 
 
+_FORCE_SORTED: Optional[bool] = None
+
+
+def set_sorted_strategy(v: Optional[bool]) -> None:
+    """Force the sort-based sketch-update path on (True) / off (False);
+    None = auto by platform (TPU prefers sort: its scalar scatter costs
+    ~7ns/element, while a radix sort + deduped unique-index scatter is
+    ~4x cheaper at 8M rows — measured r4)."""
+    global _FORCE_SORTED
+    _FORCE_SORTED = v
+
+
+def sorted_strategy() -> bool:
+    if _FORCE_SORTED is not None:
+        return _FORCE_SORTED
+    platform = getattr(_TLS, "hint", None) or jax.default_backend()
+    return platform != "cpu"
+
+
 def _matvec_sum(values_f32, seg_ids, num_segments: int):
     """sum per segment as [1,n]@[n,S] — MXU path, f32 accumulate."""
     oh = jax.nn.one_hot(seg_ids, num_segments, dtype=jnp.float32)
@@ -103,6 +124,131 @@ def _matvec_sum_f64(values, seg_ids, num_segments: int):
     )
 
 
+_LIMB_CHUNK = 1 << 16  # 8-bit limbs: in-chunk f32 sums <= 2^16*255 < 2^24
+
+
+def limb_rows_i64(values) -> list:
+    """Decompose int64 (two's-complement bit pattern) into eight 8-bit
+    limbs as f32 rows. Reconstruction mod 2^64 reproduces exact wrapped
+    int64 sums. Only native 32-bit ALU ops (bitcast + shifts/masks)."""
+    w = jax.lax.bitcast_convert_type(values.astype(jnp.int64), jnp.uint32)
+    rows = []
+    for word in (w[..., 0], w[..., 1]):
+        for sh in (0, 8, 16, 24):
+            rows.append(
+                ((word >> jnp.uint32(sh)) & jnp.uint32(0xFF)).astype(
+                    jnp.float32
+                )
+            )
+    return rows
+
+
+def limb_einsum_sums(rows, seg_ids, num_segments: int):
+    """Exact per-segment sums of non-negative f32 integer rows — each
+    value MUST be an integer in [0, 255] — sharing ONE one-hot:
+    [L, n] -> [L, S] float64.
+
+    Exactness: within a chunk every f32 partial sum is an integer
+    <= chunk (2^16) * 255 < 2^24, so each add is exact; chunk partials
+    are accumulated in f64 (integers < 2^52, exact). Values above 255
+    would overflow the 2^24 exact-integer range of f32 mid-chunk — wider
+    values must be limb-decomposed first (limb_rows_i64). The MXU does
+    the heavy lifting — this replaces the s64 scalar scatter (12x
+    slower)."""
+    V = jnp.stack(rows)  # [L, n]
+    n = V.shape[1]
+    chunk = min(_LIMB_CHUNK, max(n, 1))
+    pad = (-n) % chunk
+    if pad:
+        V = jnp.pad(V, ((0, 0), (0, pad)))
+        seg_ids = jnp.pad(seg_ids, (0, pad))  # pad rows are 0: no-op in sums
+    c = V.shape[1] // chunk
+    oh = jax.nn.one_hot(
+        seg_ids.reshape(c, chunk), num_segments, dtype=jnp.float32
+    )
+    parts = jnp.einsum("vck,cks->vcs", V.reshape(-1, c, chunk), oh)
+    return jnp.sum(parts.astype(jnp.float64), axis=1)  # [L, S]
+
+
+def reconstruct_i64(limb_totals):
+    """[8, S] f64 limb sums -> exact int64 sums (mod 2^64)."""
+    acc = limb_totals[0].astype(jnp.int64)
+    for i in range(1, 8):
+        acc = acc + (limb_totals[i].astype(jnp.int64) << (8 * i))
+    return acc
+
+
+# -- sort-based sketch kernels (TPU fast path) -------------------------------
+# TPU's scalar unit serializes scatters (~7ns/element); a radix sort +
+# deduped unique-index scatter beats it once blocks are big enough to
+# amortize the sort (~4x at 8M rows). Shared by HLL register maxes and
+# count-min bucket counts; the sentinel segment `nseg` collects masked/
+# duplicate rows and lands on a dropped extra slot.
+
+SORTED_MIN_ROWS = 1 << 22  # below this, direct scatter wins (r4 measured)
+
+
+def sorted_segment_counts(flat, nseg: int, mask=None):
+    """Per-segment counts via sort + run-length + unique-index scatter.
+    Exact; int32 result (callers widen)."""
+    n = flat.shape[0]
+    if n == 0:
+        return jnp.zeros(nseg, jnp.int32)
+    if mask is not None:
+        flat = jnp.where(mask, flat, jnp.int32(nseg))
+    ks = jnp.sort(flat)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    first = jnp.concatenate([jnp.ones(1, jnp.bool_), ks[1:] != ks[:-1]])
+    # Index of the next run start AFTER each position: reverse cummin of
+    # start positions (n where not a start).
+    start_at = jnp.where(first, idx, jnp.int32(n))
+    nxt = jnp.flip(
+        jax.lax.cummin(
+            jnp.flip(
+                jnp.concatenate([start_at[1:], jnp.full(1, n, jnp.int32)])
+            )
+        )
+    )
+    runlen = jnp.where(first, nxt - idx, 0)
+    keep = first & (ks < nseg)
+    seg = jnp.where(keep, ks, jnp.int32(nseg))
+    out = (
+        jnp.zeros(nseg + 1, jnp.int32)
+        .at[seg]
+        .add(jnp.where(keep, runlen, 0), mode="drop")
+    )
+    return out[:-1]
+
+
+def sorted_segment_max_small(flat, values, value_bits: int, nseg: int, mask=None):
+    """Per-segment max of small non-negative ints (< 2^value_bits) via a
+    single packed-key sort: key = flat << bits | (max_value - value), so
+    each segment's LARGEST value sorts first and the first-occurrence mask
+    yields unique scatter indices. Requires (nseg+1) << value_bits < 2^31.
+    Returns int32 maxes (0 for empty segments)."""
+    n = flat.shape[0]
+    if n == 0:
+        return jnp.zeros(nseg, jnp.int32)
+    vmax = jnp.int32((1 << value_bits) - 1)
+    key = (flat << value_bits) | (vmax - values)
+    if mask is not None:
+        key = jnp.where(mask, key, jnp.int32(nseg << value_bits))
+    ks = jnp.sort(key)
+    flat_s = ks >> value_bits
+    val_s = vmax - (ks & vmax)
+    first = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), flat_s[1:] != flat_s[:-1]]
+    )
+    keep = first & (flat_s < nseg)
+    idx = jnp.where(keep, flat_s, nseg)
+    out = (
+        jnp.zeros(nseg + 1, jnp.int32)
+        .at[idx]
+        .max(jnp.where(keep, val_s, 0), mode="drop")
+    )
+    return out[:-1]
+
+
 def seg_sum(values, seg_ids, num_segments: int, mask=None):
     if _use_matmul(num_segments) and jnp.issubdtype(
         values.dtype, jnp.floating
@@ -114,6 +260,14 @@ def seg_sum(values, seg_ids, num_segments: int, mask=None):
         if mask is not None:
             v = jnp.where(mask, v, 0.0)
         return _matvec_sum(v, seg_ids, num_segments).astype(values.dtype)
+    if _use_matmul(num_segments) and values.dtype == jnp.int64:
+        # int32 stays on the (fast) s32 scatter; int64 scatter is ~12x
+        # slower than s32, so exact limb sums on the MXU win decisively.
+        v = values if mask is None else jnp.where(mask, values, 0)
+        totals = limb_einsum_sums(
+            limb_rows_i64(v), seg_ids.astype(jnp.int32), num_segments
+        )
+        return reconstruct_i64(totals)
     v = values if mask is None else jnp.where(mask, values, 0)
     return jax.ops.segment_sum(v, seg_ids, num_segments=num_segments)
 
@@ -125,11 +279,11 @@ def seg_count(seg_ids, num_segments: int, mask=None):
             if mask is None
             else mask.astype(jnp.float32)
         )
-        # Exact while each call covers < 2^24 rows (blocks are 2^17); the
-        # int accumulation across blocks happens in the UDA state.
-        return jnp.round(
-            _matvec_sum(ones, seg_ids, num_segments)
-        ).astype(jnp.int64)
+        # Chunk-exact at any n: in-chunk f32 sums are integers <= 2^16.
+        totals = limb_einsum_sums(
+            [ones], seg_ids.astype(jnp.int32), num_segments
+        )
+        return totals[0].astype(jnp.int64)
     # Scatter-add in int32 — TPU emulates s64 scatters at ~3x the cost —
     # and widen after: a single call covers one block (< 2^31 rows), so the
     # int32 partial is exact; the int64 accumulation across blocks happens

@@ -766,6 +766,8 @@ class MeshExecutor:
             )
 
             def body(carry, xs):
+                from pixie_tpu.ops import segment as _segment
+
                 states, presence = carry
                 blk_cols, blk_mask, blk_gids = xs
                 env = dict(zip(col_names, blk_cols))
@@ -782,14 +784,8 @@ class MeshExecutor:
                 gids = gids.astype(jnp.int32) - gid_base
                 mask = mask & (gids >= 0) & (gids < capacity)
                 gids = jnp.clip(gids, 0, capacity - 1)
-                new_states = []
-                for (out, arg_e, uda), st in zip(specs, states):
-                    if not uda.reads_args:
-                        # Column never read; gids is a shape-correct dummy.
-                        new_states.append(
-                            uda.update(st, gids, gids, mask=mask)
-                        )
-                        continue
+
+                def eval_col(arg_e, uda):
                     col = evaluator.device_eval(arg_e, env, aux)
                     hkey = (
                         f"arghash:{arg_e.name}"
@@ -800,12 +796,48 @@ class MeshExecutor:
                     if hkey is not None and hkey in aux:
                         lut = aux[hkey]
                         col = lut[jnp.clip(col, 0, lut.shape[0] - 1)]
-                    new_states.append(uda.update(st, gids, col, mask=mask))
-                from pixie_tpu.ops import segment as _segment
+                    return col
 
-                presence = presence + _segment.seg_count(
-                    gids, capacity, mask
-                ).astype(presence.dtype)
+                # Fused-sum lane: every sum-family UDA contributes f32 limb
+                # rows to ONE shared one-hot einsum (plus the engine's
+                # presence row) — the one-hot generation dominates MXU
+                # segment sums, so per-UDA calls pay it k+1 times (r4).
+                use_fused = _segment.matmul_strategy(capacity)
+                fused_slices: dict[str, tuple[int, int]] = {}
+                totals = None
+                if use_fused:
+                    rows = []
+                    for out, arg_e, uda in specs:
+                        if uda.fused_rows is None:
+                            continue
+                        col = (
+                            eval_col(arg_e, uda) if uda.reads_args else None
+                        )
+                        r = uda.fused_rows(col, mask)
+                        fused_slices[out] = (len(rows), len(rows) + len(r))
+                        rows.extend(r)
+                    rows.append(mask.astype(jnp.float32))  # presence
+                    totals = _segment.limb_einsum_sums(rows, gids, capacity)
+                    presence = presence + totals[-1].astype(presence.dtype)
+                else:
+                    presence = presence + _segment.seg_count(
+                        gids, capacity, mask
+                    ).astype(presence.dtype)
+                new_states = []
+                for (out, arg_e, uda), st in zip(specs, states):
+                    if out in fused_slices:
+                        a, b = fused_slices[out]
+                        new_states.append(uda.fused_apply(st, totals[a:b]))
+                        continue
+                    if not uda.reads_args:
+                        # Column never read; gids is a shape-correct dummy.
+                        new_states.append(
+                            uda.update(st, gids, gids, mask=mask)
+                        )
+                        continue
+                    new_states.append(
+                        uda.update(st, gids, eval_col(arg_e, uda), mask=mask)
+                    )
                 return (tuple(new_states), presence), None
 
             xs = (

@@ -31,14 +31,36 @@ def register(r: Registry) -> None:
             zero = jnp.zeros_like(st)
             return jnp.where(st == ident, zero, st)
 
+        if arg_t in (S, B):
+            # Dictionary codes / bools fit int32, and TPU s64 scatter-max
+            # is ~12x the cost of s32 (r4 measurement) — reduce each block
+            # in int32, widen once per block, and remap the int32 identity
+            # (all-masked segments) back to the int64 identity.
+            i32_min = jnp.iinfo(jnp.int32).min
+
+            def update(st, gids, col, mask=None):
+                m32 = segment.seg_max(
+                    col.astype(jnp.int32), gids, st.shape[0], mask
+                )
+                m64 = jnp.where(m32 == i32_min, ident, m32.astype(dtype))
+                return jnp.maximum(st, m64)
+
+        else:
+
+            def update(st, gids, col, mask=None):
+                return jnp.maximum(
+                    st,
+                    segment.seg_max(
+                        col.astype(dtype), gids, st.shape[0], mask
+                    ),
+                )
+
         return UDA(
             name="any",
             arg_types=(arg_t,),
             out_type=arg_t,
             init=lambda g: jnp.full((g,), ident, dtype),
-            update=lambda st, gids, col, mask=None: jnp.maximum(
-                st, segment.seg_max(col.astype(dtype), gids, st.shape[0], mask)
-            ),
+            update=update,
             merge=jnp.maximum,
             finalize=fin,
             merge_kind=MergeKind.PMAX,

@@ -108,6 +108,8 @@ def register(r: Registry) -> None:
             finalize=lambda st: st,
             merge_kind=MergeKind.PSUM,
             reads_args=False,  # counts rows; never reads the column
+            fused_rows=lambda col, mask: [mask.astype(jnp.float32)],
+            fused_apply=lambda st, t: st + t[0].astype(jnp.int64),
             doc="Number of rows in the group.",
         )
 
@@ -115,6 +117,20 @@ def register(r: Registry) -> None:
         r.register_uda(count_uda(t))
 
     def sum_uda(arg_t, out_t, acc_dtype):
+        if acc_dtype == jnp.int64:
+            if arg_t == B:
+                # Bool sums are counts of trues: one f32 row suffices.
+                fused_rows = lambda col, mask: [
+                    (col & mask).astype(jnp.float32)
+                ]
+                fused_apply = lambda st, t: st + t[0].astype(jnp.int64)
+            else:
+                fused_rows = lambda col, mask: segment.limb_rows_i64(
+                    jnp.where(mask, col.astype(jnp.int64), 0)
+                )
+                fused_apply = lambda st, t: st + segment.reconstruct_i64(t)
+        else:
+            fused_rows = fused_apply = None  # f64 keeps its own chunked path
         return UDA(
             name="sum",
             arg_types=(arg_t,),
@@ -126,6 +142,8 @@ def register(r: Registry) -> None:
             finalize=lambda st: st,
             merge_kind=MergeKind.PSUM,
             out_semantic=_preserve_first,
+            fused_rows=fused_rows,
+            fused_apply=fused_apply,
             doc="Sum of the column within the group.",
         )
 

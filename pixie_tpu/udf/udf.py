@@ -117,6 +117,20 @@ class UDA:
     # arg 0; the partial stage then ships that dictionary in the StateBatch
     # and the merge stage translates incoming codes into its own latch.
     string_state: bool = False
+    # Fused-sum lane (r4): sum-family UDAs contribute f32 limb rows to ONE
+    # shared one-hot einsum per block instead of issuing their own segment
+    # reduction — the one-hot generation dominates MXU segment sums, so
+    # batching every sum/count (and the engine's presence counter) into a
+    # single einsum is ~3x cheaper than per-UDA calls (measured r4).
+    #   fused_rows(col, mask) -> list of [n] f32 rows, each value an
+    #     integer in [0, 255] (masked rows must contribute 0). The bound
+    #     is what makes the shared einsum exact: chunk(2^16) * 255 < 2^24
+    #     keeps every f32 partial sum exactly representable. Wider values
+    #     must be limb-decomposed (segment.limb_rows_i64).
+    #   fused_apply(state, totals) -> state, where totals is the [L, G]
+    #     float64 exact per-segment sums of this UDA's rows.
+    fused_rows: Callable[..., list] | None = None
+    fused_apply: Callable[[Any, Any], Any] | None = None
     doc: str = ""
 
     @property

@@ -52,6 +52,116 @@ class TestSegment:
             assert mx[k] == pytest.approx(sel.max())
 
 
+class TestDevicePathKernels:
+    """Force the TPU-side strategies (MXU limb einsum, sort-based sketch
+    updates) on the CPU backend so their exactness is pinned in CI — the
+    real chip runs the same code (r4 kernels replacing the s64 scalar
+    scatters; see ops/segment.py limb_einsum_sums)."""
+
+    def setup_method(self):
+        segment.set_strategy("matmul")
+        segment.set_sorted_strategy(True)
+
+    def teardown_method(self):
+        segment.set_strategy(None)
+        segment.set_sorted_strategy(None)
+
+    def test_int64_limb_sums_exact(self, rng):
+        n, g = 20_000, 37
+        gids = jnp.asarray(rng.integers(0, g, n), dtype=jnp.int32)
+        # Mixed magnitudes incl. negatives and > 2^53 (f64-inexact range).
+        vals_np = np.concatenate(
+            [
+                rng.integers(-(1 << 62), 1 << 62, n // 2),
+                rng.integers(-(1 << 20), 1 << 20, n - n // 2),
+            ]
+        )
+        rng.shuffle(vals_np)
+        mask_np = rng.random(n) < 0.8
+        got = np.asarray(
+            segment.seg_sum(
+                jnp.asarray(vals_np), gids, g, jnp.asarray(mask_np)
+            )
+        )
+        np_g = np.asarray(gids)
+        for k in range(g):
+            sel = vals_np[(np_g == k) & mask_np]
+            # Exact wrapped int64 arithmetic, not approximate.
+            want = np.sum(sel.astype(np.uint64), dtype=np.uint64).astype(
+                np.int64
+            )
+            assert got[k] == want, k
+
+    def test_count_exact_and_int32_path(self, rng):
+        n, g = 30_000, 11
+        gids = jnp.asarray(rng.integers(0, g, n), dtype=jnp.int32)
+        mask = jnp.asarray(rng.random(n) < 0.5)
+        got = np.asarray(segment.seg_count(gids, g, mask))
+        np_g, np_m = np.asarray(gids), np.asarray(mask)
+        for k in range(g):
+            assert got[k] == ((np_g == k) & np_m).sum()
+
+    def test_hll_sorted_matches_scatter(self, rng):
+        n, g = 50_000, 5
+        gids = jnp.asarray(rng.integers(0, g, n), dtype=jnp.int32)
+        vals = jnp.asarray(rng.integers(0, 3000, n), dtype=jnp.int64)
+        mask = jnp.asarray(rng.random(n) < 0.9)
+        st_sorted = hll.update(hll.init(g), gids, vals, mask)
+        segment.set_sorted_strategy(False)
+        st_scatter = hll.update(hll.init(g), gids, vals, mask)
+        np.testing.assert_array_equal(
+            np.asarray(st_sorted), np.asarray(st_scatter)
+        )
+        # And the estimates are sane.
+        est = np.asarray(hll.estimate(st_sorted))
+        np_g, np_m = np.asarray(gids), np.asarray(mask)
+        np_v = np.asarray(vals)
+        for k in range(g):
+            true = len(np.unique(np_v[(np_g == k) & np_m]))
+            assert abs(est[k] - true) <= 0.15 * true
+
+    def test_countmin_sorted_matches_scatter(self, rng):
+        n, g = 40_000, 3
+        gids = jnp.asarray(rng.integers(0, g, n), dtype=jnp.int32)
+        vals = jnp.asarray(rng.integers(0, 50, n), dtype=jnp.int64)
+        mask = jnp.asarray(rng.random(n) < 0.85)
+        st_sorted = countmin.update(
+            countmin.init(g, depth=3, width=1024), gids, vals, mask
+        )
+        segment.set_sorted_strategy(False)
+        st_scatter = countmin.update(
+            countmin.init(g, depth=3, width=1024), gids, vals, mask
+        )
+        np.testing.assert_array_equal(
+            np.asarray(st_sorted), np.asarray(st_scatter)
+        )
+        # Point queries bound true counts from above (CM guarantee) and
+        # total mass per depth row equals the masked row count.
+        np_g, np_v, np_m = map(np.asarray, (gids, vals, mask))
+        q = np.asarray(
+            countmin.query(st_sorted, gids[:200], vals[:200])
+        )
+        for i in range(200):
+            true = (
+                (np_g == np_g[i]) & (np_v == np_v[i]) & np_m
+            ).sum()
+            assert q[i] >= true
+        per_depth = np.asarray(st_sorted).sum(axis=2)
+        for k in range(g):
+            assert (per_depth[k] == ((np_g == k) & np_m).sum()).all()
+
+    def test_hash32_properties(self):
+        x = jnp.arange(5000, dtype=jnp.int64) * 1_000_003
+        h = np.asarray(hashing.hash32(x))
+        assert len(np.unique(h)) > 4990  # few collisions
+        a, b = hashing.hash32_pair(x)
+        assert (np.asarray(a) != np.asarray(b)).mean() > 0.99
+        f = np.asarray(hashing.hash32(x.astype(jnp.float64)))
+        assert len(np.unique(f)) > 4990
+        got = np.asarray(hashing.clz32(jnp.asarray([1, 2**31, 255], dtype=jnp.uint32)))
+        assert got.tolist() == [31, 0, 24]
+
+
 class TestHistogram:
     def test_quantiles_relative_error(self, rng):
         spec = histogram.DEFAULT_SPEC

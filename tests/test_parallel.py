@@ -532,3 +532,41 @@ def test_mesh_count_only_ungrouped_offloads(mesh):
     import collections
 
     assert by == dict(collections.Counter(data["service"].tolist()))
+
+
+def test_mesh_fused_sum_lane_forced_matmul(mesh):
+    """Force the TPU strategies (fused limb einsum + sorted sketches) on
+    the CPU mesh: int64 sums, bool sums, counts, and HLL must stay exact
+    vs numpy truth through the full device pipeline (r4 kernels)."""
+    from pixie_tpu.ops import segment as _segment
+
+    _segment.set_strategy("matmul")
+    _segment.set_sorted_strategy(True)
+    try:
+        cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+        q = (
+            "df = px.DataFrame(table='http_events')\n"
+            "df.failure = df.resp_status >= 400\n"
+            "s = df.groupby(['service']).agg(\n"
+            "    status_sum=('resp_status', px.sum),\n"
+            "    failures=('failure', px.sum),\n"
+            "    n=('time_', px.count),\n"
+            "    distinct=('resp_status', px.approx_count_distinct),\n"
+            ")\n"
+            "px.display(s, 'out')\n"
+        )
+        rows = cd.execute_query(q).table("out")
+        by = {s: i for i, s in enumerate(rows["service"])}
+        for svc in "abc":
+            m = data["service"] == svc
+            i = by[svc]
+            assert rows["status_sum"][i] == int(data["resp_status"][m].sum())
+            assert rows["failures"][i] == int(
+                (data["resp_status"][m] >= 400).sum()
+            )
+            assert rows["n"][i] == int(m.sum())
+            # 3 distinct statuses; HLL is near-exact at this cardinality
+            assert rows["distinct"][i] == 3
+    finally:
+        _segment.set_strategy(None)
+        _segment.set_sorted_strategy(None)
