@@ -1,0 +1,179 @@
+"""Socket-trace connector: byte streams → protocol tables.
+
+Ref: socket_trace_connector.h:89 — the reference's flagship connector
+attaches eBPF probes, reassembles per-connection byte streams through
+ConnTrackers, parses protocol frames, stitches request/response pairs,
+and appends rows to per-protocol tables. On TPU hosts the eBPF capture
+layer is out of scope (BASELINE: collection stays CPU-side), so this
+connector consumes *socket events* — (conn, direction, position, bytes,
+timestamp) tuples — from replayed captures or synthetic workloads, and
+runs the SAME userspace pipeline: ConnTracker → DataStreamBuffer →
+parser → stitcher → http_events / dns_events rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+from pixie_tpu.ingest.http_gen import HTTP_EVENTS_REL
+from pixie_tpu.ingest.source_connector import DataTable, SourceConnector
+from pixie_tpu.protocols import dns as dns_proto
+from pixie_tpu.protocols import http as http_proto
+from pixie_tpu.protocols.base import ConnTracker, TraceRole
+from pixie_tpu.types import DataType, Relation, SemanticType
+
+I, S, T = DataType.INT64, DataType.STRING, DataType.TIME64NS
+
+# ref: dns_table.h kDNSElements
+DNS_EVENTS_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("upid", S, SemanticType.ST_UPID),
+    ("remote_addr", S, SemanticType.ST_IP_ADDRESS),
+    ("remote_port", I),
+    ("trace_role", I),
+    ("req_header", S),
+    ("req_body", S),
+    ("resp_header", S),
+    ("resp_body", S),
+    ("latency", I, SemanticType.ST_DURATION_NS),
+)
+
+_PARSERS = {
+    "http": http_proto.HttpParser(),
+    "dns": dns_proto.DnsParser(),
+}
+_ROW_FNS = {
+    "http": http_proto.record_to_row,
+    "dns": dns_proto.record_to_row,
+}
+_TABLE_FOR = {"http": "http_events", "dns": "dns_events"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ConnId:
+    """Ref: conn_id_t — (upid, fd, generation) identifies a connection."""
+
+    upid: str
+    fd: int
+    tsid: int = 0
+
+
+class SocketTraceConnector(SourceConnector):
+    """Drives ConnTrackers from fed socket events (ref:
+    SocketTraceConnector::TransferDataImpl iterating conn trackers)."""
+
+    name = "socket_tracer"
+
+    def __init__(self):
+        super().__init__()
+        self._lock = threading.Lock()
+        self._trackers: dict[ConnId, ConnTracker] = {}
+        self._protocol: dict[ConnId, str] = {}
+
+    def init_impl(self) -> None:
+        self.tables = [
+            DataTable("http_events", HTTP_EVENTS_REL),
+            DataTable("dns_events", DNS_EVENTS_REL),
+        ]
+
+    # -- event feed (the capture boundary) -----------------------------------
+    def conn_open(
+        self,
+        conn: ConnId,
+        protocol: str,
+        role: TraceRole = TraceRole.CLIENT,
+        remote_addr: str = "",
+        remote_port: int = 0,
+    ) -> None:
+        if protocol not in _PARSERS:
+            raise ValueError(f"unsupported protocol {protocol!r}")
+        with self._lock:
+            self._trackers[conn] = ConnTracker(
+                _PARSERS[protocol],
+                upid=conn.upid,
+                remote_addr=remote_addr,
+                remote_port=remote_port,
+                role=role,
+            )
+            self._protocol[conn] = protocol
+
+    def data_event(
+        self,
+        conn: ConnId,
+        direction: str,  # "send" | "recv"
+        pos: int,
+        data: bytes,
+        timestamp_ns: int,
+    ) -> None:
+        """One captured chunk (ref: socket_trace.c data events carry
+        per-direction byte positions so userspace can reassemble)."""
+        with self._lock:
+            tracker = self._trackers.get(conn)
+        if tracker is None:
+            return  # conn never opened (capture raced) — drop, like the ref
+        if direction == "send":
+            tracker.add_send(pos, data, timestamp_ns)
+        else:
+            tracker.add_recv(pos, data, timestamp_ns)
+
+    def conn_close(self, conn: ConnId) -> None:
+        with self._lock:
+            tracker = self._trackers.get(conn)
+        if tracker is not None:
+            tracker.closed = True
+
+    def replay(self, events) -> None:
+        """Feed a sequence of (kind, ...) capture tuples:
+        ("open", conn, protocol, role, remote_addr, remote_port),
+        ("data", conn, direction, pos, bytes, timestamp_ns),
+        ("close", conn)."""
+        for ev in events:
+            kind = ev[0]
+            if kind == "open":
+                self.conn_open(*ev[1:])
+            elif kind == "data":
+                self.data_event(*ev[1:])
+            elif kind == "close":
+                self.conn_close(ev[1])
+            else:
+                raise ValueError(f"unknown capture event {kind!r}")
+
+    # -- the sample step ------------------------------------------------------
+    def transfer_data_impl(self, ctx) -> None:
+        with self._lock:
+            items = list(self._trackers.items())
+        for conn, tracker in items:
+            records = tracker.process_to_records()
+            if not records:
+                continue
+            proto = self._protocol[conn]
+            table = next(
+                t for t in self.tables if t.name == _TABLE_FOR[proto]
+            )
+            row_fn = _ROW_FNS[proto]
+            for rec in records:
+                table.append_record(
+                    **row_fn(
+                        rec,
+                        tracker.upid,
+                        tracker.remote_addr,
+                        tracker.remote_port,
+                        int(tracker.role),
+                    )
+                )
+        # GC closed trackers whose buffers are drained (ref: ConnTracker
+        # disposal after inactivity).
+        with self._lock:
+            for conn in [
+                c
+                for c, t in self._trackers.items()
+                if t.closed
+                and not t.send.buffer.head()
+                and not t.recv.buffer.head()
+                and not t.send.frames
+                and not t.recv.frames
+            ]:
+                del self._trackers[conn]
+                del self._protocol[conn]

@@ -1,0 +1,31 @@
+"""Protocol frame parsing + stitching — Pixie's actual product surface.
+
+Ref: src/stirling/source_connectors/socket_tracer/protocols/ — the
+userspace half of the socket tracer: per-connection byte-stream
+reassembly (common/data_stream_buffer.*), per-protocol frame parsers
+(http/parse.cc, dns/parse.cc, ...), and request/response stitchers
+(http/stitcher.cc, common/timestamp_stitcher.h). eBPF is only the capture
+mechanism; these transforms are pure userspace and run unchanged on TPU
+hosts over replayed or synthetic socket events.
+"""
+
+from pixie_tpu.protocols.base import (
+    ConnTracker,
+    DataStreamBuffer,
+    MessageType,
+    ParseState,
+    Record,
+    TraceRole,
+)
+from pixie_tpu.protocols import dns, http
+
+__all__ = [
+    "ConnTracker",
+    "DataStreamBuffer",
+    "MessageType",
+    "ParseState",
+    "Record",
+    "TraceRole",
+    "dns",
+    "http",
+]

@@ -1,0 +1,302 @@
+"""Protocol framework: stream reassembly, parser interface, conn tracking.
+
+Ref mapping:
+- ``DataStreamBuffer`` ≙ protocols/common/data_stream_buffer.{h,cc}
+  (AlwaysContiguous impl): byte chunks arrive tagged with an absolute
+  stream position + timestamp; the contiguous head is handed to the
+  parser; a gap larger than the buffer allowance fast-forwards past the
+  missing bytes (counted as a data-loss event).
+- ``ProtocolParser`` ≙ the per-protocol template trio in
+  protocols/common/interface.h — find_frame_boundary / parse_frame /
+  stitch.
+- ``ConnTracker`` ≙ conn_tracker.h:88's per-connection state machine:
+  two DataStreams (send/recv), role-based request/response assignment,
+  ProcessToRecords = parse both streams, stitch, emit records.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional
+
+from pixie_tpu.utils import metrics_registry
+from pixie_tpu.utils.config import define_flag, flags
+
+define_flag(
+    "protocol_stream_gap_limit",
+    1 << 20,
+    help_="Bytes a stream buffer may hold waiting for a gap to fill "
+    "before fast-forwarding past the missing data "
+    "(ref: datastream buffer size limits).",
+)
+
+_M = metrics_registry()
+_GAP_SKIPS = _M.counter(
+    "protocol_stream_gap_skips_total",
+    "Stream gaps fast-forwarded (missing capture data).",
+)
+_PARSE_ERRORS = _M.counter(
+    "protocol_parse_errors_total", "Frames that failed protocol parsing."
+)
+
+
+class ParseState(enum.Enum):
+    # Ref: src/stirling/utils/parse_state.h
+    SUCCESS = "success"
+    NEEDS_MORE_DATA = "needs_more_data"
+    INVALID = "invalid"
+    IGNORED = "ignored"
+
+
+class MessageType(enum.Enum):
+    # Ref: message_type_t in bcc_bpf_intf/common.h
+    REQUEST = "request"
+    RESPONSE = "response"
+
+
+class TraceRole(enum.IntEnum):
+    # Ref: endpoint_role_t — numeric values surface in the trace_role column.
+    UNKNOWN = 0
+    CLIENT = 1
+    SERVER = 2
+
+
+@dataclasses.dataclass
+class Frame:
+    """Base parsed frame (ref: FrameBase in common/event_parser.h)."""
+
+    timestamp_ns: int = 0
+
+
+@dataclasses.dataclass
+class Record:
+    """A stitched request/response pair."""
+
+    req: Any = None
+    resp: Any = None
+
+
+class DataStreamBuffer:
+    """Reassembles a directional byte stream from positioned chunks.
+
+    Chunks may arrive out of order (kernel perf buffers do); each carries
+    (stream position, bytes, timestamp). ``head()`` exposes the contiguous
+    prefix; ``consume(n)`` advances past parsed bytes; ``timestamp_at``
+    answers "when did the byte at this position arrive" for frame
+    timestamping (ref: data_stream_buffer.h position/timestamp API).
+    """
+
+    def __init__(self, gap_limit: Optional[int] = None):
+        self._chunks: dict[int, tuple[bytes, int]] = {}  # pos -> (data, ts)
+        self._pos = 0  # stream position of buf start
+        self._buf = bytearray()
+        self._ts_marks: list[tuple[int, int]] = []  # (pos, ts), sorted
+        self._gap_limit = (
+            gap_limit
+            if gap_limit is not None
+            else flags.protocol_stream_gap_limit
+        )
+        self.gap_skips = 0
+
+    def add(self, pos: int, data: bytes, timestamp_ns: int) -> None:
+        if pos + len(data) <= self._pos:
+            return  # duplicate of already-consumed bytes
+        self._chunks[pos] = (bytes(data), timestamp_ns)
+        self._assemble()
+
+    def _assemble(self) -> None:
+        progressed = True
+        while progressed:
+            progressed = False
+            end = self._pos + len(self._buf)
+            for pos in sorted(self._chunks):
+                data, ts = self._chunks[pos]
+                if pos + len(data) <= end:
+                    del self._chunks[pos]  # fully stale
+                    progressed = True
+                elif pos <= end:
+                    take = data[end - pos :]
+                    self._ts_marks.append((end, ts))
+                    self._buf.extend(take)
+                    del self._chunks[pos]
+                    progressed = True
+                    break
+        # Gap handling: if pending out-of-order data exceeds the allowance,
+        # fast-forward to the earliest pending chunk (data loss).
+        pending = sum(len(d) for d, _ in self._chunks.values())
+        if pending > self._gap_limit and self._chunks:
+            nxt = min(self._chunks)
+            if nxt > self._pos + len(self._buf):
+                self.gap_skips += 1
+                _GAP_SKIPS.inc()
+                self._pos = nxt
+                self._buf.clear()
+                self._assemble()
+
+    def head(self) -> bytes:
+        return bytes(self._buf)
+
+    def position(self) -> int:
+        return self._pos
+
+    def consume(self, n: int) -> None:
+        assert 0 <= n <= len(self._buf)
+        self._pos += n
+        del self._buf[:n]
+        self._ts_marks = [
+            (p, t) for p, t in self._ts_marks if p >= self._pos
+        ] or self._ts_marks[-1:]
+
+    def timestamp_at(self, pos: int) -> int:
+        """Arrival timestamp of the chunk covering stream position pos."""
+        best = 0
+        for p, t in self._ts_marks:
+            if p <= pos:
+                best = t
+            else:
+                break
+        return best
+
+
+class ProtocolParser:
+    """Per-protocol behavior (ref: common/interface.h template trio)."""
+
+    name = "base"
+
+    def find_frame_boundary(
+        self, msg_type: MessageType, buf: bytes, start: int
+    ) -> int:
+        """Position of a plausible frame start > start, or -1."""
+        raise NotImplementedError
+
+    def parse_frame(self, msg_type: MessageType, buf: bytes):
+        """(ParseState, bytes_consumed, frame_or_None)."""
+        raise NotImplementedError
+
+    def stitch(self, requests: list, responses: list, state=None):
+        """(records, error_count, requests_kept, responses_kept)."""
+        raise NotImplementedError
+
+
+class _DataStream:
+    """One direction of a connection: buffer + parsed-frame deque
+    (ref: data_stream.h:50)."""
+
+    def __init__(self, parser: ProtocolParser, msg_type: MessageType):
+        self.buffer = DataStreamBuffer()
+        self.frames: list = []
+        self._parser = parser
+        self._msg_type = msg_type
+        self._last_ts = 0
+
+    def parse_loop(self) -> None:
+        """Parse as many frames as the contiguous head allows
+        (ref: event_parser.h ParseFramesLoop)."""
+        while True:
+            buf = self.buffer.head()
+            if not buf:
+                return
+            state, consumed, frame = self._parser.parse_frame(
+                self._msg_type, buf
+            )
+            if state == ParseState.SUCCESS:
+                if frame.timestamp_ns == 0:
+                    # Frames within one captured chunk share its arrival
+                    # timestamp; nudge them monotonic so stitchers see the
+                    # in-stream order (pipelined bursts stay ordered).
+                    frame.timestamp_ns = max(
+                        self.buffer.timestamp_at(self.buffer.position()),
+                        self._last_ts + 1,
+                    )
+                self._last_ts = frame.timestamp_ns
+                self.frames.append(frame)
+                self.buffer.consume(consumed)
+            elif state == ParseState.NEEDS_MORE_DATA:
+                return
+            else:  # INVALID: resync at the next plausible boundary
+                _PARSE_ERRORS.inc(protocol=self._parser.name)
+                nxt = self._parser.find_frame_boundary(
+                    self._msg_type, buf, 1
+                )
+                self.buffer.consume(len(buf) if nxt < 0 else nxt)
+
+
+class ConnTracker:
+    """Per-connection protocol state machine (ref: conn_tracker.h:88).
+
+    ``role`` decides which direction carries requests: a CLIENT conn
+    sends requests; a SERVER conn receives them."""
+
+    def __init__(
+        self,
+        parser: ProtocolParser,
+        upid: str = "",
+        remote_addr: str = "",
+        remote_port: int = 0,
+        role: TraceRole = TraceRole.CLIENT,
+    ):
+        self.parser = parser
+        self.upid = upid
+        self.remote_addr = remote_addr
+        self.remote_port = remote_port
+        self.role = TraceRole(role)
+        # send stream carries requests for clients, responses for servers.
+        if self.role == TraceRole.SERVER:
+            self.send = _DataStream(parser, MessageType.RESPONSE)
+            self.recv = _DataStream(parser, MessageType.REQUEST)
+        else:
+            self.send = _DataStream(parser, MessageType.REQUEST)
+            self.recv = _DataStream(parser, MessageType.RESPONSE)
+        self.protocol_state = None
+        self.closed = False
+
+    def add_send(self, pos: int, data: bytes, timestamp_ns: int) -> None:
+        self.send.buffer.add(pos, data, timestamp_ns)
+
+    def add_recv(self, pos: int, data: bytes, timestamp_ns: int) -> None:
+        self.recv.buffer.add(pos, data, timestamp_ns)
+
+    def process_to_records(self) -> list[Record]:
+        """Parse pending bytes and stitch (ref: ConnTracker::
+        ProcessToRecords)."""
+        self.send.parse_loop()
+        self.recv.parse_loop()
+        if self.role == TraceRole.SERVER:
+            requests, responses = self.recv.frames, self.send.frames
+        else:
+            requests, responses = self.send.frames, self.recv.frames
+        records, errors, req_keep, resp_keep = self.parser.stitch(
+            requests, responses, self.protocol_state
+        )
+        if self.role == TraceRole.SERVER:
+            self.recv.frames, self.send.frames = req_keep, resp_keep
+        else:
+            self.send.frames, self.recv.frames = req_keep, resp_keep
+        if errors:
+            _PARSE_ERRORS.inc(errors, protocol=self.parser.name)
+        return records
+
+
+def stitch_by_timestamp(requests: list, responses: list):
+    """The generic timestamp-order merge stitcher
+    (ref: common/timestamp_stitcher.h:47 StitchMessagesWithTimestampOrder):
+    each response pairs with the latest request older than it; responses
+    with no older request are dropped (counted as errors); unconsumed
+    requests are kept for the next round."""
+    records: list[Record] = []
+    errors = 0
+    cur_req = None
+    ri = 0
+    for resp in responses:
+        while ri < len(requests) and (
+            requests[ri].timestamp_ns <= resp.timestamp_ns
+        ):
+            cur_req = requests[ri]  # newest older request wins
+            ri += 1
+        if cur_req is None:
+            errors += 1
+            continue
+        records.append(Record(req=cur_req, resp=resp))
+        cur_req = None
+    return records, errors, requests[ri:], []

@@ -1,0 +1,261 @@
+"""DNS frame parser + stitcher (the binary protocol).
+
+Ref: protocols/dns/parse.cc (wire-format header + name decompression +
+A/AAAA/CNAME record extraction), protocols/dns/stitcher.cc (header/query/
+answers rendered to JSON; response-led txid matching bounded by
+timestamps), dns_table.h kDNSElements (req_header/req_body/resp_header/
+resp_body string columns).
+
+DNS messages are datagram-framed: one UDP payload = one message, so
+parse_frame consumes whole payloads (the reference parses per-event the
+same way).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import struct
+
+from pixie_tpu.protocols import base
+from pixie_tpu.protocols.base import MessageType, ParseState, Record
+
+_HDR = struct.Struct(">HHHHHH")
+
+TYPE_A = 1
+TYPE_NS = 2
+TYPE_CNAME = 5
+TYPE_AAAA = 28
+_TYPE_NAMES = {TYPE_A: "A", TYPE_AAAA: "AAAA", TYPE_CNAME: "CNAME", TYPE_NS: "NS"}
+
+
+@dataclasses.dataclass
+class ResourceRecord:
+    name: str = ""
+    rtype: int = 0
+    cname: str = ""
+    addr: str = ""
+
+
+@dataclasses.dataclass
+class Frame(base.Frame):
+    """Ref: dns::Frame (types.h) — header fields + parsed records."""
+
+    txid: int = 0
+    flags: int = 0
+    num_queries: int = 0
+    num_answers: int = 0
+    num_auth: int = 0
+    num_addl: int = 0
+    queries: list = dataclasses.field(default_factory=list)
+    answers: list = dataclasses.field(default_factory=list)
+
+    @property
+    def qr(self) -> int:
+        return (self.flags >> 15) & 1
+
+    @property
+    def rcode(self) -> int:
+        return self.flags & 0xF
+
+    def header_json(self) -> str:
+        """Ref: HeaderToJSONString (stitcher.cc:37)."""
+        f = self.flags
+        return json.dumps(
+            {
+                "txid": self.txid,
+                "qr": (f >> 15) & 1,
+                "opcode": (f >> 11) & 0xF,
+                "aa": (f >> 10) & 1,
+                "tc": (f >> 9) & 1,
+                "rd": (f >> 8) & 1,
+                "ra": (f >> 7) & 1,
+                "ad": (f >> 5) & 1,
+                "cd": (f >> 4) & 1,
+                "rcode": f & 0xF,
+                "num_queries": self.num_queries,
+                "num_answers": self.num_answers,
+                "num_auth": self.num_auth,
+                "num_addl": self.num_addl,
+            }
+        )
+
+
+def _decode_name(buf: bytes, pos: int, depth: int = 0) -> tuple[str, int]:
+    """DNS name with compression pointers. Returns (name, next position).
+    Raises ValueError on malformed/looping names."""
+    if depth > 16:
+        raise ValueError("dns name compression loop")
+    labels = []
+    while True:
+        if pos >= len(buf):
+            raise ValueError("dns name past end")
+        n = buf[pos]
+        if n == 0:
+            pos += 1
+            break
+        if n & 0xC0 == 0xC0:
+            if pos + 2 > len(buf):
+                raise ValueError("dns pointer past end")
+            ptr = ((n & 0x3F) << 8) | buf[pos + 1]
+            if ptr >= pos:
+                raise ValueError("dns forward pointer")
+            tail, _ = _decode_name(buf, ptr, depth + 1)
+            labels.append(tail)
+            pos += 2
+            break
+        pos += 1
+        if pos + n > len(buf):
+            raise ValueError("dns label past end")
+        labels.append(buf[pos : pos + n].decode("latin-1"))
+        pos += n
+    return ".".join(l for l in labels if l), pos
+
+
+def _addr_str(rtype: int, rdata: bytes) -> str:
+    import ipaddress
+
+    if rtype == TYPE_A and len(rdata) == 4:
+        return str(ipaddress.IPv4Address(rdata))
+    if rtype == TYPE_AAAA and len(rdata) == 16:
+        return str(ipaddress.IPv6Address(rdata))
+    return ""
+
+
+class DnsParser(base.ProtocolParser):
+    name = "dns"
+
+    def find_frame_boundary(self, msg_type, buf: bytes, start: int) -> int:
+        # Datagram framing: a failed parse drops the datagram; there is no
+        # in-stream resync (matches the reference's per-event parsing).
+        return -1
+
+    def parse_frame(self, msg_type: MessageType, buf: bytes):
+        if len(buf) < _HDR.size:
+            return ParseState.NEEDS_MORE_DATA, 0, None
+        txid, fl, qd, an, ns, ar = _HDR.unpack_from(buf, 0)
+        frame = Frame(
+            txid=txid,
+            flags=fl,
+            num_queries=qd,
+            num_answers=an,
+            num_auth=ns,
+            num_addl=ar,
+        )
+        is_resp = (fl >> 15) & 1
+        if (msg_type == MessageType.RESPONSE) != bool(is_resp):
+            return ParseState.INVALID, 0, None
+        pos = _HDR.size
+        try:
+            for _ in range(qd):
+                name, pos = _decode_name(buf, pos)
+                if pos + 4 > len(buf):
+                    raise ValueError("query past end")
+                qtype = struct.unpack_from(">H", buf, pos)[0]
+                pos += 4
+                frame.queries.append(
+                    ResourceRecord(name=name, rtype=qtype)
+                )
+            for _ in range(an):
+                name, pos = _decode_name(buf, pos)
+                if pos + 10 > len(buf):
+                    raise ValueError("answer past end")
+                rtype, _cls, _ttl, rdlen = struct.unpack_from(
+                    ">HHIH", buf, pos
+                )
+                pos += 10
+                rdata = buf[pos : pos + rdlen]
+                if len(rdata) != rdlen:
+                    raise ValueError("rdata past end")
+                rec = ResourceRecord(name=name, rtype=rtype)
+                if rtype == TYPE_CNAME:
+                    rec.cname, _ = _decode_name(buf, pos)
+                else:
+                    rec.addr = _addr_str(rtype, rdata)
+                frame.answers.append(rec)
+                pos += rdlen
+        except ValueError:
+            return ParseState.INVALID, 0, None
+        # auth/additional sections are skipped (not surfaced in the table)
+        return ParseState.SUCCESS, len(buf), frame
+
+    def stitch(self, requests: list, responses: list, state=None):
+        """Response-led txid matching bounded by timestamps
+        (ref: dns StitchFrames, stitcher.cc:175-219)."""
+        records: list[Record] = []
+        errors = 0
+        consumed: set[int] = set()
+        for resp in responses:
+            found = False
+            for i, req in enumerate(requests):
+                if i in consumed:
+                    continue
+                if req.timestamp_ns > resp.timestamp_ns:
+                    break
+                if req.txid == resp.txid:
+                    records.append(Record(req=req, resp=resp))
+                    consumed.add(i)
+                    found = True
+                    break
+            if not found:
+                errors += 1
+        keep_reqs = [
+            r for i, r in enumerate(requests) if i not in consumed
+        ]
+        return records, errors, keep_reqs, []
+
+
+def _queries_json(frame: Frame) -> str:
+    return json.dumps(
+        {
+            "queries": [
+                {"name": q.name, "type": _TYPE_NAMES.get(q.rtype, "")}
+                for q in frame.queries
+            ]
+        }
+    )
+
+
+def _answers_json(frame: Frame) -> str:
+    answers = []
+    for a in frame.answers:
+        if a.rtype == TYPE_CNAME:
+            answers.append(
+                {
+                    "name": a.name,
+                    "type": _TYPE_NAMES.get(a.rtype, ""),
+                    "cname": a.cname,
+                }
+            )
+        else:
+            answers.append(
+                {
+                    "name": a.name,
+                    "type": _TYPE_NAMES.get(a.rtype, ""),
+                    "addr": a.addr,
+                }
+            )
+    return json.dumps({"answers": answers})
+
+
+def record_to_row(
+    record: Record,
+    upid: str,
+    remote_addr: str,
+    remote_port: int,
+    trace_role: int,
+) -> dict:
+    """A dns_events row (ref: dns_table.h kDNSElements)."""
+    req, resp = record.req, record.resp
+    return {
+        "time_": req.timestamp_ns,
+        "upid": upid,
+        "remote_addr": remote_addr,
+        "remote_port": remote_port,
+        "trace_role": int(trace_role),
+        "req_header": req.header_json(),
+        "req_body": _queries_json(req),
+        "resp_header": resp.header_json(),
+        "resp_body": _answers_json(resp),
+        "latency": max(resp.timestamp_ns - req.timestamp_ns, 0),
+    }

@@ -1,0 +1,281 @@
+"""HTTP/1.x frame parser + stitcher.
+
+Ref: protocols/http/parse.{h,cc} (picohttpparser-based request/response
+parsing, Content-Length and chunked bodies, body truncation at
+http_body_limit_bytes), protocols/http/stitcher.{h,cc} (PreProcessMessage
+content-type filter + gzip inflate, then the generic timestamp-order
+merge of common/timestamp_stitcher.h), and protocols/http/types.h
+(Message/Record shapes feeding http_table.h's http_events columns).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import json
+import zlib
+
+from pixie_tpu.protocols import base
+from pixie_tpu.protocols.base import MessageType, ParseState
+from pixie_tpu.utils.config import define_flag, flags
+
+define_flag(
+    "http_body_limit_bytes",
+    1024,
+    help_="How much of an HTTP body is retained on parse "
+    "(ref: FLAGS_http_body_limit_bytes, parse.cc).",
+)
+
+_METHODS = (
+    b"GET ",
+    b"POST ",
+    b"PUT ",
+    b"DELETE ",
+    b"HEAD ",
+    b"OPTIONS ",
+    b"PATCH ",
+    b"CONNECT ",
+    b"TRACE ",
+)
+
+# content_type column enum (ref: http_table.h HTTPContentType)
+CONTENT_TYPE_UNKNOWN = 0
+CONTENT_TYPE_JSON = 1
+CONTENT_TYPE_GRPC = 2
+
+
+@dataclasses.dataclass
+class Message(base.Frame):
+    """Ref: http::Message (types.h:49)."""
+
+    type: MessageType = MessageType.REQUEST
+    minor_version: int = 0
+    headers: dict = dataclasses.field(default_factory=dict)
+    req_method: str = "-"
+    req_path: str = "-"
+    resp_status: int = -1
+    resp_message: str = "-"
+    body: str = ""
+    body_size: int = 0
+
+
+class HttpParser(base.ProtocolParser):
+    name = "http"
+
+    # -- framing -------------------------------------------------------------
+    def find_frame_boundary(
+        self, msg_type: MessageType, buf: bytes, start: int
+    ) -> int:
+        """Ref: http FindFrameBoundary — scan for a plausible start line."""
+        candidates = []
+        if msg_type == MessageType.RESPONSE:
+            i = buf.find(b"HTTP/1.", start)
+            if i >= 0:
+                candidates.append(i)
+        else:
+            for m in _METHODS:
+                i = buf.find(m, start)
+                if i >= 0:
+                    candidates.append(i)
+        return min(candidates) if candidates else -1
+
+    def parse_frame(self, msg_type: MessageType, buf: bytes):
+        hdr_end = buf.find(b"\r\n\r\n")
+        if hdr_end < 0:
+            return ParseState.NEEDS_MORE_DATA, 0, None
+        head = buf[:hdr_end]
+        lines = head.split(b"\r\n")
+        msg = Message(type=msg_type)
+        try:
+            first = lines[0].decode("latin-1")
+        except Exception:
+            return ParseState.INVALID, 0, None
+        if msg_type == MessageType.REQUEST:
+            parts = first.split(" ")
+            if len(parts) != 3 or not parts[2].startswith("HTTP/1."):
+                return ParseState.INVALID, 0, None
+            msg.req_method, msg.req_path = parts[0], parts[1]
+            try:
+                msg.minor_version = int(parts[2][len("HTTP/1.") :])
+            except ValueError:
+                return ParseState.INVALID, 0, None
+        else:
+            parts = first.split(" ", 2)
+            if not parts[0].startswith("HTTP/1."):
+                return ParseState.INVALID, 0, None
+            try:
+                msg.minor_version = int(parts[0][len("HTTP/1.") :])
+                msg.resp_status = int(parts[1])
+            except (ValueError, IndexError):
+                return ParseState.INVALID, 0, None
+            msg.resp_message = parts[2] if len(parts) > 2 else ""
+        for raw in lines[1:]:
+            name, sep, value = raw.partition(b":")
+            if not sep:
+                return ParseState.INVALID, 0, None
+            # Header lookup is case-insensitive per RFC 7230; the reference
+            # normalizes through its HeadersMap.
+            msg.headers[name.decode("latin-1").strip().title()] = (
+                value.decode("latin-1").strip()
+            )
+        body_start = hdr_end + 4
+        state, consumed = self._parse_body(buf, body_start, msg)
+        if state != ParseState.SUCCESS:
+            return state, 0, None
+        return ParseState.SUCCESS, consumed, msg
+
+    def _parse_body(self, buf: bytes, start: int, msg: Message):
+        """Ref: ParseRequestBody/ParseResponseBody (parse.cc)."""
+        limit = flags.http_body_limit_bytes
+        cl = msg.headers.get("Content-Length")
+        if cl is not None:
+            try:
+                n = int(cl)
+            except ValueError:
+                return ParseState.INVALID, 0
+            if len(buf) - start < n:
+                return ParseState.NEEDS_MORE_DATA, 0
+            body = buf[start : start + n]
+            msg.body = body[:limit].decode("latin-1")
+            msg.body_size = n
+            return ParseState.SUCCESS, start + n
+        if msg.headers.get("Transfer-Encoding", "").lower() == "chunked":
+            return self._parse_chunked(buf, start, msg, limit)
+        # No Content-Length, no Transfer-Encoding: no body (the reference
+        # applies this to requests and to responses like 204/304).
+        msg.body = ""
+        msg.body_size = 0
+        return ParseState.SUCCESS, start
+
+    def _parse_chunked(self, buf: bytes, start: int, msg: Message, limit: int):
+        pos = start
+        body = bytearray()
+        total = 0
+        while True:
+            line_end = buf.find(b"\r\n", pos)
+            if line_end < 0:
+                return ParseState.NEEDS_MORE_DATA, 0
+            size_token = buf[pos:line_end].split(b";", 1)[0].strip()
+            try:
+                size = int(size_token, 16)
+            except ValueError:
+                return ParseState.INVALID, 0
+            pos = line_end + 2
+            if size == 0:
+                # trailer section ends with CRLF
+                trailer_end = buf.find(b"\r\n", pos)
+                if trailer_end < 0:
+                    return ParseState.NEEDS_MORE_DATA, 0
+                while buf[pos:trailer_end]:
+                    pos = trailer_end + 2
+                    trailer_end = buf.find(b"\r\n", pos)
+                    if trailer_end < 0:
+                        return ParseState.NEEDS_MORE_DATA, 0
+                pos = trailer_end + 2
+                msg.body = bytes(body[:limit]).decode("latin-1")
+                msg.body_size = total
+                return ParseState.SUCCESS, pos
+            if len(buf) - pos < size + 2:
+                return ParseState.NEEDS_MORE_DATA, 0
+            if len(body) < limit:
+                body.extend(buf[pos : pos + min(size, limit - len(body))])
+            total += size
+            if buf[pos + size : pos + size + 2] != b"\r\n":
+                return ParseState.INVALID, 0
+            pos += size + 2
+
+    # -- stitching -----------------------------------------------------------
+    def stitch(self, requests: list, responses: list, state=None):
+        """FIFO pairing bounded by timestamps.
+
+        Deliberate divergence from the reference's timestamp-merge
+        (common/timestamp_stitcher.h pairs each response with the LATEST
+        older request, which drops all but the last of a pipelined burst —
+        acknowledged in its own comments): HTTP/1.1 guarantees responses
+        arrive in request order on a connection (RFC 7230 §6.3.2), so the
+        oldest unconsumed request not newer than the response is the
+        correct partner, and pipelined bursts stitch losslessly."""
+        for m in requests:
+            _preprocess(m)
+        for m in responses:
+            _preprocess(m)
+        records: list[base.Record] = []
+        errors = 0
+        ri = 0
+        for resp in responses:
+            if ri < len(requests) and (
+                requests[ri].timestamp_ns <= resp.timestamp_ns
+            ):
+                records.append(base.Record(req=requests[ri], resp=resp))
+                ri += 1
+            else:
+                errors += 1  # response with no preceding request
+        return records, errors, requests[ri:], []
+
+
+def _preprocess(msg: Message) -> None:
+    """Ref: PreProcessMessage (stitcher.cc:46) — body content-type policy +
+    gzip inflate. Idempotent (frames may sit across stitch rounds)."""
+    if getattr(msg, "_preprocessed", False):
+        return
+    msg._preprocessed = True
+    ctype = msg.headers.get("Content-Type", "")
+    if not ctype:
+        if msg.body_size > 0:
+            msg.body = "<removed: unknown content-type>"
+        return
+    if msg.type == MessageType.RESPONSE and not (
+        "json" in ctype or ctype.startswith("text/")
+    ):
+        # Ref default filter: Content-Type:json,Content-Type:text/
+        msg.body = "<removed: non-text content-type>"
+        return
+    if msg.headers.get("Content-Encoding") == "gzip":
+        try:
+            msg.body = gzip.decompress(msg.body.encode("latin-1")).decode(
+                "latin-1", errors="replace"
+            )
+        except (OSError, zlib.error, EOFError):
+            msg.body = "<Failed to gunzip body>"
+
+
+def content_type_enum(record: base.Record) -> int:
+    """Ref: http utils' content-type classification for the table column."""
+    ctype = (record.resp.headers.get("Content-Type", "") if record.resp else "")
+    if "json" in ctype:
+        return CONTENT_TYPE_JSON
+    if "grpc" in ctype:
+        return CONTENT_TYPE_GRPC
+    return CONTENT_TYPE_UNKNOWN
+
+
+def record_to_row(
+    record: base.Record,
+    upid: str,
+    remote_addr: str,
+    remote_port: int,
+    trace_role: int,
+) -> dict:
+    """An http_events row (ref: http_table.h kHTTPElements order)."""
+    req, resp = record.req, record.resp
+    return {
+        "time_": req.timestamp_ns,
+        "upid": upid,
+        "remote_addr": remote_addr,
+        "remote_port": remote_port,
+        "trace_role": int(trace_role),
+        "major_version": 1,
+        "minor_version": req.minor_version,
+        "content_type": content_type_enum(record),
+        "req_headers": json.dumps(req.headers, sort_keys=True),
+        "req_method": req.req_method,
+        "req_path": req.req_path,
+        "req_body": req.body,
+        "req_body_size": req.body_size,
+        "resp_headers": json.dumps(resp.headers, sort_keys=True),
+        "resp_status": resp.resp_status,
+        "resp_message": resp.resp_message,
+        "resp_body": resp.body,
+        "resp_body_size": resp.body_size,
+        "latency": max(resp.timestamp_ns - req.timestamp_ns, 0),
+    }
