@@ -2,10 +2,11 @@
 
 Ref: src/vizier/utils/datastore/datastore.go — a small Get/Set/Delete/
 GetWithPrefix interface with pebble (default), etcd, badger, buntdb
-backends. Here: an in-memory store and a file-backed store whose
-append-only JSON-lines log with periodic compaction fills pebble's role
-(durable metadata that survives agent restarts) without a native KV
-dependency. Values are bytes; keys are '/'-scoped strings.
+backends. Here three backends: in-memory, an append-only JSON-lines log
+with CRC-checked records, torn-tail recovery, and periodic compaction
+(the log-structured store), and a sqlite-backed store in WAL mode (the
+pebble-class durable default — real fsync'd crash safety from a battle-
+tested engine). Values are bytes; keys are '/'-scoped strings.
 """
 
 from __future__ import annotations
@@ -13,7 +14,9 @@ from __future__ import annotations
 import base64
 import json
 import os
+import sqlite3
 import threading
+import zlib
 from typing import Optional
 
 
@@ -63,9 +66,16 @@ class Datastore:
 
 
 class FileDatastore(Datastore):
-    """Durable backend: JSON-lines write-ahead log, replayed at open,
-    compacted when the log grows past ``compact_every`` records (the role
-    pebble plays for the reference's metadata service)."""
+    """Log-structured backend: JSON-lines write-ahead log with a per-record
+    CRC32, replayed at open, compacted when the log grows past
+    ``compact_every`` records (the role pebble plays for the reference's
+    metadata service).
+
+    Crash posture: a record is ``<json>\\t<crc32-hex>\\n``. A torn tail
+    (process killed mid-write) or a bit-flipped record fails the CRC or the
+    JSON parse; replay stops at the first bad record, keeps everything
+    before it, and truncates the log there — the pebble/WAL recovery
+    contract (complete records survive, the torn suffix is discarded)."""
 
     def __init__(self, path: str, compact_every: int = 4096):
         super().__init__()
@@ -73,46 +83,81 @@ class FileDatastore(Datastore):
         self.compact_every = compact_every
         self._writes_since_compact = 0
         self._f = None
+        good_end = 0
         if os.path.exists(path):
-            with open(path) as f:
+            with open(path, "rb") as f:
                 for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    rec = json.loads(line)
-                    if rec.get("v") is None:
-                        self._data.pop(rec["k"], None)
+                    rec = self._parse_record(line)
+                    if rec is None:
+                        break  # torn/corrupt tail: discard from here on
+                    key, value = rec
+                    if value is None:
+                        self._data.pop(key, None)
                     else:
-                        self._data[rec["k"]] = base64.b64decode(rec["v"])
+                        self._data[key] = value
+                    good_end += len(line)
+            if good_end < os.path.getsize(path):
+                with open(path, "r+b") as f:
+                    f.truncate(good_end)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._f = open(path, "a")
+        self._f = open(path, "ab")
+
+    @staticmethod
+    def _parse_record(line: bytes) -> Optional[tuple[str, Optional[bytes]]]:
+        if not line.endswith(b"\n"):
+            return None  # torn write: no terminator
+        body, sep, crc_hex = line.rstrip(b"\n").rpartition(b"\t")
+        if not sep:
+            # Legacy pre-CRC format (plain JSON line, r3): accept it —
+            # treating old logs as torn tails would truncate the whole
+            # store to zero on upgrade. JSON never emits a raw tab byte,
+            # so the formats are unambiguous.
+            body = line.rstrip(b"\n")
+        else:
+            try:
+                if int(crc_hex, 16) != zlib.crc32(body):
+                    return None
+            except ValueError:
+                return None
+        try:
+            rec = json.loads(body)
+            v = rec.get("v")
+            return rec["k"], (None if v is None else base64.b64decode(v))
+        except (ValueError, KeyError, TypeError):
+            return None
+
+    @staticmethod
+    def _format_record(key: str, value: Optional[bytes]) -> bytes:
+        body = json.dumps(
+            {
+                "k": key,
+                "v": base64.b64encode(value).decode()
+                if value is not None
+                else None,
+            }
+        ).encode()
+        return body + b"\t" + format(zlib.crc32(body), "08x").encode() + b"\n"
 
     def _on_write(self, key: str, value: Optional[bytes]) -> None:
         if self._f is None:
             return
-        rec = {
-            "k": key,
-            "v": base64.b64encode(value).decode() if value is not None else None,
-        }
-        self._f.write(json.dumps(rec) + "\n")
+        self._f.write(self._format_record(key, value))
         self._f.flush()
+        os.fsync(self._f.fileno())
         self._writes_since_compact += 1
         if self._writes_since_compact >= self.compact_every:
             self._compact_locked()
 
     def _compact_locked(self) -> None:
         tmp = self.path + ".compact"
-        with open(tmp, "w") as f:
+        with open(tmp, "wb") as f:
             for k, v in sorted(self._data.items()):
-                f.write(
-                    json.dumps(
-                        {"k": k, "v": base64.b64encode(v).decode()}
-                    )
-                    + "\n"
-                )
+                f.write(self._format_record(k, v))
+            f.flush()
+            os.fsync(f.fileno())
         self._f.close()
         os.replace(tmp, self.path)
-        self._f = open(self.path, "a")
+        self._f = open(self.path, "ab")
         self._writes_since_compact = 0
 
     def close(self) -> None:
@@ -120,3 +165,45 @@ class FileDatastore(Datastore):
             if self._f is not None:
                 self._f.close()
                 self._f = None
+
+
+class SqliteDatastore(Datastore):
+    """Durable default backend on sqlite in WAL mode — the pebble-class
+    engine (ref: src/vizier/utils/datastore/pebbledb/ is the reference
+    default). Crash safety comes from sqlite's own journal; every write is
+    a committed transaction."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=FULL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS kv (k TEXT PRIMARY KEY, v BLOB NOT NULL)"
+        )
+        self._db.commit()
+        # Warm the in-memory mirror so reads never touch the DB and the
+        # base-class interface (get/get_prefix under one lock) holds.
+        for k, v in self._db.execute("SELECT k, v FROM kv"):
+            self._data[k] = bytes(v)
+
+    def _on_write(self, key: str, value: Optional[bytes]) -> None:
+        if self._db is None:
+            return
+        if value is None:
+            self._db.execute("DELETE FROM kv WHERE k = ?", (key,))
+        else:
+            self._db.execute(
+                "INSERT INTO kv (k, v) VALUES (?, ?) "
+                "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                (key, bytes(value)),
+            )
+        self._db.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._db is not None:
+                self._db.close()
+                self._db = None
