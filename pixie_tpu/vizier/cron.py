@@ -1,0 +1,203 @@
+"""Cron script runner — scheduled PxL execution in the broker.
+
+Ref: src/vizier/services/query_broker/script_runner/script_runner.go —
+`ScriptRunner` syncs a persisted cron-script set (cloud `cron_script`
+store; ours is the datastore-backed `CronScriptStore`), keeps one `runner`
+per script with a ticker at the script's frequency
+(script_runner.go:90,112), executes each tick through the query engine,
+and forwards results to a sink (cloud plugins there; a table store here —
+the retention-script posture without the SaaS side).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from typing import Callable, Optional
+
+from pixie_tpu.utils import metrics_registry
+from pixie_tpu.vizier.datastore import Datastore
+
+_M = metrics_registry()
+_RUNS = _M.counter("cron_script_runs_total", "Cron script executions.")
+_ERRORS = _M.counter(
+    "cron_script_errors_total", "Cron script executions that failed."
+)
+
+_PREFIX = "/cron_scripts/"
+
+
+class CronScript:
+    """A stored scheduled script (ref: cvmsgspb CronScript fields)."""
+
+    def __init__(
+        self,
+        script_id: str,
+        script: str,
+        frequency_s: float,
+        configs: Optional[dict] = None,
+    ):
+        self.script_id = script_id
+        self.script = script
+        self.frequency_s = float(frequency_s)
+        self.configs = configs or {}
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "script_id": self.script_id,
+                "script": self.script,
+                "frequency_s": self.frequency_s,
+                "configs": self.configs,
+            }
+        ).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "CronScript":
+        d = json.loads(raw)
+        return cls(
+            d["script_id"], d["script"], d["frequency_s"], d.get("configs")
+        )
+
+
+class CronScriptStore:
+    """Persisted cron-script set (ref: metadata controllers/cronscript/
+    backed by the datastore; survives broker restarts)."""
+
+    def __init__(self, datastore: Datastore):
+        self._ds = datastore
+
+    def upsert(self, script: CronScript) -> None:
+        self._ds.set(_PREFIX + script.script_id, script.to_json())
+
+    def delete(self, script_id: str) -> None:
+        self._ds.delete(_PREFIX + script_id)
+
+    def all(self) -> dict[str, CronScript]:
+        return {
+            k[len(_PREFIX) :]: CronScript.from_json(v)
+            for k, v in self._ds.get_prefix(_PREFIX)
+        }
+
+
+class _Runner:
+    """One scheduled script (ref: script_runner.go `runner` struct with its
+    ticker goroutine)."""
+
+    def __init__(self, script: CronScript, execute: Callable, on_error):
+        self.script = script
+        self._execute = execute
+        self._on_error = on_error
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        # Tick at the script frequency; the first run happens one period in
+        # (matches time.NewTicker semantics in script_runner.go:112).
+        while not self._stop.wait(self.script.frequency_s):
+            try:
+                self._execute(self.script)
+                _RUNS.inc(script=self.script.script_id)
+            except Exception as e:  # keep ticking (ref logs and continues)
+                _ERRORS.inc(script=self.script.script_id)
+                self._on_error(self.script, e)
+
+
+class ScriptRunner:
+    """Syncs the stored script set and runs each on schedule.
+
+    ``sink(script, query_result)`` receives each run's result; the default
+    writes every result table into ``result_store`` under
+    ``cron_<script_id>_<table>`` (the reference forwards to cloud plugin
+    retention; a local table store is our retention surface)."""
+
+    def __init__(
+        self,
+        broker,
+        store: CronScriptStore,
+        result_store=None,
+        sink: Optional[Callable] = None,
+        timeout_s: float = 30.0,
+    ):
+        self._broker = broker
+        self.store = store
+        self._result_store = result_store
+        self._sink = sink
+        self._timeout_s = timeout_s
+        self._runners: dict[str, _Runner] = {}
+        self._lock = threading.Lock()
+        self.last_errors: dict[str, str] = {}
+
+    # -- script set management (ref: SyncScripts + update channel) ----------
+    def sync(self) -> None:
+        """Reconcile running tickers with the persisted set."""
+        want = self.store.all()
+        with self._lock:
+            for sid in [s for s in self._runners if s not in want]:
+                self._runners.pop(sid).stop()
+            for sid, script in want.items():
+                cur = self._runners.get(sid)
+                if cur is not None and (
+                    cur.script.script == script.script
+                    and cur.script.frequency_s == script.frequency_s
+                ):
+                    continue
+                if cur is not None:
+                    cur.stop()
+                r = _Runner(script, self._run_one, self._record_error)
+                self._runners[sid] = r
+                r.start()
+
+    def upsert_script(self, script: CronScript) -> None:
+        """Persist + (re)schedule (ref: upsert on the updates channel)."""
+        self.store.upsert(script)
+        self.sync()
+
+    def delete_script(self, script_id: str) -> None:
+        self.store.delete(script_id)
+        self.sync()
+
+    def stop(self) -> None:
+        with self._lock:
+            for r in self._runners.values():
+                r.stop()
+            self._runners.clear()
+
+    # -- execution -----------------------------------------------------------
+    def _run_one(self, script: CronScript) -> None:
+        result = self._broker.execute_script(
+            script.script,
+            timeout_s=self._timeout_s,
+            script_args=script.configs.get("args"),
+        )
+        if self._sink is not None:
+            self._sink(script, result)
+        elif self._result_store is not None:
+            self._store_result(script, result)
+
+    def _store_result(self, script: CronScript, result) -> None:
+        from pixie_tpu.table.row_batch import RowBatch
+
+        for name, batches in result.tables.items():
+            batches = [b for b in batches if b.num_rows]
+            if not batches:
+                continue
+            merged = RowBatch.concat(batches)
+            tname = f"cron_{script.script_id}_{name}"
+            table = self._result_store.get_table(tname)
+            if table is None:
+                table = self._result_store.create_table(
+                    tname, merged.relation
+                )
+            table.write(merged)
+
+    def _record_error(self, script: CronScript, e: Exception) -> None:
+        self.last_errors[script.script_id] = str(e)
