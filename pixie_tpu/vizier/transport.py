@@ -94,7 +94,9 @@ def _recv_frame(
     hostile/broken peer and drop the connection. ``max_len`` caps the
     attacker-controlled length word BEFORE allocation — mandatory for
     pre-authentication reads, where an 8-byte header could otherwise force
-    a multi-GB bytearray per connection."""
+    a multi-GB bytearray per connection; it also disables array/batch
+    nodes, whose forged numpy headers are allocation bombs the length cap
+    cannot see."""
     hdr = _recv_exact(sock, _LEN.size)
     if hdr is None:
         return None
@@ -105,7 +107,7 @@ def _recv_frame(
     if payload is None:
         return None
     try:
-        frame = wire.decode(payload)
+        frame = wire.decode(payload, allow_arrays=max_len is None)
     except wire.WireError:
         raise
     except Exception as e:  # unhashable map keys, bad npy, ...

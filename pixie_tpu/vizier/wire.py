@@ -178,9 +178,33 @@ class _Encoder:
         raise WireError(f"type {type(obj).__name__} is not wire-encodable")
 
 
+def _validated_npy_load(blob: bytes) -> np.ndarray:
+    """np.load that verifies the header-claimed payload size against the
+    actual blob BEFORE np.load allocates — np.empty(shape) happens before
+    any data is read, so a ~100-byte forged header could otherwise demand
+    a 128GiB allocation (verified in r4 review)."""
+    f = io.BytesIO(blob)
+    try:
+        version = np.lib.format.read_magic(f)
+        shape, fortran, dtype = np.lib.format._read_array_header(f, version)
+    except Exception as e:
+        raise WireError(f"bad npy header: {e}") from None
+    if dtype.hasobject:
+        raise WireError("object-dtype arrays are not wire-decodable")
+    expected = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    remaining = len(blob) - f.tell()
+    if expected != remaining:
+        raise WireError(
+            f"npy header claims {expected} payload bytes, blob has {remaining}"
+        )
+    f.seek(0)
+    return np.load(f, allow_pickle=False)
+
+
 class _Decoder:
-    def __init__(self, blobs: list[bytes]):
+    def __init__(self, blobs: list[bytes], allow_arrays: bool = True):
         self.blobs = blobs
+        self.allow_arrays = allow_arrays
 
     def _blob(self, idx: Any) -> bytes:
         if not isinstance(idx, int) or not 0 <= idx < len(self.blobs):
@@ -214,8 +238,9 @@ class _Decoder:
         if "$map" in node:
             return {self.dec(k): self.dec(v) for k, v in node["$map"]}
         if "$np" in node:
-            arr = np.load(io.BytesIO(self._blob(node["$np"])), allow_pickle=False)
-            return arr
+            if not self.allow_arrays:
+                raise WireError("arrays are not allowed in this context")
+            return _validated_npy_load(self._blob(node["$np"]))
         if "$e" in node:
             enum_name, _, member = node["$e"].partition(":")
             cls = _ENUMS.get(enum_name)
@@ -258,10 +283,14 @@ class _Decoder:
                 )
             return cls(**{k: self.dec(v) for k, v in fields.items()})
         if "$rb" in node:
+            if not self.allow_arrays:
+                raise WireError("batches are not allowed in this context")
             from pixie_tpu.table.row_batch import RowBatch
 
             return RowBatch.from_bytes(self._blob(node["$rb"]))
         if "$sb" in node:
+            if not self.allow_arrays:
+                raise WireError("batches are not allowed in this context")
             from pixie_tpu.exec.agg_node import StateBatch
 
             return StateBatch.from_bytes(self._blob(node["$sb"]))
@@ -281,7 +310,11 @@ def encode(obj: Any) -> bytes:
     return out.getvalue()
 
 
-def decode(data: bytes) -> Any:
+def decode(data: bytes, allow_arrays: bool = True) -> Any:
+    """Decode a frame. ``allow_arrays=False`` additionally refuses
+    $np/$rb/$sb nodes — REQUIRED for pre-authentication reads, where a
+    forged numpy header inside a tiny frame is an allocation bomb that the
+    transport's frame-length cap cannot see."""
     if len(data) < _HDR.size:
         raise WireError("short frame")
     magic, version, json_len = _HDR.unpack_from(data, 0)
@@ -306,7 +339,7 @@ def decode(data: bytes) -> Any:
         blobs.append(data[off : off + n])
         off += n
     try:
-        return _Decoder(blobs).dec(tree)
+        return _Decoder(blobs, allow_arrays=allow_arrays).dec(tree)
     except WireError:
         raise
     except (KeyError, TypeError, ValueError, RecursionError) as e:

@@ -9,6 +9,7 @@ behavior (malformed frames, unauthenticated/wrong-secret connections).
 
 from __future__ import annotations
 
+import io
 import socket
 import struct
 import time
@@ -356,3 +357,42 @@ def test_transport_caps_preauth_frame_length():
         s.close()
     finally:
         server.stop()
+
+
+def test_wire_rejects_forged_npy_header():
+    """An npy blob whose header claims far more payload than the blob holds
+    must be refused BEFORE allocation (pre-auth allocation bomb)."""
+    # Write a real npy, then forge its header to claim a 128GiB payload.
+    g = io.BytesIO()
+    np.save(g, np.zeros(4, np.int64))
+    real = g.getvalue()
+    header_end = real.index(b"\n") + 1
+    forged = real[:header_end].replace(b"(4,)", b"(17179869184,)")
+    payload = wire.encode({"k": b"x"})
+    # craft a frame with a $np node referencing the forged blob
+    enc = wire._Encoder()
+    tree = {"$map": [["a", {"$np": enc._blob(forged)}]]}
+    import json as _json, struct as _struct
+
+    body = _json.dumps(tree, separators=(",", ":")).encode()
+    frame = io.BytesIO()
+    frame.write(_struct.pack(">2sBI", b"PW", 1, len(body)))
+    frame.write(body)
+    for b in enc.blobs:
+        frame.write(_struct.pack(">Q", len(b)))
+        frame.write(b)
+    with pytest.raises(wire.WireError):
+        wire.decode(frame.getvalue())
+
+
+def test_wire_allow_arrays_false_refuses_array_nodes():
+    for obj in (
+        {"a": np.arange(3)},
+        {"b": RowBatch.from_pydict(
+            Relation.of(("x", DataType.INT64)), {"x": [1]}
+        )},
+    ):
+        data = wire.encode(obj)
+        assert wire.decode(data)  # allowed by default
+        with pytest.raises(wire.WireError):
+            wire.decode(data, allow_arrays=False)
