@@ -19,10 +19,20 @@ N timed runs — the reference's operator-benchmark methodology
 correctness is asserted against HOST-computed truth accumulated during
 generation (exact counts/error rates; quantiles vs an independent numpy
 log-histogram), so a kernel bug that preserved row counts still fails.
+Cold (first-query: compile + stage) latency is reported separately per
+config alongside the warm steady-state number.
+
+Regression gate: BENCH_DETAIL.json keeps each config's best-ever value;
+any config regressing >10% vs its best marks the gate red (and the
+headline line carries "gate": "red") so non-headline regressions cannot
+ship silently. BENCH_GATE_SELFTEST=1 injects an impossible prior to
+prove the gate trips.
 
 Env knobs: BENCH_ROWS (configs 2/5; default 256M), BENCH_SMALL_ROWS
-(configs 1/3/4; default 8M), BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS
-(comma list, default "1,2,3,4,5").
+(configs 1/3/4; default 64M — large enough that the ~100ms tunnel fetch
+round-trip does not dominate the steady-state metric), BENCH_RUNS,
+BENCH_SERVICES, BENCH_CONFIGS (comma list, default "1,2,3,4,5"),
+BENCH_BLOCK_ROWS (device block size).
 """
 
 import json
@@ -36,6 +46,46 @@ import numpy as np
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+GATE_TOLERANCE = 0.10  # >10% below best-ever trips the gate
+
+
+def load_prior_best(path: str) -> dict:
+    """metric name -> best-ever value from the ledger (accepts the old
+    list format and the current dict format)."""
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    if isinstance(prior, list):  # r3 format
+        return {
+            e["metric"]: e["value"]
+            for e in prior
+            if "metric" in e and "value" in e
+        }
+    best = dict(prior.get("best", {}))
+    for e in prior.get("configs", []):
+        if "metric" in e and "value" in e:
+            best[e["metric"]] = max(best.get(e["metric"], 0), e["value"])
+    return best
+
+
+def apply_gate(detail: list[dict], best: dict) -> dict:
+    """Mark regressions >10% vs best-ever; returns the gate summary."""
+    regressions = []
+    for e in detail:
+        prior = best.get(e["metric"])
+        if prior and e["value"] < prior * (1 - GATE_TOLERANCE):
+            e["regressed_vs_best"] = prior
+            regressions.append(
+                f"{e['metric']}: {e['value']:.3g} < best {prior:.3g}"
+            )
+    return {
+        "status": "red" if regressions else "green",
+        "regressions": regressions,
+    }
 
 
 # Host-truth latency histogram: log-spaced bins, ~0.7% relative bin width —
@@ -75,9 +125,10 @@ def best_of(fn, runs: int):
 
 def main() -> None:
     n_rows = int(os.environ.get("BENCH_ROWS", 256_000_000))
-    n_small = int(os.environ.get("BENCH_SMALL_ROWS", 8_000_000))
+    n_small = int(os.environ.get("BENCH_SMALL_ROWS", 64_000_000))
     n_services = int(os.environ.get("BENCH_SERVICES", 16))
     runs = int(os.environ.get("BENCH_RUNS", 5))
+    block_rows = int(os.environ.get("BENCH_BLOCK_ROWS", 1 << 21))
     configs = {
         c.strip()
         for c in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(",")
@@ -105,7 +156,7 @@ def main() -> None:
     n_chips = len(devices)
     mesh = Mesh(np.array(devices), ("d",))
     carnot = Carnot(
-        device_executor=MeshExecutor(mesh=mesh, block_rows=1 << 21)
+        device_executor=MeshExecutor(mesh=mesh, block_rows=block_rows)
     )
     rng = np.random.default_rng(42)
     services = np.array(
@@ -192,7 +243,8 @@ def main() -> None:
 
         t0 = time.perf_counter()
         result = carnot.execute_query(query)
-        log(f"config2 warm-up (compile+stage) {time.perf_counter() - t0:.1f}s")
+        cold2 = time.perf_counter() - t0
+        log(f"config2 cold (compile+stage+run) {cold2:.1f}s")
         verify(result)
         best, last = best_of(lambda: carnot.execute_query(query), runs)
         verify(last)
@@ -203,7 +255,7 @@ def main() -> None:
             "unit": "rows/s/chip",
             "vs_baseline": round(rps / 1e8, 3),
         }
-        detail.append({"config": 2, **headline})
+        detail.append({"config": 2, "cold_s": round(cold2, 2), **headline})
         log(f"config2: {headline}")
 
     # ---- config 5: streaming sketches (t-digest + count-min) --------------
@@ -216,13 +268,16 @@ def main() -> None:
             ")\n"
             "px.display(s, 'sketches')\n"
         )
-        r5 = carnot.execute_query(q5)  # warm
+        t0 = time.perf_counter()
+        r5 = carnot.execute_query(q5)  # cold
+        cold5 = time.perf_counter() - t0
         best, last = best_of(lambda: carnot.execute_query(q5), runs)
         assert len(last.table("sketches")["service"]) == n_services
         rps = n_rows / best / n_chips
         detail.append(
             {
                 "config": 5,
+                "cold_s": round(cold5, 2),
                 "metric": "sketch_tdigest_countmin_rows_per_sec_per_chip",
                 "value": round(rps),
                 "unit": "rows/s/chip",
@@ -254,12 +309,15 @@ def main() -> None:
             "df = df[['time_', 'service', 'latency_ms']]\n"
             "px.display(df, 'out')\n"
         )
-        carnot.execute_query(q1)  # warm
+        t0 = time.perf_counter()
+        carnot.execute_query(q1)  # cold
+        cold1 = time.perf_counter() - t0
         best, last = best_of(lambda: carnot.execute_query(q1), runs)
         assert len(last.table("out")["time_"]) > 0
         detail.append(
             {
                 "config": 1,
+                "cold_s": round(cold1, 2),
                 "metric": "http_data_filter_project_rows_per_sec",
                 "value": round(m / best),
                 "unit": "rows/s",
@@ -303,12 +361,15 @@ def main() -> None:
             ")\n"
             "px.display(s, 'flows')\n"
         )
-        carnot.execute_query(q3)  # warm
+        t0 = time.perf_counter()
+        carnot.execute_query(q3)  # cold
+        cold3 = time.perf_counter() - t0
         best, last = best_of(lambda: carnot.execute_query(q3), runs)
         assert sum(last.table("flows")["bytes_sent"]) > 0
         detail.append(
             {
                 "config": 3,
+                "cold_s": round(cold3, 2),
                 "metric": "net_flow_group_hll_rows_per_sec_per_chip",
                 "value": round(m / best / n_chips),
                 "unit": "rows/s/chip",
@@ -350,12 +411,15 @@ def main() -> None:
             ")\n"
             "px.display(s, 'merged')\n"
         )
-        carnot.execute_query(q4)  # warm
+        t0 = time.perf_counter()
+        carnot.execute_query(q4)  # cold
+        cold4 = time.perf_counter() - t0
         best, last = best_of(lambda: carnot.execute_query(q4), runs)
         assert len(last.table("merged")["stack_trace_id"]) == n_stacks
         detail.append(
             {
                 "config": 4,
+                "cold_s": round(cold4, 2),
                 "metric": "flamegraph_stack_merge_rows_per_sec_per_chip",
                 "value": round(m / best / n_chips),
                 "unit": "rows/s/chip",
@@ -363,13 +427,32 @@ def main() -> None:
         )
         log(f"config4: {detail[-1]}")
 
-    with open(
-        os.path.join(os.path.dirname(__file__) or ".", "BENCH_DETAIL.json"),
-        "w",
-    ) as f:
-        json.dump(detail, f, indent=1)
+    ledger_path = os.path.join(
+        os.path.dirname(__file__) or ".", "BENCH_DETAIL.json"
+    )
+    best_prior = load_prior_best(ledger_path)
+    gate_prior = best_prior
+    if os.environ.get("BENCH_GATE_SELFTEST"):
+        # Prove the gate trips: pretend every metric was 100x better —
+        # but NEVER persist the fabricated bests (that would brick the
+        # gate baseline for every later real run).
+        gate_prior = {e["metric"]: e["value"] * 100 for e in detail}
+    gate = apply_gate(detail, gate_prior)
+    best_now = dict(best_prior)
+    for e in detail:
+        best_now[e["metric"]] = max(best_now.get(e["metric"], 0), e["value"])
+    with open(ledger_path, "w") as f:
+        json.dump(
+            {"configs": detail, "best": best_now, "gate": gate}, f, indent=1
+        )
+    if gate["status"] == "red":
+        for r in gate["regressions"]:
+            log(f"PERF GATE RED: {r}")
     if not headline and detail:
-        headline = {k: v for k, v in detail[0].items() if k != "config"}
+        headline = {
+            k: v for k, v in detail[0].items() if k not in ("config", "cold_s")
+        }
+    headline["gate"] = gate["status"]
     print(json.dumps(headline))
 
 

@@ -73,6 +73,7 @@ def register(r: Registry) -> None:
             merge_kind=MergeKind.PSUM,
             out_semantic=_quantile_semantic,
             host_finalize=True,
+            stage_f32_ok=True,  # log-bin assignment is way coarser than f32
             doc=(
                 "Approximate p01..p99 via a log-binned histogram sketch "
                 "(DDSketch-style; ~1.4% relative error; psum-mergeable)."
@@ -101,6 +102,7 @@ def register(r: Registry) -> None:
             merge_kind=MergeKind.TREE,
             out_semantic=_quantile_semantic,
             host_finalize=True,
+            stage_f32_ok=True,  # centroid means/weights are f32 already
             doc="Approximate p01..p99 via a static-shape merging t-digest.",
         )
 

@@ -131,6 +131,12 @@ class UDA:
     #     float64 exact per-segment sums of this UDA's rows.
     fused_rows: Callable[..., list] | None = None
     fused_apply: Callable[[Any, Any], Any] | None = None
+    # True when a FLOAT64 arg may be staged to HBM as f32 without changing
+    # results beyond the UDA's own approximation (e.g. t-digest centroids
+    # and log-binned histogram sketches are f32-grained anyway). Cold
+    # staging is host->HBM-transfer-bound, so halving sketch-arg bytes is
+    # a first-query latency lever, not a precision trade.
+    stage_f32_ok: bool = False
     doc: str = ""
 
     @property

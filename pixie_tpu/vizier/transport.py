@@ -87,16 +87,17 @@ _HANDSHAKE_MAX_FRAME = 1 << 12  # hello/challenge are ~100 bytes
 
 
 def _recv_frame(
-    sock: socket.socket, max_len: Optional[int] = None
+    sock: socket.socket,
+    max_len: Optional[int] = None,
+    pre_auth: bool = False,
 ) -> Optional[dict]:
     """Next decoded frame, or None on EOF. Raises wire.WireError (or a
     ValueError subclass) on malformed content — callers treat that as a
     hostile/broken peer and drop the connection. ``max_len`` caps the
-    attacker-controlled length word BEFORE allocation — mandatory for
-    pre-authentication reads, where an 8-byte header could otherwise force
-    a multi-GB bytearray per connection; it also disables array/batch
-    nodes, whose forged numpy headers are allocation bombs the length cap
-    cannot see."""
+    attacker-controlled length word BEFORE allocation; ``pre_auth`` reads
+    additionally refuse array/batch nodes, whose forged numpy headers are
+    allocation bombs the length cap cannot see. The two are independent:
+    a capped post-auth read must still decode batches."""
     hdr = _recv_exact(sock, _LEN.size)
     if hdr is None:
         return None
@@ -107,7 +108,7 @@ def _recv_frame(
     if payload is None:
         return None
     try:
-        frame = wire.decode(payload, allow_arrays=max_len is None)
+        frame = wire.decode(payload, allow_arrays=not pre_auth)
     except wire.WireError:
         raise
     except Exception as e:  # unhashable map keys, bad npy, ...
@@ -123,7 +124,7 @@ def _server_handshake(conn: socket.socket, secret: str) -> bool:
     acted on; the server's counter-MAC proves the same to the client."""
     nonce = os.urandom(_NONCE_BYTES)
     _send_frame(conn, {"kind": "challenge", "nonce": nonce})
-    frame = _recv_frame(conn, max_len=_HANDSHAKE_MAX_FRAME)
+    frame = _recv_frame(conn, max_len=_HANDSHAKE_MAX_FRAME, pre_auth=True)
     if (
         frame is None
         or frame.get("kind") != "hello"
@@ -137,7 +138,7 @@ def _server_handshake(conn: socket.socket, secret: str) -> bool:
 
 
 def _client_handshake(sock: socket.socket, secret: str) -> None:
-    frame = _recv_frame(sock, max_len=_HANDSHAKE_MAX_FRAME)
+    frame = _recv_frame(sock, max_len=_HANDSHAKE_MAX_FRAME, pre_auth=True)
     if frame is None or frame.get("kind") != "challenge" or not isinstance(
         frame.get("nonce"), bytes
     ):
@@ -147,7 +148,7 @@ def _client_handshake(sock: socket.socket, secret: str) -> None:
         sock,
         {"kind": "hello", "mac": _mac(secret, frame["nonce"]), "nonce": nonce},
     )
-    resp = _recv_frame(sock, max_len=_HANDSHAKE_MAX_FRAME)
+    resp = _recv_frame(sock, max_len=_HANDSHAKE_MAX_FRAME, pre_auth=True)
     if (
         resp is None
         or resp.get("kind") != "welcome"

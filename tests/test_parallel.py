@@ -467,11 +467,11 @@ def test_stage_oom_retry_policy(mesh):
     calls = []
     orig = ex._stage
 
-    def oom_once(cols, n, key_plan, table):
+    def oom_once(cols, n, key_plan, table, f32_cols=None):
         calls.append(1)
         if len(calls) == 1:
             raise RuntimeError("RESOURCE_EXHAUSTED: out of HBM")
-        return orig(cols, n, key_plan, table)
+        return orig(cols, n, key_plan, table, f32_cols)
 
     ex._stage = oom_once
     # Different time window -> cache miss -> staging path runs.
@@ -570,3 +570,52 @@ def test_mesh_fused_sum_lane_forced_matmul(mesh):
     finally:
         _segment.set_strategy(None)
         _segment.set_sorted_strategy(None)
+
+
+def test_mesh_frame_of_reference_narrowing_exact(mesh):
+    """Staged int64 columns narrow to u8/i32 + offset (transfer is the
+    cold-path bottleneck); sums must stay exact through widen, including
+    huge offsets and negatives."""
+    c = Carnot(device_executor=MeshExecutor(mesh=mesh, block_rows=1024))
+    rel = Relation.of(
+        ("time_", T, SemanticType.ST_TIME_NS),
+        ("k", S),
+        ("near_ts", I),   # big offset, tiny range -> u8
+        ("wide", I),      # range > 2^31 -> unnarrowed
+        ("neg", I),       # negative band -> i32 + negative offset
+    )
+    t = c.table_store.create_table("nrw", rel)
+    n = 5000
+    rng = np.random.default_rng(3)
+    base = 1_700_000_000_000_000_000
+    data = {
+        "time_": np.arange(n) * 100,
+        "k": rng.choice(["x", "y"], n).astype(object),
+        "near_ts": base + rng.integers(0, 200, n),
+        "wide": rng.integers(-(1 << 40), 1 << 40, n),
+        "neg": rng.integers(-5_000_000_000, -4_999_000_000, n),
+    }
+    t.write_pydict(data)
+    t.compact()
+    t.stop()
+    res = c.execute_query(
+        "df = px.DataFrame(table='nrw')\n"
+        "s = df.groupby(['k']).agg(\n"
+        "    a=('near_ts', px.sum),\n"
+        "    b=('wide', px.sum),\n"
+        "    c=('neg', px.sum),\n"
+        "    n=('time_', px.count),\n"
+        ")\n"
+        "px.display(s, 'out')\n"
+    )
+    rows = res.table("out")
+    by = {s: i for i, s in enumerate(rows["k"])}
+    for key in ("x", "y"):
+        m = data["k"] == key
+        i = by[key]
+        assert rows["a"][i] == int(data["near_ts"][m].sum())
+        assert rows["b"][i] == int(data["wide"][m].sum())
+        assert rows["c"][i] == int(data["neg"][m].sum())
+        assert rows["n"][i] == int(m.sum())
+    # offload actually ran (not host fallback)
+    assert not c.device_executor.fallback_errors
