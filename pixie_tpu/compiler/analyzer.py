@@ -172,8 +172,63 @@ def prune_columns(ir) -> int:
                 )
                 changed += 1
     if changed:
+        # Union branches can diverge in width after pruning: a Map branch
+        # shrinks to what sinks consume while a Filter branch keeps full
+        # width (filters do not project) — e.g. px/dns_flow_graph's
+        # df.append(leftovers). Project only the branches whose
+        # POST-prune columns diverge from the target, BEFORE relations
+        # recompute (the UnionOp's own consistency check would raise
+        # mid-recompute otherwise).
+        predicted = _predicted_columns(ir, order)
+        for nid in order:
+            if not isinstance(ir._ops[nid], UnionOp):
+                continue
+            need = needed[nid]
+            parents = ir.parents(nid)
+            if not need or not parents:
+                continue
+            target = [c for c in predicted[parents[0]] if c in need]
+            if not target:
+                continue
+            new_parents = []
+            for p in parents:
+                if predicted[p] == target:
+                    new_parents.append(p)
+                    continue
+                proj = ir.add(
+                    MapOp(tuple((c, ColumnRef(c)) for c in target)), [p]
+                )
+                new_parents.append(proj)
+                changed += 1
+            ir._parents[nid] = new_parents
         ir.recompute_all()
     return changed
+
+
+def _predicted_columns(ir, order) -> dict[int, list]:
+    """Post-prune output column lists per node, computed WITHOUT touching
+    stored relations (they may be transiently inconsistent mid-batch)."""
+    out: dict[int, list] = {}
+    for nid in order:
+        op = ir._ops[nid]
+        parents = ir.parents(nid)
+        if isinstance(op, MemorySourceOp):
+            out[nid] = (
+                list(op.column_names)
+                if op.column_names is not None
+                else list(ir.relation(nid).col_names())
+            )
+        elif isinstance(op, MapOp):
+            out[nid] = [n for n, _ in op.exprs]
+        elif isinstance(op, JoinOp):
+            out[nid] = [o for _, _, o in op.output_columns]
+        elif isinstance(op, AggOp):
+            out[nid] = list(op.groups) + [n for n, _ in op.values]
+        elif parents:
+            out[nid] = list(out[parents[0]])
+        else:
+            out[nid] = list(ir.relation(nid).col_names())
+    return out
 
 
 def run_all(ir) -> None:

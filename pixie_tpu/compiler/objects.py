@@ -616,6 +616,11 @@ class PxModule:
         nid = self._ir.add(ResultSinkOp(name), [df._id])
         self.display_calls.append((nid, name))
 
+    def debug(self, df: DataFrameObj, name: str = "output") -> None:
+        """Ref: px.debug — display under a '_'-prefixed table name
+        (planner/objects/pixie_module.cc kDebugTableCmdID)."""
+        self.display(df, "_" + name)
+
     # -- OTel export (ref: planner/objects/otel.h px.otel module +
     #    px.export lowering to OTelExportSinkOperator) ---------------------
     @property

@@ -1,9 +1,12 @@
 """Process/network stats connectors reading procfs (real host telemetry).
 
 Ref: src/stirling/source_connectors/process_stats/ (265 LoC) and
-network_stats/ (284 LoC) — per-process CPU/memory counters resolved against
-metadata, and host-level network interface counters. These read the same
-/proc files the reference's proc_parser does
+network_stats/ (284 LoC) — per-process CPU/memory/IO counters and per-pod
+network counters. Column schemas match the reference tables exactly
+(process_stats_table.h kProcessStatsElements, network_stats_table.h
+kNetworkStatsElements) so the px/ script library (pods, nodes,
+namespaces, upids, pod_memory_usage, ...) runs unchanged. These read the
+same /proc files the reference's proc_parser does
 (src/common/system/proc_parser.*), so they produce REAL telemetry on any
 Linux host without eBPF.
 """
@@ -23,29 +26,41 @@ I, F, S, T = (
     DataType.TIME64NS,
 )
 
+# ref: process_stats_table.h kProcessStatsElements (column-for-column)
 PROCESS_STATS_REL = Relation.of(
     ("time_", T, SemanticType.ST_TIME_NS),
     ("upid", S, SemanticType.ST_UPID),
-    ("cmdline", S),
-    ("utime_ticks", I),
-    ("stime_ticks", I),
-    ("rss_bytes", I, SemanticType.ST_BYTES),
+    ("major_faults", I),
+    ("minor_faults", I),
+    ("cpu_utime_ns", I, SemanticType.ST_DURATION_NS),
+    ("cpu_ktime_ns", I, SemanticType.ST_DURATION_NS),
+    ("num_threads", I),
     ("vsize_bytes", I, SemanticType.ST_BYTES),
+    ("rss_bytes", I, SemanticType.ST_BYTES),
+    ("rchar_bytes", I, SemanticType.ST_BYTES),
+    ("wchar_bytes", I, SemanticType.ST_BYTES),
+    ("read_bytes", I, SemanticType.ST_BYTES),
+    ("write_bytes", I, SemanticType.ST_BYTES),
 )
 
+# ref: network_stats_table.h kNetworkStatsElements (pod-scoped counters)
 NETWORK_STATS_REL = Relation.of(
     ("time_", T, SemanticType.ST_TIME_NS),
-    ("interface", S),
+    ("pod_id", S),
     ("rx_bytes", I, SemanticType.ST_BYTES),
     ("rx_packets", I),
+    ("rx_errors", I),
+    ("rx_drops", I),
     ("tx_bytes", I, SemanticType.ST_BYTES),
     ("tx_packets", I),
+    ("tx_errors", I),
+    ("tx_drops", I),
 )
 
 
 class ProcessStatsConnector(SourceConnector):
-    """Samples /proc/<pid>/stat + statm (ref: process_stats connector +
-    proc_parser.cc ParseProcPIDStat)."""
+    """Samples /proc/<pid>/{stat,statm,io} (ref: process_stats connector +
+    proc_parser.cc ParseProcPIDStat/ParseProcPIDStatIO)."""
 
     name = "process_stats"
     sample_period_s = 1.0
@@ -57,6 +72,7 @@ class ProcessStatsConnector(SourceConnector):
         self.max_pids = max_pids
         self.tables = [DataTable("process_stats", PROCESS_STATS_REL)]
         self._page_size = os.sysconf("SC_PAGE_SIZE")
+        self._ns_per_tick = 1_000_000_000 // os.sysconf("SC_CLK_TCK")
 
     def transfer_data_impl(self, ctx) -> None:
         dt = self.tables[0]
@@ -71,38 +87,52 @@ class ProcessStatsConnector(SourceConnector):
             try:
                 with open(f"/proc/{pid}/stat") as f:
                     stat = f.read()
-                with open(f"/proc/{pid}/cmdline", "rb") as f:
-                    cmdline = (
-                        f.read().replace(b"\x00", b" ").decode(errors="replace").strip()
-                    )
                 # comm may contain spaces/parens; split after the last ')'.
                 rest = stat.rsplit(")", 1)[1].split()
                 with open(f"/proc/{pid}/statm") as f:
                     statm = f.read().split()
             except (FileNotFoundError, ProcessLookupError, PermissionError):
                 continue
+            io = {}
+            try:
+                with open(f"/proc/{pid}/io") as f:
+                    for line in f:
+                        k, _, v = line.partition(":")
+                        io[k.strip()] = int(v)
+            except (OSError, ValueError):
+                pass  # /proc/<pid>/io needs privileges for other users
             start_ticks = int(rest[19])  # starttime: stable UPID component
             dt.append_record(
                 time_=now,
                 upid=f"{self.asid}:{pid}:{start_ticks}",
-                cmdline=cmdline or "[kernel]",
-                utime_ticks=int(rest[11]),
-                stime_ticks=int(rest[12]),
-                rss_bytes=int(statm[1]) * self._page_size,
+                major_faults=int(rest[9]),
+                minor_faults=int(rest[7]),
+                cpu_utime_ns=int(rest[11]) * self._ns_per_tick,
+                cpu_ktime_ns=int(rest[12]) * self._ns_per_tick,
+                num_threads=int(rest[17]),
                 vsize_bytes=int(rest[20]),
+                rss_bytes=int(statm[1]) * self._page_size,
+                rchar_bytes=io.get("rchar", 0),
+                wchar_bytes=io.get("wchar", 0),
+                read_bytes=io.get("read_bytes", 0),
+                write_bytes=io.get("write_bytes", 0),
             )
             count += 1
 
 
 class NetworkStatsConnector(SourceConnector):
-    """Samples /proc/net/dev (ref: network_stats connector)."""
+    """Samples /proc/net/dev (ref: network_stats connector). The reference
+    attributes counters to pods via each pod's network namespace; without
+    a cluster the host's interfaces aggregate under the node's pod_id
+    ('' when unmapped)."""
 
     name = "network_stats"
     sample_period_s = 1.0
     push_period_s = 2.0
 
-    def __init__(self):
+    def __init__(self, pod_id: str = ""):
         super().__init__()
+        self.pod_id = pod_id
         self.tables = [DataTable("network_stats", NETWORK_STATS_REL)]
 
     def transfer_data_impl(self, ctx) -> None:
@@ -113,16 +143,29 @@ class NetworkStatsConnector(SourceConnector):
                 lines = f.readlines()[2:]
         except FileNotFoundError:  # pragma: no cover - non-Linux
             return
+        rx_b = rx_p = rx_e = rx_d = tx_b = tx_p = tx_e = tx_d = 0
         for line in lines:
             iface, _, rest = line.partition(":")
             fields = rest.split()
-            if len(fields) < 12:
+            if len(fields) < 12 or iface.strip() == "lo":
                 continue
-            dt.append_record(
-                time_=now,
-                interface=iface.strip(),
-                rx_bytes=int(fields[0]),
-                rx_packets=int(fields[1]),
-                tx_bytes=int(fields[8]),
-                tx_packets=int(fields[9]),
-            )
+            rx_b += int(fields[0])
+            rx_p += int(fields[1])
+            rx_e += int(fields[2])
+            rx_d += int(fields[3])
+            tx_b += int(fields[8])
+            tx_p += int(fields[9])
+            tx_e += int(fields[10])
+            tx_d += int(fields[11])
+        dt.append_record(
+            time_=now,
+            pod_id=self.pod_id,
+            rx_bytes=rx_b,
+            rx_packets=rx_p,
+            rx_errors=rx_e,
+            rx_drops=rx_d,
+            tx_bytes=tx_b,
+            tx_packets=tx_p,
+            tx_errors=tx_e,
+            tx_drops=tx_d,
+        )

@@ -22,6 +22,7 @@ class PodInfo:
     node_name: str
     ip: str
     phase: str = "Running"
+    start_time_ns: int = 0  # ref: k8s_objects PodInfo start_timestamp_ns
 
 
 @dataclasses.dataclass(frozen=True)

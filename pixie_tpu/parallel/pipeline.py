@@ -64,7 +64,14 @@ from pixie_tpu.plan.expressions import (
     expr_data_type,
     referenced_columns,
 )
-from pixie_tpu.plan.operators import AggOp, AggStage, FilterOp, MapOp, MemorySourceOp
+from pixie_tpu.plan.operators import (
+    AggOp,
+    AggStage,
+    FilterOp,
+    LimitOp,
+    MapOp,
+    MemorySourceOp,
+)
 from pixie_tpu.plan.plan import PlanFragment
 from pixie_tpu.table.column import DictColumn, StringDictionary
 from pixie_tpu.table.row_batch import RowBatch
@@ -163,6 +170,77 @@ def match_fragment(fragment: PlanFragment, relations) -> Optional[_Match]:
 
 
 @dataclasses.dataclass
+class _ScanMatch:
+    """Source→(Map|Filter)*→Limit chain (no aggregate): the device
+    evaluates predicates + projections and returns the first ``limit``
+    surviving rows (ref: the reference's hot path includes plain
+    filter/map scans, memory_source_node.h:42 → map/filter → limit;
+    px/http_data always bounds output with head())."""
+
+    source_nid: int
+    limit_nid: int
+    source_op: MemorySourceOp
+    limit: int
+    out_exprs: list  # [(name, expr in source terms)]
+    predicates: list
+    source_relation: Any
+    out_relation: Any
+
+
+def match_scan_fragment(fragment: PlanFragment, relations) -> Optional[_ScanMatch]:
+    """Find MemorySource→(Map|Filter)*→Limit with single-parent/child
+    links. Unbounded scans stay on the host: their output is the whole
+    selection, and shipping it back row-for-row forfeits the offload."""
+    for nid in fragment.topo_order():
+        op = fragment.node(nid)
+        if not isinstance(op, LimitOp):
+            continue
+        chain = []
+        cur = nid
+        source_nid = None
+        while True:
+            parents = fragment.parents(cur)
+            if len(parents) != 1:
+                return None
+            cur = parents[0]
+            pop = fragment.node(cur)
+            if len(fragment.children(cur)) != 1:
+                return None
+            if isinstance(pop, MemorySourceOp):
+                if pop.streaming:
+                    return None
+                source_nid = cur
+                break
+            if not isinstance(pop, (MapOp, FilterOp)):
+                return None
+            chain.append(pop)
+        chain.reverse()
+        source_rel = relations[source_nid]
+        mapping = {c.name: ColumnRef(c.name) for c in source_rel}
+        preds = []
+        for pop in chain:
+            if isinstance(pop, FilterOp):
+                preds.append(substitute(pop.expr, mapping))
+            else:
+                mapping = {
+                    name: substitute(e, mapping) for name, e in pop.exprs
+                }
+        out_rel = relations[nid]
+        out_exprs = [(c.name, mapping[c.name]) for c in out_rel]
+        return _ScanMatch(
+            source_nid=source_nid,
+            limit_nid=nid,
+            source_op=fragment.node(source_nid),
+            limit=op.n,
+            out_exprs=out_exprs,
+            predicates=preds,
+            source_relation=source_rel,
+            out_relation=out_rel,
+        )
+    return None
+
+
+@dataclasses.dataclass
 class _KeyPlan:
     """How group gids materialize. Exactly one of the modes applies:
     device_expr (codes/LUT gather on device) or host_gids (densified on
@@ -255,7 +333,9 @@ class MeshExecutor:
         relations = fragment.resolve_relations(registry, table_rel)
         m = match_fragment(fragment, relations)
         if m is None:
-            return None
+            return self._try_execute_scan(
+                fragment, relations, table_store, registry, func_ctx
+            )
         table = table_store.get_table(m.source_op.table_name)
         if table is None:
             return None
@@ -360,17 +440,9 @@ class MeshExecutor:
                 # device buffers until the handler exits.
                 staged = self._stage(cols, n, key_plan, table, f32_cols)
             if cacheable:
-                # Evict stale versions of this table, then LRU-cap.
-                for k in [
-                    k for k in self._staged_cache
-                    if k[0] == m.source_op.table_name and k[1] != version
-                ]:
-                    del self._staged_cache[k]
-                    _STAGED_EVICTIONS.inc(reason="version")
-                self._staged_cache[cache_key] = staged
-                while len(self._staged_cache) > self._staged_cache_cap:
-                    self._staged_cache.popitem(last=False)
-                    _STAGED_EVICTIONS.inc(reason="lru")
+                self._staged_insert(
+                    cache_key, staged, m.source_op.table_name, version
+                )
         aux = self._build_aux(evaluator, m, key_plan, table, specs)
         merged, capacity = self._run_program(
             m, specs, evaluator, key_plan, staged, aux
@@ -382,6 +454,270 @@ class MeshExecutor:
                 m, specs, key_plan, capacity, merged, registry, table
             )
         return m.agg_nid, batch
+
+    # -- device scan (filter/project/limit, no aggregate) --------------------
+    def _try_execute_scan(
+        self, fragment, relations, table_store, registry, func_ctx
+    ) -> Optional[tuple[int, RowBatch]]:
+        from pixie_tpu.types.dtypes import host_dtype
+
+        m = match_scan_fragment(fragment, relations)
+        if m is None:
+            return None
+        if m.limit > flags.device_scan_limit_cap:
+            return None  # unbounded-ish output: host path wins the fetch
+        table = table_store.get_table(m.source_op.table_name)
+        if table is None:
+            return None
+        # String outputs must be bare source columns so codes decode
+        # through the table dictionary host-side.
+        for name, e in m.out_exprs:
+            if m.out_relation.col(name).data_type == DataType.STRING and (
+                not isinstance(e, ColumnRef)
+            ):
+                return None
+        named = [(f"pred{i}", p) for i, p in enumerate(m.predicates)]
+        named += [(f"out:{name}", e) for name, e in m.out_exprs]
+        try:
+            evaluator = ExpressionEvaluator(
+                named, m.source_relation, registry, func_ctx
+            )
+        except ValueError:
+            return None
+        base_cols = set()
+        for e in m.predicates:
+            base_cols |= referenced_columns(e)
+        for _, e in m.out_exprs:
+            base_cols |= referenced_columns(e)
+        version = (table.min_row_id(), table.end_row_id())
+        cache_key = (
+            m.source_op.table_name,
+            version,
+            tuple(sorted(base_cols)),
+            m.source_op.start_time,
+            m.source_op.stop_time,
+            self.block_rows,
+            ":scan",
+            0,
+            (),
+        )
+        staged = self._staged_lookup(cache_key)
+        if staged is None:
+            cols, n = read_columns(
+                table,
+                sorted(base_cols),
+                m.source_op.start_time,
+                m.source_op.stop_time,
+            )
+            try:
+                staged = self._stage(cols, n, _KeyPlan(num_groups=0), table)
+            except Exception as e:
+                if "RESOURCE_EXHAUSTED" not in str(e) and (
+                    "Out of memory" not in str(e)
+                ):
+                    raise
+                # Device OOM: same policy as the agg path — drop every
+                # cached staging and retry once.
+                self._staged_cache.clear()
+                _STAGED_EVICTIONS.inc(reason="oom")
+                staged = None
+            if staged is None:
+                staged = self._stage(cols, n, _KeyPlan(num_groups=0), table)
+            self._staged_insert(cache_key, staged, m.source_op.table_name, version)
+        aux = {}
+        for name, e in evaluator.named_exprs:
+            aux.update(evaluator.build_aux(e, table.dictionaries))
+        out_dtypes = []
+        for name, e in m.out_exprs:
+            schema = m.out_relation.col(name)
+            if schema.data_type == DataType.STRING:
+                out_dtypes.append(np.dtype(np.int32))  # codes
+            else:
+                out_dtypes.append(np.dtype(host_dtype(schema.data_type)))
+        aux_vals = list(aux.values())
+        sig = "|".join(
+            [
+                "scan",
+                ",".join(
+                    f"{n2}:{a.shape}:{a.dtype}"
+                    for n2, a in sorted(staged.blocks.items())
+                ),
+                f"narrow:{sorted(staged.narrow_offsets)}",
+                f"limit:{m.limit}",
+                "preds:" + ";".join(repr(p) for p in m.predicates),
+                "outs:" + ";".join(f"{n2}={e!r}" for n2, e in m.out_exprs),
+                "aux:" + ",".join(
+                    f"{np.shape(v)}:{np.asarray(v).dtype}" for v in aux_vals
+                ),
+                f"mesh:{self.mesh.devices.shape}",
+            ]
+        )
+        entry = self._program_cache.get(sig)
+        if entry is None:
+            program = self._build_scan_program(
+                m, evaluator, staged, list(aux.keys()), out_dtypes
+            )
+            self._program_cache[sig] = (program, len(aux_vals), None)
+            _PROGRAMS.set(len(self._program_cache))
+        program = self._program_cache[sig][0]
+        args = [staged.blocks[n2] for n2 in sorted(staged.blocks)]
+        args.append(staged.mask)
+        args.extend(jnp.asarray(v) for v in aux_vals)
+        if staged.narrow_offsets:
+            args.append(
+                jnp.asarray(
+                    [
+                        staged.narrow_offsets[n2]
+                        for n2 in sorted(staged.narrow_offsets)
+                    ],
+                    jnp.int64,
+                )
+            )
+        from pixie_tpu.ops import segment as _segment
+
+        with _segment.platform_hint(self.mesh.devices.flat[0].platform):
+            outs = program(*args)
+        written = np.asarray(outs[0])  # [D]
+        cap_out = m.limit + staged.block_rows
+        ndev = staged.num_devices
+        remaining = m.limit
+        col_parts: list[list[np.ndarray]] = [[] for _ in m.out_exprs]
+        for d in range(ndev):
+            take = min(int(written[d]), remaining)
+            if take <= 0:
+                continue
+            for ci in range(len(m.out_exprs)):
+                # Slice on device; fetch only the selected prefix.
+                col_parts[ci].append(
+                    np.asarray(outs[1 + ci][d * cap_out : d * cap_out + take])
+                )
+            remaining -= take
+        out_cols = []
+        for (name, e), dt, parts in zip(m.out_exprs, out_dtypes, col_parts):
+            arr = (
+                np.concatenate(parts)
+                if parts
+                else np.empty(0, dt)
+            )
+            schema = m.out_relation.col(name)
+            if schema.data_type == DataType.STRING:
+                d2 = table.dictionaries.get(e.name)
+                if d2 is None:
+                    return None
+                out_cols.append(DictColumn(arr.astype(np.int32), d2))
+            else:
+                out_cols.append(arr.astype(dt))
+        batch = RowBatch(m.out_relation, out_cols, eow=True, eos=True)
+        return m.limit_nid, batch
+
+    def _staged_lookup(self, cache_key):
+        staged = self._staged_cache.get(cache_key)
+        if staged is not None:
+            self._staged_cache.move_to_end(cache_key)
+        return staged
+
+    def _staged_insert(self, cache_key, staged, table_name, version) -> None:
+        for k in [
+            k
+            for k in self._staged_cache
+            if k[0] == table_name and k[1] != version
+        ]:
+            del self._staged_cache[k]
+            _STAGED_EVICTIONS.inc(reason="version")
+        self._staged_cache[cache_key] = staged
+        while len(self._staged_cache) > self._staged_cache_cap:
+            self._staged_cache.popitem(last=False)
+            _STAGED_EVICTIONS.inc(reason="lru")
+
+    def _build_scan_program(
+        self, m: _ScanMatch, evaluator, staged, aux_key_order, out_dtypes
+    ):
+        axis = self.mesh.axis_names[0]
+        col_names = sorted(staged.blocks)
+        narrow_names = sorted(staged.narrow_offsets)
+        limit = m.limit
+        cap_out = limit + staged.block_rows
+        preds = [
+            e for n, e in evaluator.named_exprs if n.startswith("pred")
+        ]
+        outs = [
+            (n[len("out:"):], e)
+            for n, e in evaluator.named_exprs
+            if n.startswith("out:")
+        ]
+        jdtypes = [jnp.dtype(dt) for dt in out_dtypes]
+
+        def shard_fn(*arrs):
+            i = len(col_names)
+            cols = {n: a[0] for n, a in zip(col_names, arrs[:i])}
+            mask_all = arrs[i][0]
+            i += 1
+            end = len(arrs)
+            narrow_vec = None
+            if narrow_names:
+                narrow_vec = arrs[-1]
+                end -= 1
+            aux = dict(zip(aux_key_order, arrs[i:end]))
+            nblk = mask_all.shape[0]
+            bufs = tuple(jnp.zeros(cap_out, dt) for dt in jdtypes)
+
+            def cond(carry):
+                written, blk, _ = carry
+                return (written < limit) & (blk < nblk)
+
+            def body(carry):
+                written, blk, bufs = carry
+                env = {
+                    n: jax.lax.dynamic_index_in_dim(
+                        cols[n], blk, 0, keepdims=False
+                    )
+                    for n in col_names
+                }
+                for ni, nm in enumerate(narrow_names):
+                    env[nm] = env[nm].astype(jnp.int64) + narrow_vec[ni]
+                mask = jax.lax.dynamic_index_in_dim(
+                    mask_all, blk, 0, keepdims=False
+                )
+                for p in preds:
+                    mask = mask & evaluator.device_eval(p, env, aux)
+                vals = [
+                    evaluator.device_eval(e, env, aux).astype(dt)
+                    for (_, e), dt in zip(outs, jdtypes)
+                ]
+                # Stable compaction: selected rows first, source order kept.
+                key = (~mask).astype(jnp.int32)
+                sorted_ops = jax.lax.sort(
+                    tuple([key] + vals), num_keys=1, is_stable=True
+                )
+                cnt = jnp.sum(mask).astype(jnp.int32)
+                new_bufs = tuple(
+                    jax.lax.dynamic_update_slice(buf, sv, (written,))
+                    for buf, sv in zip(bufs, sorted_ops[1:])
+                )
+                return (
+                    jnp.minimum(written + cnt, jnp.int32(limit)),
+                    blk + 1,
+                    new_bufs,
+                )
+
+            written, _, bufs = jax.lax.while_loop(
+                cond, body, (jnp.int32(0), jnp.int32(0), bufs)
+            )
+            return (written.reshape(1),) + bufs
+
+        n_sharded = len(col_names) + 1
+        n_repl = len(aux_key_order) + (1 if narrow_names else 0)
+        in_specs = tuple([P(axis)] * n_sharded + [P()] * n_repl)
+        out_specs = tuple([P(axis)] * (1 + len(jdtypes)))
+        return jax.jit(
+            shard_map(
+                shard_fn,
+                mesh=self.mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                **_SM_CHECK_KW,
+            )
+        )
 
     def _stage(self, cols, n, key_plan, table, f32_cols=None):
         return stage_columns(

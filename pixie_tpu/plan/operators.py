@@ -251,9 +251,20 @@ class UnionOp(Operator):
     def output_relation(self, inputs, registry) -> Relation:
         first = inputs[0]
         for rel in inputs[1:]:
+            # Relation.__eq__ compares (name, dtype) pairs only.
             if rel != first:
                 raise ValueError(f"union inputs differ: {first} vs {rel}")
-        return first
+        # Semantic types may legitimately differ between branches (e.g.
+        # dns_flow_graph unions a resolved-entity branch with a raw-IP
+        # branch); keep a column's semantic only where ALL branches agree
+        # (the reference planner unions on name+dtype).
+        cols = []
+        for i, c in enumerate(first):
+            sem = c.semantic_type
+            if any(rel.col(i).semantic_type != sem for rel in inputs[1:]):
+                sem = SemanticType.ST_NONE
+            cols.append(ColumnSchema(c.name, c.data_type, sem))
+        return Relation(cols)
 
 
 @dataclasses.dataclass(frozen=True, repr=False)

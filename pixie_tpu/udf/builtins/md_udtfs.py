@@ -117,6 +117,63 @@ def register(r: Registry) -> None:
         )
     )
 
+    def get_tables(ctx):
+        store = ctx.table_store
+        names = sorted(store.table_names()) if store else []
+        return {
+            "table_name": names,
+            "table_desc": ["" for _ in names],
+        }
+
+    r.register_udtf(
+        UDTF(
+            name="GetTables",
+            arg_spec={},
+            fn=get_tables,
+            output_relation=Relation.of(
+                ("table_name", S), ("table_desc", S)
+            ),
+            doc="Data tables available to query "
+            "(md_udtfs_impl.h GetTables, px/schemas).",
+        )
+    )
+
+    def get_schemas(ctx):
+        store = ctx.table_store
+        tn, cn, ct, pt, cd = [], [], [], [], []
+        for name in sorted(store.table_names()) if store else []:
+            rel = store.get_relation(name)
+            for col in rel:
+                tn.append(name)
+                cn.append(col.name)
+                ct.append(col.data_type.name)
+                pt.append("GENERAL")
+                cd.append("")
+        return {
+            "table_name": tn,
+            "column_name": cn,
+            "column_type": ct,
+            "pattern_type": pt,
+            "column_desc": cd,
+        }
+
+    r.register_udtf(
+        UDTF(
+            name="GetSchemas",
+            arg_spec={},
+            fn=get_schemas,
+            output_relation=Relation.of(
+                ("table_name", S),
+                ("column_name", S),
+                ("column_type", S),
+                ("pattern_type", S),
+                ("column_desc", S),
+            ),
+            doc="Column schemas of every table "
+            "(md_udtfs_impl.h GetTableSchemas / px.GetSchemas).",
+        )
+    )
+
     def get_udf_list(ctx):
         reg = ctx.registry
         names, kinds, args, rets = [], [], [], []

@@ -156,6 +156,24 @@ def register(r: Registry) -> None:
         S,
         lambda st, ip: st.pod_for_ip(ip).pod_id if st.pod_for_ip(ip) else "",
     )
+
+    def _ip_to_service_id(st, ip):
+        pod = st.pod_for_ip(ip)
+        return pod.service_id if pod is not None else ""
+
+    reg("ip_to_service_id", (S,), S, _ip_to_service_id)
+
+    def _pod_id_to_node_name(st, pid):
+        pod = st.pods.get(pid)
+        return pod.node_name if pod is not None else ""
+
+    reg(
+        "pod_id_to_node_name",
+        (S,),
+        S,
+        _pod_id_to_node_name,
+        semantic=SemanticType.ST_NODE_NAME,
+    )
     reg(
         "nslookup",
         (S,),
@@ -196,6 +214,40 @@ def register(r: Registry) -> None:
         lambda st, name: next(
             (p.pod_id for p in st.pods.values() if p.name == name), ""
         ))
+
+    def _pod_by_name(st, name):
+        return next((p for p in st.pods.values() if p.name == name), None)
+
+    reg(
+        "pod_name_to_start_time",
+        (S,),
+        DataType.TIME64NS,
+        lambda st, name: (
+            _pod_by_name(st, name).start_time_ns
+            if _pod_by_name(st, name)
+            else 0
+        ),
+    )
+    reg(
+        "pod_name_to_status",
+        (S,),
+        S,
+        lambda st, name: (
+            '{"phase":"%s","message":"","reason":"","ready":true}'
+            % _pod_by_name(st, name).phase
+            if _pod_by_name(st, name)
+            else '{"phase":"Unknown","message":"","reason":"","ready":false}'
+        ),
+    )
+    reg(
+        "pod_name_to_pod_ip",
+        (S,),
+        S,
+        lambda st, name: (
+            _pod_by_name(st, name).ip if _pod_by_name(st, name) else ""
+        ),
+        semantic=SemanticType.ST_IP_ADDRESS,
+    )
     reg("service_name_to_service_id", (S,), S,
         lambda st, name: next(
             (s.service_id for s in st.services.values() if s.name == name), ""

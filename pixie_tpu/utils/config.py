@@ -128,6 +128,13 @@ define_flag(
     "(high-cardinality spill/recombine).",
 )
 define_flag(
+    "device_scan_limit_cap",
+    1 << 20,
+    help_="Largest LimitOp n the device scan path accepts; bigger outputs "
+    "are host-engine work (shipping the whole selection back forfeits "
+    "the offload).",
+)
+define_flag(
     "agent_expiry_s",
     2.0,
     help_="Heartbeat silence before an agent is pruned from plans "

@@ -619,3 +619,47 @@ def test_mesh_frame_of_reference_narrowing_exact(mesh):
         assert rows["n"][i] == int(m.sum())
     # offload actually ran (not host fallback)
     assert not c.device_executor.fallback_errors
+
+
+def test_mesh_scan_filter_project_limit(mesh):
+    """Source→filter→map→head fragments run on the mesh: predicates +
+    projections evaluate per block, rows compact in source order, and the
+    device returns only the first `limit` survivors (px/http_data's shape;
+    the r4 device scan path)."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    res = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[df.resp_status >= 400]\n"
+        "df.latency_ms = df.latency / 1000.0\n"
+        "df = df[['time_', 'service', 'latency_ms']]\n"
+        "df = df.head(50)\n"
+        "px.display(df, 'out')\n"
+    )
+    rows = res.table("out")
+    # Truth: first 50 failing rows in time order.
+    sel = np.nonzero(data["resp_status"] >= 400)[0][:50]
+    assert rows["time_"] == data["time_"][sel].tolist()
+    assert rows["service"] == data["service"][sel].tolist()
+    np.testing.assert_allclose(
+        rows["latency_ms"], data["latency"][sel] / 1000.0, rtol=1e-12
+    )
+    assert not cd.device_executor.fallback_errors
+    # the scan actually offloaded (program cached under a scan signature)
+    assert any(s.startswith("scan|") for s in cd.device_executor._program_cache)
+
+
+def test_mesh_scan_limit_exceeds_matches(mesh):
+    """Fewer matching rows than the limit: all survivors return."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    res = cd.execute_query(
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[df.service == 'c']\n"
+        "df = df[df.resp_status == 500]\n"
+        "df = df[['time_']]\n"
+        "df = df.head(1000000)\n"
+        "px.display(df, 'out')\n"
+    )
+    rows = res.table("out")
+    sel = (data["service"] == "c") & (data["resp_status"] == 500)
+    assert rows["time_"] == data["time_"][sel].tolist()
+    assert not cd.device_executor.fallback_errors

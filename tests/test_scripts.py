@@ -118,6 +118,90 @@ def cluster():
     t3.compact()
     t3.stop()
 
+    # dns_events (socket_tracer schema; px/dns_* scripts)
+    from pixie_tpu.ingest.socket_tracer import DNS_EVENTS_REL
+
+    dn = 300
+    dns_lat = rng.integers(10**4, 10**7, dn)
+    t4 = carnot.table_store.create_table("dns_events", DNS_EVENTS_REL)
+    t4.write_pydict({
+        "time_": NOW - np.arange(dn)[::-1] * 1_000_000,
+        "upid": np.array(
+            [upids[i % len(upids)] for i in range(dn)], dtype=object
+        ),
+        "remote_addr": np.array(
+            [ips[i % len(ips)] for i in range(dn)], dtype=object
+        ),
+        "remote_port": np.full(dn, 53, np.int64),
+        "trace_role": np.ones(dn, np.int64),
+        "req_header": np.full(
+            dn, '{"txid":7,"qr":0,"rcode":0}', dtype=object
+        ),
+        "req_body": np.full(
+            dn,
+            '{"queries":[{"name":"web.pl.svc.cluster.local","type":"A"}]}',
+            dtype=object,
+        ),
+        "resp_header": np.full(
+            dn, '{"txid":7,"qr":1,"rcode":0}', dtype=object
+        ),
+        "resp_body": np.full(
+            dn,
+            '{"answers":[{"name":"web","type":"A","addr":"10.64.0.1"}]}',
+            dtype=object,
+        ),
+        "latency": dns_lat,
+    })
+    t4.compact()
+    t4.stop()
+
+    # process_stats + network_stats (reference schemas; px/pods, nodes, ...)
+    from pixie_tpu.ingest.proc_stats import (
+        NETWORK_STATS_REL,
+        PROCESS_STATS_REL,
+    )
+
+    pn = 240
+    t5 = carnot.table_store.create_table("process_stats", PROCESS_STATS_REL)
+    t5.write_pydict({
+        "time_": NOW - np.arange(pn)[::-1] * 10_000_000,
+        "upid": np.array(
+            [upids[i % len(upids)] for i in range(pn)], dtype=object
+        ),
+        "major_faults": rng.integers(0, 10, pn),
+        "minor_faults": rng.integers(0, 500, pn),
+        "cpu_utime_ns": np.cumsum(rng.integers(0, 10**7, pn)),
+        "cpu_ktime_ns": np.cumsum(rng.integers(0, 10**6, pn)),
+        "num_threads": rng.integers(1, 16, pn),
+        "vsize_bytes": rng.integers(10**7, 10**9, pn),
+        "rss_bytes": rng.integers(10**6, 10**8, pn),
+        "rchar_bytes": np.cumsum(rng.integers(0, 4096, pn)),
+        "wchar_bytes": np.cumsum(rng.integers(0, 4096, pn)),
+        "read_bytes": np.cumsum(rng.integers(0, 2048, pn)),
+        "write_bytes": np.cumsum(rng.integers(0, 2048, pn)),
+    })
+    t5.compact()
+    t5.stop()
+
+    pod_ids = sorted(md.pods)
+    t6 = carnot.table_store.create_table("network_stats", NETWORK_STATS_REL)
+    t6.write_pydict({
+        "time_": NOW - np.arange(pn)[::-1] * 10_000_000,
+        "pod_id": np.array(
+            [pod_ids[i % len(pod_ids)] for i in range(pn)], dtype=object
+        ),
+        "rx_bytes": np.cumsum(rng.integers(0, 4096, pn)),
+        "rx_packets": np.cumsum(rng.integers(0, 10, pn)),
+        "rx_errors": np.zeros(pn, np.int64),
+        "rx_drops": np.zeros(pn, np.int64),
+        "tx_bytes": np.cumsum(rng.integers(0, 4096, pn)),
+        "tx_packets": np.cumsum(rng.integers(0, 10, pn)),
+        "tx_errors": np.zeros(pn, np.int64),
+        "tx_drops": np.zeros(pn, np.int64),
+    })
+    t6.compact()
+    t6.stop()
+
     truth = {
         "upids": upids,
         "md": md,
@@ -128,6 +212,7 @@ def cluster():
         "stacks": [stacks[i] for i in sid],
         "stack_upids": st_upids,
         "stack_counts": counts,
+        "dns_lat": dns_lat,
     }
     return carnot, truth
 
@@ -254,3 +339,97 @@ def test_perf_flamegraph(cluster):
         per_pod[pod] = per_pod.get(pod, 0.0) + pct
     for pod, total in per_pod.items():
         assert total == pytest.approx(100.0, abs=1e-6), pod
+
+
+# Script-specific required args (vis.json variables without defaults),
+# resolved against the synthetic metadata world.
+_SCRIPT_ARGS = {
+    "px/pods": {"namespace": "pl"},
+    "px/slow_http_requests": {"namespace": "pl"},
+    "px/net_flow_graph": {"namespace": "pl"},
+    "px/pod_edge_stats": {
+        "requesting_pod": "pl/svc-0-pod-0",
+        "responding_pod": "pl/svc-1-pod-0",
+    },
+}
+
+
+def _bundle_args(script) -> dict:
+    args = dict(_SCRIPT_ARGS.get(script.name, {}))
+    return {
+        k: v
+        for k, v in args.items()
+        if any(var["name"] == k for var in script.variables)
+    }
+
+
+def test_every_bundled_script_runs(cluster):
+    """The whole vendored px/ bundle (28 scripts) compiles and executes
+    UNCHANGED over the seeded tables — each unported script was an
+    untested compiler surface (VERDICT r3 §missing 2)."""
+    carnot, _ = cluster
+    lib = ScriptLibrary()
+    names = lib.names()
+    assert len(names) >= 28, names
+    produced_rows = 0
+    for name in names:
+        script = lib.load(name)
+        res = lib.run(carnot, name, args=_bundle_args(script), now_ns=NOW)
+        assert res.tables, f"{name}: no output tables"
+        produced_rows += sum(
+            b.num_rows for bs in res.tables.values() for b in bs
+        )
+    assert produced_rows > 0
+
+
+def test_http_request_stats_truth(cluster):
+    """px/http_request_stats: per-service throughput total matches numpy."""
+    carnot, truth = cluster
+    res = ScriptLibrary().run(carnot, "px/http_request_stats", now_ns=NOW)
+    name = next(iter(res.tables))
+    rows = table(res, name)
+    md = truth["md"]
+    upid_to_svc = {
+        u: md.services[md.pods[md.upid_to_pod[u]].service_id].name
+        for u in truth["upids"]
+    }
+    svc_of_rows = np.array(
+        [upid_to_svc[truth["upids"][i]] for i in truth["svc_idx"]]
+    )
+    got = dict(zip(rows["service"], rows["throughput total"]))
+    for svc in sorted(set(svc_of_rows)):
+        assert got[svc] == int((svc_of_rows == svc).sum()), svc
+
+
+def test_dns_query_summary_truth(cluster):
+    """px/dns_query_summary: request count matches the seeded dns table."""
+    carnot, truth = cluster
+    res = ScriptLibrary().run(carnot, "px/dns_query_summary", now_ns=NOW)
+    flow_name = next(t for t in res.tables if not t.startswith("_"))
+    rows = table(res, flow_name)
+    assert sum(rows["num_requests"]) == len(truth["dns_lat"])
+    # all resolved (seeded answers are non-empty, rcode 0)
+    assert sum(rows["num_resolved"]) == len(truth["dns_lat"])
+    assert all(r == 0 for r in rows["nxdomain_rate"])
+
+
+def test_upids_lists_processes(cluster):
+    carnot, truth = cluster
+    res = ScriptLibrary().run(carnot, "px/upids", now_ns=NOW)
+    rows = table(res, next(iter(res.tables)))
+    assert set(rows["pod"]) <= {
+        p.name for p in truth["md"].pods.values()
+    } | {""}
+    assert len(rows["pod"]) > 0
+
+
+def test_schemas_reports_tables(cluster):
+    carnot, _ = cluster
+    res = ScriptLibrary().run(carnot, "px/schemas", now_ns=NOW)
+    all_rows = {}
+    for tname in res.tables:
+        all_rows[tname] = table(res, tname)
+    merged = set()
+    for rows in all_rows.values():
+        merged |= set(rows["table_name"])
+    assert {"http_events", "conn_stats", "dns_events"} <= merged
