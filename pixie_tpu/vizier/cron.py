@@ -133,14 +133,18 @@ class ScriptRunner:
         self._sink = sink
         self._timeout_s = timeout_s
         self._runners: dict[str, _Runner] = {}
-        self._lock = threading.Lock()
+        # One lock serializes store mutation + reconcile: without it, a
+        # concurrent sync() that read the store BEFORE a delete can
+        # resurrect the deleted script's runner AFTER the deleter's
+        # reconcile ran (caught by the r4 concurrency stress suite).
+        self._lock = threading.RLock()
         self.last_errors: dict[str, str] = {}
 
     # -- script set management (ref: SyncScripts + update channel) ----------
     def sync(self) -> None:
         """Reconcile running tickers with the persisted set."""
-        want = self.store.all()
         with self._lock:
+            want = self.store.all()
             for sid in [s for s in self._runners if s not in want]:
                 self._runners.pop(sid).stop()
             for sid, script in want.items():
@@ -158,12 +162,14 @@ class ScriptRunner:
 
     def upsert_script(self, script: CronScript) -> None:
         """Persist + (re)schedule (ref: upsert on the updates channel)."""
-        self.store.upsert(script)
-        self.sync()
+        with self._lock:
+            self.store.upsert(script)
+            self.sync()
 
     def delete_script(self, script_id: str) -> None:
-        self.store.delete(script_id)
-        self.sync()
+        with self._lock:
+            self.store.delete(script_id)
+            self.sync()
 
     def stop(self) -> None:
         with self._lock:
