@@ -215,7 +215,7 @@ class ASTVisitor:
             if isinstance(obj, DataFrameObj):
                 if node.attr in (
                     "ctx", "relation", "groupby", "agg", "merge", "head",
-                    "drop", "append", "stream",
+                    "drop", "append", "stream", "rolling",
                 ):
                     return getattr(obj, node.attr)
                 return obj._col(node.attr)

@@ -240,6 +240,20 @@ class CtxAccessor:
         return ColumnExpr(FuncCall(fn, (ColumnRef(upid),)), self.df)
 
 
+def _parse_window(window) -> int:
+    """Window size → nanoseconds (ref: ParseAllTimeFormats accepting ints
+    and duration strings)."""
+    import numpy as _np
+
+    if isinstance(window, (int, _np.integer)):
+        return int(window)
+    if isinstance(window, str):
+        return -parse_relative_time("-" + window.lstrip("-"), 0)
+    if isinstance(window, ColumnExpr) and isinstance(window.expr, Constant):
+        return int(window.expr.value)
+    raise CompilerError(f"rolling: cannot parse window {window!r}")
+
+
 class GroupedDataFrame:
     def __init__(self, df: "DataFrameObj", by: tuple[str, ...]):
         self.df = df
@@ -251,7 +265,13 @@ class GroupedDataFrame:
                 )
 
     def agg(self, **kwargs) -> "DataFrameObj":
-        return self.df._agg(self.by, kwargs)
+        by = self.by
+        rolling_on = getattr(self.df, "_rolling_on", None)
+        if rolling_on is not None and rolling_on not in by:
+            # Rolling view: the window id is one more group axis, and the
+            # output rows carry the window start in that column.
+            by = (rolling_on,) + by
+        return self.df._agg(by, kwargs)
 
 
 class DataFrameObj:
@@ -348,6 +368,41 @@ class DataFrameObj:
         if isinstance(by, str):
             by = [by]
         return GroupedDataFrame(self, tuple(by))
+
+    def rolling(self, window, on: str = "time_") -> "DataFrameObj":
+        """Windowed view: subsequent groupby().agg() aggregates per
+        (window, groups) with ``on`` rewritten to the window start.
+
+        Ref: objects/dataframe.cc:386-407 RollingHandler validates the
+        same surface (on='time_' only, window > 0) but the reference's
+        RollingIR never lowers (rolling_ir.cc ToProto: 'Rolling operator
+        not yet implemented'). We lower it TPU-first instead: the window
+        id becomes one more dense group axis (floor-binned time), which
+        the device pipeline's segment reductions handle natively — so
+        rolling queries actually execute here."""
+        if on != "time_":
+            raise CompilerError(
+                f"Windowing is only supported on time_ at the moment, "
+                f"not {on}"
+            )
+        if not self.relation.has_column(on):
+            raise CompilerError(f"rolling: no column {on!r}")
+        window_ns = _parse_window(window)
+        if window_ns <= 0:
+            raise CompilerError("Window size must be > 0")
+        binned = self.assign_column(
+            on,
+            ColumnExpr(
+                FuncCall(
+                    "bin",
+                    (ColumnRef(on), Constant(window_ns, DataType.INT64)),
+                ),
+                self,
+            ),
+        )
+        out = self._wrap(binned._id)
+        out._rolling_on = on
+        return out
 
     def agg(self, **kwargs) -> "DataFrameObj":
         return self._agg((), kwargs)

@@ -220,3 +220,58 @@ def test_dead_code_pruned():
         "px.display(df, 'out')\n"
     )
     assert ops == [MemorySourceOp, ResultSinkOp]
+
+
+def test_rolling_windowed_agg():
+    """df.rolling(window).groupby().agg() aggregates per (window, groups)
+    with time_ rewritten to the window start.
+
+    Ref surface: objects/dataframe.cc:386-407 RollingHandler (validates
+    on='time_', window > 0); the reference never lowers RollingIR
+    (rolling_ir.cc: 'Rolling operator not yet implemented') — ours lowers
+    to a window-binned group axis and actually executes."""
+    import numpy as np
+
+    from pixie_tpu.engine import Carnot
+    from pixie_tpu.types import DataType, Relation, SemanticType
+
+    c = Carnot()
+    rel = Relation.of(
+        ("time_", DataType.TIME64NS, SemanticType.ST_TIME_NS),
+        ("svc", DataType.STRING),
+        ("v", DataType.FLOAT64),
+    )
+    t = c.table_store.create_table("m", rel)
+    n = 1000
+    times = np.arange(n) * 10_000_000  # 10ms apart -> 10 windows of 1s
+    t.write_pydict({
+        "time_": times,
+        "svc": np.array(["a" if i % 2 else "b" for i in range(n)], dtype=object),
+        "v": np.ones(n),
+    })
+    t.compact()
+    t.stop()
+    res = c.execute_query(
+        "df = px.DataFrame(table='m')\n"
+        "df = df.rolling('1s')\n"
+        "s = df.groupby(['svc']).agg(n=('v', px.count))\n"
+        "px.display(s, 'out')\n"
+    )
+    rows = res.table("out")
+    assert set(rows.keys()) == {"time_", "svc", "n"}
+    # 10 windows x 2 services, 50 rows each
+    assert len(rows["n"]) == 20
+    assert all(v == 50 for v in rows["n"])
+    assert set(rows["time_"]) == {i * 1_000_000_000 for i in range(10)}
+
+    # reference-parity validation errors
+    import pytest
+
+    from pixie_tpu.compiler.objects import CompilerError
+
+    with pytest.raises(Exception, match="only supported on time_"):
+        c.execute_query(
+            "df = px.DataFrame(table='m')\n"
+            "df = df.rolling('1s', on='v')\n"
+            "px.display(df, 'x')\n"
+        )
