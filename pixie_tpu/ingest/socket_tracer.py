@@ -21,6 +21,7 @@ from pixie_tpu.ingest.http_gen import HTTP_EVENTS_REL
 from pixie_tpu.ingest.source_connector import DataTable, SourceConnector
 from pixie_tpu.protocols import dns as dns_proto
 from pixie_tpu.protocols import http as http_proto
+from pixie_tpu.protocols import mysql as mysql_proto
 from pixie_tpu.protocols.base import ConnTracker, TraceRole
 from pixie_tpu.types import DataType, Relation, SemanticType
 
@@ -40,15 +41,35 @@ DNS_EVENTS_REL = Relation.of(
     ("latency", I, SemanticType.ST_DURATION_NS),
 )
 
+# ref: mysql_table.h kMySQLElements
+MYSQL_EVENTS_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("upid", S, SemanticType.ST_UPID),
+    ("remote_addr", S, SemanticType.ST_IP_ADDRESS),
+    ("remote_port", I),
+    ("trace_role", I),
+    ("req_cmd", I),
+    ("req_body", S),
+    ("resp_status", I),
+    ("resp_body", S),
+    ("latency", I, SemanticType.ST_DURATION_NS),
+)
+
 _PARSERS = {
     "http": http_proto.HttpParser(),
     "dns": dns_proto.DnsParser(),
+    "mysql": mysql_proto.MysqlParser(),
 }
 _ROW_FNS = {
     "http": http_proto.record_to_row,
     "dns": dns_proto.record_to_row,
+    "mysql": mysql_proto.record_to_row,
 }
-_TABLE_FOR = {"http": "http_events", "dns": "dns_events"}
+_TABLE_FOR = {
+    "http": "http_events",
+    "dns": "dns_events",
+    "mysql": "mysql_events",
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +97,7 @@ class SocketTraceConnector(SourceConnector):
         self.tables = [
             DataTable("http_events", HTTP_EVENTS_REL),
             DataTable("dns_events", DNS_EVENTS_REL),
+            DataTable("mysql_events", MYSQL_EVENTS_REL),
         ]
 
     # -- event feed (the capture boundary) -----------------------------------

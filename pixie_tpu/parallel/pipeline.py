@@ -68,6 +68,8 @@ from pixie_tpu.plan.operators import (
     AggOp,
     AggStage,
     FilterOp,
+    JoinOp,
+    JoinType,
     LimitOp,
     MapOp,
     MemorySourceOp,
@@ -241,6 +243,210 @@ def match_scan_fragment(fragment: PlanFragment, relations) -> Optional[_ScanMatc
 
 
 @dataclasses.dataclass
+class _JoinAggMatch:
+    """Source→(Map|Filter)*→⌐                                  ⌐→Agg
+       Source→(Map|Filter)*→┘ INNER Join →(Map|Filter)* ┘
+
+    Device join-aggregate decomposition: the join's PAIRS are never
+    materialized. For decomposable aggregates, aggregating over the join
+    equals aggregating the LEFT rows with per-row weight w = (number of
+    matching RIGHT rows), plus per-key RIGHT statistics gathered by join
+    key:  count ≡ Σ_L w;  sum(left x) ≡ Σ_L x·w;
+    sum(right y) ≡ Σ_L sumR[y, key];  min/max(right y) ≡ min/max over
+    L of minR/maxR[y, key].  The reference's EquijoinNode
+    (equijoin_node.h:48) builds hash tables and materializes chunked
+    output rows; on TPU the decomposition keeps everything in segment
+    reductions over statically-shaped tensors."""
+
+    left_source_nid: int
+    right_source_nid: int
+    join_nid: int
+    agg_nid: int
+    left_source_op: MemorySourceOp
+    right_source_op: MemorySourceOp
+    join_op: JoinOp
+    agg_op: AggOp
+    left_exprs: dict       # left source-term mapping (pre-join chain)
+    right_exprs: dict      # right source-term mapping
+    left_preds: list       # pre-join predicates, left source terms
+    right_preds: list      # pre-join predicates, right source terms
+    left_key_exprs: list   # join keys in left source terms
+    right_key_exprs: list  # join keys in right source terms
+    post_left_preds: list  # post-join predicates that touch only left side
+    post_right_preds: list
+    left_relation: Any
+    right_relation: Any
+    # agg specs rewritten: [(out_name, side, arg_expr_in_side_terms, agg_name)]
+    specs: list
+    group_exprs: list      # [(group_name, left-side expr)]
+
+
+def _chain_to_source(fragment, start_nid, relations):
+    """Walk (Map|Filter)* up to a non-streaming MemorySource; returns
+    (source_nid, mapping, preds) or None."""
+    chain = []
+    cur = start_nid
+    while True:
+        op = fragment.node(cur)
+        if isinstance(op, MemorySourceOp):
+            if op.streaming:
+                return None
+            source_nid = cur
+            break
+        if not isinstance(op, (MapOp, FilterOp)):
+            return None
+        if len(fragment.children(cur)) != 1:
+            return None
+        chain.append(op)
+        parents = fragment.parents(cur)
+        if len(parents) != 1:
+            return None
+        cur = parents[0]
+    chain.reverse()
+    rel = relations[source_nid]
+    mapping = {c.name: ColumnRef(c.name) for c in rel}
+    preds = []
+    for op in chain:
+        if isinstance(op, FilterOp):
+            preds.append(substitute(op.expr, mapping))
+        else:
+            mapping = {n: substitute(e, mapping) for n, e in op.exprs}
+    return source_nid, mapping, preds, rel
+
+
+def _expr_side(expr, left_cols: set, right_cols: set):
+    """0 if the expression references only left-output columns, 1 if only
+    right, None if mixed/unknown."""
+    refs = referenced_columns(expr)
+    if refs <= left_cols:
+        return 0
+    if refs <= right_cols:
+        return 1
+    return None
+
+
+def match_join_agg(fragment: PlanFragment, relations) -> Optional[_JoinAggMatch]:
+    join_nid = None
+    for nid in fragment.topo_order():
+        if isinstance(fragment.node(nid), JoinOp):
+            join_nid = nid
+            break
+    if join_nid is None:
+        return None
+    join_op: JoinOp = fragment.node(join_nid)
+    if join_op.how != JoinType.INNER or not join_op.left_on:
+        return None
+    parents = fragment.parents(join_nid)
+    if len(parents) != 2 or len(fragment.children(join_nid)) != 1:
+        return None
+    left = _chain_to_source(fragment, parents[0], relations)
+    right = _chain_to_source(fragment, parents[1], relations)
+    if left is None or right is None:
+        return None
+    lsrc, lmap, lpreds, lrel = left
+    rsrc, rmap, rpreds, rrel = right
+    if lsrc == rsrc:
+        return None  # self-join over one cursor: host engine's job
+    # Walk DOWN from the join through (Map|Filter)* to the Agg.
+    out_cols = {o: (side, name) for side, name, o in join_op.output_columns}
+    post_map = {o: ColumnRef(o) for o in out_cols}
+    post_preds = []
+    cur = join_nid
+    agg_nid = None
+    while True:
+        children = fragment.children(cur)
+        if len(children) != 1:
+            return None
+        cur = children[0]
+        op = fragment.node(cur)
+        if isinstance(op, AggOp):
+            # FULL only: a PARTIAL stage must emit serialized states for
+            # its MERGE consumer, which this decomposition does not build.
+            if op.windowed or op.stage != AggStage.FULL:
+                return None
+            if len(fragment.parents(cur)) != 1:
+                return None
+            agg_nid = cur
+            break
+        if isinstance(op, FilterOp):
+            post_preds.append(substitute(op.expr, post_map))
+        elif isinstance(op, MapOp):
+            post_map = {n: substitute(e, post_map) for n, e in op.exprs}
+        else:
+            return None
+    agg_op: AggOp = fragment.node(agg_nid)
+
+    # Rewrite every post-join expression into single-side source terms.
+    left_out = {o for o, (s, _) in out_cols.items() if s == 0}
+    right_out = {o for o, (s, _) in out_cols.items() if s == 1}
+
+    def rewrite(expr):
+        side = _expr_side(expr, left_out, right_out)
+        if side is None:
+            return None
+        src_map = lmap if side == 0 else rmap
+        name_map = {
+            o: substitute(ColumnRef(out_cols[o][1]), src_map)
+            for o in (left_out if side == 0 else right_out)
+        }
+        return side, substitute(expr, name_map)
+
+    post_left_preds, post_right_preds = [], []
+    for p in post_preds:
+        rw = rewrite(p)
+        if rw is None:
+            return None
+        (post_left_preds if rw[0] == 0 else post_right_preds).append(rw[1])
+    group_exprs = []
+    for g in agg_op.groups:
+        rw = rewrite(post_map[g] if g in post_map else ColumnRef(g))
+        if rw is None or rw[0] != 0:
+            return None  # v1: groups must come from the left side
+        group_exprs.append((g, rw[1]))
+    specs = []
+    for out_name, agg in agg_op.values:
+        if agg.name not in _JOIN_DECOMPOSABLE:
+            return None
+        if not agg.args:
+            return None
+        arg = substitute(agg.args[0], post_map)
+        rw = rewrite(arg)
+        if rw is None:
+            return None
+        specs.append((out_name, rw[0], rw[1], agg.name))
+    # Join keys are named on each side's JOIN INPUT; map through the
+    # pre-join chains into source terms.
+    left_key_exprs = [substitute(ColumnRef(k), lmap) for k in join_op.left_on]
+    right_key_exprs = [substitute(ColumnRef(k), rmap) for k in join_op.right_on]
+    return _JoinAggMatch(
+        left_source_nid=lsrc,
+        right_source_nid=rsrc,
+        join_nid=join_nid,
+        agg_nid=agg_nid,
+        left_source_op=fragment.node(lsrc),
+        right_source_op=fragment.node(rsrc),
+        join_op=join_op,
+        agg_op=agg_op,
+        left_exprs=lmap,
+        right_exprs=rmap,
+        left_preds=lpreds,
+        right_preds=rpreds,
+        left_key_exprs=left_key_exprs,
+        right_key_exprs=right_key_exprs,
+        post_left_preds=post_left_preds,
+        post_right_preds=post_right_preds,
+        left_relation=lrel,
+        right_relation=rrel,
+        specs=specs,
+        group_exprs=group_exprs,
+    )
+
+
+# Aggregates with a join decomposition (count/sum/mean/min/max).
+_JOIN_DECOMPOSABLE = {"count", "sum", "mean", "min", "max"}
+
+
+@dataclasses.dataclass
 class _KeyPlan:
     """How group gids materialize. Exactly one of the modes applies:
     device_expr (codes/LUT gather on device) or host_gids (densified on
@@ -333,6 +539,11 @@ class MeshExecutor:
         relations = fragment.resolve_relations(registry, table_rel)
         m = match_fragment(fragment, relations)
         if m is None:
+            ja = self._try_execute_join_agg(
+                fragment, relations, table_store, registry, func_ctx
+            )
+            if ja is not None:
+                return ja
             return self._try_execute_scan(
                 fragment, relations, table_store, registry, func_ctx
             )
@@ -455,6 +666,673 @@ class MeshExecutor:
             )
         return m.agg_nid, batch
 
+    # -- device join-aggregate (inner join fused into the agg) ---------------
+    def _try_execute_join_agg(
+        self, fragment, relations, table_store, registry, func_ctx
+    ) -> Optional[tuple[int, RowBatch]]:
+        m = match_join_agg(fragment, relations)
+        if m is None:
+            return None
+        lt = table_store.get_table(m.left_source_op.table_name)
+        rt = table_store.get_table(m.right_source_op.table_name)
+        if lt is None or rt is None:
+            return None
+        # v1 gates: bare-column join keys; non-string agg args.
+        if not all(isinstance(e, ColumnRef) for e in m.left_key_exprs):
+            return None
+        if not all(isinstance(e, ColumnRef) for e in m.right_key_exprs):
+            return None
+        for (_, agg), (_o, side, arg_e, _name) in zip(m.agg_op.values, m.specs):
+            if len(agg.args) != 1:
+                return None  # single-arg decompositions only
+            rel = m.left_relation if side == 0 else m.right_relation
+            try:
+                if expr_data_type(arg_e, rel, registry) == DataType.STRING:
+                    return None
+            except (KeyError, ValueError):
+                return None
+
+        # --- shared join-key id space (host; the 'dense gids' the sorted
+        # merge would use — here they index the per-key stat tensors) ------
+        def read_keys(table, rel, key_exprs, src_op):
+            cols, n = read_columns(
+                table,
+                sorted({e.name for e in key_exprs}),
+                src_op.start_time,
+                src_op.stop_time,
+            )
+            return cols, n
+
+        lcols, nl = read_keys(lt, m.left_relation, m.left_key_exprs, m.left_source_op)
+        rcols, nr = read_keys(rt, m.right_relation, m.right_key_exprs, m.right_source_op)
+        lkey_arrays, rkey_arrays = [], []
+        for le, re_ in zip(m.left_key_exprs, m.right_key_exprs):
+            la, ra = lcols[le.name], rcols[re_.name]
+            lt_dt = m.left_relation.col(le.name).data_type
+            rt_dt = m.right_relation.col(re_.name).data_type
+            if lt_dt == DataType.STRING or rt_dt == DataType.STRING:
+                if lt_dt != rt_dt:
+                    return None
+                shared = StringDictionary()
+                dl, dr = lt.dictionaries.get(le.name), rt.dictionaries.get(re_.name)
+                if dl is None or dr is None:
+                    return None
+                lut_l = shared.encode(np.asarray(list(dl.values()), dtype=object))
+                lut_r = shared.encode(np.asarray(list(dr.values()), dtype=object))
+                la = lut_l[la] if len(lut_l) else la
+                ra = lut_r[ra] if len(lut_r) else ra
+            lkey_arrays.append(np.asarray(la))
+            rkey_arrays.append(np.asarray(ra))
+        enc = GroupEncoder()
+        kl = enc.encode(lkey_arrays) if nl else np.empty(0, np.int32)
+        kr = enc.encode(rkey_arrays) if nr else np.empty(0, np.int32)
+        K = max(enc.num_groups, 1)
+        if K > (1 << 22):
+            return None  # stat tensors would be unreasonable
+
+        # --- group-key plan over the LEFT side ---------------------------
+        shim = _Match(
+            source_nid=m.left_source_nid,
+            agg_nid=m.agg_nid,
+            source_op=m.left_source_op,
+            agg_op=dataclasses.replace(
+                m.agg_op, groups=tuple(g for g, _ in m.group_exprs)
+            ),
+            col_exprs={g: e for g, e in m.group_exprs},
+            predicates=[],
+            source_relation=m.left_relation,
+        )
+        base_left = {e.name for e in m.left_key_exprs}
+        for p in m.left_preds + m.post_left_preds:
+            base_left |= referenced_columns(p)
+        for _, side, arg_e, _n in m.specs:
+            if side == 0:
+                base_left |= referenced_columns(arg_e)
+        key_plan = self._plan_keys(shim, lt, registry, func_ctx, base_left)
+        if key_plan is None:
+            return None
+        if m.group_exprs and key_plan.host_gids is None:
+            # _plan_keys prefers device key paths (dict codes / LUT); the
+            # join-agg program wants host gids — derive them cheaply from
+            # the same dictionary structures.
+            if isinstance(key_plan.device_expr, ColumnRef):
+                cols2, n2 = read_columns(
+                    lt,
+                    [key_plan.device_expr.name],
+                    m.left_source_op.start_time,
+                    m.left_source_op.stop_time,
+                )
+                gids2 = cols2[key_plan.device_expr.name].astype(np.int32)
+            elif isinstance(key_plan.device_expr, tuple):
+                _, src_col, lut_codes = key_plan.device_expr
+                cols2, n2 = read_columns(
+                    lt,
+                    [src_col],
+                    m.left_source_op.start_time,
+                    m.left_source_op.stop_time,
+                )
+                codes = np.maximum(cols2[src_col], 0)
+                gids2 = np.asarray(lut_codes)[codes].astype(np.int32)
+            else:
+                return None
+            key_plan = dataclasses.replace(key_plan, host_gids=gids2)
+        if key_plan.host_gids is not None and len(key_plan.host_gids) != nl:
+            return None
+        if key_plan.host_gids is None:
+            # Group-by-none: one global group; the program still wants a
+            # staged gid lane.
+            key_plan = dataclasses.replace(
+                key_plan, host_gids=np.zeros(nl, np.int32), num_groups=1
+            )
+        capacity = _pow2_at_least(max(key_plan.num_groups, 1))
+        if capacity > (1 << 20):
+            return None
+
+        # --- right-side per-key statistics (device, stays resident) ------
+        base_right = set()
+        for p in m.right_preds + m.post_right_preds:
+            base_right |= referenced_columns(p)
+        right_specs = [
+            (out, arg_e, name)
+            for out, side, arg_e, name in m.specs
+            if side == 1
+        ]
+        for _, arg_e, _n in right_specs:
+            base_right |= referenced_columns(arg_e)
+        r_named = [
+            (f"pred{i}", p)
+            for i, p in enumerate(m.right_preds + m.post_right_preds)
+        ] + [(f"arg:{o}", e) for o, e, _n in right_specs]
+        try:
+            r_eval = ExpressionEvaluator(
+                r_named, m.right_relation, registry, func_ctx
+            )
+            l_eval = ExpressionEvaluator(
+                [
+                    (f"pred{i}", p)
+                    for i, p in enumerate(m.left_preds + m.post_left_preds)
+                ]
+                + [
+                    (f"arg:{o}", e)
+                    for o, side, e, _n in m.specs
+                    if side == 0
+                ],
+                m.left_relation,
+                registry,
+                func_ctx,
+            )
+        except ValueError:
+            return None
+        # The shared-encoder id space depends on the LEFT side too (left
+        # keys are encoded first, so left content changes permute ids):
+        # the right staging's identity must pin the whole key space.
+        key_space_sig = (
+            m.left_source_op.table_name,
+            (lt.min_row_id(), lt.end_row_id()),
+            repr(m.left_key_exprs) + repr(m.right_key_exprs),
+            m.left_source_op.start_time,
+            m.left_source_op.stop_time,
+        )
+        rstats = self._run_right_stats(
+            m, rt, rcols_needed=sorted(base_right), kr=kr, nr=nr, K=K,
+            evaluator=r_eval, right_specs=right_specs,
+            key_space_sig=key_space_sig,
+        )
+        if rstats is None:
+            return None
+        # --- left-side weighted aggregation --------------------------------
+        left_stage_cols = set()
+        for p in m.left_preds + m.post_left_preds:
+            left_stage_cols |= referenced_columns(p)
+        for _o, side, e, _n in m.specs:
+            if side == 0:
+                left_stage_cols |= referenced_columns(e)
+        out = self._run_left_join_agg(
+            m, lt, sorted(left_stage_cols),
+            kl, nl, key_plan, capacity, l_eval, rstats, registry,
+        )
+        if out is None:
+            return None
+        return m.agg_nid, out
+
+    def _run_right_stats(
+        self, m, table, rcols_needed, kr, nr, K, evaluator, right_specs,
+        key_space_sig=None, **_
+    ):
+        """Stage the right side and reduce per-key stats on the mesh:
+        nR[K] plus per-right-arg sum/min/max as needed. Outputs are device
+        arrays (replicated); nothing is fetched."""
+        cache_key = (
+            m.right_source_op.table_name,
+            (table.min_row_id(), table.end_row_id()),
+            tuple(sorted(set(rcols_needed))),
+            m.right_source_op.start_time,
+            m.right_source_op.stop_time,
+            self.block_rows,
+            ":joinright:" + repr(key_space_sig),
+            K,
+            (),
+        )
+        staged = self._stage_cached(
+            cache_key,
+            table,
+            m.right_source_op,
+            rcols_needed,
+            _KeyPlan(host_gids=kr.astype(np.int32), num_groups=K),
+        )
+        if staged is None or staged.num_rows != nr:
+            return None
+        aux = {}
+        for name, e in evaluator.named_exprs:
+            aux.update(evaluator.build_aux(e, table.dictionaries))
+        col_names = sorted(staged.blocks)
+        narrow_names = sorted(staged.narrow_offsets)
+        preds = [e for n, e in evaluator.named_exprs if n.startswith("pred")]
+        axis = self.mesh.axis_names[0]
+        ndev = staged.num_devices
+        aux_order = list(aux.keys())
+        stat_kinds = []  # [(spec out name, kind)] kinds: sum/min/max
+        for out, _e, name in right_specs:
+            if name in ("sum", "mean"):
+                stat_kinds.append((out, "sum"))
+            elif name == "min":
+                stat_kinds.append((out, "min"))
+            elif name == "max":
+                stat_kinds.append((out, "max"))
+            else:
+                return None  # count needs no right stat
+
+        sig = "|".join(
+            [
+                "joinR",
+                ",".join(
+                    f"{n2}:{a.shape}:{a.dtype}"
+                    for n2, a in sorted(staged.blocks.items())
+                ),
+                f"narrow:{narrow_names}",
+                f"K:{K}",
+                "preds:" + ";".join(repr(p) for p in preds),
+                "stats:" + ";".join(f"{o}:{k}" for o, k in stat_kinds),
+                "aux:" + ",".join(
+                    f"{np.shape(v)}:{np.asarray(v).dtype}" for v in aux.values()
+                ),
+                f"mesh:{self.mesh.devices.shape}",
+            ]
+        )
+        arg_exprs = {o: e for o, e, _n in right_specs}
+
+        if sig not in self._program_cache:
+
+            def shard_fn(*arrs):
+                i = len(col_names)
+                cols = {n: a[0] for n, a in zip(col_names, arrs[:i])}
+                mask_all = arrs[i][0]
+                jk_all = arrs[i + 1][0]
+                i += 2
+                end = len(arrs)
+                narrow_vec = None
+                if narrow_names:
+                    narrow_vec = arrs[-1]
+                    end -= 1
+                aux_v = dict(zip(aux_order, arrs[i:end]))
+
+                def body(carry, xs):
+                    from pixie_tpu.ops import segment as _segment
+
+                    counts, sums, mins, maxs = carry
+                    blk_cols, blk_mask, blk_jk = xs
+                    env = dict(zip(col_names, blk_cols))
+                    for ni, nm in enumerate(narrow_names):
+                        env[nm] = env[nm].astype(jnp.int64) + narrow_vec[ni]
+                    mask = blk_mask
+                    for p in preds:
+                        mask = mask & evaluator.device_eval(p, env, aux_v)
+                    jk = blk_jk.astype(jnp.int32)
+                    counts = counts + _segment.seg_sum(
+                        mask.astype(jnp.float64), jk, K
+                    )
+                    new_sums = {}
+                    for o, kind in stat_kinds:
+                        val = evaluator.device_eval(
+                            arg_exprs[o], env, aux_v
+                        ).astype(jnp.float64)
+                        if kind == "sum":
+                            new_sums[o] = sums[o] + _segment.seg_sum(
+                                val, jk, K, mask
+                            )
+                        elif kind == "min":
+                            mins[o] = jnp.minimum(
+                                mins[o],
+                                _segment.seg_min(val, jk, K, mask),
+                            )
+                        else:
+                            maxs[o] = jnp.maximum(
+                                maxs[o],
+                                _segment.seg_max(val, jk, K, mask),
+                            )
+                    sums.update(new_sums)
+                    return (counts, sums, mins, maxs), None
+
+                init = (
+                    jnp.zeros(K, jnp.float64),
+                    {o: jnp.zeros(K, jnp.float64) for o, k in stat_kinds if k == "sum"},
+                    {o: jnp.full(K, jnp.inf) for o, k in stat_kinds if k == "min"},
+                    {o: jnp.full(K, -jnp.inf) for o, k in stat_kinds if k == "max"},
+                )
+                xs = (
+                    tuple(cols[n] for n in col_names),
+                    mask_all,
+                    jk_all,
+                )
+                (counts, sums, mins, maxs), _ = jax.lax.scan(body, init, xs)
+                if ndev > 1:
+                    counts = jax.lax.psum(counts, axis)
+                    sums = {o: jax.lax.psum(v, axis) for o, v in sums.items()}
+                    mins = {o: jax.lax.pmin(v, axis) for o, v in mins.items()}
+                    maxs = {o: jax.lax.pmax(v, axis) for o, v in maxs.items()}
+                return (
+                    (counts,)
+                    + tuple(sums[o] for o, k in stat_kinds if k == "sum")
+                    + tuple(mins[o] for o, k in stat_kinds if k == "min")
+                    + tuple(maxs[o] for o, k in stat_kinds if k == "max")
+                )
+
+            n_sharded = len(col_names) + 2
+            n_repl = len(aux_order) + (1 if narrow_names else 0)
+            in_specs = tuple([P(axis)] * n_sharded + [P()] * n_repl)
+            n_out = 1 + len(stat_kinds)
+            program = jax.jit(
+                shard_map(
+                    shard_fn,
+                    mesh=self.mesh,
+                    in_specs=in_specs,
+                    out_specs=tuple([P()] * n_out),
+                    **_SM_CHECK_KW,
+                )
+            )
+            self._program_cache[sig] = (program, len(aux_order), None)
+            _PROGRAMS.set(len(self._program_cache))
+        program = self._program_cache[sig][0]
+        args = [staged.blocks[n2] for n2 in col_names]
+        args.append(staged.mask)
+        args.append(staged.gids)  # join-key ids staged as gids
+        args.extend(jnp.asarray(v) for v in aux.values())
+        if staged.narrow_offsets:
+            args.append(
+                jnp.asarray(
+                    [staged.narrow_offsets[n2] for n2 in narrow_names],
+                    jnp.int64,
+                )
+            )
+        from pixie_tpu.ops import segment as _segment
+
+        with _segment.platform_hint(self.mesh.devices.flat[0].platform):
+            outs = program(*args)
+        result = {"__n__": outs[0]}
+        idx = 1
+        for o, k in [(o, k) for o, k in stat_kinds if k == "sum"]:
+            result[f"sum:{o}"] = outs[idx]
+            idx += 1
+        for o, k in [(o, k) for o, k in stat_kinds if k == "min"]:
+            result[f"min:{o}"] = outs[idx]
+            idx += 1
+        for o, k in [(o, k) for o, k in stat_kinds if k == "max"]:
+            result[f"max:{o}"] = outs[idx]
+            idx += 1
+        return result
+
+    def _run_left_join_agg(
+        self, m, table, lcols_needed, kl, nl, key_plan, capacity,
+        evaluator, rstats, registry,
+    ):
+        """Scan the LEFT side with per-row join weights gathered from the
+        right-key stats; segment-reduce per agg group; fetch one buffer."""
+        from pixie_tpu.types.dtypes import host_dtype
+
+        base = set(lcols_needed)
+        cache_key = (
+            m.left_source_op.table_name,
+            (table.min_row_id(), table.end_row_id()),
+            tuple(sorted(base)),
+            m.left_source_op.start_time,
+            m.left_source_op.stop_time,
+            self.block_rows,
+            ":joinleft:" + repr(m.left_key_exprs) + repr(
+                [e for _, e in m.group_exprs]
+            ),
+            key_plan.num_groups,
+            (),
+        )
+        staged = self._stage_cached(
+            cache_key,
+            table,
+            m.left_source_op,
+            base,
+            key_plan,
+            extra_cols={"__jk__": kl.astype(np.int32)},
+        )
+        if staged is None or staged.num_rows != nl:
+            return None
+        aux = {}
+        for name, e in evaluator.named_exprs:
+            aux.update(evaluator.build_aux(e, table.dictionaries))
+        col_names = sorted(staged.blocks)
+        narrow_names = sorted(staged.narrow_offsets)
+        preds = [e for n, e in evaluator.named_exprs if n.startswith("pred")]
+        axis = self.mesh.axis_names[0]
+        ndev = staged.num_devices
+        aux_order = list(aux.keys())
+        stat_names = sorted(rstats)
+        arg_exprs = {
+            o: e for o, side, e, _n in m.specs if side == 0
+        }
+        spec_plan = [(o, side, name) for o, side, _e, name in m.specs]
+
+        sig = "|".join(
+            [
+                "joinL",
+                ",".join(
+                    f"{n2}:{a.shape}:{a.dtype}"
+                    for n2, a in sorted(staged.blocks.items())
+                ),
+                f"narrow:{narrow_names}",
+                f"cap:{capacity}",
+                "preds:" + ";".join(repr(p) for p in preds),
+                "specs:" + ";".join(
+                    f"{o}:{s}:{n2}" for o, s, n2 in spec_plan
+                ),
+                "largs:" + ";".join(
+                    f"{o}={e!r}" for o, e in sorted(arg_exprs.items())
+                ),
+                "stats:" + ",".join(stat_names),
+                "aux:" + ",".join(
+                    f"{np.shape(v)}:{np.asarray(v).dtype}" for v in aux.values()
+                ),
+                f"mesh:{self.mesh.devices.shape}",
+            ]
+        )
+        if sig not in self._program_cache:
+
+            def shard_fn(*arrs):
+                from pixie_tpu.ops import segment as _segment
+
+                i = len(col_names)
+                cols = {n: a[0] for n, a in zip(col_names, arrs[:i])}
+                mask_all = arrs[i][0]
+                gids_all = arrs[i + 1][0]
+                i += 2
+                stats = dict(zip(stat_names, arrs[i : i + len(stat_names)]))
+                i += len(stat_names)
+                end = len(arrs)
+                narrow_vec = None
+                if narrow_names:
+                    narrow_vec = arrs[-1]
+                    end -= 1
+                aux_v = dict(zip(aux_order, arrs[i:end]))
+                nR = stats["__n__"]
+
+                def body(carry, xs):
+                    acc = carry
+                    blk_cols, blk_mask, blk_gids = xs
+                    env = dict(zip(col_names, blk_cols))
+                    for ni, nm in enumerate(narrow_names):
+                        env[nm] = env[nm].astype(jnp.int64) + narrow_vec[ni]
+                    mask = blk_mask
+                    for p in preds:
+                        mask = mask & evaluator.device_eval(p, env, aux_v)
+                    jk = env["__jk__"].astype(jnp.int32)
+                    w = nR[jk]
+                    mask = mask & (w > 0)
+                    gids = blk_gids.astype(jnp.int32)
+                    wm = jnp.where(mask, w, 0.0)
+                    new_acc = dict(acc)
+                    new_acc["__count__"] = acc["__count__"] + _segment.seg_sum(
+                        wm, gids, capacity
+                    )
+                    for o, side, name in spec_plan:
+                        key = f"s:{o}"
+                        if name == "count":
+                            continue  # __count__ serves every count spec
+                        if side == 0:
+                            val = evaluator.device_eval(
+                                arg_exprs[o], env, aux_v
+                            ).astype(jnp.float64)
+                            if name in ("sum", "mean"):
+                                new_acc[key] = acc[key] + _segment.seg_sum(
+                                    val * wm, gids, capacity
+                                )
+                            elif name == "min":
+                                new_acc[key] = jnp.minimum(
+                                    acc[key],
+                                    _segment.seg_min(val, gids, capacity, mask),
+                                )
+                            else:
+                                new_acc[key] = jnp.maximum(
+                                    acc[key],
+                                    _segment.seg_max(val, gids, capacity, mask),
+                                )
+                        else:
+                            if name in ("sum", "mean"):
+                                g = stats[f"sum:{o}"][jk]
+                                new_acc[key] = acc[key] + _segment.seg_sum(
+                                    jnp.where(mask, g, 0.0), gids, capacity
+                                )
+                            elif name == "min":
+                                g = stats[f"min:{o}"][jk]
+                                new_acc[key] = jnp.minimum(
+                                    acc[key],
+                                    _segment.seg_min(g, gids, capacity, mask),
+                                )
+                            else:
+                                g = stats[f"max:{o}"][jk]
+                                new_acc[key] = jnp.maximum(
+                                    acc[key],
+                                    _segment.seg_max(g, gids, capacity, mask),
+                                )
+                    return new_acc, None
+
+                init = {"__count__": jnp.zeros(capacity, jnp.float64)}
+                for o, side, name in spec_plan:
+                    if name == "count":
+                        continue
+                    if name in ("sum", "mean"):
+                        init[f"s:{o}"] = jnp.zeros(capacity, jnp.float64)
+                    elif name == "min":
+                        init[f"s:{o}"] = jnp.full(capacity, jnp.inf)
+                    else:
+                        init[f"s:{o}"] = jnp.full(capacity, -jnp.inf)
+                xs = (
+                    tuple(cols[n] for n in col_names),
+                    mask_all,
+                    gids_all,
+                )
+                acc, _ = jax.lax.scan(body, init, xs)
+                if ndev > 1:
+                    merged = {}
+                    merged["__count__"] = jax.lax.psum(acc["__count__"], axis)
+                    for o, side, name in spec_plan:
+                        if name == "count":
+                            continue
+                        k2 = f"s:{o}"
+                        if name in ("sum", "mean"):
+                            merged[k2] = jax.lax.psum(acc[k2], axis)
+                        elif name == "min":
+                            merged[k2] = jax.lax.pmin(acc[k2], axis)
+                        else:
+                            merged[k2] = jax.lax.pmax(acc[k2], axis)
+                    acc = merged
+                parts = [acc["__count__"]]
+                for o, side, name in spec_plan:
+                    if name != "count":
+                        parts.append(acc[f"s:{o}"])
+                return jnp.concatenate(parts)
+
+            n_sharded = len(col_names) + 2
+            n_repl = (
+                len(stat_names)
+                + len(aux_order)
+                + (1 if narrow_names else 0)
+            )
+            in_specs = tuple([P(axis)] * n_sharded + [P()] * n_repl)
+            program = jax.jit(
+                shard_map(
+                    shard_fn,
+                    mesh=self.mesh,
+                    in_specs=in_specs,
+                    out_specs=P(),
+                    **_SM_CHECK_KW,
+                )
+            )
+            self._program_cache[sig] = (program, len(aux_order), None)
+            _PROGRAMS.set(len(self._program_cache))
+        program = self._program_cache[sig][0]
+        args = [staged.blocks[n2] for n2 in col_names]
+        args.append(staged.mask)
+        args.append(staged.gids)
+        args.extend(rstats[n2] for n2 in stat_names)
+        args.extend(jnp.asarray(v) for v in aux.values())
+        if staged.narrow_offsets:
+            args.append(
+                jnp.asarray(
+                    [staged.narrow_offsets[n2] for n2 in narrow_names],
+                    jnp.int64,
+                )
+            )
+        from pixie_tpu.ops import segment as _segment
+
+        with _segment.platform_hint(self.mesh.devices.flat[0].platform):
+            buf = np.asarray(program(*args))
+        counts = buf[:capacity]
+        vals = {}
+        off = capacity
+        for o, side, name in spec_plan:
+            if name != "count":
+                vals[o] = buf[off : off + capacity]
+                off += capacity
+        n = max(key_plan.num_groups, 1) if m.agg_op.groups else 1
+        keep = counts[:n] > 0 if m.agg_op.groups else np.ones(1, bool)
+        rel = m.agg_op.output_relation(
+            [self._join_pre_agg_relation(m, registry)], registry
+        )
+        out_cols: list = []
+        for (g, _e), col in zip(m.group_exprs, key_plan.key_columns):
+            out_cols.append(
+                col.take(np.nonzero(keep)[0])
+                if isinstance(col, DictColumn)
+                else np.asarray(col)[keep]
+            )
+        for out_name, side, _e, name in m.specs:
+            schema = rel.col(out_name)
+            if name == "count":
+                out = counts[:n][keep]
+            elif name == "mean":
+                out = vals[out_name][:n][keep] / np.maximum(
+                    counts[:n][keep], 1.0
+                )
+            else:
+                out = vals[out_name][:n][keep]
+            dt = host_dtype(schema.data_type)
+            if np.issubdtype(dt, np.integer):
+                out = np.round(out).astype(dt)
+            else:
+                out = out.astype(dt)
+            out_cols.append(out)
+        return RowBatch(rel, out_cols, eow=True, eos=True)
+
+    def _join_pre_agg_relation(self, m: "_JoinAggMatch", registry):
+        """Relation the agg's output resolution expects: group columns (in
+        left-source terms) + the post-join arg columns typed per side."""
+        from pixie_tpu.types import ColumnSchema, Relation as _Relation
+
+        cols = []
+        seen = set()
+        for g, e in m.group_exprs:
+            cols.append(
+                ColumnSchema(
+                    g, expr_data_type(e, m.left_relation, registry)
+                )
+            )
+            seen.add(g)
+        # Arg columns: the AggOp's value exprs reference post-join names;
+        # synthesize a relation typing each referenced column by its side.
+        for out_name, agg in m.agg_op.values:
+            for ref in referenced_columns(agg):
+                if ref in seen:
+                    continue
+                for _o, side, arg_e, _n in m.specs:
+                    if _o == out_name:
+                        rel = (
+                            m.left_relation if side == 0 else m.right_relation
+                        )
+                        try:
+                            dt = expr_data_type(arg_e, rel, registry)
+                        except (KeyError, ValueError):
+                            dt = DataType.FLOAT64
+                        cols.append(ColumnSchema(ref, dt))
+                        seen.add(ref)
+                        break
+        return _Relation(cols)
+
     # -- device scan (filter/project/limit, no aggregate) --------------------
     def _try_execute_scan(
         self, fragment, relations, table_store, registry, func_ctx
@@ -501,29 +1379,11 @@ class MeshExecutor:
             0,
             (),
         )
-        staged = self._staged_lookup(cache_key)
+        staged = self._stage_cached(
+            cache_key, table, m.source_op, base_cols, _KeyPlan(num_groups=0)
+        )
         if staged is None:
-            cols, n = read_columns(
-                table,
-                sorted(base_cols),
-                m.source_op.start_time,
-                m.source_op.stop_time,
-            )
-            try:
-                staged = self._stage(cols, n, _KeyPlan(num_groups=0), table)
-            except Exception as e:
-                if "RESOURCE_EXHAUSTED" not in str(e) and (
-                    "Out of memory" not in str(e)
-                ):
-                    raise
-                # Device OOM: same policy as the agg path — drop every
-                # cached staging and retry once.
-                self._staged_cache.clear()
-                _STAGED_EVICTIONS.inc(reason="oom")
-                staged = None
-            if staged is None:
-                staged = self._stage(cols, n, _KeyPlan(num_groups=0), table)
-            self._staged_insert(cache_key, staged, m.source_op.table_name, version)
+            return None
         aux = {}
         for name, e in evaluator.named_exprs:
             aux.update(evaluator.build_aux(e, table.dictionaries))
@@ -614,6 +1474,52 @@ class MeshExecutor:
         staged = self._staged_cache.get(cache_key)
         if staged is not None:
             self._staged_cache.move_to_end(cache_key)
+        return staged
+
+    def _stage_cached(
+        self,
+        cache_key,
+        table,
+        src_op,
+        cols_needed,
+        key_plan,
+        extra_cols=None,
+        f32_cols=None,
+    ):
+        """Cache-or-stage with the shared OOM clear-and-retry policy.
+        Returns the StagedColumns (staged.num_rows tells callers what the
+        cursor actually saw). One implementation for the scan and join
+        paths — three hand-rolled copies drifted in r4 review."""
+        staged = self._staged_lookup(cache_key)
+        if staged is not None:
+            return staged
+        cols, n = read_columns(
+            table,
+            sorted(set(cols_needed)),
+            src_op.start_time,
+            src_op.stop_time,
+        )
+        for name, arr in (extra_cols or {}).items():
+            if len(arr) != n:
+                return None  # table moved under us
+            cols[name] = arr
+        if key_plan.host_gids is not None and len(key_plan.host_gids) != n:
+            return None
+        try:
+            staged = self._stage(cols, n, key_plan, table, f32_cols)
+        except Exception as e:
+            if "RESOURCE_EXHAUSTED" not in str(e) and (
+                "Out of memory" not in str(e)
+            ):
+                raise
+            self._staged_cache.clear()
+            _STAGED_EVICTIONS.inc(reason="oom")
+            staged = None
+        if staged is None:
+            staged = self._stage(cols, n, key_plan, table, f32_cols)
+        self._staged_insert(
+            cache_key, staged, src_op.table_name, cache_key[1]
+        )
         return staged
 
     def _staged_insert(self, cache_key, staged, table_name, version) -> None:

@@ -17,7 +17,7 @@ from pixie_tpu.protocols.base import (
     Record,
     TraceRole,
 )
-from pixie_tpu.protocols import dns, http
+from pixie_tpu.protocols import dns, http, mysql
 
 __all__ = [
     "ConnTracker",
@@ -28,4 +28,5 @@ __all__ = [
     "TraceRole",
     "dns",
     "http",
+    "mysql",
 ]

@@ -1,0 +1,296 @@
+"""MySQL frame parser + stitcher.
+
+Ref: protocols/mysql/{parse.cc,types.h,stitcher.cc,handler.cc} — packets
+are 3-byte little-endian length + sequence id + payload; a request is a
+sequence-0 packet whose first payload byte is a valid command; responses
+are packet bundles interpreted per command (OK 0x00 / ERR 0xff / EOF 0xfe
+/ resultsets with column definitions and row packets). Output rows match
+mysql_table.h kMySQLElements (req_cmd, req_body, resp_status, resp_body,
+latency).
+
+Subset: the command set and OK/ERR/EOF/resultset framing are complete;
+prepared-statement argument inflation (stmt_execute parameter decoding,
+handler.cc ProcessStmtExecute) is not — stmt commands surface with their
+raw statement ids, which keeps conn trackers and tables truthful without
+the prepared-statement registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+from pixie_tpu.protocols import base
+from pixie_tpu.protocols.base import MessageType, ParseState, Record
+
+HEADER_LEN = 4
+MAX_PACKET = (1 << 24) - 1
+
+# ref: types.h Command enum
+COMMANDS = {
+    0x01: "Quit",
+    0x02: "InitDB",
+    0x03: "Query",
+    0x04: "FieldList",
+    0x05: "CreateDB",
+    0x06: "DropDB",
+    0x07: "Refresh",
+    0x08: "Shutdown",
+    0x09: "Statistics",
+    0x0A: "ProcessInfo",
+    0x0C: "ProcessKill",
+    0x0D: "Debug",
+    0x0E: "Ping",
+    0x11: "ChangeUser",
+    0x16: "StmtPrepare",
+    0x17: "StmtExecute",
+    0x18: "StmtSendLongData",
+    0x19: "StmtClose",
+    0x1A: "StmtReset",
+    0x1B: "SetOption",
+    0x1C: "StmtFetch",
+    0x1F: "ResetConnection",
+}
+# Commands whose body is a single string argument (ref: handler.cc).
+_STRING_BODY = {0x02, 0x03, 0x05, 0x06, 0x16}
+# Commands with no response at all (ref: handler.cc kNoResponse).
+NO_RESPONSE_CMDS = {0x01, 0x18, 0x19}
+
+RESP_UNKNOWN, RESP_NONE, RESP_OK, RESP_ERR = 0, 1, 2, 3  # ref: RespStatus
+
+
+@dataclasses.dataclass
+class Packet(base.Frame):
+    """One wire packet (ref: mysql::Packet, types.h:60)."""
+
+    sequence_id: int = 0
+    msg: bytes = b""
+
+    @property
+    def is_ok(self) -> bool:
+        # ref: packet_utils.cc IsOKPacket (header 0x00, len >= 7... relaxed)
+        return len(self.msg) >= 1 and self.msg[0] == 0x00 and len(self.msg) >= 7
+
+    @property
+    def is_err(self) -> bool:
+        return len(self.msg) >= 3 and self.msg[0] == 0xFF
+
+    @property
+    def is_eof(self) -> bool:
+        return len(self.msg) < 9 and len(self.msg) >= 1 and self.msg[0] == 0xFE
+
+
+class MysqlParser(base.ProtocolParser):
+    name = "mysql"
+
+    def find_frame_boundary(self, msg_type, buf: bytes, start: int) -> int:
+        # ref: parse.cc FindFrameBoundary — scan for a plausible header:
+        # requests restart at sequence id 0 with a valid command byte.
+        for i in range(start, len(buf) - HEADER_LEN):
+            length = int.from_bytes(buf[i : i + 3], "little")
+            seq = buf[i + 3]
+            if length == 0 or length > MAX_PACKET:
+                continue
+            if msg_type == MessageType.REQUEST:
+                if seq == 0 and i + HEADER_LEN < len(buf) and (
+                    buf[i + HEADER_LEN] in COMMANDS
+                ):
+                    return i
+            else:
+                if seq != 0:
+                    return i
+        return -1
+
+    def parse_frame(self, msg_type: MessageType, buf: bytes):
+        if len(buf) < HEADER_LEN:
+            return ParseState.NEEDS_MORE_DATA, 0, None
+        length = int.from_bytes(buf[:3], "little")
+        seq = buf[3]
+        if length > MAX_PACKET:
+            return ParseState.INVALID, 0, None
+        if len(buf) < HEADER_LEN + length:
+            return ParseState.NEEDS_MORE_DATA, 0, None
+        msg = buf[HEADER_LEN : HEADER_LEN + length]
+        if msg_type == MessageType.REQUEST:
+            # Requests are command packets at sequence 0.
+            if seq != 0 or not msg or msg[0] not in COMMANDS:
+                return ParseState.INVALID, 0, None
+        frame = Packet(sequence_id=seq, msg=bytes(msg))
+        return ParseState.SUCCESS, HEADER_LEN + length, frame
+
+    def stitch(self, requests: list, responses: list, state=None):
+        """Bundle responses per request (ref: stitcher.cc StitchFrames:
+        timestamp-bounded, sequence-contiguous response bundles handed to
+        per-command handlers)."""
+        records: list[Record] = []
+        errors = 0
+        ri = 0
+        qi = 0
+        while qi < len(requests):
+            req = requests[qi]
+            nxt_ts = (
+                requests[qi + 1].timestamp_ns
+                if qi + 1 < len(requests)
+                else None
+            )
+            # Drop stale responses that pre-date this request (ref:
+            # SyncRespQueue).
+            while ri < len(responses) and (
+                responses[ri].timestamp_ns < req.timestamp_ns
+            ):
+                ri += 1
+                errors += 1
+            cmd = req.msg[0]
+            if cmd in NO_RESPONSE_CMDS:
+                records.append(
+                    Record(req=req, resp=_Resp(req.timestamp_ns, RESP_NONE, b""))
+                )
+                qi += 1
+                continue
+            bundle = []
+            j = ri
+            while j < len(responses) and (
+                nxt_ts is None or responses[j].timestamp_ns < nxt_ts
+            ):
+                bundle.append(responses[j])
+                j += 1
+            if not bundle:
+                if nxt_ts is None:
+                    break  # response may still be in flight: keep request
+                errors += 1
+                qi += 1
+                ri = j
+                continue
+            if nxt_ts is None and not _bundle_complete(bundle):
+                # Response still streaming across ingest ticks (a
+                # resultset's rows/EOF may arrive next tick): keep both
+                # the request and its partial bundle for the next round.
+                break
+            records.append(Record(req=req, resp=_interpret(cmd, bundle)))
+            ri = j
+            qi += 1
+        return records, errors, requests[qi:], responses[ri:]
+
+
+class _Resp(base.Frame):
+    """Interpreted response (ref: mysql::Response)."""
+
+    def __init__(self, timestamp_ns, status, msg):
+        self.timestamp_ns = timestamp_ns
+        self.status = status
+        self.msg = msg
+
+
+def _lenenc_int(buf: bytes, pos: int):
+    """MySQL length-encoded integer (ref: parse_utils.cc)."""
+    if pos >= len(buf):
+        return None, pos
+    b0 = buf[pos]
+    if b0 < 0xFB:
+        return b0, pos + 1
+    if b0 == 0xFC:
+        return int.from_bytes(buf[pos + 1 : pos + 3], "little"), pos + 3
+    if b0 == 0xFD:
+        return int.from_bytes(buf[pos + 1 : pos + 4], "little"), pos + 4
+    if b0 == 0xFE:
+        return int.from_bytes(buf[pos + 1 : pos + 9], "little"), pos + 9
+    return None, pos
+
+
+def _bundle_complete(bundle: list) -> bool:
+    """Whether a response bundle has reached its terminator: OK/ERR/EOF
+    head packets complete immediately; resultsets need the row-section
+    terminator (EOF, or a trailing OK in CLIENT_DEPRECATE_EOF mode)."""
+    first = bundle[0]
+    if first.is_err or first.is_ok or first.is_eof:
+        return True
+    ncols, _ = _lenenc_int(first.msg, 0)
+    if ncols is None:
+        return True  # uninterpretable: don't hold the queue hostage
+    eofs = sum(1 for p in bundle[1:] if p.is_eof)
+    if eofs >= 2:
+        return True  # column-section EOF + row-section EOF
+    last = bundle[-1]
+    # Deprecate-EOF mode terminates rows with an OK packet; a single EOF
+    # plus trailing OK also closes the set.
+    return len(bundle) > 1 and (last.is_err or (last.is_ok and eofs <= 1))
+
+
+def _interpret(cmd: int, bundle: list) -> _Resp:
+    """Interpret a response bundle (ref: handler.cc HandleOKMessage /
+    HandleErrMessage / HandleResultsetResponse)."""
+    first = bundle[0]
+    ts = bundle[-1].timestamp_ns
+    if first.is_err:
+        code = int.from_bytes(first.msg[1:3], "little")
+        text = first.msg[3:]
+        if text[:1] == b"#":  # SQL-state marker: '#' + 5 chars
+            text = text[6:]
+        return _Resp(ts, RESP_ERR, f"{code}: ".encode() + text)
+    if first.is_ok or first.is_eof:
+        return _Resp(ts, RESP_OK, b"")
+    # Resultset: first packet is the column count (length-encoded int).
+    ncols, _ = _lenenc_int(first.msg, 0)
+    nrows = 0
+    if ncols is not None:
+        seen_cols = 0
+        phase = "cols"
+        for p in bundle[1:]:
+            if p.is_err:
+                code = int.from_bytes(p.msg[1:3], "little")
+                return _Resp(ts, RESP_ERR, f"{code}".encode())
+            if phase == "cols":
+                if p.is_eof:
+                    phase = "rows"
+                    continue
+                seen_cols += 1
+                if seen_cols >= ncols:
+                    # Next packet is either the column-section EOF or (in
+                    # CLIENT_DEPRECATE_EOF mode) already the first row.
+                    phase = "cols_done"
+                continue
+            if phase == "cols_done":
+                if p.is_eof:
+                    phase = "rows"
+                    continue
+                phase = "rows"  # deprecate-EOF: fall through as a row
+            # rows phase. A text-protocol row CAN start with 0x00 (empty
+            # first column), so an OK header only terminates when it is
+            # the bundle's final packet (deprecate-EOF terminator).
+            if p.is_eof or (p.is_ok and p is bundle[-1]):
+                break
+            nrows += 1
+        return _Resp(
+            ts, RESP_OK, f"Resultset rows = {nrows}".encode()
+        )
+    return _Resp(ts, RESP_UNKNOWN, b"")
+
+
+def request_body(req: Packet) -> str:
+    cmd = req.msg[0]
+    if cmd in _STRING_BODY:
+        return req.msg[1:].decode("latin-1", errors="replace")
+    return req.msg[1:].hex() if len(req.msg) > 1 else ""
+
+
+def record_to_row(
+    record: Record,
+    upid: str,
+    remote_addr: str,
+    remote_port: int,
+    trace_role: int,
+) -> dict:
+    """A mysql_events row (ref: mysql_table.h kMySQLElements)."""
+    req, resp = record.req, record.resp
+    return {
+        "time_": req.timestamp_ns,
+        "upid": upid,
+        "remote_addr": remote_addr,
+        "remote_port": remote_port,
+        "trace_role": int(trace_role),
+        "req_cmd": int(req.msg[0]),
+        "req_body": request_body(req),
+        "resp_status": int(resp.status),
+        "resp_body": resp.msg.decode("latin-1", errors="replace"),
+        "latency": max(resp.timestamp_ns - req.timestamp_ns, 0),
+    }

@@ -663,3 +663,141 @@ def test_mesh_scan_limit_exceeds_matches(mesh):
     sel = (data["service"] == "c") & (data["resp_status"] == 500)
     assert rows["time_"] == data["time_"][sel].tolist()
     assert not cd.device_executor.fallback_errors
+
+
+def test_mesh_join_agg_decomposition(mesh):
+    """INNER join fused into a downstream agg runs on the mesh WITHOUT
+    materializing join pairs: right side reduces to per-key stats, left
+    side aggregates with gathered weights (r4; ref EquijoinNode builds
+    hash tables and materializes chunked pair output instead). Results
+    must match the host join+agg exactly."""
+    rng = np.random.default_rng(5)
+    nl, nr = 6000, 3000
+    rel_l = Relation.of(
+        ("time_", T, SemanticType.ST_TIME_NS),
+        ("svc", S),
+        ("ep", S),
+        ("lat", F),
+        ("bytes", I),
+    )
+    rel_r = Relation.of(
+        ("time_", T, SemanticType.ST_TIME_NS),
+        ("endpoint", S),
+        ("cost", F),
+        ("quota", I),
+    )
+    eps = [f"/api/{i}" for i in range(40)]
+
+    def build():
+        c = Carnot(device_executor=MeshExecutor(mesh=mesh, block_rows=512))
+        tl = c.table_store.create_table("reqs", rel_l)
+        tl.write_pydict({
+            "time_": np.arange(nl) * 10,
+            "svc": rng_l_svc.copy(),
+            "ep": rng_l_ep.copy(),
+            "lat": rng_l_lat.copy(),
+            "bytes": rng_l_bytes.copy(),
+        })
+        tl.compact(); tl.stop()
+        tr = c.table_store.create_table("costs", rel_r)
+        tr.write_pydict({
+            "time_": np.arange(nr) * 10,
+            "endpoint": rng_r_ep.copy(),
+            "cost": rng_r_cost.copy(),
+            "quota": rng_r_quota.copy(),
+        })
+        tr.compact(); tr.stop()
+        return c
+
+    rng_l_svc = rng.choice(["a", "b", "c"], nl).astype(object)
+    rng_l_ep = rng.choice(eps[:30], nl).astype(object)  # some keys unmatched
+    rng_l_lat = rng.normal(100, 10, nl)
+    rng_l_bytes = rng.integers(0, 1 << 20, nl)
+    rng_r_ep = rng.choice(eps[10:], nr).astype(object)  # dups + unmatched
+    rng_r_cost = rng.normal(5, 1, nr)
+    rng_r_quota = rng.integers(1, 100, nr)
+
+    q = (
+        "l = px.DataFrame(table='reqs')\n"
+        "r = px.DataFrame(table='costs')\n"
+        "r = r[r.quota > 10]\n"
+        "j = l.merge(r, how='inner', left_on=['ep'], right_on=['endpoint'],"
+        " suffixes=['', '_r'])\n"
+        "s = j.groupby(['svc']).agg(\n"
+        "    n=('time_', px.count),\n"
+        "    lat_total=('lat', px.sum),\n"
+        "    cost_total=('cost', px.sum),\n"
+        "    cost_avg=('cost', px.mean),\n"
+        "    lat_max=('lat', px.max),\n"
+        "    quota_min=('quota', px.min),\n"
+        ")\n"
+        "px.display(s, 'out')\n"
+    )
+    cd = build()
+    ch_exec = cd.device_executor
+    res_d = cd.execute_query(q)
+    assert not ch_exec.fallback_errors, ch_exec.fallback_errors
+    assert any(s2.startswith("joinL|") for s2 in ch_exec._program_cache), (
+        "join-agg did not offload"
+    )
+    ch = build()
+    ch.device_executor = None
+    res_h = ch.execute_query(q)
+    rows_d = res_d.table("out")
+    rows_h = res_h.table("out")
+    dd = {s2: i for i, s2 in enumerate(rows_d["svc"])}
+    hh = {s2: i for i, s2 in enumerate(rows_h["svc"])}
+    assert set(dd) == set(hh)
+    for svc in dd:
+        i, j2 = dd[svc], hh[svc]
+        assert rows_d["n"][i] == rows_h["n"][j2]
+        assert rows_d["lat_total"][i] == pytest.approx(
+            rows_h["lat_total"][j2], rel=1e-9
+        )
+        assert rows_d["cost_total"][i] == pytest.approx(
+            rows_h["cost_total"][j2], rel=1e-9
+        )
+        assert rows_d["cost_avg"][i] == pytest.approx(
+            rows_h["cost_avg"][j2], rel=1e-9
+        )
+        assert rows_d["lat_max"][i] == pytest.approx(
+            rows_h["lat_max"][j2], rel=1e-12
+        )
+        assert rows_d["quota_min"][i] == rows_h["quota_min"][j2]
+
+
+def test_mesh_join_agg_ungrouped(mesh):
+    """Global (no-groupby) join aggregate also offloads (single group)."""
+    c = Carnot(device_executor=MeshExecutor(mesh=mesh, block_rows=512))
+    rel_l = Relation.of(("time_", T), ("k", S), ("v", F))
+    rel_r = Relation.of(("time_", T), ("k2", S), ("w", I))
+    tl = c.table_store.create_table("lhs", rel_l)
+    tl.write_pydict({
+        "time_": np.arange(1000),
+        "k": np.array([f"k{i % 20}" for i in range(1000)], dtype=object),
+        "v": np.ones(1000) * 2.0,
+    })
+    tl.compact(); tl.stop()
+    tr = c.table_store.create_table("rhs", rel_r)
+    tr.write_pydict({
+        "time_": np.arange(500),
+        "k2": np.array([f"k{i % 10}" for i in range(500)], dtype=object),
+        "w": np.arange(500),
+    })
+    tr.compact(); tr.stop()
+    res = c.execute_query(
+        "l = px.DataFrame(table='lhs')\n"
+        "r = px.DataFrame(table='rhs')\n"
+        "j = l.merge(r, how='inner', left_on=['k'], right_on=['k2'],"
+        " suffixes=['', '_r'])\n"
+        "s = j.agg(n=('v', px.count), total=('v', px.sum))\n"
+        "px.display(s, 'out')\n"
+    )
+    assert not c.device_executor.fallback_errors
+    assert any(s2.startswith("joinL|") for s2 in c.device_executor._program_cache)
+    rows = res.table("out")
+    # truth: keys k0..k9 match; each left key has 50 rows x 50 right rows
+    # per key => 500 left rows (k0..k9) each matching 50 right rows
+    n_true = 500 * 50
+    assert rows["n"] == [n_true]
+    assert rows["total"][0] == pytest.approx(2.0 * n_true)

@@ -348,3 +348,128 @@ def test_socket_tracer_replay_to_tables():
     # closed + drained trackers are GC'd on the next sample
     c.transfer_data(None)
     assert not c._trackers
+
+
+# -- MySQL -------------------------------------------------------------------
+
+from pixie_tpu.protocols import mysql
+
+
+def _pkt(seq: int, payload: bytes) -> bytes:
+    return len(payload).to_bytes(3, "little") + bytes([seq]) + payload
+
+
+def _ok_pkt(seq: int) -> bytes:
+    return _pkt(seq, b"\x00\x00\x00\x02\x00\x00\x00")  # OK, 7 bytes
+
+
+def _err_pkt(seq: int, code: int, msg: bytes) -> bytes:
+    return _pkt(seq, b"\xff" + code.to_bytes(2, "little") + b"#HY000" + msg)
+
+
+def _eof_pkt(seq: int) -> bytes:
+    return _pkt(seq, b"\xfe\x00\x00\x02\x00")
+
+
+def _resultset(ncols: int, rows: list[bytes]) -> bytes:
+    out = _pkt(1, bytes([ncols]))
+    seq = 2
+    for i in range(ncols):
+        out += _pkt(seq, b"\x03def" + f"col{i}".encode())
+        seq += 1
+    out += _eof_pkt(seq)
+    seq += 1
+    for r in rows:
+        out += _pkt(seq, r)
+        seq += 1
+    out += _eof_pkt(seq)
+    return out
+
+
+def test_mysql_query_resultset():
+    p = mysql.MysqlParser()
+    req = _pkt(0, b"\x03SELECT * FROM t")
+    state, consumed, frame = p.parse_frame(MessageType.REQUEST, req)
+    assert state == ParseState.SUCCESS and consumed == len(req)
+    assert frame.msg[0] == 0x03
+    t = ConnTracker(mysql.MysqlParser(), role=TraceRole.CLIENT)
+    t.add_send(0, req, 100)
+    t.add_recv(0, _resultset(2, [b"\x011\x012", b"\x013\x014"]), 200)
+    recs = t.process_to_records()
+    assert len(recs) == 1
+    row = mysql.record_to_row(recs[0], "u", "10.0.0.5", 3306, 1)
+    assert row["req_cmd"] == 0x03
+    assert row["req_body"] == "SELECT * FROM t"
+    assert row["resp_status"] == mysql.RESP_OK
+    assert "rows = 2" in row["resp_body"]
+    assert row["latency"] > 0
+
+
+def test_mysql_error_response():
+    t = ConnTracker(mysql.MysqlParser(), role=TraceRole.CLIENT)
+    t.add_send(0, _pkt(0, b"\x03SELECT bogus"), 10)
+    t.add_recv(0, _err_pkt(1, 1064, b"You have an error"), 20)
+    recs = t.process_to_records()
+    assert len(recs) == 1
+    row = mysql.record_to_row(recs[0], "u", "", 3306, 1)
+    assert row["resp_status"] == mysql.RESP_ERR
+    assert "1064" in row["resp_body"]
+    assert "You have an error" in row["resp_body"]
+
+
+def test_mysql_no_response_commands_and_pipelining():
+    t = ConnTracker(mysql.MysqlParser(), role=TraceRole.CLIENT)
+    quit_req = _pkt(0, b"\x01")
+    q1 = _pkt(0, b"\x03SELECT 1")
+    t.add_send(0, q1, 10)
+    t.add_send(len(q1), quit_req, 30)
+    t.add_recv(0, _ok_pkt(1), 20)
+    recs = t.process_to_records()
+    assert len(recs) == 2
+    assert recs[0].resp.status == mysql.RESP_OK
+    assert recs[1].resp.status == mysql.RESP_NONE  # Quit: no response
+
+
+def test_mysql_torn_packet_needs_more():
+    p = mysql.MysqlParser()
+    req = _pkt(0, b"\x03SELECT * FROM t")
+    state, _, _ = p.parse_frame(MessageType.REQUEST, req[:5])
+    assert state == ParseState.NEEDS_MORE_DATA
+    # request packets must be sequence 0 with a valid command byte
+    state, _, _ = p.parse_frame(
+        MessageType.REQUEST, _pkt(1, b"\x03SELECT 1")
+    )
+    assert state == ParseState.INVALID
+
+
+def test_mysql_via_socket_tracer():
+    c = SocketTraceConnector()
+    c.init()
+    conn = ConnId(upid="9:9:9", fd=7)
+    c.replay([
+        ("open", conn, "mysql", TraceRole.CLIENT, "10.2.0.4", 3306),
+        ("data", conn, "send", 0, _pkt(0, b"\x03SELECT a FROM b"), 50),
+        ("data", conn, "recv", 0, _resultset(1, [b"\x015"]), 90),
+        ("close", conn),
+    ])
+    c.transfer_data(None)
+    table = next(t for t in c.tables if t.name == "mysql_events")
+    cols = table.take()
+    assert cols["req_body"] == ["SELECT a FROM b"]
+    assert cols["resp_status"] == [mysql.RESP_OK]
+    assert cols["remote_port"] == [3306]
+
+
+def test_mysql_resultset_across_ticks():
+    """A resultset split across ingest ticks is NOT truncated: the
+    stitcher defers until the terminator arrives (r4 review fix)."""
+    t = ConnTracker(mysql.MysqlParser(), role=TraceRole.CLIENT)
+    t.add_send(0, _pkt(0, b"\x03SELECT * FROM big"), 10)
+    full = _resultset(1, [b"\x011", b"\x012", b"\x013"])
+    cut = len(full) - 12  # split inside the row section
+    t.add_recv(0, full[:cut], 20)
+    assert t.process_to_records() == []  # incomplete: defer
+    t.add_recv(cut, full[cut:], 30)
+    recs = t.process_to_records()
+    assert len(recs) == 1
+    assert b"rows = 3" in recs[0].resp.msg
