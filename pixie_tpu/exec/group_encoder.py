@@ -32,9 +32,13 @@ class GroupEncoder:
 
     @staticmethod
     def _key_rows_of(key_cols: list) -> tuple[int, "np.ndarray", "np.ndarray"]:
-        """(n, unique_rows, inverse) via one np.unique. Multi-column keys go
-        through a structured (record) array — stacking would upcast mixed
-        int64/float64 keys to float64 and collapse keys beyond 2^53."""
+        """(n, unique_rows, inverse) via one np.unique. Integer multi-keys
+        whose shifted widths fit 63 bits pack into ONE int64 first —
+        np.unique on a plain int64 is a radix-class sort, while the
+        structured-record fallback is a comparison sort that costs minutes
+        at 64M rows (the r4 config-3 cold-path hotspot). Mixed/wide keys
+        keep the record path — stacking would upcast int64/float64 keys to
+        float64 and collapse keys beyond 2^53."""
         arrs = [
             c.codes if isinstance(c, DictColumn) else np.asarray(c)
             for c in key_cols
@@ -45,11 +49,53 @@ class GroupEncoder:
         if len(arrs) == 1:
             uniq, inverse = np.unique(arrs[0], return_inverse=True)
             rows = [(v,) for v in uniq.tolist()]
+            return n, rows, inverse
+        packed = GroupEncoder._pack_int_keys(arrs)
+        if packed is not None:
+            key, mins, widths = packed
+            total_bits = sum(widths)
+            if total_bits <= 24:
+                # Small packed range: O(n) bincount + rank LUT beats the
+                # sort inside np.unique by ~10x at 64M rows.
+                counts = np.bincount(key, minlength=1 << total_bits)
+                uniq = np.nonzero(counts)[0]
+                rank = np.full(1 << total_bits, -1, np.int32)
+                rank[uniq] = np.arange(len(uniq), dtype=np.int32)
+                inverse = rank[key]
+            else:
+                uniq, inverse = np.unique(key, return_inverse=True)
+            rows_cols = []
+            rem = uniq
+            for lo, w in zip(reversed(mins), reversed(widths)):
+                rows_cols.append((rem & ((1 << w) - 1)) + lo)
+                rem = rem >> w
+            rows_cols.reverse()
+            rows = list(zip(*(c.tolist() for c in rows_cols)))
         else:
             rec = np.rec.fromarrays(arrs)
             uniq, inverse = np.unique(rec, return_inverse=True)
             rows = [tuple(r.tolist()) for r in uniq]
         return n, rows, inverse
+
+    @staticmethod
+    def _pack_int_keys(arrs):
+        """(packed int64 key, per-col mins, per-col bit widths) when every
+        column is integral and the shifted widths fit 63 bits; else None."""
+        if not all(np.issubdtype(a.dtype, np.integer) for a in arrs):
+            return None
+        mins, widths = [], []
+        for a in arrs:
+            lo = int(a.min())
+            hi = int(a.max())
+            rng = hi - lo
+            mins.append(lo)
+            widths.append(max(rng.bit_length(), 1))
+        if sum(widths) > 63:
+            return None
+        key = np.zeros(len(arrs[0]), np.int64)
+        for a, lo, w in zip(arrs, mins, widths):
+            key = (key << w) | (a.astype(np.int64) - lo)
+        return key, mins, widths
 
     def encode(self, key_cols: list) -> np.ndarray:
         """Map rows of the given key columns to gids, assigning new ids to
