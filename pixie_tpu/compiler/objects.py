@@ -254,6 +254,20 @@ def _parse_window(window) -> int:
     raise CompilerError(f"rolling: cannot parse window {window!r}")
 
 
+def _reject_rolling_operand(left, right, op_name: str) -> None:
+    """Combining a rolling view with another frame has no well-defined
+    window semantics (the other side's time_ is unbinned); fail loudly
+    instead of silently losing or misapplying the window axis. Aggregate
+    the rolling view first, then merge/append the per-window rows."""
+    if getattr(left, "_rolling_on", None) is not None or (
+        getattr(right, "_rolling_on", None) is not None
+    ):
+        raise CompilerError(
+            f"{op_name}() over a rolling view is unsupported: aggregate "
+            "the windowed frame first, then combine the per-window rows"
+        )
+
+
 class GroupedDataFrame:
     def __init__(self, df: "DataFrameObj", by: tuple[str, ...]):
         self.df = df
@@ -265,13 +279,7 @@ class GroupedDataFrame:
                 )
 
     def agg(self, **kwargs) -> "DataFrameObj":
-        by = self.by
-        rolling_on = getattr(self.df, "_rolling_on", None)
-        if rolling_on is not None and rolling_on not in by:
-            # Rolling view: the window id is one more group axis, and the
-            # output rows carry the window start in that column.
-            by = (rolling_on,) + by
-        return self.df._agg(by, kwargs)
+        return self.df._agg(self.by, kwargs)
 
 
 class DataFrameObj:
@@ -287,7 +295,14 @@ class DataFrameObj:
         return self._ir.relation(self._id)
 
     def _wrap(self, nid: int) -> "DataFrameObj":
-        return DataFrameObj(self._ir, nid)
+        out = DataFrameObj(self._ir, nid)
+        # A rolling() view survives intervening ops (filter, assign, drop):
+        # the window marker rides every derived frame so the window group
+        # axis cannot be silently lost before groupby().agg() (ADVICE r4).
+        rolling_on = getattr(self, "_rolling_on", None)
+        if rolling_on is not None:
+            out._rolling_on = rolling_on
+        return out
 
     def _col(self, name: str) -> ColumnExpr:
         if not self.relation.has_column(name):
@@ -408,6 +423,19 @@ class DataFrameObj:
         return self._agg((), kwargs)
 
     def _agg(self, groups: tuple[str, ...], kwargs: dict) -> "DataFrameObj":
+        rolling_on = getattr(self, "_rolling_on", None)
+        if rolling_on is not None:
+            if not self.relation.has_column(rolling_on):
+                raise CompilerError(
+                    f"rolling window column {rolling_on!r} was dropped "
+                    "before agg(); keep it in the frame so the window axis "
+                    "can group"
+                )
+            if rolling_on not in groups:
+                # Rolling view: the window id is one more group axis, and
+                # the output rows carry the window start in that column —
+                # for groupby().agg() AND bare df.agg() alike.
+                groups = (rolling_on,) + groups
         values = []
         for out_name, spec in kwargs.items():
             if not isinstance(spec, tuple) or len(spec) < 2:
@@ -433,7 +461,10 @@ class DataFrameObj:
         nid = self._ir.add(
             AggOp(groups=groups, values=tuple(values)), [self._id]
         )
-        return self._wrap(nid)
+        # The agg CONSUMES the rolling view: its output is per-window rows,
+        # not another windowed frame — construct directly so the marker
+        # does not ride _wrap into downstream aggregations.
+        return DataFrameObj(self._ir, nid)
 
     def merge(
         self,
@@ -449,6 +480,7 @@ class DataFrameObj:
             right_on = [right_on]
         if not left_on or not right_on:
             raise CompilerError("merge requires left_on and right_on")
+        _reject_rolling_operand(self, right, "merge")
         lrel, rrel = self.relation, right.relation
         rnames = set(rrel.col_names())
         out_cols = []
@@ -469,6 +501,7 @@ class DataFrameObj:
         return self._wrap(nid)
 
     def append(self, other: "DataFrameObj") -> "DataFrameObj":
+        _reject_rolling_operand(self, other, "append")
         return self._wrap(
             self._ir.add(UnionOp(), [self._id, other._id])
         )

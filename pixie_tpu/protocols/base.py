@@ -137,6 +137,17 @@ class DataStreamBuffer:
     def head(self) -> bytes:
         return bytes(self._buf)
 
+    def drain(self) -> None:
+        """Discard everything buffered (contiguous head AND pending
+        out-of-order chunks) — used when the connection closed and the
+        bytes can never complete a frame."""
+        end = self._pos + len(self._buf)
+        for pos, (data, _) in self._chunks.items():
+            end = max(end, pos + len(data))
+        self._chunks.clear()
+        self._buf.clear()
+        self._pos = end
+
     def position(self) -> int:
         return self._pos
 
@@ -170,8 +181,30 @@ class ProtocolParser:
         """Position of a plausible frame start > start, or -1."""
         raise NotImplementedError
 
-    def parse_frame(self, msg_type: MessageType, buf: bytes):
-        """(ParseState, bytes_consumed, frame_or_None)."""
+    def new_state(self):
+        """Fresh per-connection protocol state shared by both directions
+        (ref: each protocol's StateWrapper in its types.h). None when the
+        protocol needs none. Passed to parse_frame and stitch."""
+        return None
+
+    def on_resync(self, msg_type: MessageType, state) -> None:
+        """Called when a direction hits INVALID and resyncs: a frame was
+        lost, so any cross-direction bookkeeping (e.g. HTTP's request-
+        method FIFO) may be desynchronized and should degrade safely."""
+
+    def parse_frame(
+        self,
+        msg_type: MessageType,
+        buf: bytes,
+        conn_closed: bool = False,
+        state=None,
+    ):
+        """(ParseState, bytes_consumed, frame_or_None). ``conn_closed``
+        tells parsers the stream has ended: protocols with close-delimited
+        payloads (HTTP responses lacking both Content-Length and
+        Transfer-Encoding, ref http/parse.cc ParseResponseBody Case 4) may
+        then emit the buffered remainder as the body instead of waiting.
+        ``state`` is the connection's shared protocol state (new_state)."""
         raise NotImplementedError
 
     def stitch(self, requests: list, responses: list, state=None):
@@ -190,7 +223,7 @@ class _DataStream:
         self._msg_type = msg_type
         self._last_ts = 0
 
-    def parse_loop(self) -> None:
+    def parse_loop(self, conn_closed: bool = False, proto_state=None) -> None:
         """Parse as many frames as the contiguous head allows
         (ref: event_parser.h ParseFramesLoop)."""
         while True:
@@ -198,7 +231,10 @@ class _DataStream:
             if not buf:
                 return
             state, consumed, frame = self._parser.parse_frame(
-                self._msg_type, buf
+                self._msg_type,
+                buf,
+                conn_closed=conn_closed,
+                state=proto_state,
             )
             if state == ParseState.SUCCESS:
                 if frame.timestamp_ns == 0:
@@ -216,6 +252,7 @@ class _DataStream:
                 return
             else:  # INVALID: resync at the next plausible boundary
                 _PARSE_ERRORS.inc(protocol=self._parser.name)
+                self._parser.on_resync(self._msg_type, proto_state)
                 nxt = self._parser.find_frame_boundary(
                     self._msg_type, buf, 1
                 )
@@ -248,8 +285,12 @@ class ConnTracker:
         else:
             self.send = _DataStream(parser, MessageType.REQUEST)
             self.recv = _DataStream(parser, MessageType.RESPONSE)
-        self.protocol_state = None
+        self.protocol_state = parser.new_state()
         self.closed = False
+        # One full process cycle of grace after close before draining:
+        # capture sources can deliver a conn's final data chunks after its
+        # close event (ref: ConnTracker::MarkForDeath iteration countdown).
+        self._close_grace = 1
 
     def add_send(self, pos: int, data: bytes, timestamp_ns: int) -> None:
         self.send.buffer.add(pos, data, timestamp_ns)
@@ -260,21 +301,36 @@ class ConnTracker:
     def process_to_records(self) -> list[Record]:
         """Parse pending bytes and stitch (ref: ConnTracker::
         ProcessToRecords)."""
-        self.send.parse_loop()
-        self.recv.parse_loop()
         if self.role == TraceRole.SERVER:
-            requests, responses = self.recv.frames, self.send.frames
+            req_stream, resp_stream = self.recv, self.send
         else:
-            requests, responses = self.send.frames, self.recv.frames
-        records, errors, req_keep, resp_keep = self.parser.stitch(
-            requests, responses, self.protocol_state
+            req_stream, resp_stream = self.send, self.recv
+        # Requests first: response parsing consults the request-method FIFO
+        # in the protocol state (HEAD/CONNECT responses are bodiless).
+        req_stream.parse_loop(
+            conn_closed=self.closed, proto_state=self.protocol_state
         )
-        if self.role == TraceRole.SERVER:
-            self.recv.frames, self.send.frames = req_keep, resp_keep
-        else:
-            self.send.frames, self.recv.frames = req_keep, resp_keep
+        resp_stream.parse_loop(
+            conn_closed=self.closed, proto_state=self.protocol_state
+        )
+        records, errors, req_keep, resp_keep = self.parser.stitch(
+            req_stream.frames, resp_stream.frames, self.protocol_state
+        )
+        req_stream.frames, resp_stream.frames = req_keep, resp_keep
         if errors:
             _PARSE_ERRORS.inc(errors, protocol=self.parser.name)
+        if self.closed:
+            if self._close_grace > 0:
+                self._close_grace -= 1
+            else:
+                # The stream ended and the grace cycle for late-arriving
+                # chunks has passed: bytes still unparseable (truncated
+                # transfers) and unpaired frames can never complete —
+                # drain both directions so the connector can GC this
+                # tracker (ref: ConnTracker::MarkForDeath + countdown).
+                for s in (self.send, self.recv):
+                    s.buffer.drain()
+                    s.frames.clear()
         return records
 
 

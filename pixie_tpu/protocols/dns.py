@@ -130,7 +130,13 @@ class DnsParser(base.ProtocolParser):
         # in-stream resync (matches the reference's per-event parsing).
         return -1
 
-    def parse_frame(self, msg_type: MessageType, buf: bytes):
+    def parse_frame(
+        self,
+        msg_type: MessageType,
+        buf: bytes,
+        conn_closed: bool = False,
+        state=None,
+    ):
         if len(buf) < _HDR.size:
             return ParseState.NEEDS_MORE_DATA, 0, None
         txid, fl, qd, an, ns, ar = _HDR.unpack_from(buf, 0)

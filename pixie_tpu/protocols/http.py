@@ -26,6 +26,16 @@ define_flag(
     "(ref: FLAGS_http_body_limit_bytes, parse.cc).",
 )
 
+define_flag(
+    "http_close_delimited_limit_bytes",
+    1 << 20,
+    help_="Cap on bytes buffered for a close-delimited response body "
+    "(no Content-Length/Transfer-Encoding) while waiting for connection "
+    "close; past it the response is emitted with the body truncated. "
+    "Improvement over the reference, which accumulates without bound "
+    "(parse.cc Case 4 TODO).",
+)
+
 _METHODS = (
     b"GET ",
     b"POST ",
@@ -59,8 +69,37 @@ class Message(base.Frame):
     body_size: int = 0
 
 
+@dataclasses.dataclass
+class HttpState:
+    """Per-connection parse state (ref: http::StateWrapper, types.h:103 —
+    whose TODO asks for exactly this: HEAD-awareness in the parser).
+    ``methods`` is a FIFO of request methods not yet answered; HTTP/1.1
+    responses arrive in request order (RFC 7230 §6.3.2), so the front
+    entry is the method the next response answers. A parse resync can
+    desynchronize it, in which case responses fall back to the
+    adjacent-response probe heuristic."""
+
+    methods: list = dataclasses.field(default_factory=list)
+
+
+# Past this many unanswered requests the FIFO is almost certainly desynced
+# (response direction lost to capture gaps) — clear it and fall back to
+# the probe heuristic rather than grow forever / answer with stale entries.
+_METHOD_FIFO_CAP = 256
+
+
 class HttpParser(base.ProtocolParser):
     name = "http"
+
+    def new_state(self):
+        return HttpState()
+
+    def on_resync(self, msg_type: MessageType, state) -> None:
+        if msg_type == MessageType.RESPONSE and state is not None:
+            # A response frame was lost: the method FIFO is now shifted —
+            # stale context is worse than none (it mis-attributes every
+            # later response); drop it and rely on the probe heuristic.
+            state.methods.clear()
 
     # -- framing -------------------------------------------------------------
     def find_frame_boundary(
@@ -79,9 +118,23 @@ class HttpParser(base.ProtocolParser):
                     candidates.append(i)
         return min(candidates) if candidates else -1
 
-    def parse_frame(self, msg_type: MessageType, buf: bytes):
+    # No legitimate HTTP header block approaches this size (servers cap at
+    # 8-16KB); past it the bytes are a non-HTTP stream (e.g. the remainder
+    # of a cap-truncated close-delimited body) — INVALID lets the parse
+    # loop's resync consume them instead of buffering forever.
+    MAX_HEADER_BYTES = 1 << 16
+
+    def parse_frame(
+        self,
+        msg_type: MessageType,
+        buf: bytes,
+        conn_closed: bool = False,
+        state=None,
+    ):
         hdr_end = buf.find(b"\r\n\r\n")
         if hdr_end < 0:
+            if len(buf) > self.MAX_HEADER_BYTES:
+                return ParseState.INVALID, 0, None
             return ParseState.NEEDS_MORE_DATA, 0, None
         head = buf[:hdr_end]
         lines = head.split(b"\r\n")
@@ -119,14 +172,83 @@ class HttpParser(base.ProtocolParser):
                 value.decode("latin-1").strip()
             )
         body_start = hdr_end + 4
-        state, consumed = self._parse_body(buf, body_start, msg)
-        if state != ParseState.SUCCESS:
-            return state, 0, None
+        req_method = (
+            state.methods[0]
+            if msg_type == MessageType.RESPONSE and state and state.methods
+            else None
+        )
+        pstate, consumed = self._parse_body(
+            buf, body_start, msg, conn_closed, req_method
+        )
+        if pstate != ParseState.SUCCESS:
+            return pstate, 0, None
+        if state is not None:
+            if msg_type == MessageType.REQUEST:
+                if len(state.methods) >= _METHOD_FIFO_CAP:
+                    state.methods.clear()
+                state.methods.append(msg.req_method)
+            elif state.methods and not (100 <= msg.resp_status < 200):
+                # 1xx responses are interim: the final response to the
+                # same request is still coming — keep the method queued.
+                state.methods.pop(0)
         return ParseState.SUCCESS, consumed, msg
 
-    def _parse_body(self, buf: bytes, start: int, msg: Message):
+    @staticmethod
+    def _adjacent_response(buf: bytes, start: int) -> bool:
+        """Do the bytes at ``start`` parse as the START of another
+        response (status line + complete well-formed header block)?
+        Detects bodiless responses to HEAD (which may legally carry
+        Content-Length) — ref: parse.cc ParseResponseBody Case 0's pico
+        re-parse probe, which likewise fires for every response (its own
+        TODO notes HEAD state is not plumbed); a body that itself holds a
+        full serialized HTTP response (e.g. proxy diagnostics) misfires
+        the same way there. Offset-based: no tail copy on the hot path."""
+        if not buf.startswith(b"HTTP/1.", start):
+            return False
+        hdr_end = buf.find(b"\r\n\r\n", start)
+        if hdr_end < 0:
+            return False
+        lines = buf[start:hdr_end].split(b"\r\n")
+        first = lines[0].split(b" ", 2)
+        if len(first) < 2:
+            return False
+        try:
+            int(first[1])
+        except ValueError:
+            return False
+        return all(b":" in ln for ln in lines[1:])
+
+    def _parse_body(
+        self,
+        buf: bytes,
+        start: int,
+        msg: Message,
+        conn_closed: bool,
+        req_method: str = None,
+    ):
         """Ref: ParseRequestBody/ParseResponseBody (parse.cc)."""
         limit = flags.http_body_limit_bytes
+        # Case 0: bodiless responses. With request context (HttpState
+        # method FIFO) this is exact: HEAD responses have no body even
+        # with Content-Length (RFC 7230 §3.3.3), and a 2xx CONNECT reply
+        # is followed by tunnel bytes, never a body. Without context
+        # (FIFO desynced / response-led capture), fall back to the
+        # adjacent-response probe (ref parse.cc Case 0's pico re-parse).
+        # (Deliberately NOT the reference's empty-buffer-at-close
+        # shortcut: that emits a Content-Length response truncated by
+        # close as a successful empty-body record. Here a truncated
+        # transfer stays unemitted; bodiless no-CL responses at close
+        # fall to Case 4, which emits them with an empty body anyway.)
+        if msg.type == MessageType.RESPONSE:
+            bodiless = req_method == "HEAD" or (
+                req_method == "CONNECT" and 200 <= msg.resp_status < 300
+            )
+            if bodiless or (
+                req_method is None and self._adjacent_response(buf, start)
+            ):
+                msg.body = ""
+                msg.body_size = 0
+                return ParseState.SUCCESS, start
         cl = msg.headers.get("Content-Length")
         if cl is not None:
             try:
@@ -141,8 +263,32 @@ class HttpParser(base.ProtocolParser):
             return ParseState.SUCCESS, start + n
         if msg.headers.get("Transfer-Encoding", "").lower() == "chunked":
             return self._parse_chunked(buf, start, msg, limit)
-        # No Content-Length, no Transfer-Encoding: no body (the reference
-        # applies this to requests and to responses like 204/304).
+        if msg.type == MessageType.RESPONSE and not (
+            100 <= msg.resp_status < 200 or msg.resp_status in (204, 304)
+        ):
+            # Close-delimited body (ref: parse.cc ParseResponseBody Case 4):
+            # a response with neither Content-Length nor Transfer-Encoding
+            # carries everything up to connection close. Wait for the close
+            # — but only up to a byte cap: endless streams (SSE) or a lost
+            # close event must not buffer/rescan the head unboundedly.
+            # Escape hatch: if another response START follows immediately,
+            # this one ended bodiless (nothing may follow a true
+            # close-delimited body) — emit now, don't wait for close.
+            if self._adjacent_response(buf, start):
+                msg.body = ""
+                msg.body_size = 0
+                return ParseState.SUCCESS, start
+            pending = len(buf) - start
+            if not conn_closed and (
+                pending <= flags.http_close_delimited_limit_bytes
+            ):
+                return ParseState.NEEDS_MORE_DATA, 0
+            body = buf[start:]
+            msg.body = body[:limit].decode("latin-1")
+            msg.body_size = len(body)
+            return ParseState.SUCCESS, len(buf)
+        # No Content-Length, no Transfer-Encoding: no body (requests, and
+        # bodiless response statuses like 1xx/204/304).
         msg.body = ""
         msg.body_size = 0
         return ParseState.SUCCESS, start

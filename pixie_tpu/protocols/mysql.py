@@ -101,7 +101,13 @@ class MysqlParser(base.ProtocolParser):
                     return i
         return -1
 
-    def parse_frame(self, msg_type: MessageType, buf: bytes):
+    def parse_frame(
+        self,
+        msg_type: MessageType,
+        buf: bytes,
+        conn_closed: bool = False,
+        state=None,
+    ):
         if len(buf) < HEADER_LEN:
             return ParseState.NEEDS_MORE_DATA, 0, None
         length = int.from_bytes(buf[:3], "little")

@@ -264,9 +264,55 @@ def test_rolling_windowed_agg():
     assert all(v == 50 for v in rows["n"])
     assert set(rows["time_"]) == {i * 1_000_000_000 for i in range(10)}
 
-    # reference-parity validation errors
+    # The window marker survives intervening ops (ADVICE r4): a filter
+    # between rolling() and groupby() must not drop the window axis.
+    res2 = c.execute_query(
+        "df = px.DataFrame(table='m')\n"
+        "df = df.rolling('1s')\n"
+        "df = df[df.svc == 'a']\n"
+        "s = df.groupby(['svc']).agg(n=('v', px.count))\n"
+        "px.display(s, 'out')\n"
+    )
+    rows2 = res2.table("out")
+    assert len(rows2["n"]) == 10  # 10 windows x 1 service
+    assert all(v == 50 for v in rows2["n"])
+
+    # Bare df.agg() on a rolling frame also gets the window axis.
+    res3 = c.execute_query(
+        "df = px.DataFrame(table='m')\n"
+        "df = df.rolling('1s')\n"
+        "s = df.agg(n=('v', px.count))\n"
+        "px.display(s, 'out')\n"
+    )
+    rows3 = res3.table("out")
+    assert len(rows3["n"]) == 10 and all(v == 100 for v in rows3["n"])
+
+    # agg() CONSUMES the rolling view: a second aggregation over its
+    # output is an ordinary agg, not another windowed one.
+    res4 = c.execute_query(
+        "df = px.DataFrame(table='m')\n"
+        "df = df.rolling('1s')\n"
+        "s = df.groupby(['svc']).agg(n=('v', px.count))\n"
+        "t = s.groupby(['svc']).agg(m=('n', px.sum))\n"
+        "px.display(t, 'out')\n"
+    )
+    rows4 = res4.table("out")
+    assert len(rows4["m"]) == 2 and all(v == 500 for v in rows4["m"])
+
+    # Dropping the window column before agg errors instead of silently
+    # aggregating without the window axis.
     import pytest
 
+    with pytest.raises(Exception, match="rolling window column"):
+        c.execute_query(
+            "df = px.DataFrame(table='m')\n"
+            "df = df.rolling('1s')\n"
+            "df = df[['svc', 'v']]\n"
+            "s = df.groupby(['svc']).agg(n=('v', px.count))\n"
+            "px.display(s, 'out')\n"
+        )
+
+    # reference-parity validation errors
     from pixie_tpu.compiler.objects import CompilerError
 
     with pytest.raises(Exception, match="only supported on time_"):

@@ -200,6 +200,134 @@ def test_conn_tracker_pipelined_requests():
     assert records[1].resp.resp_status == 404
 
 
+def test_http_close_delimited_response_body():
+    """A response with neither Content-Length nor Transfer-Encoding is
+    close-delimited (ref: parse.cc ParseResponseBody Case 4): the parser
+    waits for connection close, then emits the buffered bytes as the
+    body."""
+    t = ConnTracker(http.HttpParser(), role=TraceRole.CLIENT)
+    t.add_send(0, _req("/stream"), 10)
+    raw = b"HTTP/1.0 200 OK\r\nContent-Type: text/plain\r\n\r\nhello wor"
+    t.add_recv(0, raw, 20)
+    assert t.process_to_records() == []  # body still open: no record yet
+    t.add_recv(len(raw), b"ld", 30)
+    assert t.process_to_records() == []
+    t.closed = True
+    recs = t.process_to_records()
+    assert len(recs) == 1
+    assert recs[0].resp.body == "hello world"
+    assert recs[0].resp.body_size == 11
+
+
+def test_http_head_response_pipelined_not_swallowed():
+    """A bodiless HEAD response (no Content-Length) followed by a normal
+    response: the adjacent-response probe (ref parse.cc Case 0) keeps the
+    second response out of the first one's 'body'."""
+    t = ConnTracker(http.HttpParser(), role=TraceRole.CLIENT)
+    t.add_send(0, _req("/a") + _req("/b"), 10)
+    head_resp = b"HTTP/1.1 200 OK\r\nServer: x\r\n\r\n"
+    t.add_recv(0, head_resp + _resp(200, b"hi"), 20)
+    recs = t.process_to_records()
+    assert len(recs) == 2
+    assert recs[0].resp.body_size == 0
+    assert recs[1].resp.body == "hi"
+
+
+def test_http_close_delimited_cap_truncates():
+    """An endless close-delimited stream (SSE-style) emits at the cap
+    instead of buffering unboundedly."""
+    from pixie_tpu.utils.config import flags as _flags
+
+    old = _flags.http_close_delimited_limit_bytes
+    _flags.http_close_delimited_limit_bytes = 64
+    try:
+        t = ConnTracker(http.HttpParser(), role=TraceRole.CLIENT)
+        t.add_send(0, _req("/events"), 10)
+        raw = b"HTTP/1.0 200 OK\r\nContent-Type: text/plain\r\n\r\n"
+        t.add_recv(0, raw, 20)
+        t.add_recv(len(raw), b"x" * 200, 30)  # past the cap, no close
+        recs = t.process_to_records()
+        assert len(recs) == 1
+        assert recs[0].resp.body_size == 200
+        # The stream keeps flowing with no HTTP framing: the header-size
+        # bound turns it INVALID so resync drains it — no unbounded head.
+        pos = len(raw) + 200
+        for _ in range(3):
+            t.add_recv(pos, b"data: tick\n\n" * 8192, 40)  # ~96KB chunks
+            pos += 12 * 8192
+            t.process_to_records()
+        assert len(t.recv.buffer.head()) <= (1 << 16) + 12 * 8192
+    finally:
+        _flags.http_close_delimited_limit_bytes = old
+
+
+def test_http_truncated_content_length_not_emitted_as_success():
+    """A Content-Length response cut off by connection close must NOT
+    surface as a successful empty-body record — and the closed tracker
+    drains so the connector can GC it instead of leaking."""
+    t = ConnTracker(http.HttpParser(), role=TraceRole.CLIENT)
+    t.add_send(0, _req("/f"), 10)
+    t.add_recv(0, b"HTTP/1.1 200 OK\r\nContent-Length: 100\r\n\r\npartial", 20)
+    t.closed = True
+    assert t.process_to_records() == []
+    # One grace cycle for late-arriving chunks, then the tracker drains
+    # so the connector can GC it instead of leaking.
+    assert t.process_to_records() == []
+    assert not t.recv.buffer.head() and not t.send.frames  # drained
+
+
+def test_http_late_chunk_after_close_still_records():
+    """Data chunks delivered after the close event (perf-buffer
+    reordering) still complete their record within the grace cycle."""
+    t = ConnTracker(http.HttpParser(), role=TraceRole.CLIENT)
+    t.add_send(0, _req("/late"), 10)
+    r = _resp(200, b"ok")
+    t.add_recv(0, r[:10], 20)
+    t.closed = True  # close event arrives before the final chunk
+    assert t.process_to_records() == []
+    t.add_recv(10, r[10:], 30)  # late chunk within the grace cycle
+    recs = t.process_to_records()
+    assert len(recs) == 1 and recs[0].resp.body == "ok"
+
+
+def test_http_head_response_with_content_length():
+    """HEAD responses may carry Content-Length yet have no body (RFC 7230
+    §3.3.3); the method FIFO makes the parser skip the body exactly."""
+    t = ConnTracker(http.HttpParser(), role=TraceRole.CLIENT)
+    t.add_send(0, b"HEAD /x HTTP/1.1\r\nHost: h\r\n\r\n" + _req("/y"), 10)
+    head_resp = b"HTTP/1.1 200 OK\r\nContent-Length: 5000\r\n\r\n"
+    t.add_recv(0, head_resp + _resp(200, b"yy"), 20)
+    recs = t.process_to_records()
+    assert len(recs) == 2
+    assert recs[0].req.req_method == "HEAD"
+    assert recs[0].resp.body_size == 0
+    assert recs[1].resp.body == "yy"
+
+
+def test_http_connect_tunnel_not_swallowed():
+    """A 2xx CONNECT response is bodiless; tunneled bytes after it are not
+    parsed into its body."""
+    t = ConnTracker(http.HttpParser(), role=TraceRole.CLIENT)
+    t.add_send(0, b"CONNECT h:443 HTTP/1.1\r\nHost: h\r\n\r\n", 10)
+    t.add_recv(0, b"HTTP/1.1 200 Connection established\r\n\r\n", 20)
+    t.add_recv(39, b"\x16\x03\x01\x02\x00" * 16, 30)  # TLS bytes
+    recs = t.process_to_records()
+    assert len(recs) == 1
+    assert recs[0].req.req_method == "CONNECT"
+    assert recs[0].resp.body_size == 0
+
+
+def test_http_close_delimited_not_applied_to_204():
+    """204/304 responses stay bodiless without waiting for close."""
+    t = ConnTracker(http.HttpParser(), role=TraceRole.CLIENT)
+    t.add_send(0, _req("/d"), 10)
+    t.add_recv(0, b"HTTP/1.1 204 No Content\r\n\r\n", 20)
+    recs = t.process_to_records()
+    assert len(recs) == 1
+    assert recs[0].resp.resp_status == 204
+    assert recs[0].resp.body_size == 0
+
+
 def test_conn_tracker_interleaved_rounds():
     """Records appear incrementally as bytes arrive; leftovers carry over."""
     t = ConnTracker(http.HttpParser(), role=TraceRole.CLIENT)
