@@ -73,9 +73,13 @@ _FORCE_SORTED: Optional[bool] = None
 
 def set_sorted_strategy(v: Optional[bool]) -> None:
     """Force the sort-based sketch-update path on (True) / off (False);
-    None = auto by platform (TPU prefers sort: its scalar scatter costs
-    ~7ns/element, while a radix sort + deduped unique-index scatter is
-    ~4x cheaper at 8M rows — measured r4)."""
+    None = default off. r5 re-measured on a v5e with state-carrying
+    scans: a sort+dedup still issues a FULL-LENGTH scatter (dropped
+    duplicates are not free — the scalar unit walks every index), so
+    sort-based updates cost sort (~2.5ns/row) ON TOP of the ~7ns scatter
+    and LOSE everywhere (count-min 43 vs 27 ns/row, HLL 12.6 vs 10.6).
+    The r4 default (sort on TPU) was measured with a harness whose work
+    XLA had folded away; kept only as a test hook."""
     global _FORCE_SORTED
     _FORCE_SORTED = v
 
@@ -83,8 +87,7 @@ def set_sorted_strategy(v: Optional[bool]) -> None:
 def sorted_strategy() -> bool:
     if _FORCE_SORTED is not None:
         return _FORCE_SORTED
-    platform = getattr(_TLS, "hint", None) or jax.default_backend()
-    return platform != "cpu"
+    return False
 
 
 def _matvec_sum(values_f32, seg_ids, num_segments: int):
@@ -127,6 +130,26 @@ def _matvec_sum_f64(values, seg_ids, num_segments: int):
 _LIMB_CHUNK = 1 << 16  # 8-bit limbs: in-chunk f32 sums <= 2^16*255 < 2^24
 
 
+def _chunked_onehot_sums(V, seg_ids, num_segments: int, chunk: int):
+    """[R, n] f32 rows -> [R, S] f64 per-segment sums sharing ONE one-hot,
+    accumulating f32 within ``chunk``-sized pieces and f64 across them.
+    The precision contract is the CALLER's: limb_einsum_sums feeds exact
+    small ints (error-free), f32_rows_einsum feeds arbitrary f32
+    (~chunk*eps relative in-chunk error)."""
+    n = V.shape[1]
+    chunk = min(chunk, max(n, 1))
+    pad = (-n) % chunk
+    if pad:
+        V = jnp.pad(V, ((0, 0), (0, pad)))
+        seg_ids = jnp.pad(seg_ids, (0, pad))  # pad rows are 0: no-op
+    c = V.shape[1] // chunk
+    oh = jax.nn.one_hot(
+        seg_ids.reshape(c, chunk), num_segments, dtype=jnp.float32
+    )
+    parts = jnp.einsum("vck,cks->vcs", V.reshape(-1, c, chunk), oh)
+    return jnp.sum(parts.astype(jnp.float64), axis=1)  # [R, S]
+
+
 def limb_rows_i64(values) -> list:
     """Decompose int64 (two's-complement bit pattern) into eight 8-bit
     limbs as f32 rows. Reconstruction mod 2^64 reproduces exact wrapped
@@ -155,19 +178,26 @@ def limb_einsum_sums(rows, seg_ids, num_segments: int):
     values must be limb-decomposed first (limb_rows_i64). The MXU does
     the heavy lifting — this replaces the s64 scalar scatter (12x
     slower)."""
-    V = jnp.stack(rows)  # [L, n]
-    n = V.shape[1]
-    chunk = min(_LIMB_CHUNK, max(n, 1))
-    pad = (-n) % chunk
-    if pad:
-        V = jnp.pad(V, ((0, 0), (0, pad)))
-        seg_ids = jnp.pad(seg_ids, (0, pad))  # pad rows are 0: no-op in sums
-    c = V.shape[1] // chunk
-    oh = jax.nn.one_hot(
-        seg_ids.reshape(c, chunk), num_segments, dtype=jnp.float32
+    return _chunked_onehot_sums(
+        jnp.stack(rows), seg_ids, num_segments, _LIMB_CHUNK
     )
-    parts = jnp.einsum("vck,cks->vcs", V.reshape(-1, c, chunk), oh)
-    return jnp.sum(parts.astype(jnp.float64), axis=1)  # [L, S]
+
+
+_F32_CHUNK = 1 << 16
+
+
+def f32_rows_einsum(rows, seg_ids, num_segments: int):
+    """Per-segment sums of several f32 rows sharing ONE one-hot:
+    [R, n] -> [R, S] float64. Unlike limb_einsum_sums the row values are
+    arbitrary f32 (not exact small ints): in-chunk accumulation is f32
+    (relative error ~chunk*eps of the chunk partial), chunk partials
+    accumulate in f64. Right for f32-grained sketch states (t-digest
+    weights/means); exact integer sums must use limb_einsum_sums. The
+    one-hot generation dominates, so batching all rows into one einsum
+    costs the same as one row (r5 measured: 2 rows 3.76ns vs 9 rows
+    4.05ns at 4096 segments)."""
+    V = jnp.stack([r.astype(jnp.float32) for r in rows])  # [R, n]
+    return _chunked_onehot_sums(V, seg_ids, num_segments, _F32_CHUNK)
 
 
 def reconstruct_i64(limb_totals):

@@ -45,6 +45,36 @@ def _cluster_ids(q, capacity):
     )
 
 
+# Packed-key sort applies while the group id (plus its sentinel) fits in
+# this many key bits; the same number of value-mantissa LOW bits is
+# dropped (≤2^-15 relative perturbation at 8 bits — far below the
+# digest's own ~1% error). One u32 single-key sort costs ~2.5ns/row on a
+# v5e vs ~2x for the (group, value) 2-key sort it replaces (r5 measured).
+_PACK_MAX_GROUP_BITS = 8
+
+
+def _packed_sort(gids, v32, mask, num_groups: int, bits_g: int):
+    """Sort (group, value) as ONE u32 key: order-preserving float bits in
+    the low lanes, group (sentinel = num_groups for masked rows) in the
+    high lanes. Returns (sorted gids, values reconstructed from the key —
+    low ``bits_g`` mantissa bits zeroed)."""
+    u = jax.lax.bitcast_convert_type(v32, jnp.uint32)
+    # Standard order-preserving map: flip all bits of negatives, set the
+    # sign bit of non-negatives.
+    mapped = jnp.where(
+        (u >> jnp.uint32(31)) > 0, ~u, u | jnp.uint32(0x80000000)
+    )
+    g = jnp.where(mask, gids.astype(jnp.uint32), jnp.uint32(num_groups))
+    key = (g << jnp.uint32(32 - bits_g)) | (mapped >> jnp.uint32(bits_g))
+    ks = jnp.sort(key)
+    g_s = (ks >> jnp.uint32(32 - bits_g)).astype(jnp.int32)
+    mp = ks << jnp.uint32(bits_g)
+    uu = jnp.where(
+        (mp >> jnp.uint32(31)) > 0, mp & jnp.uint32(0x7FFFFFFF), ~mp
+    )
+    return g_s, jax.lax.bitcast_convert_type(uu, jnp.float32)
+
+
 def update(state, gids, values, mask=None):
     """Fold a batch of (group, value) rows into the digests."""
     num_groups, capacity = state["means"].shape
@@ -52,14 +82,23 @@ def update(state, gids, values, mask=None):
     v = values.astype(jnp.float32)
     if mask is None:
         mask = jnp.ones((n,), jnp.bool_)
-    # Masked rows sort to a sentinel group so they never touch real segments.
-    g = jnp.where(mask, gids.astype(jnp.int32), num_groups)
-    g_s, v_s = jax.lax.sort((g, v), num_keys=2)
+    bits_g = max((num_groups + 1).bit_length(), 1)
+    if bits_g <= _PACK_MAX_GROUP_BITS:
+        g_s, v_s = _packed_sort(gids, v, mask, num_groups, bits_g)
+    else:
+        # Masked rows sort to a sentinel group: never touch real segments.
+        g = jnp.where(mask, gids.astype(jnp.int32), num_groups)
+        g_s, v_s = jax.lax.sort((g, v), num_keys=2)
     w_s = (g_s < num_groups).astype(jnp.float32)
-    # Ranks in exact int32 arithmetic (f32 arange collapses above 2^24 rows).
-    counts_i = segment.seg_count(g_s, num_groups + 1).astype(jnp.int32)
-    starts_i = jnp.cumsum(counts_i) - counts_i
-    rank = (jnp.arange(n, dtype=jnp.int32) - starts_i[g_s]).astype(jnp.float32)
+    # Group boundaries by binary search over the SORTED gids — a handful
+    # of log(n) probes instead of a segment reduction (r5).
+    qs = jnp.arange(num_groups + 1, dtype=g_s.dtype)
+    starts_i = jnp.searchsorted(g_s, qs, side="left").astype(jnp.int32)
+    ends_i = jnp.searchsorted(g_s, qs, side="right").astype(jnp.int32)
+    counts_i = ends_i - starts_i  # [G+1]; exact int32 ranks
+    rank = (jnp.arange(n, dtype=jnp.int32) - starts_i[g_s]).astype(
+        jnp.float32
+    )
     counts = counts_i.astype(jnp.float32)
     qmid = (rank + 0.5) / jnp.maximum(counts[g_s], 1.0)
     cl = _cluster_ids(qmid, capacity)
@@ -67,8 +106,23 @@ def update(state, gids, values, mask=None):
         g_s < num_groups, g_s * capacity + cl, num_groups * capacity
     )
     nseg = num_groups * capacity + 1
-    w_new = segment.seg_sum(w_s, flat, nseg)[:-1].reshape(num_groups, capacity)
-    m_sum = segment.seg_sum(v_s * w_s, flat, nseg)[:-1].reshape(num_groups, capacity)
+    if segment.matmul_strategy(nseg):
+        # Both reductions share ONE one-hot on the MXU (the one-hot
+        # generation dominates; a second einsum row is nearly free).
+        totals = segment.f32_rows_einsum([w_s, v_s * w_s], flat, nseg)
+        w_new = totals[0][:-1].astype(jnp.float32).reshape(
+            num_groups, capacity
+        )
+        m_sum = totals[1][:-1].astype(jnp.float32).reshape(
+            num_groups, capacity
+        )
+    else:
+        w_new = segment.seg_sum(w_s, flat, nseg)[:-1].reshape(
+            num_groups, capacity
+        )
+        m_sum = segment.seg_sum(v_s * w_s, flat, nseg)[:-1].reshape(
+            num_groups, capacity
+        )
     batch = {
         "means": jnp.where(w_new > 0, m_sum / jnp.maximum(w_new, 1.0), 0.0),
         "weights": w_new,

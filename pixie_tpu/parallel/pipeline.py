@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Any, Optional
 
 import jax
@@ -99,6 +100,14 @@ _STAGED_EVICTIONS = _M.counter(
 )
 _PROGRAMS = _M.gauge(
     "device_program_cache_size", "Compiled shard_map programs cached."
+)
+
+# Cold-path phase timings live in staging (shared with the transfer
+# layer); re-exported here for callers.
+from pixie_tpu.parallel.staging import (  # noqa: E402
+    COLD_PROFILE,
+    reset_cold_profile,
+    timed as _timed,
 )
 
 
@@ -502,6 +511,9 @@ class MeshExecutor:
         self.fallback_errors: dict[str, str] = {}
         # (uda set, capacity) -> (finalize modes, packed-output templates).
         self._finmode_cache: dict[tuple, Any] = {}
+        # Host-computed any() representatives, keyed by
+        # (table, version, window, key exprs, col); small LRU.
+        self._hostany_cache: dict[tuple, np.ndarray] = {}
 
     # -- public -------------------------------------------------------------
     def try_execute_fragment(
@@ -558,18 +570,46 @@ class MeshExecutor:
         if evaluator is None:
             return None
 
+        # Host-side any() candidates are syntactic (no predicates, bare
+        # column): their arg columns never ship to HBM — exclude them from
+        # base_cols up front; if planning falls through after the key plan
+        # resolves, they rejoin the device path below.
+        any_candidates = set()
+        if not m.predicates and m.agg_op.stage == AggStage.FULL:
+            any_candidates = {
+                out
+                for out, arg_e, uda in specs
+                if uda.name == "any"
+                and uda.reads_args
+                and isinstance(arg_e, ColumnRef)
+            }
         # Host: read needed source columns. UDAs that never read their
         # column (count) contribute nothing — staging their arg would ship
         # gigabytes of unread data to HBM.
         base_cols = set()
         for e in m.predicates:
             base_cols |= referenced_columns(e)
-        for _, e, uda in specs:
-            if uda.reads_args:
+        for out, e, uda in specs:
+            if uda.reads_args and out not in any_candidates:
                 base_cols |= referenced_columns(e)
-        key_plan = self._plan_keys(m, table, registry, func_ctx, base_cols)
+        with _timed("plan_keys"):
+            key_plan = self._plan_keys(m, table, registry, func_ctx, base_cols)
         if key_plan is None:
             return None
+        with _timed("host_any"):
+            host_any = (
+                self._plan_host_any(m, specs, key_plan, table)
+                if any_candidates
+                else {}
+            )
+        for out, e, uda in specs:
+            if out in any_candidates and out not in host_any:
+                # Host-side plan fell through (no usable gid source):
+                # back to the device path — its column must stage.
+                base_cols |= referenced_columns(e)
+        device_specs = [s for s in specs if s[0] not in host_any]
+        capacity_hint, _ = self._pass_plan(device_specs, key_plan.num_groups)
+        cell_cols = self._cell_cols(m, device_specs, capacity_hint)
         # The key signature must pin the actual group expressions — two
         # queries over the same table version with different groupbys must
         # not share staged gids.
@@ -604,6 +644,10 @@ class MeshExecutor:
             key_sig,
             key_plan.num_groups,
             tuple(sorted(f32_cols)),
+            # name AND cardinality bound: two queries with different
+            # pass capacities must not share codes staged under a
+            # different max_card (their cell-lane segment budgets differ).
+            tuple(sorted(cell_cols.items())),
         )
         staged = self._staged_cache.get(cache_key) if cacheable else None
         if staged is None and cacheable:
@@ -624,16 +668,28 @@ class MeshExecutor:
         if staged is not None:
             self._staged_cache.move_to_end(cache_key)
         else:
-            cols, n = read_columns(
-                table,
-                sorted(base_cols),
-                m.source_op.start_time,
-                m.source_op.stop_time,
-            )
+            with _timed("read_columns"):
+                cols, n = read_columns(
+                    table,
+                    sorted(base_cols),
+                    m.source_op.start_time,
+                    m.source_op.stop_time,
+                )
             if key_plan.host_gids is not None and len(key_plan.host_gids) != n:
                 return None  # table moved under us; fall back
+            int_dicts = {}
+            with _timed("int_dict_encode"):
+                from pixie_tpu.parallel.staging import int_dict_encode
+
+                for col, max_card in cell_cols.items():
+                    enc = int_dict_encode(cols[col], max_card)
+                    if enc is not None:
+                        cols[col], int_dicts[col] = enc
             try:
-                staged = self._stage(cols, n, key_plan, table, f32_cols)
+                with _timed("stage"):
+                    staged = self._stage(
+                        cols, n, key_plan, table, f32_cols, int_dicts
+                    )
             except Exception as e:
                 if "RESOURCE_EXHAUSTED" not in str(e) and (
                     "Out of memory" not in str(e)
@@ -649,20 +705,34 @@ class MeshExecutor:
                 # Retry OUTSIDE the except block: the in-flight exception's
                 # traceback pins the failed attempt's partially allocated
                 # device buffers until the handler exits.
-                staged = self._stage(cols, n, key_plan, table, f32_cols)
+                with _timed("stage"):
+                    staged = self._stage(
+                        cols, n, key_plan, table, f32_cols, int_dicts
+                    )
             if cacheable:
                 self._staged_insert(
                     cache_key, staged, m.source_op.table_name, version
                 )
-        aux = self._build_aux(evaluator, m, key_plan, table, specs)
-        merged, capacity = self._run_program(
-            m, specs, evaluator, key_plan, staged, aux
-        )
+        with _timed("aux"):
+            aux = self._build_aux(evaluator, m, key_plan, table, device_specs)
+        with _timed("program"):
+            merged, capacity = self._run_program(
+                m, device_specs, evaluator, key_plan, staged, aux
+            )
         if m.agg_op.stage == AggStage.PARTIAL:
-            batch = self._partial_state_batch(m, specs, key_plan, merged, table)
+            batch = self._partial_state_batch(
+                m, device_specs, key_plan, merged, table
+            )
         else:
             batch = self._finalize(
-                m, specs, key_plan, capacity, merged, registry, table
+                m,
+                specs,
+                key_plan,
+                capacity,
+                merged,
+                registry,
+                table,
+                host_any=host_any,
             )
         return m.agg_nid, batch
 
@@ -1625,7 +1695,7 @@ class MeshExecutor:
             )
         )
 
-    def _stage(self, cols, n, key_plan, table, f32_cols=None):
+    def _stage(self, cols, n, key_plan, table, f32_cols=None, int_dicts=None):
         return stage_columns(
             self.mesh,
             cols,
@@ -1636,7 +1706,114 @@ class MeshExecutor:
             dictionaries=table.dictionaries,
             block_rows=self.block_rows,
             f32_cols=f32_cols,
+            int_dicts=int_dicts,
         )
+
+    def _cell_cols(self, m: _Match, specs, capacity: int) -> dict:
+        """Columns eligible for int-dictionary staging + the cell lane:
+        INT64, consumed ONLY as the bare arg of cell-capable UDAs, and
+        untouched by predicates/keys. Returns {col: max cardinality} —
+        bounded so the per-(group, code) histogram einsum stays on the
+        MXU's cheap side (capacity * C <= MATMUL_MAX_SEGMENTS)."""
+        from pixie_tpu.ops import segment as _segment
+
+        max_card = min(256, _segment.MATMUL_MAX_SEGMENTS // max(capacity, 1))
+        if max_card < 2:
+            return {}
+        pred_refs = set()
+        for p in m.predicates:
+            pred_refs |= referenced_columns(p)
+        key_refs = set()
+        for g in m.agg_op.groups:
+            key_refs |= referenced_columns(m.col_exprs[g])
+        consumers: dict[str, list] = {}
+        for _out, arg_e, uda in specs:
+            if not uda.reads_args:
+                continue
+            for col in referenced_columns(arg_e):
+                consumers.setdefault(col, []).append((arg_e, uda))
+        out = {}
+        for col, cons in consumers.items():
+            if col in pred_refs or col in key_refs:
+                continue
+            try:
+                if m.source_relation.col(col).data_type != DataType.INT64:
+                    continue
+            except KeyError:
+                continue
+            if all(
+                isinstance(ae, ColumnRef) and u.cell_update is not None
+                for ae, u in cons
+            ):
+                out[col] = max_card
+        return out
+
+    def _plan_host_any(
+        self, m: _Match, specs, key_plan, table
+    ) -> dict:
+        """any() without predicates needs ONE representative value per
+        group — computable host-side from the key plan's gids in a single
+        vectorized pass, so the device never pays the ~7ns/row scatter a
+        segment-max costs (the only non-sum reduction in the hot configs;
+        r5). Returns {out_name: per-group np array (codes for strings)},
+        cached per (table version, window, keys, col)."""
+        if m.predicates or m.agg_op.stage != AggStage.FULL:
+            return {}
+        cand = [
+            (out, arg_e, uda)
+            for out, arg_e, uda in specs
+            if uda.name == "any"
+            and uda.reads_args
+            and isinstance(arg_e, ColumnRef)
+        ]
+        if not cand:
+            return {}
+        num_groups = max(key_plan.num_groups, 1)
+        # Per-row gids host-side: the generic key plan has them; a
+        # dictionary-code key IS the gid column.
+        gids = key_plan.host_gids
+        gid_col = None
+        if gids is None:
+            if isinstance(key_plan.device_expr, ColumnRef):
+                gid_col = key_plan.device_expr.name
+            else:
+                return {}
+        out = {}
+        for out_name, arg_e, uda in cand:
+            ck = (
+                m.source_op.table_name,
+                (table.min_row_id(), table.end_row_id()),
+                m.source_op.start_time,
+                m.source_op.stop_time,
+                repr([m.col_exprs[g] for g in m.agg_op.groups]),
+                arg_e.name,
+            )
+            rep = self._hostany_cache.get(ck)
+            if rep is None:
+                want = [arg_e.name] + ([gid_col] if gid_col else [])
+                cols, n = read_columns(
+                    table,
+                    sorted(set(want)),
+                    m.source_op.start_time,
+                    m.source_op.stop_time,
+                )
+                g = gids if gids is not None else np.maximum(cols[gid_col], 0)
+                if len(g) != n or n == 0 or int(g.max()) >= num_groups:
+                    # Table moved under us (new dictionary codes appended
+                    # after planning): fall back to the device path, like
+                    # the host_gids length guard.
+                    return {}
+                vals = cols[arg_e.name]
+                rep = np.zeros(num_groups, vals.dtype)
+                # Reversed assignment: the LAST write per gid wins, which
+                # is the FIRST occurrence in row order — one vectorized
+                # pass, no sort.
+                rep[g[::-1]] = vals[::-1]
+                self._hostany_cache[ck] = rep
+                while len(self._hostany_cache) > 32:
+                    self._hostany_cache.pop(next(iter(self._hostany_cache)))
+            out[out_name] = rep
+        return out
 
     def _sketch_f32_cols(self, m: _Match, specs) -> set:
         """FLOAT64 source columns eligible for f32 staging: referenced ONLY
@@ -1994,6 +2171,7 @@ class MeshExecutor:
             f"mask:{staged.mask.shape}",
             f"cap:{capacity}",
             f"narrow:{sorted(staged.narrow_offsets)}",
+            f"intdict:{sorted(staged.int_dicts)}",
             f"hostgids:{key_plan.host_gids is not None}",
             "preds:" + ";".join(repr(p) for p in m.predicates),
             "aggs:" + ";".join(
@@ -2022,6 +2200,7 @@ class MeshExecutor:
         )
         col_names = sorted(staged.blocks)
         narrow_names = sorted(staged.narrow_offsets)
+        int_dict_names = sorted(staged.int_dicts)
         has_host_gids = key_plan.host_gids is not None
         has_key_lut = isinstance(key_plan.device_expr, tuple)
         device_key = key_plan.device_expr
@@ -2123,6 +2302,12 @@ class MeshExecutor:
                     for out, arg_e, uda in specs:
                         if uda.fused_rows is None:
                             continue
+                        if (
+                            uda.cell_update is not None
+                            and isinstance(arg_e, ColumnRef)
+                            and arg_e.name in int_dict_names
+                        ):
+                            continue  # cell lane serves it
                         col = (
                             eval_col(arg_e, uda) if uda.reads_args else None
                         )
@@ -2136,8 +2321,48 @@ class MeshExecutor:
                     presence = presence + _segment.seg_count(
                         gids, capacity, mask
                     ).astype(presence.dtype)
+                # Cell lane: per-column (group, code) histograms via one
+                # MXU einsum each; cell-capable UDAs over int-dictionary
+                # columns update per CELL instead of per row (r5).
+                hists: dict[str, Any] = {}
+                for cname in int_dict_names:
+                    lut = aux[f"intdict:{cname}"]
+                    C = lut.shape[0]
+                    if capacity * C > _segment.MATMUL_MAX_SEGMENTS:
+                        # Cache reuse under a bigger pass capacity than
+                        # the staging's max_card assumed: histogram would
+                        # blow the einsum budget — row path (below) takes
+                        # over via a LUT gather instead.
+                        continue
+                    flat = gids * C + env[cname].astype(jnp.int32)
+                    h = _segment.limb_einsum_sums(
+                        [mask.astype(jnp.float32)], flat, capacity * C
+                    )
+                    hists[cname] = h[0].astype(jnp.int64).reshape(
+                        capacity, C
+                    )
                 new_states = []
                 for (out, arg_e, uda), st in zip(specs, states):
+                    if (
+                        uda.cell_update is not None
+                        and isinstance(arg_e, ColumnRef)
+                        and arg_e.name in int_dict_names
+                    ):
+                        if arg_e.name in hists:
+                            new_states.append(
+                                uda.cell_update(
+                                    st,
+                                    hists[arg_e.name],
+                                    aux[f"intdict:{arg_e.name}"],
+                                )
+                            )
+                        else:
+                            lut = aux[f"intdict:{arg_e.name}"]
+                            vals = lut[env[arg_e.name].astype(jnp.int32)]
+                            new_states.append(
+                                uda.update(st, gids, vals, mask=mask)
+                            )
+                        continue
                     if out in fused_slices:
                         a, b = fused_slices[out]
                         new_states.append(uda.fused_apply(st, totals[a:b]))
@@ -2274,6 +2499,10 @@ class MeshExecutor:
 
     def _run_program(self, m, specs, evaluator, key_plan, staged, aux):
         col_names = sorted(staged.blocks)
+        # Int-dictionary LUTs ride the aux lane (replicated args), so
+        # dictionary content can change without recompiling.
+        for n2 in sorted(staged.int_dicts):
+            aux[f"intdict:{n2}"] = np.asarray(staged.int_dicts[n2])
         aux_vals = list(aux.values())
         capacity, n_passes = self._pass_plan(specs, key_plan.num_groups)
         sig = self._signature(m, specs, key_plan, staged, aux_vals, capacity)
@@ -2375,13 +2604,27 @@ class MeshExecutor:
         )
 
     def _finalize(
-        self, m, specs, key_plan, capacity, outputs_and_presence, registry, table
+        self,
+        m,
+        specs,
+        key_plan,
+        capacity,
+        outputs_and_presence,
+        registry,
+        table,
+        host_any=None,
     ):
+        host_any = host_any or {}
+        device_specs = [s for s in specs if s[0] not in host_any]
         values, presence = outputs_and_presence
         # Use the SAME per-pass capacity the program was compiled with —
         # recomputing modes at staged.capacity could disagree with the
         # packed buffer layout when _pass_plan shrank the window (ADVICE r3).
-        modes, _ = self._finalize_modes(specs, capacity)
+        modes, _ = self._finalize_modes(device_specs, capacity)
+        by_out = {
+            s[0]: (s, mode, val)
+            for s, mode, val in zip(device_specs, modes, values)
+        }
         n = max(key_plan.num_groups, 1) if m.agg_op.groups else 1
         rel = m.agg_op.output_relation([_pre_agg_relation(m, registry)], registry)
         # Only observed groups are emitted (host-engine semantics): drop
@@ -2400,7 +2643,25 @@ class MeshExecutor:
             )
         from pixie_tpu.types.dtypes import host_dtype
 
-        for (out_name, arg_e, uda), mode, val in zip(specs, modes, values):
+        for out_name, arg_e, uda in specs:
+            schema = rel.col(out_name)
+            if out_name in host_any:
+                rep = np.asarray(host_any[out_name])[:n][keep]
+                if schema.data_type == DataType.STRING:
+                    src_dict = table.dictionaries.get(arg_e.name)
+                    vals2 = (
+                        src_dict.decode(rep.astype(np.int32))
+                        if src_dict is not None
+                        else np.full(len(rep), "", dtype=object)
+                    )
+                    d = StringDictionary()
+                    out_cols.append(DictColumn(d.encode(vals2), d))
+                else:
+                    out_cols.append(
+                        rep.astype(host_dtype(schema.data_type))
+                    )
+                continue
+            _spec, mode, val = by_out[out_name]
             if mode == "state":
                 sliced = jax.tree.map(lambda a: np.asarray(a)[:n][keep], val)
                 out = uda.finalize(sliced)
@@ -2411,7 +2672,6 @@ class MeshExecutor:
                     if mode == "devfin" and uda.format_output is not None
                     else arr
                 )
-            schema = rel.col(out_name)
             if schema.data_type == DataType.STRING:
                 if uda.string_state:
                     # Code-valued state (any(STRING)): decode through the

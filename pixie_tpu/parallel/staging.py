@@ -15,6 +15,7 @@ staging (ops/segment.py's contract).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional
 
 import jax
@@ -26,6 +27,35 @@ from pixie_tpu.table.column import DictColumn
 from pixie_tpu.table.table import Table
 
 DEFAULT_BLOCK_ROWS = 1 << 17
+
+# Cold-path phase timings (cumulative seconds since last reset): where a
+# first query's latency goes — host column reads, gid densification,
+# host-side pack, host→HBM transfer, program trace+compile+execute.
+# bench.py resets before each cold query and writes the breakdown to the
+# ledger (VERDICT r4 weakness 4).
+COLD_PROFILE: dict[str, float] = {}
+
+
+def reset_cold_profile() -> dict:
+    snap = dict(COLD_PROFILE)
+    COLD_PROFILE.clear()
+    return snap
+
+
+class timed:
+    """with timed('stage'): ... — accumulates into COLD_PROFILE."""
+
+    def __init__(self, key: str):
+        self.key = key
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+
+    def __exit__(self, *exc):
+        COLD_PROFILE[self.key] = COLD_PROFILE.get(self.key, 0.0) + (
+            time.perf_counter() - self.t0
+        )
+        return False
 
 
 @dataclasses.dataclass
@@ -48,6 +78,10 @@ class StagedColumns:
     # is the cold-path bottleneck (~19MB/s through a tunneled chip, ~10GB/s
     # on local PCIe), so staged bytes are the metric that matters.
     narrow_offsets: dict = dataclasses.field(default_factory=dict)
+    # Int-dictionary columns: blocks[name] holds SMALL-DOMAIN CODES
+    # (uint8/uint16) and int_dicts[name] is the [C] int64 value LUT — the
+    # cell lane aggregates per (group, code) histogram instead of per row.
+    int_dicts: dict = dataclasses.field(default_factory=dict)
 
 
 def _pow2_at_least(n: int, floor: int = 8) -> int:
@@ -87,12 +121,40 @@ def read_columns(
     return cols, n
 
 
+def int_dict_encode(
+    arr: np.ndarray, max_card: int
+) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    """(codes, sorted value LUT) when the column has <= max_card distinct
+    values, else None. Costs one sample-unique + one searchsorted pass +
+    one verify compare over the column — paid once per staging (cached
+    with it). Telemetry int columns (status codes, ports, enum-ish ids)
+    are routinely tiny-domain."""
+    if arr.size == 0 or arr.dtype != np.int64 or max_card < 2:
+        return None
+    lut = np.unique(arr[: 1 << 16])
+    if len(lut) > max_card:
+        return None
+    codes = np.searchsorted(lut, arr)
+    codes = np.minimum(codes, len(lut) - 1)
+    ok = lut[codes] == arr
+    if not ok.all():
+        extra = np.unique(arr[~ok])
+        lut = np.unique(np.concatenate([lut, extra]))
+        if len(lut) > max_card:
+            return None
+        codes = np.searchsorted(lut, arr)
+    dtype = np.uint8 if len(lut) <= 256 else np.uint16
+    return codes.astype(dtype), lut
+
+
 def _narrow_int(arr: np.ndarray) -> tuple[np.ndarray, Optional[int]]:
     """Frame-of-reference narrowing for int columns: ship (value - min) as
-    uint8 (or int32 for int64 inputs) when the RANGE fits, with the offset
-    reconstructed on device (widened back to int64 per block). Applies to
-    int64 values AND int32 dictionary codes — low-cardinality string
-    columns (services, pods) ship at 1 byte/row. (None offset = as-is.)"""
+    uint8/uint16 (or int32 for int64 inputs) when the RANGE fits, with the
+    offset reconstructed on device (widened back to int64 per block).
+    Applies to int64 values AND int32 dictionary codes — low-cardinality
+    string columns (services, pods) ship at 1 byte/row, ports/status codes
+    at 2. (None offset = as-is.) Host→HBM transfer is the cold-path
+    bottleneck, so staged bytes are the metric that matters."""
     if arr.size == 0 or arr.dtype not in (np.int64, np.int32):
         return arr, None
     lo = int(arr.min())
@@ -100,6 +162,8 @@ def _narrow_int(arr: np.ndarray) -> tuple[np.ndarray, Optional[int]]:
     rng = hi - lo
     if rng <= 0xFF:
         return (arr - lo).astype(np.uint8), lo
+    if rng <= 0xFFFF:
+        return (arr - lo).astype(np.uint16), lo
     if arr.dtype == np.int64 and rng < (1 << 31):
         return (arr - lo).astype(np.int32), lo
     return arr, None
@@ -143,12 +207,15 @@ def stage_columns(
     dictionaries: Optional[dict] = None,
     block_rows: int = DEFAULT_BLOCK_ROWS,
     f32_cols: Optional[set] = None,
+    int_dicts: Optional[dict] = None,
 ) -> StagedColumns:
     """Pad/reshape host columns into [D, nblk, B] and shard over the mesh.
 
     ``f32_cols`` names float64 columns consumed only by f32-state sketch
     UDAs (t-digest keeps f32 centroids): staging them as f32 halves their
-    transfer with zero end-to-end precision change."""
+    transfer with zero end-to-end precision change. ``int_dicts`` maps
+    column names already replaced by small-domain codes (see
+    int_dict_encode) to their value LUTs."""
     (axis_name,) = mesh.axis_names
     d = mesh.devices.size
     b = min(block_rows, _pow2_at_least(max(num_rows // d, 1), floor=256))
@@ -164,19 +231,36 @@ def stage_columns(
     narrow_offsets: dict[str, int] = {}
     blocks: dict[str, jax.Array] = {}
     for name, a in cols.items():
-        if f32_cols and name in f32_cols and a.dtype == np.float64:
-            a = a.astype(np.float32)
-        else:
-            a, off = _narrow_int(a)
-            if off is not None:
-                narrow_offsets[name] = off
-        blocks[name] = jax.device_put(shape3(a, 0), sharding)
+        with timed("stage_host_pack"):
+            if f32_cols and name in f32_cols and a.dtype == np.float64:
+                a = a.astype(np.float32)
+            else:
+                a, off = _narrow_int(a)
+                if off is not None:
+                    narrow_offsets[name] = off
+            packed = shape3(a, 0)
+        with timed("stage_transfer"):
+            blocks[name] = jax.device_put(packed, sharding)
+            # device_put is async on local backends: block so the
+            # breakdown attributes transfer time here, not to the first
+            # program execution. (On the tunneled axon backend the put
+            # itself streams synchronously.)
+            jax.block_until_ready(blocks[name])
+            COLD_PROFILE["stage_bytes"] = COLD_PROFILE.get(
+                "stage_bytes", 0.0
+            ) + float(packed.nbytes)
     mask_dev = _build_mask(mesh, d, nblk, b, num_rows)
-    gids_dev = (
-        jax.device_put(shape3(gids.astype(np.int32), 0), sharding)
-        if gids is not None
-        else None
-    )
+    gids_dev = None
+    if gids is not None:
+        # gids are dense [0, num_groups): ship u8/u16 when they fit (the
+        # compiled programs cast to int32 per block anyway).
+        if num_groups <= 0xFF + 1:
+            g = gids.astype(np.uint8)
+        elif num_groups <= 0xFFFF + 1:
+            g = gids.astype(np.uint16)
+        else:
+            g = gids.astype(np.int32)
+        gids_dev = jax.device_put(shape3(g, 0), sharding)
     return StagedColumns(
         blocks=blocks,
         mask=mask_dev,
@@ -189,4 +273,5 @@ def stage_columns(
         key_columns=list(key_columns or []),
         dictionaries=dict(dictionaries or {}),
         narrow_offsets=narrow_offsets,
+        int_dicts=dict(int_dicts or {}),
     )

@@ -143,6 +143,10 @@ def register(r: Registry) -> None:
                 + segment.seg_count(gids, st["total"].shape[0], mask),
             },
             merge=lambda a, b: {"cm": a["cm"] + b["cm"], "total": a["total"] + b["total"]},
+            cell_update=lambda st, hist, lut: {
+                "cm": countmin.cell_update(st["cm"], hist, lut),
+                "total": st["total"] + hist.sum(axis=1),
+            },
             finalize=lambda st: _format_cm(st),
             device_finalize=lambda st: jnp.stack(
                 [st["total"], st["cm"].max(axis=(1, 2))], axis=1

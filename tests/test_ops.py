@@ -150,6 +150,27 @@ class TestDevicePathKernels:
         for k in range(g):
             assert (per_depth[k] == ((np_g == k) & np_m).sum()).all()
 
+    def test_countmin_cell_update_matches_rowwise(self, rng):
+        """cell_update over a (group, code) histogram + LUT reproduces the
+        row-wise update exactly (same hash pairs per cell)."""
+        n, g, C = 30_000, 4, 7
+        lut = jnp.asarray([-3, 0, 5, 17, 1 << 40, 999, 12345], jnp.int64)
+        codes = rng.integers(0, C, n)
+        gids = jnp.asarray(rng.integers(0, g, n), dtype=jnp.int32)
+        mask = jnp.asarray(rng.random(n) < 0.9)
+        vals = jnp.asarray(np.asarray(lut)[codes])
+        ref = countmin.update(
+            countmin.init(g, depth=3, width=1024), gids, vals, mask
+        )
+        hist = np.zeros((g, C), np.int64)
+        np.add.at(
+            hist, (np.asarray(gids)[np.asarray(mask)], codes[np.asarray(mask)]), 1
+        )
+        got = countmin.cell_update(
+            countmin.init(g, depth=3, width=1024), jnp.asarray(hist), lut
+        )
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
     def test_hash32_properties(self):
         x = jnp.arange(5000, dtype=jnp.int64) * 1_000_003
         h = np.asarray(hashing.hash32(x))
@@ -201,6 +222,29 @@ class TestTDigest:
                 true = np.quantile(v, q)
                 spread = np.quantile(v, 0.999) - np.quantile(v, 0.001)
                 assert abs(qv[k, qi] - true) < 0.05 * spread, (k, q, qv[k, qi], true)
+
+    def test_packed_sort_matches_two_key_path(self, rng):
+        """The packed single-key sort (small G) and the 2-key sort path
+        (large G) build near-identical digests: same weights, means within
+        the dropped-mantissa-bits tolerance."""
+        n = 20_000
+        vals = jnp.asarray(rng.normal(0, 1000, n))
+        gids = jnp.asarray(rng.integers(0, 3, n), dtype=jnp.int32)
+        mask = jnp.asarray(rng.random(n) < 0.9)
+        packed = tdigest.update(tdigest.init(3), gids, vals, mask)
+        old_cap = tdigest._PACK_MAX_GROUP_BITS
+        try:
+            tdigest._PACK_MAX_GROUP_BITS = 0  # force the 2-key path
+            twokey = tdigest.update(tdigest.init(3), gids, vals, mask)
+        finally:
+            tdigest._PACK_MAX_GROUP_BITS = old_cap
+        np.testing.assert_allclose(
+            np.asarray(packed["weights"]), np.asarray(twokey["weights"]),
+            rtol=0, atol=0,
+        )
+        qp = np.asarray(tdigest.quantile_values(packed, [0.5, 0.99]))
+        qt = np.asarray(tdigest.quantile_values(twokey, [0.5, 0.99]))
+        np.testing.assert_allclose(qp, qt, rtol=2e-3, atol=1.0)
 
     def test_distributed_merge_close_to_single(self, rng):
         v = rng.normal(0, 1, 40000)

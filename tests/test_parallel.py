@@ -467,11 +467,11 @@ def test_stage_oom_retry_policy(mesh):
     calls = []
     orig = ex._stage
 
-    def oom_once(cols, n, key_plan, table, f32_cols=None):
+    def oom_once(cols, n, key_plan, table, f32_cols=None, int_dicts=None):
         calls.append(1)
         if len(calls) == 1:
             raise RuntimeError("RESOURCE_EXHAUSTED: out of HBM")
-        return orig(cols, n, key_plan, table, f32_cols)
+        return orig(cols, n, key_plan, table, f32_cols, int_dicts)
 
     ex._stage = oom_once
     # Different time window -> cache miss -> staging path runs.
@@ -801,3 +801,91 @@ def test_mesh_join_agg_ungrouped(mesh):
     n_true = 500 * 50
     assert rows["n"] == [n_true]
     assert rows["total"][0] == pytest.approx(2.0 * n_true)
+
+
+def test_mesh_countmin_cell_lane_matches_host(mesh):
+    """count_min over a small-domain int column takes the int-dictionary
+    cell lane on the mesh (r5) and must equal the host engine's sketch
+    exactly (identical buckets: cells hash like their rows)."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    ch, _ = seed_carnot(None)
+    pxl = (
+        "df = px.DataFrame(table='http_events')\n"
+        "s = df.groupby(['service']).agg(freq=('resp_status', px.count_min))\n"
+        "px.display(s, 'out')\n"
+    )
+    rows_d = cd.execute_query(pxl).table("out")
+    rows_h = ch.execute_query(pxl).table("out")
+    dd = {s: rows_d["freq"][i] for i, s in enumerate(rows_d["service"])}
+    hh = {s: rows_h["freq"][i] for i, s in enumerate(rows_h["service"])}
+    assert dd == hh
+    # The staged column really is int-dictionary coded (not raw int64).
+    ex = cd.device_executor
+    staged = next(iter(ex._staged_cache.values()))
+    assert "resp_status" in staged.int_dicts
+    assert list(staged.int_dicts["resp_status"]) == [200, 400, 500]
+    assert staged.blocks["resp_status"].dtype == np.uint8
+
+
+def test_mesh_countmin_cell_lane_with_filter_stays_rowwise(mesh):
+    """A predicate referencing the sketch column disables the cell lane
+    (the histogram could not honor the filter) — results still match."""
+    cd, _ = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    ch, _ = seed_carnot(None)
+    pxl = (
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[df.resp_status >= 400]\n"
+        "s = df.groupby(['service']).agg(freq=('resp_status', px.count_min))\n"
+        "px.display(s, 'out')\n"
+    )
+    rows_d = cd.execute_query(pxl).table("out")
+    rows_h = ch.execute_query(pxl).table("out")
+    dd = {s: rows_d["freq"][i] for i, s in enumerate(rows_d["service"])}
+    hh = {s: rows_h["freq"][i] for i, s in enumerate(rows_h["service"])}
+    assert dd == hh
+    staged = next(iter(cd.device_executor._staged_cache.values()))
+    assert not staged.int_dicts
+
+
+def test_mesh_any_host_representative(mesh):
+    """any() without predicates is served by the host-side representative
+    pass (r5): no device work for the column, same output contract as the
+    host engine — one observed value per group."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    pxl = (
+        "df = px.DataFrame(table='http_events')\n"
+        "s = df.groupby(['service']).agg(\n"
+        "    upid=('upid', px.any),\n"
+        "    st=('resp_status', px.any),\n"
+        "    n=('time_', px.count),\n"
+        ")\n"
+        "px.display(s, 'out')\n"
+    )
+    rows = cd.execute_query(pxl).table("out")
+    assert set(rows["service"]) == {"a", "b", "c"}
+    for i, svc in enumerate(rows["service"]):
+        mask = data["service"] == svc
+        # the representative is a value actually observed in the group
+        assert rows["upid"][i] in set(data["upid"][mask])
+        assert rows["st"][i] in set(data["resp_status"][mask])
+        assert rows["n"][i] == int(mask.sum())
+    # and the arg columns were never staged to the device
+    staged = next(iter(cd.device_executor._staged_cache.values()))
+    assert "upid" not in staged.blocks
+    assert "resp_status" not in staged.blocks
+
+
+def test_mesh_any_with_filter_uses_device_path(mesh):
+    """With a predicate, any() must respect the filter — host engine and
+    mesh agree, and the column IS staged (device path)."""
+    cd, data = seed_carnot(MeshExecutor(mesh=mesh, block_rows=1024))
+    pxl = (
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[df.resp_status >= 400]\n"
+        "s = df.groupby(['service']).agg(st=('resp_status', px.any))\n"
+        "px.display(s, 'out')\n"
+    )
+    rows = cd.execute_query(pxl).table("out")
+    for i, svc in enumerate(rows["service"]):
+        mask = (data["service"] == svc) & (data["resp_status"] >= 400)
+        assert rows["st"][i] in set(data["resp_status"][mask])
