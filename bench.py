@@ -1,40 +1,53 @@
-"""Benchmarks for the five BASELINE configs.
+"""Benchmarks for the five BASELINE configs (+ the host-path config 0).
 
-Prints ONE JSON line (the headline metric: config-2 px/service_stats-class
-throughput on TPU, target 1e8 rows/s/chip per BASELINE.md) and writes all
-five configs' numbers to BENCH_DETAIL.json:
+Prints ONE JSON line on stdout (the headline metric: config-2
+px/service_stats-class throughput on TPU, target 1e8 rows/s/chip per
+BASELINE.md) — emitted IMMEDIATELY after config 2 completes so a driver
+timeout later in the run cannot lose it — and writes every config's
+numbers to BENCH_DETAIL.json incrementally as each config finishes.
 
-  1. http_data   — filter+project over http_events (host exec path).
-  2. service_stats — groupby(service) count + error-rate + quantile sketch
-     on the device pipeline (the headline; truth-checked).
-  3. net_flow_graph — groupby(src,dst) byte-count sum + HLL distinct over
-     conn_stats.
+  2. service_stats — groupby(service) count + error-rate + quantile
+     sketch on the device pipeline (the headline; truth-checked). Runs
+     FIRST; its JSON line goes to stdout the moment it verifies.
+  5. streaming sketches — t-digest + count-min over http_events latency.
   4. perf_flamegraph — stack groupby + count merge over stack_traces.
-  5. streaming sketches — t-digest + count-min over http_events latency
-     with mesh sketch merge.
+  1. http_data — filter+project+head over http_events (device scan).
+  0. http_data host path — the same filter+project WITHOUT head(),
+     pinned to the host engine: keeps the r3 host metric measured so the
+     regression gate retains host-path coverage (VERDICT r4 weakness 5).
+  3. net_flow_graph — groupby(src,dst) sum + HLL distinct. Runs LAST:
+     costliest cold path, so a driver timeout costs the least.
 
 Steady-state protocol: tables are staged once (warm-up excluded); best of
 N timed runs — the reference's operator-benchmark methodology
-(/root/reference/src/carnot/blocking_agg_benchmark.cc). Config 2 output
-correctness is asserted against HOST-computed truth accumulated during
-generation (exact counts/error rates; quantiles vs an independent numpy
-log-histogram), so a kernel bug that preserved row counts still fails.
-Cold (first-query: compile + stage) latency is reported separately per
-config alongside the warm steady-state number.
+(/root/reference/src/carnot/exec/blocking_agg_benchmark.cc). Config 2
+output correctness is asserted against HOST-computed truth accumulated
+during generation (exact counts/error rates; quantiles vs an independent
+numpy log-histogram), so a kernel bug that preserved row counts still
+fails. Cold (first-query) latency is reported per config alongside the
+warm number, WITH a phase breakdown (read/plan/pack/transfer/program)
+from pixie_tpu.parallel.staging.COLD_PROFILE.
+
+Generated datasets are cached on disk (BENCH_CACHE_DIR, default
+.bench_cache/) keyed by (rows, services, seed, schema version) and
+reloaded in ~seconds; the JAX persistent compilation cache (.jax_cache/)
+makes repeat cold queries skip XLA compiles. Both caches cut the official
+driver run from tens of minutes to a few (VERDICT r4 weakness 1).
 
 Regression gate: BENCH_DETAIL.json keeps each config's best-ever value;
-any config regressing >10% vs its best marks the gate red (and the
-headline line carries "gate": "red") so non-headline regressions cannot
-ship silently. BENCH_GATE_SELFTEST=1 injects an impossible prior to
-prove the gate trips.
+any config regressing >10% vs its best marks the gate red so
+non-headline regressions cannot ship silently. BENCH_GATE_SELFTEST=1
+injects an impossible prior to prove the gate trips (on a deep copy —
+the ledger never records fabricated baselines, ADVICE r4).
 
 Env knobs: BENCH_ROWS (configs 2/5; default 256M), BENCH_SMALL_ROWS
-(configs 1/3/4; default 64M — large enough that the ~100ms tunnel fetch
-round-trip does not dominate the steady-state metric), BENCH_RUNS,
-BENCH_SERVICES, BENCH_CONFIGS (comma list, default "1,2,3,4,5"),
-BENCH_BLOCK_ROWS (device block size).
+(configs 1/3/4; default 64M), BENCH_HOST_ROWS (config 0; default 8M),
+BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS (comma list, default
+"2,5,4,1,0,3" — also the execution order), BENCH_BLOCK_ROWS,
+BENCH_CACHE_DIR, BENCH_NO_DATA_CACHE=1 to force regeneration.
 """
 
+import copy
 import json
 import math
 import os
@@ -43,12 +56,15 @@ import time
 
 import numpy as np
 
+REPO = os.path.dirname(os.path.abspath(__file__))
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
 GATE_TOLERANCE = 0.10  # >10% below best-ever trips the gate
+_SCHEMA_V = "v1"  # bump to invalidate cached datasets
 
 
 def load_prior_best(path: str) -> dict:
@@ -123,26 +139,120 @@ def best_of(fn, runs: int):
     return best, result
 
 
+class DatasetCache:
+    """Disk cache for generated benchmark datasets: one .npz per dataset,
+    keyed by shape parameters + seed + schema version. Generation at
+    256M rows costs minutes of RNG + encode; reload costs seconds."""
+
+    def __init__(self):
+        self.dir = os.environ.get(
+            "BENCH_CACHE_DIR", os.path.join(REPO, ".bench_cache")
+        )
+        self.enabled = not os.environ.get("BENCH_NO_DATA_CACHE")
+        if self.enabled:
+            os.makedirs(self.dir, exist_ok=True)
+
+    def get_or_build(self, key: str, build):
+        """build() -> dict[str, np.ndarray]; returns the dict (from disk
+        when cached)."""
+        path = os.path.join(self.dir, f"{key}_{_SCHEMA_V}.npz")
+        if self.enabled and os.path.exists(path):
+            t0 = time.perf_counter()
+            with np.load(path) as z:
+                out = {k: z[k] for k in z.files}
+            log(f"dataset cache hit {key} ({time.perf_counter()-t0:.1f}s)")
+            return out
+        t0 = time.perf_counter()
+        out = build()
+        log(f"dataset {key} generated in {time.perf_counter()-t0:.1f}s")
+        if self.enabled:
+            tmp = path + ".tmp"
+            np.savez(tmp, **out)
+            os.replace(tmp + ".npz" if os.path.exists(tmp + ".npz") else tmp, path)
+            log(f"dataset {key} cached to {path}")
+        return out
+
+
+def _pick(rng, options: np.ndarray, p: list[float], m: int) -> np.ndarray:
+    """Weighted choice via searchsorted — much faster than rng.choice."""
+    cum = np.cumsum(p)
+    return options[np.searchsorted(cum, rng.random(m), side="right")]
+
+
+class Ledger:
+    """Incremental BENCH_DETAIL.json writer: every finished config is
+    persisted immediately so a driver timeout later cannot lose it."""
+
+    def __init__(self):
+        self.path = os.path.join(REPO, "BENCH_DETAIL.json")
+        self.best_prior = load_prior_best(self.path)
+        self.detail: list[dict] = []
+
+    def add(self, entry: dict) -> None:
+        self.detail.append(entry)
+        log(f"config{entry['config']}: {json.dumps(entry)}")
+        self.flush()
+
+    def gate(self) -> dict:
+        detail = self.detail
+        gate_prior = self.best_prior
+        if os.environ.get("BENCH_GATE_SELFTEST"):
+            # Prove the gate trips — on a COPY: the ledger must never
+            # record fabricated baselines or their regression markers.
+            detail = copy.deepcopy(self.detail)
+            gate_prior = {e["metric"]: e["value"] * 100 for e in detail}
+        return apply_gate(detail, gate_prior)
+
+    def flush(self) -> None:
+        gate = self.gate()
+        best_now = dict(self.best_prior)
+        for e in self.detail:
+            best_now[e["metric"]] = max(
+                best_now.get(e["metric"], 0), e["value"]
+            )
+        with open(self.path, "w") as f:
+            json.dump(
+                {"configs": self.detail, "best": best_now, "gate": gate},
+                f,
+                indent=1,
+            )
+
+
 def main() -> None:
     n_rows = int(os.environ.get("BENCH_ROWS", 256_000_000))
     n_small = int(os.environ.get("BENCH_SMALL_ROWS", 64_000_000))
+    n_host = int(os.environ.get("BENCH_HOST_ROWS", 8_000_000))
     n_services = int(os.environ.get("BENCH_SERVICES", 16))
     runs = int(os.environ.get("BENCH_RUNS", 5))
     block_rows = int(os.environ.get("BENCH_BLOCK_ROWS", 1 << 21))
-    configs = {
+    order = [
         c.strip()
-        for c in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(",")
+        for c in os.environ.get("BENCH_CONFIGS", "2,5,4,1,0,3").split(",")
         if c.strip()
-    }
-    unknown = configs - {"1", "2", "3", "4", "5"}
+    ]
+    unknown = set(order) - {"0", "1", "2", "3", "4", "5"}
     if unknown:
         raise SystemExit(f"BENCH_CONFIGS has unknown entries: {unknown}")
+    configs = set(order)
 
     import jax
+
+    # Persistent XLA compilation cache: repeat cold queries (including the
+    # driver's official run after this round's pre-warm) skip compiles.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     from jax.sharding import Mesh
 
     from pixie_tpu.engine import Carnot
     from pixie_tpu.parallel import MeshExecutor
+    from pixie_tpu.parallel.staging import reset_cold_profile
+    from pixie_tpu.table.column import DictColumn
     from pixie_tpu.types import DataType, Relation, SemanticType
 
     F, I, S, T = (
@@ -158,12 +268,23 @@ def main() -> None:
     carnot = Carnot(
         device_executor=MeshExecutor(mesh=mesh, block_rows=block_rows)
     )
-    rng = np.random.default_rng(42)
+    cache = DatasetCache()
+    ledger = Ledger()
     services = np.array(
         [f"ns/svc-{i}" for i in range(n_services)], dtype=object
     )
-    detail: list[dict] = []
-    headline: dict = {}
+    headline_printed = False
+
+    def breakdown() -> dict:
+        snap = reset_cold_profile()
+        return {k: round(v, 2) for k, v in sorted(snap.items())}
+
+    def cold_run(query):
+        reset_cold_profile()
+        t0 = time.perf_counter()
+        result = carnot.execute_query(query)
+        cold_s = time.perf_counter() - t0
+        return result, round(cold_s, 2), breakdown()
 
     # ---- shared large http_events table (configs 2 and 5) -----------------
     rel = Relation.of(
@@ -172,47 +293,88 @@ def main() -> None:
         ("resp_status", I),
         ("latency", F, SemanticType.ST_DURATION_NS),
     )
-    true_count = np.zeros(n_services, np.int64)
-    true_errors = np.zeros(n_services, np.int64)
-    true_hist = np.zeros((n_services, TRUTH_BINS), np.int64)
-    if configs & {"2", "5"}:
+    true_count = true_errors = true_hist = None
+    _built = set()
+
+    def ensure_http_table():
+        nonlocal true_count, true_errors, true_hist
+        if "http" in _built:
+            return
+        _built.add("http")
+
+        def build_http():
+            rng = np.random.default_rng(42)
+            svc_idx = np.empty(n_rows, np.uint8)
+            status = np.empty(n_rows, np.uint16)
+            latency = np.empty(n_rows, np.float64)
+            tc = np.zeros(n_services, np.int64)
+            te = np.zeros(n_services, np.int64)
+            th = np.zeros((n_services, TRUTH_BINS), np.int64)
+            chunk = 16_000_000
+            opts = np.array([200, 301, 404, 500], np.uint16)
+            for off in range(0, n_rows, chunk):
+                m = min(chunk, n_rows - off)
+                si = rng.integers(0, n_services, m, dtype=np.uint8)
+                st = _pick(rng, opts, [0.85, 0.05, 0.05, 0.05], m)
+                la = rng.exponential(3e7, m)
+                svc_idx[off : off + m] = si
+                status[off : off + m] = st
+                latency[off : off + m] = la
+                tc += np.bincount(si, minlength=n_services)
+                te += np.bincount(
+                    si, weights=(st >= 400), minlength=n_services
+                ).astype(np.int64)
+                bins = np.digitize(la, TRUTH_EDGES)
+                th += np.bincount(
+                    si.astype(np.int64) * TRUTH_BINS + bins,
+                    minlength=n_services * TRUTH_BINS,
+                ).reshape(n_services, TRUTH_BINS)
+                log(f"http_events: generated {off + m}/{n_rows} rows")
+            return {
+                "svc_idx": svc_idx,
+                "status": status,
+                "latency": latency,
+                "true_count": tc,
+                "true_errors": te,
+                "true_hist": th,
+            }
+
+        d = cache.get_or_build(f"http_{n_rows}_{n_services}_s42", build_http)
+        true_count = d["true_count"]
+        true_errors = d["true_errors"]
+        true_hist = d["true_hist"]
+        t_gen = time.perf_counter()
         table = carnot.table_store.create_table(
             "http_events", rel, size_limit=1 << 42
         )
-        chunk = 8_000_000
-        t_gen = time.perf_counter()
+        svc_dict = table.dictionaries["service"]
+        for name in services:  # identity codes 0..n-1 (encode() would
+            svc_dict.get_code(name)  # assign codes in SORTED order)
+        chunk = 16_000_000
         for off in range(0, n_rows, chunk):
             m = min(chunk, n_rows - off)
-            svc_idx = rng.integers(0, n_services, m)
-            status = rng.choice(
-                [200, 301, 404, 500], m, p=[0.85, 0.05, 0.05, 0.05]
-            )
-            latency = rng.exponential(3e7, m)
             table.write_pydict(
                 {
-                    "time_": np.arange(off, off + m) * 1000,
-                    "service": services[svc_idx],
-                    "resp_status": status,
-                    "latency": latency,
+                    "time_": np.arange(off, off + m, dtype=np.int64) * 1000,
+                    "service": DictColumn(
+                        d["svc_idx"][off : off + m].astype(np.int32),
+                        svc_dict,
+                    ),
+                    "resp_status": d["status"][off : off + m],
+                    "latency": d["latency"][off : off + m],
                 }
             )
-            if "2" in configs:  # truth only feeds config 2's verify
-                true_count += np.bincount(svc_idx, minlength=n_services)
-                true_errors += np.bincount(
-                    svc_idx, weights=(status >= 400), minlength=n_services
-                ).astype(np.int64)
-                bins = np.digitize(latency, TRUTH_EDGES)
-                true_hist += np.bincount(
-                    svc_idx * TRUTH_BINS + bins,
-                    minlength=n_services * TRUTH_BINS,
-                ).reshape(n_services, TRUTH_BINS)
-            log(f"http_events: generated {off + m}/{n_rows} rows")
         table.compact()
         table.stop()
-        log(f"http_events built in {time.perf_counter() - t_gen:.1f}s")
+        assert table.min_row_id() == 0 and table.end_row_id() == n_rows, (
+            "table expired rows; the metric would be inflated"
+        )
+        log(f"http_events table built in {time.perf_counter() - t_gen:.1f}s")
 
     # ---- config 2: service_stats (headline) -------------------------------
-    if "2" in configs:
+    def run_config_2():
+        nonlocal headline_printed
+        ensure_http_table()
         query = (
             "df = px.DataFrame(table='http_events')\n"
             "df.failure = df.resp_status >= 400\n"
@@ -241,10 +403,8 @@ def main() -> None:
                     # decisive: a wrong kernel is off by far more.
                     assert abs(q[key] - want) <= 0.04 * want, (name, key)
 
-        t0 = time.perf_counter()
-        result = carnot.execute_query(query)
-        cold2 = time.perf_counter() - t0
-        log(f"config2 cold (compile+stage+run) {cold2:.1f}s")
+        result, cold2, bd = cold_run(query)
+        log(f"config2 cold (compile+stage+run) {cold2:.1f}s {bd}")
         verify(result)
         best, last = best_of(lambda: carnot.execute_query(query), runs)
         verify(last)
@@ -255,11 +415,19 @@ def main() -> None:
             "unit": "rows/s/chip",
             "vs_baseline": round(rps / 1e8, 3),
         }
-        detail.append({"config": 2, "cold_s": round(cold2, 2), **headline})
-        log(f"config2: {headline}")
+        ledger.add(
+            {"config": 2, "cold_s": cold2, "cold_breakdown": bd, **headline}
+        )
+        # stdout headline NOW — the driver must capture it even if a later
+        # config blows its timeout. Gate reflects configs finished so far
+        # vs the prior ledger; the final ledger carries the full gate.
+        headline["gate"] = ledger.gate()["status"]
+        print(json.dumps(headline), flush=True)
+        headline_printed = True
 
     # ---- config 5: streaming sketches (t-digest + count-min) --------------
-    if "5" in configs:
+    def run_config_5():
+        ensure_http_table()
         q5 = (
             "df = px.DataFrame(table='http_events')\n"
             "s = df.groupby(['service']).agg(\n"
@@ -268,40 +436,135 @@ def main() -> None:
             ")\n"
             "px.display(s, 'sketches')\n"
         )
-        t0 = time.perf_counter()
-        r5 = carnot.execute_query(q5)  # cold
-        cold5 = time.perf_counter() - t0
+        r5, cold5, bd = cold_run(q5)
         best, last = best_of(lambda: carnot.execute_query(q5), runs)
         assert len(last.table("sketches")["service"]) == n_services
         rps = n_rows / best / n_chips
-        detail.append(
+        ledger.add(
             {
                 "config": 5,
-                "cold_s": round(cold5, 2),
+                "cold_s": cold5,
+                "cold_breakdown": bd,
                 "metric": "sketch_tdigest_countmin_rows_per_sec_per_chip",
                 "value": round(rps),
                 "unit": "rows/s/chip",
                 "vs_baseline": round(rps / 1e8, 3),
             }
         )
-        log(f"config5: {detail[-1]}")
 
-    # ---- config 1: http_data filter+project (host path) -------------------
-    if "1" in configs:
-        t1 = carnot.table_store.create_table("http_small", rel)
-        m = n_small
-        t1.write_pydict(
+    # ---- config 4: flamegraph stack merge ---------------------------------
+    def run_config_4():
+        st_rel = Relation.of(
+            ("time_", T, SemanticType.ST_TIME_NS),
+            ("stack_trace_id", I),
+            ("stack_trace", S),
+            ("count", I),
+        )
+        n_stacks = 4096
+
+        def build_stacks():
+            rng = np.random.default_rng(43)
+            sid = rng.integers(0, n_stacks, n_small, dtype=np.uint16)
+            cnt = rng.integers(1, 100, n_small, dtype=np.uint8)
+            return {"sid": sid, "cnt": cnt}
+
+        d4 = cache.get_or_build(f"stacks_{n_small}_s43", build_stacks)
+        t4 = carnot.table_store.create_table(
+            "stacks", st_rel, size_limit=1 << 42
+        )
+        stack_dict = t4.dictionaries["stack_trace"]
+        for i in range(n_stacks):  # identity codes, matching sid values
+            stack_dict.get_code(f"main;f{i % 61};g{i % 127};h{i}")
+        chunk = 16_000_000
+        for off in range(0, n_small, chunk):
+            m = min(chunk, n_small - off)
+            sid = d4["sid"][off : off + m]
+            t4.write_pydict(
+                {
+                    "time_": np.arange(off, off + m, dtype=np.int64) * 1000,
+                    "stack_trace_id": sid,
+                    "stack_trace": DictColumn(
+                        sid.astype(np.int32), stack_dict
+                    ),
+                    "count": d4["cnt"][off : off + m],
+                }
+            )
+        t4.compact()
+        t4.stop()
+        assert t4.min_row_id() == 0 and t4.end_row_id() == n_small, (
+            "table expired rows; the metric would be inflated"
+        )
+        q4 = (
+            "df = px.DataFrame(table='stacks')\n"
+            "s = df.groupby(['stack_trace_id']).agg(\n"
+            "    stack_trace=('stack_trace', px.any),\n"
+            "    count=('count', px.sum),\n"
+            ")\n"
+            "px.display(s, 'merged')\n"
+        )
+        _, cold4, bd = cold_run(q4)
+        best, last = best_of(lambda: carnot.execute_query(q4), runs)
+        assert len(last.table("merged")["stack_trace_id"]) == n_stacks
+        ledger.add(
             {
-                "time_": np.arange(m) * 1000,
-                "service": services[rng.integers(0, n_services, m)],
-                "resp_status": rng.choice(
-                    [200, 404, 500], m, p=[0.9, 0.05, 0.05]
-                ),
-                "latency": rng.exponential(3e7, m),
+                "config": 4,
+                "cold_s": cold4,
+                "cold_breakdown": bd,
+                "metric": "flamegraph_stack_merge_rows_per_sec_per_chip",
+                "value": round(n_small / best / n_chips),
+                "unit": "rows/s/chip",
             }
         )
+
+    # ---- configs 1 + 0 share the http_small table -------------------------
+    def ensure_small_table():
+        if "small" in _built:
+            return
+        _built.add("small")
+        t1 = carnot.table_store.create_table(
+            "http_small", rel, size_limit=1 << 42
+        )
+        sd = t1.dictionaries["service"]
+        for name in services:
+            sd.get_code(name)
+
+        def build_small():
+            rng = np.random.default_rng(44)
+            return {
+                "svc_idx": rng.integers(
+                    0, n_services, n_small, dtype=np.uint8
+                ),
+                "status": _pick(
+                    rng,
+                    np.array([200, 404, 500], np.uint16),
+                    [0.9, 0.05, 0.05],
+                    n_small,
+                ),
+                "latency": rng.exponential(3e7, n_small),
+            }
+
+        d1 = cache.get_or_build(f"httpsmall_{n_small}_s44", build_small)
+        chunk = 16_000_000
+        for off in range(0, n_small, chunk):
+            m = min(chunk, n_small - off)
+            t1.write_pydict(
+                {
+                    "time_": np.arange(off, off + m, dtype=np.int64) * 1000,
+                    "service": DictColumn(
+                        d1["svc_idx"][off : off + m].astype(np.int32), sd
+                    ),
+                    "resp_status": d1["status"][off : off + m],
+                    "latency": d1["latency"][off : off + m],
+                }
+            )
         t1.compact()
         t1.stop()
+        assert t1.min_row_id() == 0 and t1.end_row_id() == n_small, (
+            "table expired rows; the metric would be inflated"
+        )
+
+    def run_config_1():
+        ensure_small_table()
         # The reference px/http_data script always bounds output with
         # head() (src/pxl_scripts/px/http_data/data.pxl); with the bound
         # the scan runs on the device (r4 scan path), which evaluates
@@ -314,24 +577,51 @@ def main() -> None:
             "df = df.head(1000)\n"
             "px.display(df, 'out')\n"
         )
-        t0 = time.perf_counter()
-        carnot.execute_query(q1)  # cold
-        cold1 = time.perf_counter() - t0
+        _, cold1, bd = cold_run(q1)
         best, last = best_of(lambda: carnot.execute_query(q1), runs)
         assert len(last.table("out")["time_"]) > 0
-        detail.append(
+        ledger.add(
             {
                 "config": 1,
-                "cold_s": round(cold1, 2),
+                "cold_s": cold1,
+                "cold_breakdown": bd,
                 "metric": "http_data_filter_head_rows_per_sec_per_chip",
-                "value": round(m / best / n_chips),
+                "value": round(n_small / best / n_chips),
                 "unit": "rows/s/chip",
             }
         )
-        log(f"config1: {detail[-1]}")
+
+    def run_config_0():
+        ensure_small_table()
+        # Host engine path: no head() bound -> the full selection is the
+        # output, which stays on the host engine by design. Smaller row
+        # count (default 8M): the metric tracks host-path regressions, not
+        # the chip. start_time pins the window so the device scan-limit
+        # cannot pick it up.
+        q0 = (
+            f"df = px.DataFrame(table='http_small', start_time=0, "
+            f"end_time={n_host * 1000})\n"
+            "df = df[df.resp_status >= 400]\n"
+            "df.latency_ms = df.latency / 1000000.0\n"
+            "df = df[['time_', 'service', 'latency_ms']]\n"
+            "px.display(df, 'out')\n"
+        )
+        _, cold0, bd = cold_run(q0)
+        best, last = best_of(lambda: carnot.execute_query(q0), runs)
+        assert len(last.table("out")["time_"]) > 0
+        ledger.add(
+            {
+                "config": 0,
+                "cold_s": cold0,
+                "cold_breakdown": bd,
+                "metric": "http_data_filter_project_rows_per_sec",
+                "value": round(n_host / best),
+                "unit": "rows/s",
+            }
+        )
 
     # ---- config 3: net_flow groupby(src,dst) sum + HLL distinct -----------
-    if "3" in configs:
+    def run_config_3():
         conn_rel = Relation.of(
             ("time_", T, SemanticType.ST_TIME_NS),
             ("src", S),
@@ -340,23 +630,51 @@ def main() -> None:
             ("bytes_sent", I),
             ("bytes_recv", I),
         )
-        t3 = carnot.table_store.create_table("conn_flows", conn_rel)
-        m = n_small
+        t3 = carnot.table_store.create_table(
+            "conn_flows", conn_rel, size_limit=1 << 42
+        )
         hosts = np.array(
             [f"default/pod-{i}" for i in range(64)], dtype=object
         )
-        t3.write_pydict(
-            {
-                "time_": np.arange(m) * 1000,
-                "src": hosts[rng.integers(0, 64, m)],
-                "dst": hosts[rng.integers(0, 64, m)],
-                "remote_port": rng.integers(1024, 65535, m),
-                "bytes_sent": rng.integers(0, 1 << 20, m),
-                "bytes_recv": rng.integers(0, 1 << 20, m),
+        for col in ("src", "dst"):
+            for h in hosts:
+                t3.dictionaries[col].get_code(h)
+
+        def build_flows():
+            rng = np.random.default_rng(45)
+            return {
+                "src": rng.integers(0, 64, n_small, dtype=np.uint8),
+                "dst": rng.integers(0, 64, n_small, dtype=np.uint8),
+                "port": rng.integers(1024, 65535, n_small, dtype=np.uint16),
+                "bs": rng.integers(0, 1 << 20, n_small, dtype=np.uint32),
+                "br": rng.integers(0, 1 << 20, n_small, dtype=np.uint32),
             }
-        )
+
+        d3 = cache.get_or_build(f"flows_{n_small}_s45", build_flows)
+        chunk = 16_000_000
+        for off in range(0, n_small, chunk):
+            m = min(chunk, n_small - off)
+            t3.write_pydict(
+                {
+                    "time_": np.arange(off, off + m, dtype=np.int64) * 1000,
+                    "src": DictColumn(
+                        d3["src"][off : off + m].astype(np.int32),
+                        t3.dictionaries["src"],
+                    ),
+                    "dst": DictColumn(
+                        d3["dst"][off : off + m].astype(np.int32),
+                        t3.dictionaries["dst"],
+                    ),
+                    "remote_port": d3["port"][off : off + m],
+                    "bytes_sent": d3["bs"][off : off + m],
+                    "bytes_recv": d3["br"][off : off + m],
+                }
+            )
         t3.compact()
         t3.stop()
+        assert t3.min_row_id() == 0 and t3.end_row_id() == n_small, (
+            "table expired rows; the metric would be inflated"
+        )
         q3 = (
             "df = px.DataFrame(table='conn_flows')\n"
             "s = df.groupby(['src', 'dst']).agg(\n"
@@ -366,99 +684,46 @@ def main() -> None:
             ")\n"
             "px.display(s, 'flows')\n"
         )
-        t0 = time.perf_counter()
-        carnot.execute_query(q3)  # cold
-        cold3 = time.perf_counter() - t0
+        _, cold3, bd = cold_run(q3)
         best, last = best_of(lambda: carnot.execute_query(q3), runs)
         assert sum(last.table("flows")["bytes_sent"]) > 0
-        detail.append(
+        ledger.add(
             {
                 "config": 3,
-                "cold_s": round(cold3, 2),
+                "cold_s": cold3,
+                "cold_breakdown": bd,
                 "metric": "net_flow_group_hll_rows_per_sec_per_chip",
-                "value": round(m / best / n_chips),
+                "value": round(n_small / best / n_chips),
                 "unit": "rows/s/chip",
             }
         )
-        log(f"config3: {detail[-1]}")
 
-    # ---- config 4: flamegraph stack merge ---------------------------------
-    if "4" in configs:
-        st_rel = Relation.of(
-            ("time_", T, SemanticType.ST_TIME_NS),
-            ("stack_trace_id", I),
-            ("stack_trace", S),
-            ("count", I),
-        )
-        t4 = carnot.table_store.create_table("stacks", st_rel)
-        m = n_small
-        n_stacks = 4096
-        stack_strs = np.array(
-            [f"main;f{i % 61};g{i % 127};h{i}" for i in range(n_stacks)],
-            dtype=object,
-        )
-        sid = rng.integers(0, n_stacks, m)
-        t4.write_pydict(
-            {
-                "time_": np.arange(m) * 1000,
-                "stack_trace_id": sid,
-                "stack_trace": stack_strs[sid],
-                "count": rng.integers(1, 100, m),
-            }
-        )
-        t4.compact()
-        t4.stop()
-        q4 = (
-            "df = px.DataFrame(table='stacks')\n"
-            "s = df.groupby(['stack_trace_id']).agg(\n"
-            "    stack_trace=('stack_trace', px.any),\n"
-            "    count=('count', px.sum),\n"
-            ")\n"
-            "px.display(s, 'merged')\n"
-        )
-        t0 = time.perf_counter()
-        carnot.execute_query(q4)  # cold
-        cold4 = time.perf_counter() - t0
-        best, last = best_of(lambda: carnot.execute_query(q4), runs)
-        assert len(last.table("merged")["stack_trace_id"]) == n_stacks
-        detail.append(
-            {
-                "config": 4,
-                "cold_s": round(cold4, 2),
-                "metric": "flamegraph_stack_merge_rows_per_sec_per_chip",
-                "value": round(m / best / n_chips),
-                "unit": "rows/s/chip",
-            }
-        )
-        log(f"config4: {detail[-1]}")
+    runners = {
+        "0": run_config_0,
+        "1": run_config_1,
+        "2": run_config_2,
+        "3": run_config_3,
+        "4": run_config_4,
+        "5": run_config_5,
+    }
+    ran = set()
+    for c in order:  # BENCH_CONFIGS order IS the execution order
+        if c not in ran:
+            ran.add(c)
+            runners[c]()
 
-    ledger_path = os.path.join(
-        os.path.dirname(__file__) or ".", "BENCH_DETAIL.json"
-    )
-    best_prior = load_prior_best(ledger_path)
-    gate_prior = best_prior
-    if os.environ.get("BENCH_GATE_SELFTEST"):
-        # Prove the gate trips: pretend every metric was 100x better —
-        # but NEVER persist the fabricated bests (that would brick the
-        # gate baseline for every later real run).
-        gate_prior = {e["metric"]: e["value"] * 100 for e in detail}
-    gate = apply_gate(detail, gate_prior)
-    best_now = dict(best_prior)
-    for e in detail:
-        best_now[e["metric"]] = max(best_now.get(e["metric"], 0), e["value"])
-    with open(ledger_path, "w") as f:
-        json.dump(
-            {"configs": detail, "best": best_now, "gate": gate}, f, indent=1
-        )
+    gate = ledger.gate()
     if gate["status"] == "red":
         for r in gate["regressions"]:
             log(f"PERF GATE RED: {r}")
-    if not headline and detail:
+    if not headline_printed and ledger.detail:
         headline = {
-            k: v for k, v in detail[0].items() if k not in ("config", "cold_s")
+            k: v
+            for k, v in ledger.detail[0].items()
+            if k not in ("config", "cold_s", "cold_breakdown")
         }
-    headline["gate"] = gate["status"]
-    print(json.dumps(headline))
+        headline["gate"] = gate["status"]
+        print(json.dumps(headline), flush=True)
 
 
 if __name__ == "__main__":
