@@ -21,7 +21,10 @@ from pixie_tpu.ingest.http_gen import HTTP_EVENTS_REL
 from pixie_tpu.ingest.source_connector import DataTable, SourceConnector
 from pixie_tpu.protocols import dns as dns_proto
 from pixie_tpu.protocols import http as http_proto
+from pixie_tpu.protocols import http2 as http2_proto
 from pixie_tpu.protocols import mysql as mysql_proto
+from pixie_tpu.protocols import pgsql as pgsql_proto
+from pixie_tpu.protocols import redis as redis_proto
 from pixie_tpu.protocols.base import ConnTracker, TraceRole
 from pixie_tpu.types import DataType, Relation, SemanticType
 
@@ -55,20 +58,55 @@ MYSQL_EVENTS_REL = Relation.of(
     ("latency", I, SemanticType.ST_DURATION_NS),
 )
 
+# ref: pgsql_table.h kPGSQLElements
+PGSQL_EVENTS_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("upid", S, SemanticType.ST_UPID),
+    ("remote_addr", S, SemanticType.ST_IP_ADDRESS),
+    ("remote_port", I),
+    ("trace_role", I),
+    ("req_cmd", S),
+    ("req", S),
+    ("resp", S),
+    ("latency", I, SemanticType.ST_DURATION_NS),
+)
+
+# ref: redis_table.h kRedisElements
+REDIS_EVENTS_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("upid", S, SemanticType.ST_UPID),
+    ("remote_addr", S, SemanticType.ST_IP_ADDRESS),
+    ("remote_port", I),
+    ("trace_role", I),
+    ("req_cmd", S),
+    ("req_args", S),
+    ("resp", S),
+    ("latency", I, SemanticType.ST_DURATION_NS),
+)
+
 _PARSERS = {
     "http": http_proto.HttpParser(),
+    "http2": http2_proto.Http2Parser(),
     "dns": dns_proto.DnsParser(),
     "mysql": mysql_proto.MysqlParser(),
+    "pgsql": pgsql_proto.PgsqlParser(),
+    "redis": redis_proto.RedisParser(),
 }
 _ROW_FNS = {
     "http": http_proto.record_to_row,
+    "http2": http_proto.record_to_row,  # gRPC lands in http_events
     "dns": dns_proto.record_to_row,
     "mysql": mysql_proto.record_to_row,
+    "pgsql": pgsql_proto.record_to_row,
+    "redis": redis_proto.record_to_row,
 }
 _TABLE_FOR = {
     "http": "http_events",
+    "http2": "http_events",
     "dns": "dns_events",
     "mysql": "mysql_events",
+    "pgsql": "pgsql_events",
+    "redis": "redis_events",
 }
 
 
@@ -98,6 +136,8 @@ class SocketTraceConnector(SourceConnector):
             DataTable("http_events", HTTP_EVENTS_REL),
             DataTable("dns_events", DNS_EVENTS_REL),
             DataTable("mysql_events", MYSQL_EVENTS_REL),
+            DataTable("pgsql_events", PGSQL_EVENTS_REL),
+            DataTable("redis_events", REDIS_EVENTS_REL),
         ]
 
     # -- event feed (the capture boundary) -----------------------------------

@@ -237,6 +237,11 @@ class _DataStream:
                 state=proto_state,
             )
             if state == ParseState.SUCCESS:
+                if frame is None:
+                    # Frame consumed but no message completed yet (e.g. an
+                    # HTTP/2 SETTINGS frame, or a DATA frame mid-stream).
+                    self.buffer.consume(consumed)
+                    continue
                 if frame.timestamp_ns == 0:
                     # Frames within one captured chunk share its arrival
                     # timestamp; nudge them monotonic so stitchers see the

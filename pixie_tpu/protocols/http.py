@@ -59,6 +59,7 @@ class Message(base.Frame):
     """Ref: http::Message (types.h:49)."""
 
     type: MessageType = MessageType.REQUEST
+    major_version: int = 1
     minor_version: int = 0
     headers: dict = dataclasses.field(default_factory=dict)
     req_method: str = "-"
@@ -410,7 +411,7 @@ def record_to_row(
         "remote_addr": remote_addr,
         "remote_port": remote_port,
         "trace_role": int(trace_role),
-        "major_version": 1,
+        "major_version": req.major_version,
         "minor_version": req.minor_version,
         "content_type": content_type_enum(record),
         "req_headers": json.dumps(req.headers, sort_keys=True),

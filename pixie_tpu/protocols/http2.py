@@ -1,0 +1,266 @@
+"""HTTP/2 (+gRPC) frame parser, HPACK decoding, and stream stitcher.
+
+Ref: the reference's HTTP/2 tracing (protocols/http2/*): its capture side
+uses Go-uprobes on gRPC's HPACK state (out of scope here per BASELINE);
+the WIRE half re-implemented TPU-repo-side: RFC 7540 frame state machine
+(DATA/HEADERS/CONTINUATION/RST/SETTINGS/PING/GOAWAY/WINDOW_UPDATE),
+per-direction HPACK contexts (hpack.py), per-stream message assembly with
+END_STREAM/END_HEADERS semantics and trailers, and a stream-id stitcher
+(protocols/http2/stitcher.cc pairs half-streams by stream id). Records
+surface as http.Message pairs with major_version=2 so they land in
+http_events unchanged (http2's records carry gRPC status via trailers —
+grpc.cc's grpc-status handling).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from pixie_tpu.protocols import base, hpack
+from pixie_tpu.protocols.base import MessageType, ParseState
+from pixie_tpu.protocols.http import Message
+from pixie_tpu.utils.config import flags
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+# Frame types (RFC 7540 §6)
+DATA = 0x0
+HEADERS = 0x1
+PRIORITY = 0x2
+RST_STREAM = 0x3
+SETTINGS = 0x4
+PUSH_PROMISE = 0x5
+PING = 0x6
+GOAWAY = 0x7
+WINDOW_UPDATE = 0x8
+CONTINUATION = 0x9
+
+FLAG_END_STREAM = 0x1
+FLAG_END_HEADERS = 0x4
+FLAG_PADDED = 0x8
+FLAG_PRIORITY = 0x20
+
+_FRAME_HEADER = 9
+_MAX_FRAME = 1 << 24
+
+
+@dataclasses.dataclass
+class _StreamHalf:
+    """One direction of one stream being assembled."""
+
+    headers: dict = dataclasses.field(default_factory=dict)
+    body: bytearray = dataclasses.field(default_factory=bytearray)
+    body_size: int = 0
+    started: bool = False
+
+
+class Http2State:
+    """Per-connection state: each direction has its own HPACK context and
+    in-flight header block; streams assemble per (direction, id)."""
+
+    def __init__(self):
+        self.decoders = {
+            MessageType.REQUEST: hpack.Decoder(),
+            MessageType.RESPONSE: hpack.Decoder(),
+        }
+        # direction -> (stream_id, accumulated fragment, end_stream flag)
+        self.pending_block: dict = {}
+        self.streams: dict = {}  # (direction, stream_id) -> _StreamHalf
+        self.preface_seen = False
+
+
+class Http2Parser(base.ProtocolParser):
+    name = "http2"
+
+    def new_state(self):
+        return Http2State()
+
+    def find_frame_boundary(
+        self, msg_type: MessageType, buf: bytes, start: int
+    ) -> int:
+        """Resync on the connection preface or a plausible frame header
+        (sane length + known type)."""
+        i = buf.find(PREFACE[:8], start)
+        best = i if i >= 0 else -1
+        for j in range(start, len(buf) - _FRAME_HEADER):
+            ln = int.from_bytes(buf[j : j + 3], "big")
+            ftype = buf[j + 3]
+            if ln <= 1 << 14 and ftype <= CONTINUATION:
+                if best < 0 or j < best:
+                    best = j
+                break
+        return best
+
+    def parse_frame(
+        self,
+        msg_type: MessageType,
+        buf: bytes,
+        conn_closed: bool = False,
+        state=None,
+    ):
+        if state is None:
+            state = Http2State()  # degraded: per-call state
+        # Client preface leads the request direction.
+        if msg_type == MessageType.REQUEST and buf.startswith(b"PRI "):
+            if len(buf) < len(PREFACE):
+                return ParseState.NEEDS_MORE_DATA, 0, None
+            if buf.startswith(PREFACE):
+                return ParseState.SUCCESS, len(PREFACE), None
+            return ParseState.INVALID, 0, None
+        if len(buf) < _FRAME_HEADER:
+            return ParseState.NEEDS_MORE_DATA, 0, None
+        length = int.from_bytes(buf[0:3], "big")
+        ftype = buf[3]
+        fflags = buf[4]
+        stream_id = int.from_bytes(buf[5:9], "big") & 0x7FFFFFFF
+        if length > _MAX_FRAME or ftype > CONTINUATION:
+            return ParseState.INVALID, 0, None
+        total = _FRAME_HEADER + length
+        if len(buf) < total:
+            return ParseState.NEEDS_MORE_DATA, 0, None
+        payload = buf[_FRAME_HEADER:total]
+        msg = self._handle_frame(
+            msg_type, ftype, fflags, stream_id, payload, state
+        )
+        return ParseState.SUCCESS, total, msg
+
+    # -- frame handling ------------------------------------------------------
+    def _handle_frame(self, direction, ftype, fflags, stream_id, payload, state):
+        if ftype in (SETTINGS, PING, GOAWAY, WINDOW_UPDATE, PRIORITY):
+            return None
+        if ftype == RST_STREAM:
+            state.streams.pop((direction, stream_id), None)
+            return None
+        if ftype == DATA:
+            if fflags & FLAG_PADDED:
+                if not payload:
+                    return None
+                pad = payload[0]
+                payload = payload[1 : len(payload) - pad]
+            half = state.streams.setdefault(
+                (direction, stream_id), _StreamHalf()
+            )
+            half.started = True
+            limit = flags.http_body_limit_bytes
+            if len(half.body) < limit:
+                half.body.extend(payload[: limit - len(half.body)])
+            half.body_size += len(payload)
+            if fflags & FLAG_END_STREAM:
+                return self._emit(direction, stream_id, state)
+            return None
+        if ftype in (HEADERS, PUSH_PROMISE):
+            frag = payload
+            if fflags & FLAG_PADDED:
+                if not frag:
+                    return None
+                pad = frag[0]
+                frag = frag[1 : len(frag) - pad]
+            if ftype == HEADERS and fflags & FLAG_PRIORITY:
+                frag = frag[5:]
+            if ftype == PUSH_PROMISE:
+                frag = frag[4:]  # promised stream id
+            end_stream = bool(fflags & FLAG_END_STREAM)
+            if not fflags & FLAG_END_HEADERS:
+                state.pending_block[direction] = (
+                    stream_id,
+                    bytearray(frag),
+                    end_stream,
+                )
+                return None
+            return self._header_block(
+                direction, stream_id, bytes(frag), end_stream, state
+            )
+        if ftype == CONTINUATION:
+            pend = state.pending_block.get(direction)
+            if pend is None or pend[0] != stream_id:
+                return None  # stray continuation
+            pend[1].extend(payload)
+            if not fflags & FLAG_END_HEADERS:
+                return None
+            del state.pending_block[direction]
+            return self._header_block(
+                direction, stream_id, bytes(pend[1]), pend[2], state
+            )
+        return None
+
+    def _header_block(self, direction, stream_id, block, end_stream, state):
+        try:
+            pairs = state.decoders[direction].decode(block)
+        except hpack.HpackError:
+            # HPACK context corrupted (lost frames): drop the block; the
+            # stream may still complete with partial headers.
+            pairs = []
+        half = state.streams.setdefault((direction, stream_id), _StreamHalf())
+        for name, value in pairs:
+            if half.started and name in half.headers and not name.startswith(
+                ":"
+            ):
+                half.headers[name] += ", " + value
+            else:
+                half.headers[name] = value
+        half.started = True
+        if end_stream:
+            return self._emit(direction, stream_id, state)
+        return None
+
+    def _emit(self, direction, stream_id, state):
+        half = state.streams.pop((direction, stream_id), None)
+        if half is None:
+            return None
+        h = half.headers
+        msg = Message(type=direction)
+        msg.major_version = 2
+        msg.minor_version = 0
+        msg.headers = {
+            k.title() if not k.startswith(":") else k: v
+            for k, v in h.items()
+        }
+        msg.headers["__stream_id__"] = str(stream_id)
+        msg.body = bytes(half.body).decode("latin-1", "replace")
+        msg.body_size = half.body_size
+        if direction == MessageType.REQUEST:
+            msg.req_method = h.get(":method", "-")
+            msg.req_path = h.get(":path", "-")
+        else:
+            try:
+                msg.resp_status = int(h.get(":status", "-1"))
+            except ValueError:
+                msg.resp_status = -1
+            # gRPC: status rides trailers (grpc.cc grpc-status handling).
+            if "grpc-status" in h:
+                msg.resp_message = (
+                    f"grpc-status:{h['grpc-status']} "
+                    + h.get("grpc-message", "")
+                ).strip()
+        return msg
+
+    # -- stitching -----------------------------------------------------------
+    def stitch(self, requests: list, responses: list, state=None):
+        """Pair half-streams by stream id (ref: http2/stitcher.cc)."""
+        by_id = {}
+        for req in requests:
+            by_id[req.headers.get("__stream_id__")] = req
+        records: list[base.Record] = []
+        errors = 0
+        used_reqs: set[int] = set()  # matched request OBJECT ids
+        resp_keep = []
+        for resp in responses:
+            sid = resp.headers.get("__stream_id__")
+            req = by_id.get(sid)
+            if req is None:
+                # The request half-stream may still be assembling (its
+                # HEADERS straddled a capture chunk): keep the response
+                # for a later round — stream-id pairing is lossless,
+                # unlike HTTP/1's FIFO. Bounded so lost request halves
+                # cannot accumulate responses forever.
+                resp_keep.append(resp)
+                continue
+            used_reqs.add(id(req))
+            req.headers.pop("__stream_id__", None)
+            resp.headers.pop("__stream_id__", None)
+            records.append(base.Record(req=req, resp=resp))
+        if len(resp_keep) > 128:
+            errors += len(resp_keep) - 128
+            resp_keep = resp_keep[-128:]
+        req_keep = [r for r in requests if id(r) not in used_reqs]
+        return records, errors, req_keep, resp_keep

@@ -8,11 +8,11 @@ are packet bundles interpreted per command (OK 0x00 / ERR 0xff / EOF 0xfe
 mysql_table.h kMySQLElements (req_cmd, req_body, resp_status, resp_body,
 latency).
 
-Subset: the command set and OK/ERR/EOF/resultset framing are complete;
-prepared-statement argument inflation (stmt_execute parameter decoding,
-handler.cc ProcessStmtExecute) is not — stmt commands surface with their
-raw statement ids, which keeps conn trackers and tables truthful without
-the prepared-statement registry.
+The command set, OK/ERR/EOF/resultset framing, and prepared-statement
+stitching are covered: STMT_PREPARE responses register (stmt_id -> query,
+param count) in per-connection state, STMT_EXECUTE decodes the binary
+parameter values and inflates them into the query's '?' placeholders
+(stitcher.cc HandleStmtExecuteRequest), STMT_CLOSE evicts.
 """
 
 from __future__ import annotations
@@ -58,6 +58,18 @@ NO_RESPONSE_CMDS = {0x01, 0x18, 0x19}
 
 RESP_UNKNOWN, RESP_NONE, RESP_OK, RESP_ERR = 0, 1, 2, 3  # ref: RespStatus
 
+COM_STMT_PREPARE, COM_STMT_EXECUTE, COM_STMT_CLOSE = 0x16, 0x17, 0x19
+
+
+class MysqlState:
+    """Per-connection prepared-statement map (ref: mysql::State's
+    prepared_statements, types.h — resolves COM_STMT_EXECUTE back to the
+    prepared query text with arguments inflated)."""
+
+    def __init__(self):
+        # stmt_id -> {"query": str, "num_params": int, "types": list|None}
+        self.prepared: dict[int, dict] = {}
+
 
 @dataclasses.dataclass
 class Packet(base.Frame):
@@ -80,8 +92,20 @@ class Packet(base.Frame):
         return len(self.msg) < 9 and len(self.msg) >= 1 and self.msg[0] == 0xFE
 
 
+@dataclasses.dataclass
+class MysqlRecord(Record):
+    """Record with an optional resolved request text (prepared-statement
+    EXECUTEs carry the query with params inflated, ref: stitcher.cc
+    HandleStmtExecuteRequest)."""
+
+    req_text: str = ""
+
+
 class MysqlParser(base.ProtocolParser):
     name = "mysql"
+
+    def new_state(self):
+        return MysqlState()
 
     def find_frame_boundary(self, msg_type, buf: bytes, start: int) -> int:
         # ref: parse.cc FindFrameBoundary — scan for a plausible header:
@@ -148,6 +172,12 @@ class MysqlParser(base.ProtocolParser):
                 errors += 1
             cmd = req.msg[0]
             if cmd in NO_RESPONSE_CMDS:
+                if cmd == COM_STMT_CLOSE and state is not None and (
+                    len(req.msg) >= 5
+                ):
+                    state.prepared.pop(
+                        int.from_bytes(req.msg[1:5], "little"), None
+                    )
                 records.append(
                     Record(req=req, resp=_Resp(req.timestamp_ns, RESP_NONE, b""))
                 )
@@ -172,7 +202,16 @@ class MysqlParser(base.ProtocolParser):
                 # resultset's rows/EOF may arrive next tick): keep both
                 # the request and its partial bundle for the next round.
                 break
-            records.append(Record(req=req, resp=_interpret(cmd, bundle)))
+            resp = _interpret(cmd, bundle)
+            req_text = ""
+            if state is not None:
+                if cmd == COM_STMT_PREPARE and resp.status == RESP_OK:
+                    _register_prepare(state, req, bundle)
+                elif cmd == COM_STMT_EXECUTE:
+                    req_text = _inflate_execute(state, req)
+            records.append(
+                MysqlRecord(req=req, resp=resp, req_text=req_text)
+            )
             ri = j
             qi += 1
         return records, errors, requests[qi:], responses[ri:]
@@ -272,6 +311,103 @@ def _interpret(cmd: int, bundle: list) -> _Resp:
     return _Resp(ts, RESP_UNKNOWN, b"")
 
 
+def _register_prepare(state: MysqlState, req, bundle) -> None:
+    """COM_STMT_PREPARE response header: [0x00][stmt_id:4][num_cols:2]
+    [num_params:2][filler:1][warnings:2] (ref: prepare handler)."""
+    first = bundle[0]
+    if len(first.msg) < 12 or first.msg[0] != 0:
+        return
+    stmt_id = int.from_bytes(first.msg[1:5], "little")
+    num_params = int.from_bytes(first.msg[7:9], "little")
+    state.prepared[stmt_id] = {
+        "query": req.msg[1:].decode("latin-1", "replace"),
+        "num_params": num_params,
+        "types": None,
+    }
+
+
+# Binary-protocol value readers by MYSQL_TYPE code (ref: the reference's
+# stmt-execute param parsing, protocols/mysql/parse.cc).
+def _read_binary_value(msg: bytes, pos: int, mtype: int):
+    need = {0x01: 1, 0x02: 2, 0x03: 4, 0x09: 4, 0x08: 8, 0x04: 4, 0x05: 8}
+    if mtype in need and pos + need[mtype] > len(msg):
+        raise ValueError("truncated binary value")  # -> raw-query fallback
+    if mtype == 0x01:  # TINY
+        return str(int.from_bytes(msg[pos:pos + 1], "little", signed=True)), pos + 1
+    if mtype == 0x02:  # SHORT
+        return str(int.from_bytes(msg[pos:pos + 2], "little", signed=True)), pos + 2
+    if mtype in (0x03, 0x09):  # LONG / INT24
+        return str(int.from_bytes(msg[pos:pos + 4], "little", signed=True)), pos + 4
+    if mtype == 0x08:  # LONGLONG
+        return str(int.from_bytes(msg[pos:pos + 8], "little", signed=True)), pos + 8
+    if mtype == 0x04:  # FLOAT
+        return repr(struct.unpack_from("<f", msg, pos)[0]), pos + 4
+    if mtype == 0x05:  # DOUBLE
+        return repr(struct.unpack_from("<d", msg, pos)[0]), pos + 8
+    if mtype in (0x0F, 0xF6, 0xFC, 0xFD, 0xFE):  # VARCHAR/DECIMAL/BLOB/STRING
+        n, pos2 = _lenenc_int(msg, pos)
+        if n is None:
+            raise ValueError("bad lenenc string")
+        val = msg[pos2:pos2 + n].decode("latin-1", "replace")
+        return "'" + val + "'", pos2 + n
+    raise ValueError(f"unsupported binary type {mtype:#x}")
+
+
+def _inflate_execute(state: MysqlState, req) -> str:
+    """COM_STMT_EXECUTE → the prepared query with '?' placeholders
+    substituted by the bound argument values (ref: stitcher.cc
+    HandleStmtExecuteRequest + FillStmtExecute). Returns "" when the
+    statement is unknown or the args cannot be decoded."""
+    msg = req.msg
+    if len(msg) < 10:
+        return ""
+    stmt_id = int.from_bytes(msg[1:5], "little")
+    entry = state.prepared.get(stmt_id)
+    if entry is None:
+        return ""
+    n = entry["num_params"]
+    query = entry["query"]
+    if n == 0:
+        return query
+    pos = 1 + 4 + 1 + 4  # cmd + stmt_id + flags + iteration_count
+    nbytes = (n + 7) // 8
+    if len(msg) < pos + nbytes + 1:
+        return ""
+    null_bitmap = msg[pos:pos + nbytes]
+    pos += nbytes
+    new_bound = msg[pos]
+    pos += 1
+    if new_bound:
+        types = []
+        for _ in range(n):
+            if pos + 2 > len(msg):
+                return ""
+            types.append(msg[pos])  # second byte = unsigned flag
+            pos += 2
+        entry["types"] = types
+    types = entry["types"]
+    if types is None:
+        return ""  # params bound before capture started
+    vals = []
+    try:
+        for i in range(n):
+            if null_bitmap[i // 8] & (1 << (i % 8)):
+                vals.append("NULL")
+                continue
+            v, pos = _read_binary_value(msg, pos, types[i])
+            vals.append(v)
+    except (ValueError, IndexError, struct.error):
+        return ""
+    parts = query.split("?")
+    if len(parts) != n + 1:
+        return query  # placeholder/param mismatch: show the raw query
+    out = [parts[0]]
+    for v, tail in zip(vals, parts[1:]):
+        out.append(v)
+        out.append(tail)
+    return "".join(out)
+
+
 def request_body(req: Packet) -> str:
     cmd = req.msg[0]
     if cmd in _STRING_BODY:
@@ -295,7 +431,7 @@ def record_to_row(
         "remote_port": remote_port,
         "trace_role": int(trace_role),
         "req_cmd": int(req.msg[0]),
-        "req_body": request_body(req),
+        "req_body": getattr(record, "req_text", "") or request_body(req),
         "resp_status": int(resp.status),
         "resp_body": resp.msg.decode("latin-1", errors="replace"),
         "latency": max(resp.timestamp_ns - req.timestamp_ns, 0),

@@ -1,0 +1,348 @@
+"""PostgreSQL wire-protocol parser + stitcher.
+
+Ref: protocols/pgsql/parse.cc (tagged regular messages: [tag:1][len:4
+incl. itself][payload], startup/SSL-request untagged frames),
+protocols/pgsql/types.h (Tag enum; QueryReqResp/ParseReqResp shapes),
+protocols/pgsql/stitcher.cc (per-request-tag response collection: Query →
+RowDesc/DataRows/CmdComplete|ErrResp; extended protocol Parse/Bind/
+Describe/Execute with a prepared-statement map so Execute records carry
+the resolved query text), and pgsql_table.h kPGSQLElements (req_cmd, req,
+resp, latency).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+from pixie_tpu.protocols import base
+from pixie_tpu.protocols.base import MessageType, ParseState
+
+_F_TAGS = set(b"QfCBpPDSEXdcHF")  # frontend tags (types.h Tag)
+_B_TAGS = set(b"IDZHGECK123RtSTWndcNAV")  # backend tags
+_STARTUP_VERSION = 196608  # 3.0
+_SSL_REQUEST = 80877103
+_CANCEL_REQUEST = 80877102
+_MAX_ROWS_RENDERED = 16
+
+TAG_NAMES = {
+    "Q": "QUERY",
+    "P": "PARSE",
+    "B": "BIND",
+    "E": "EXECUTE",
+    "D": "DESCRIBE",
+    "C": "CLOSE",
+    "S": "SYNC",
+    "X": "TERMINATE",
+    "p": "PASSWORD",
+    "f": "COPY FAIL",
+    "d": "COPY DATA",
+    "c": "COPY DONE",
+    "\x00": "STARTUP",
+}
+
+
+@dataclasses.dataclass
+class Message(base.Frame):
+    """One tagged wire message (ref: pgsql::RegularMessage)."""
+
+    type: MessageType = MessageType.REQUEST
+    tag: str = "\x00"
+    payload: bytes = b""
+
+
+@dataclasses.dataclass
+class Record(base.Record):
+    req_cmd: str = ""
+    req_text: str = ""
+    resp_text: str = ""
+
+
+class PgsqlState:
+    """Per-connection prepared-statement bookkeeping (ref: stitcher.cc
+    State: unnamed statement/portal maps resolving Execute to its query
+    text)."""
+
+    def __init__(self):
+        self.statements: dict[str, str] = {}  # stmt name -> query text
+        self.portals: dict[str, str] = {}  # portal name -> query text
+
+
+def _cstr(buf: bytes, pos: int) -> tuple[str, int]:
+    end = buf.find(b"\x00", pos)
+    if end < 0:
+        return buf[pos:].decode("latin-1", "replace"), len(buf)
+    return buf[pos:end].decode("latin-1", "replace"), end + 1
+
+
+class PgsqlParser(base.ProtocolParser):
+    name = "pgsql"
+
+    def new_state(self):
+        return PgsqlState()
+
+    def find_frame_boundary(
+        self, msg_type: MessageType, buf: bytes, start: int
+    ) -> int:
+        """A plausible tag byte followed by a sane length (ref: pgsql
+        FindFrameBoundary probes tag + length)."""
+        tags = _F_TAGS if msg_type == MessageType.REQUEST else _B_TAGS
+        for i in range(start, len(buf)):
+            if buf[i] in tags and len(buf) - i >= 5:
+                ln = struct.unpack_from(">I", buf, i + 1)[0]
+                if 4 <= ln <= (1 << 24):
+                    return i
+        return -1
+
+    def parse_frame(
+        self,
+        msg_type: MessageType,
+        buf: bytes,
+        conn_closed: bool = False,
+        state=None,
+    ):
+        if len(buf) < 5:
+            return ParseState.NEEDS_MORE_DATA, 0, None
+        tag = buf[0]
+        tags = _F_TAGS if msg_type == MessageType.REQUEST else _B_TAGS
+        if tag not in tags:
+            # Untagged startup / SSL-request frames lead a frontend stream.
+            if msg_type == MessageType.REQUEST and len(buf) >= 8:
+                ln, code = struct.unpack_from(">II", buf, 0)
+                if 8 <= ln <= (1 << 16) and code in (
+                    _STARTUP_VERSION,
+                    _SSL_REQUEST,
+                    _CANCEL_REQUEST,
+                ):
+                    if len(buf) < ln:
+                        return ParseState.NEEDS_MORE_DATA, 0, None
+                    msg = Message(
+                        type=msg_type, tag="\x00", payload=buf[8:ln]
+                    )
+                    return ParseState.SUCCESS, ln, msg
+            return ParseState.INVALID, 0, None
+        ln = struct.unpack_from(">I", buf, 1)[0]
+        if ln < 4 or ln > (1 << 24):
+            return ParseState.INVALID, 0, None
+        total = 1 + ln
+        if len(buf) < total:
+            return ParseState.NEEDS_MORE_DATA, 0, None
+        msg = Message(type=msg_type, tag=chr(tag), payload=buf[5:total])
+        return ParseState.SUCCESS, total, msg
+
+    # -- stitching -----------------------------------------------------------
+    def stitch(self, requests: list, responses: list, state=None):
+        """Per-request-tag response collection (ref: stitcher.cc
+        ProcessFrames switch)."""
+        state = state or PgsqlState()
+        records: list[base.Record] = []
+        errors = 0
+        ri = 0
+        qi = 0
+        n_resp = len(responses)
+        while qi < len(requests):
+            req = requests[qi]
+            # Skip responses older than the request (stale/unmatched).
+            while ri < n_resp and (
+                responses[ri].timestamp_ns < req.timestamp_ns
+            ):
+                if responses[ri].tag not in (
+                    "Z", "R", "S", "K", "N", "A", "1", "2", "3", "t", "n"
+                ):
+                    errors += 1  # data-bearing response with no request
+                ri += 1
+            tag = req.tag
+            if tag in ("X", "S", "H", "F", "d", "c", "p", "\x00"):
+                # Control / copy-stream / auth frames produce no records;
+                # Sync's ReadyForQuery separator is consumed below.
+                if tag == "S":
+                    while ri < n_resp and responses[ri].tag != "Z":
+                        ri += 1
+                    if ri < n_resp:
+                        ri += 1
+                qi += 1
+                continue
+            done, ri2, rec = self._collect(req, responses, ri, state)
+            if not done:
+                break  # responses incomplete: retry next round
+            ri = ri2
+            qi += 1
+            if rec is not None:
+                records.append(rec)
+        return records, errors, requests[qi:], responses[ri:]
+
+    def _collect(self, req, responses, ri, state):
+        """(complete?, new resp index, record_or_None) for one request."""
+        tag = req.tag
+        if tag == "Q":
+            return self._collect_query(
+                req, responses, ri, _cstr(req.payload, 0)[0]
+            )
+        if tag == "P":
+            stmt, pos = _cstr(req.payload, 0)
+            query, _ = _cstr(req.payload, pos)
+            if ri >= len(responses):
+                return False, ri, None
+            resp = responses[ri]
+            if resp.tag not in ("1", "E"):
+                return True, ri, None  # desynced; drop the request
+            state.statements[stmt] = query
+            rec = Record(
+                req=req,
+                resp=resp,
+                req_cmd="PARSE",
+                req_text=query,
+                resp_text=(
+                    "PARSE COMPLETE"
+                    if resp.tag == "1"
+                    else _render_error(resp.payload)
+                ),
+            )
+            return True, ri + 1, rec
+        if tag == "B":
+            portal, pos = _cstr(req.payload, 0)
+            stmt, _ = _cstr(req.payload, pos)
+            state.portals[portal] = state.statements.get(stmt, "")
+            if ri >= len(responses):
+                return False, ri, None
+            resp = responses[ri]
+            if resp.tag not in ("2", "E"):
+                return True, ri, None
+            return True, ri + 1, None  # bind itself is not a record
+        if tag == "D":
+            if ri >= len(responses):
+                return False, ri, None
+            resp = responses[ri]
+            if resp.tag not in ("T", "t", "n", "E"):
+                return True, ri, None
+            return True, ri + 1, None
+        if tag == "E":
+            portal, _ = _cstr(req.payload, 0)
+            query = state.portals.get(portal, "")
+            return self._collect_query(
+                req, responses, ri, query, cmd="EXECUTE"
+            )
+        if tag == "C":
+            if ri >= len(responses):
+                return False, ri, None
+            resp = responses[ri]
+            if resp.tag not in ("3", "E"):
+                return True, ri, None
+            return True, ri + 1, None
+        return True, ri, None  # unhandled frontend tag: no record
+
+    def _collect_query(self, req, responses, ri, query, cmd="QUERY"):
+        """Collect RowDesc/DataRows until CmdComplete / ErrResp /
+        EmptyQueryResponse (ref: stitcher.cc FillQueryResp)."""
+        cols: list[str] = []
+        rows: list[str] = []
+        n_rows = 0
+        i = ri
+        while i < len(responses):
+            resp = responses[i]
+            t = resp.tag
+            if t == "T":
+                cols = _parse_row_desc(resp.payload)
+            elif t == "D":
+                n_rows += 1
+                if n_rows <= _MAX_ROWS_RENDERED:
+                    rows.append(_parse_data_row(resp.payload))
+            elif t in ("C", "E", "I"):
+                if t == "E":
+                    text = _render_error(resp.payload)
+                elif t == "I":
+                    text = "EMPTY QUERY"
+                else:
+                    parts = []
+                    if cols:
+                        parts.append(",".join(cols))
+                    parts.extend(rows)
+                    if n_rows > _MAX_ROWS_RENDERED:
+                        parts.append(
+                            f"... ({n_rows - _MAX_ROWS_RENDERED} more rows)"
+                        )
+                    parts.append(_cstr(resp.payload, 0)[0])
+                    text = "\n".join(parts)
+                rec = Record(
+                    req=req,
+                    resp=resp,
+                    req_cmd=cmd,
+                    req_text=query,
+                    resp_text=text,
+                )
+                return True, i + 1, rec
+            elif t == "Z":
+                # ReadyForQuery before a terminal: command produced no
+                # completion (shouldn't happen) — emit nothing.
+                return True, i + 1, None
+            i += 1
+        return False, ri, None
+
+
+def _parse_row_desc(payload: bytes) -> list[str]:
+    if len(payload) < 2:
+        return []
+    (n,) = struct.unpack_from(">H", payload, 0)
+    pos = 2
+    cols = []
+    for _ in range(n):
+        name, pos = _cstr(payload, pos)
+        pos += 18  # table oid(4) attr(2) type oid(4) len(2) mod(4) fmt(2)
+        cols.append(name)
+        if pos > len(payload):
+            break
+    return cols
+
+
+def _parse_data_row(payload: bytes) -> str:
+    if len(payload) < 2:
+        return ""
+    (n,) = struct.unpack_from(">H", payload, 0)
+    pos = 2
+    vals = []
+    for _ in range(n):
+        if pos + 4 > len(payload):
+            break
+        (ln,) = struct.unpack_from(">i", payload, pos)
+        pos += 4
+        if ln < 0:
+            vals.append("NULL")
+            continue
+        vals.append(payload[pos : pos + ln].decode("latin-1", "replace"))
+        pos += ln
+    return ",".join(vals)
+
+
+def _render_error(payload: bytes) -> str:
+    """ErrorResponse fields: [code:1][cstr]... terminated by NUL (ref:
+    https://www.postgresql.org/docs/current/protocol-error-fields.html)."""
+    pos = 0
+    fields = {}
+    while pos < len(payload) and payload[pos] != 0:
+        code = chr(payload[pos])
+        val, pos = _cstr(payload, pos + 1)
+        fields[code] = val
+    sev = fields.get("S", "ERROR")
+    return f"{sev}: {fields.get('M', '')} ({fields.get('C', '')})"
+
+
+def record_to_row(
+    record: Record,
+    upid: str,
+    remote_addr: str,
+    remote_port: int,
+    trace_role: int,
+) -> dict:
+    """A pgsql_events row (ref: pgsql_table.h kPGSQLElements order)."""
+    req, resp = record.req, record.resp
+    return {
+        "time_": req.timestamp_ns,
+        "upid": upid,
+        "remote_addr": remote_addr,
+        "remote_port": remote_port,
+        "trace_role": int(trace_role),
+        "req_cmd": record.req_cmd,
+        "req": record.req_text,
+        "resp": record.resp_text,
+        "latency": max(resp.timestamp_ns - req.timestamp_ns, 0),
+    }

@@ -1,0 +1,229 @@
+"""Redis (RESP) frame parser + stitcher.
+
+Ref: protocols/redis/parse.cc (RESP type markers +,-,:,$,* with recursive
+array parsing and published-message detection), protocols/redis/cmd_args.cc
+(command table formats the first 1-2 bulk strings as the command name and
+the rest as arguments), protocols/redis/stitcher.h (FIFO pairing; pub/sub
+push messages become records with a synthesized "PUSH PUB" request), and
+redis_table.h kRedisElements (req_cmd, req_args, resp, latency).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from pixie_tpu.protocols import base
+from pixie_tpu.protocols.base import MessageType, ParseState
+
+_MARKERS = b"+-:$*"
+
+# Two-word Redis commands (ref: cmd_args.cc kCmdList two-token entries) —
+# enough to format the common surface; unknown commands fall back to
+# first-token-is-the-command.
+_TWO_WORD_PREFIXES = {
+    "ACL",
+    "CLIENT",
+    "CLUSTER",
+    "COMMAND",
+    "CONFIG",
+    "DEBUG",
+    "FUNCTION",
+    "LATENCY",
+    "MEMORY",
+    "OBJECT",
+    "PUBSUB",
+    "SCRIPT",
+    "SLOWLOG",
+    "XGROUP",
+    "XINFO",
+}
+
+
+@dataclasses.dataclass
+class Message(base.Frame):
+    """One parsed RESP value (ref: redis::Message, types.h)."""
+
+    type: MessageType = MessageType.REQUEST
+    payload: str = ""  # rendered value (JSON for arrays)
+    command: str = ""  # requests: formatted command name
+    args: str = ""  # requests: formatted arguments
+    is_published: bool = False  # pub/sub push delivered to a subscriber
+
+
+class _NeedsMore(Exception):
+    pass
+
+
+class _Invalid(Exception):
+    pass
+
+
+def _read_line(buf: bytes, pos: int) -> tuple[bytes, int]:
+    end = buf.find(b"\r\n", pos)
+    if end < 0:
+        raise _NeedsMore()
+    return buf[pos:end], end + 2
+
+
+def _parse_value(buf: bytes, pos: int):
+    """Recursive RESP value parse -> (python value, new pos)."""
+    if pos >= len(buf):
+        raise _NeedsMore()
+    marker = buf[pos : pos + 1]
+    if marker not in (b"+", b"-", b":", b"$", b"*"):
+        raise _Invalid()
+    line, pos = _read_line(buf, pos + 1)
+    if marker in (b"+", b"-"):
+        return line.decode("latin-1"), pos
+    if marker == b":":
+        try:
+            return int(line), pos
+        except ValueError:
+            raise _Invalid()
+    try:
+        n = int(line)
+    except ValueError:
+        raise _Invalid()
+    if marker == b"$":
+        if n == -1:
+            return None, pos  # null bulk string
+        if len(buf) - pos < n + 2:
+            raise _NeedsMore()
+        if buf[pos + n : pos + n + 2] != b"\r\n":
+            raise _Invalid()
+        return buf[pos : pos + n].decode("latin-1", "replace"), pos + n + 2
+    if n == -1:
+        return None, pos  # null array
+    items = []
+    for _ in range(n):
+        item, pos = _parse_value(buf, pos)
+        items.append(item)
+    return items, pos
+
+
+def _render(value) -> str:
+    if isinstance(value, str):
+        return value
+    if value is None:
+        return "<NULL>"
+    if isinstance(value, int):
+        return str(value)
+    return json.dumps(value, ensure_ascii=False)
+
+
+class RedisParser(base.ProtocolParser):
+    name = "redis"
+
+    def find_frame_boundary(
+        self, msg_type: MessageType, buf: bytes, start: int
+    ) -> int:
+        """Ref: redis FindMessageBoundary — a type marker right after a
+        CRLF (or at stream start)."""
+        i = start
+        while i < len(buf):
+            if buf[i : i + 1] in (b"+", b"-", b":", b"$", b"*") and (
+                i == 0 or buf[i - 2 : i] == b"\r\n"
+            ):
+                return i
+            i += 1
+        return -1
+
+    def parse_frame(
+        self,
+        msg_type: MessageType,
+        buf: bytes,
+        conn_closed: bool = False,
+        state=None,
+    ):
+        try:
+            value, pos = _parse_value(buf, 0)
+        except _NeedsMore:
+            return ParseState.NEEDS_MORE_DATA, 0, None
+        except _Invalid:
+            return ParseState.INVALID, 0, None
+        msg = Message(type=msg_type)
+        if msg_type == MessageType.REQUEST:
+            if not isinstance(value, list) or not value or not all(
+                isinstance(x, str) for x in value
+            ):
+                # Requests are arrays of bulk strings (inline commands are
+                # pre-RESP legacy; reject so resync can find real frames).
+                return ParseState.INVALID, 0, None
+            ncmd = (
+                2
+                if len(value) > 1 and value[0].upper() in _TWO_WORD_PREFIXES
+                else 1
+            )
+            msg.command = " ".join(v.upper() for v in value[:ncmd])
+            msg.args = json.dumps(value[ncmd:], ensure_ascii=False)
+            msg.payload = _render(value)
+        else:
+            msg.payload = _render(value)
+            # Pub/sub push: ["message", channel, payload] or
+            # ["pmessage", pattern, channel, payload] (ref parse.cc:105).
+            if (
+                isinstance(value, list)
+                and len(value) >= 3
+                and isinstance(value[0], str)
+                and value[0] in ("message", "pmessage", "smessage")
+            ):
+                msg.is_published = True
+        return ParseState.SUCCESS, pos, msg
+
+    def stitch(self, requests: list, responses: list, state=None):
+        """FIFO pairing; published pub/sub pushes consume no request
+        (ref: stitcher.h — synthesized "PUSH PUB" request)."""
+        records: list[base.Record] = []
+        errors = 0
+        ri = 0
+        resp_keep: list = []
+        for resp in responses:
+            if resp.is_published:
+                synth = Message(
+                    type=MessageType.REQUEST,
+                    timestamp_ns=resp.timestamp_ns,
+                    command="PUSH PUB",
+                    args="[]",
+                )
+                records.append(base.Record(req=synth, resp=resp))
+                continue
+            if ri < len(requests):
+                if requests[ri].timestamp_ns <= resp.timestamp_ns:
+                    records.append(
+                        base.Record(req=requests[ri], resp=resp)
+                    )
+                    ri += 1
+                else:
+                    errors += 1  # response older than any pending request
+            else:
+                # Request half may still be assembling across a capture
+                # chunk boundary: keep the response for the next round so
+                # FIFO pairing does not shift (bounded).
+                resp_keep.append(resp)
+        if len(resp_keep) > 128:
+            errors += len(resp_keep) - 128
+            resp_keep = resp_keep[-128:]
+        return records, errors, requests[ri:], resp_keep
+
+
+def record_to_row(
+    record: base.Record,
+    upid: str,
+    remote_addr: str,
+    remote_port: int,
+    trace_role: int,
+) -> dict:
+    """A redis_events row (ref: redis_table.h kRedisElements order)."""
+    req, resp = record.req, record.resp
+    return {
+        "time_": req.timestamp_ns,
+        "upid": upid,
+        "remote_addr": remote_addr,
+        "remote_port": remote_port,
+        "trace_role": int(trace_role),
+        "req_cmd": req.command,
+        "req_args": req.args,
+        "resp": resp.payload,
+        "latency": max(resp.timestamp_ns - req.timestamp_ns, 0),
+    }
