@@ -80,6 +80,42 @@ class _Return(Exception):
         self.value = value
 
 
+import re as _re
+
+# A line that ends a parenthesized value with no trailing comma, followed
+# by a line that starts another keyword argument — the libpypa-tolerated
+# shape in shipped px/ scripts.
+_KWARG_LINE = _re.compile(r"[)\]'\"\w]\s*$")
+_NEXT_KWARG = _re.compile(r"^\s*\w+\s*=[^=]")
+
+
+def _repair_missing_kwarg_commas(source: str):
+    """Insert the commas libpypa forgives: between a line ending a kwarg
+    value and a following `name=...` line at the same call depth. Returns
+    the repaired source, or None if nothing looked repairable."""
+    lines = source.split("\n")
+    changed = False
+    depth = 0
+    for i, line in enumerate(lines):
+        stripped = line.split("#", 1)[0]
+        new_depth = depth + (
+            stripped.count("(") + stripped.count("[")
+            - stripped.count(")") - stripped.count("]")
+        )
+        if (
+            depth > 0
+            and new_depth > 0
+            and _KWARG_LINE.search(stripped)
+            and not stripped.rstrip().endswith(",")
+            and i + 1 < len(lines)
+            and _NEXT_KWARG.match(lines[i + 1])
+        ):
+            lines[i] = line.rstrip() + ","
+            changed = True
+        depth = max(new_depth, 0)
+    return "\n".join(lines) if changed else None
+
+
 class ASTVisitor:
     def __init__(self, px: PxModule, globals_: Optional[dict] = None):
         self.px = px
@@ -92,7 +128,22 @@ class ASTVisitor:
         try:
             tree = ast.parse(source)
         except SyntaxError as e:
-            raise CompilerError(f"PxL syntax error: {e}") from None
+            # The reference's PxL parser (libpypa-based) tolerates a
+            # missing comma between keyword arguments across lines, and
+            # several SHIPPED px/ scripts rely on it (px/service line 101,
+            # px/pod, px/namespace, px/services: `x=('c', px.count)` with
+            # no trailing comma). Vendored scripts must run byte-identical
+            # (SURVEY §7.5), so repair exactly that shape and reparse.
+            repaired = _repair_missing_kwarg_commas(source)
+            if repaired is not None:
+                try:
+                    tree = ast.parse(repaired)
+                except SyntaxError:
+                    raise CompilerError(
+                        f"PxL syntax error: {e}"
+                    ) from None
+            else:
+                raise CompilerError(f"PxL syntax error: {e}") from None
         try:
             self._exec_body(tree.body, self.env, module_level=True)
         except _Return:

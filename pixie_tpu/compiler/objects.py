@@ -221,7 +221,22 @@ _CTX_FUNCS = {
     "asid": "upid_to_asid",
     "container": "upid_to_container_name",
     "container_name": "upid_to_container_name",
+    "container_id": "upid_to_container_id",
     "cmdline": "upid_to_cmdline",
+}
+
+
+# ctx[key] over a pod_id-keyed frame (network_stats has no upid — ref:
+# px/node and px/pod resolve ctx through pod_id there).
+_POD_ID_CTX_FUNCS = {
+    "pod": "pod_id_to_pod_name",
+    "pod_name": "pod_id_to_pod_name",
+    "service": "pod_id_to_service_name",
+    "service_name": "pod_id_to_service_name",
+    "service_id": "pod_id_to_service_id",
+    "namespace": "pod_id_to_namespace",
+    "node": "pod_id_to_node_name",
+    "node_name": "pod_id_to_node_name",
 }
 
 
@@ -235,6 +250,15 @@ class CtxAccessor:
             raise CompilerError(
                 f"ctx[{key!r}] is not a known metadata property "
                 f"(have: {sorted(_CTX_FUNCS)})"
+            )
+        if (
+            not self.df._has_upid_column()
+            and self.df.relation.has_column("pod_id")
+            and key in _POD_ID_CTX_FUNCS
+        ):
+            return ColumnExpr(
+                FuncCall(_POD_ID_CTX_FUNCS[key], (ColumnRef("pod_id"),)),
+                self.df,
             )
         upid = self.df._upid_column()
         return ColumnExpr(FuncCall(fn, (ColumnRef(upid),)), self.df)
@@ -310,6 +334,11 @@ class DataFrameObj:
                 f"column {name!r} not found; have {self.relation.col_names()}"
             )
         return ColumnExpr(ColumnRef(name), self)
+
+    def _has_upid_column(self) -> bool:
+        return any(
+            c.semantic_type == SemanticType.ST_UPID for c in self.relation
+        )
 
     def _upid_column(self) -> str:
         for c in self.relation:
@@ -478,6 +507,26 @@ class DataFrameObj:
             left_on = [left_on]
         if isinstance(right_on, str):
             right_on = [right_on]
+        if left_on == [] and right_on == []:
+            # Cross join (ref: px/cluster's add_time_window_column merges
+            # a 1-row window table with left_on=[]): lower to an inner
+            # join on a synthetic constant key, dropped from the output.
+            key = "__cross_key__"
+            lc = self.assign_column(
+                key, ColumnExpr(Constant(1, DataType.INT64), self)
+            )
+            rc = right.assign_column(
+                key, ColumnExpr(Constant(1, DataType.INT64), right)
+            )
+            out = lc.merge(
+                rc,
+                how=how,
+                left_on=[key],
+                right_on=[key],
+                suffixes=suffixes,
+            )
+            # The key exists on BOTH sides, so both copies get suffixed.
+            return out.drop([key + suffixes[0], key + suffixes[1]])
         if not left_on or not right_on:
             raise CompilerError("merge requires left_on and right_on")
         _reject_rolling_operand(self, right, "merge")
@@ -729,6 +778,14 @@ class PxModule:
     # -- time helpers -------------------------------------------------------
     def now(self) -> int:
         return self.now_ns
+
+    @staticmethod
+    def parse_duration(s) -> int:
+        """'-5m' -> -300000000000 ns (ref: compile-time ParseDuration,
+        objects/pixie_module; px/pod uses px.now() + px.parse_duration)."""
+        if isinstance(s, (int, float)):
+            return int(s)
+        return parse_relative_time(str(s), 0)
 
     @staticmethod
     def nanoseconds(n):

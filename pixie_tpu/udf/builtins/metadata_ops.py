@@ -205,10 +205,50 @@ def register(r: Registry) -> None:
         lambda st, u: st.upid_to_container.get(u, ""),
     )
     reg(
+        "upid_to_container_id",
+        (S,),
+        S,
+        # Container ids are container names prefixed per-pod in the
+        # synthetic state (no containerd runtime here); resolves to ""
+        # when unknown, like the reference on missing metadata.
+        lambda st, u: st.upid_to_container.get(u, ""),
+    )
+    reg(
         "upid_to_cmdline",
         (S,),
         S,
         lambda st, u: st.upid_to_cmdline.get(u, ""),
+    )
+
+    def _has_name(st, col_val, want):
+        # Ref: HasServiceNameUDF (metadata_ops.h:3096): equality OR
+        # membership when the column holds a JSON array of names (pods
+        # backing several services).
+        if col_val == want:
+            return True
+        if col_val.startswith("["):
+            try:
+                import json
+
+                return want in json.loads(col_val)
+            except ValueError:
+                return False
+        return False
+
+    reg("has_service_name", (S, S), DataType.BOOLEAN, _has_name, np.bool_)
+    reg("has_service_id", (S, S), DataType.BOOLEAN, _has_name, np.bool_)
+    reg(
+        "container_id_to_status",
+        (S,),
+        S,
+        # Ref: ContainerIDToStatusUDF (metadata_ops.h:2859) — JSON status
+        # blob; without a container runtime the state/reason mirror the
+        # pod-status shape for known containers.
+        lambda st, cid: (
+            '{"state":"Running","message":"","reason":""}'
+            if cid
+            else '{"state":"Unknown","message":"","reason":""}'
+        ),
     )
     reg("pod_name_to_pod_id", (S,), S,
         lambda st, name: next(

@@ -178,6 +178,21 @@ def register(r: Registry) -> None:
         doc="PostgreSQL query normalization: literals -> $N "
         "(sql_ops.h NormalizePostgresSQLUDF).",
     )
+    # 2-arg forms matching the reference signatures exactly (sql_ops.h:
+    # pgsql takes the command TAG string, mysql the command CODE int);
+    # px/sql_queries calls these over the events tables.
+    reg(
+        "normalize_pgsql", (S, S), S,
+        lambda q, _cmd: _normalize_sql(q, lambda i: f"${i}"),
+        doc="PostgreSQL query normalization with command tag "
+        "(sql_ops.h NormalizePostgresSQLUDF).",
+    )
+    reg(
+        "normalize_mysql", (S, I), S,
+        lambda q, _cmd: _normalize_sql(q, lambda i: "?"),
+        doc="MySQL query normalization with command code "
+        "(sql_ops.h NormalizeMySQLUDF).",
+    )
 
     def uri_parse(uri: str) -> str:
         from urllib.parse import urlsplit

@@ -183,6 +183,84 @@ def cluster():
     t5.compact()
     t5.stop()
 
+    # mysql_events (socket_tracer schema; px/mysql_* scripts)
+    from pixie_tpu.ingest.socket_tracer import MYSQL_EVENTS_REL
+
+    mq = 150
+    my_lat = rng.integers(10**5, 10**8, mq)
+    t7 = carnot.table_store.create_table("mysql_events", MYSQL_EVENTS_REL)
+    t7.write_pydict({
+        "time_": NOW - np.arange(mq)[::-1] * 1_000_000,
+        "upid": np.array(
+            [upids[i % len(upids)] for i in range(mq)], dtype=object
+        ),
+        "remote_addr": np.array(
+            [ips[i % len(ips)] for i in range(mq)], dtype=object
+        ),
+        "remote_port": np.full(mq, 3306, np.int64),
+        "trace_role": np.full(mq, 2, np.int64),
+        "req_cmd": np.full(mq, 3, np.int64),  # COM_QUERY
+        "req_body": np.array(
+            [f"SELECT * FROM t{i % 3}" for i in range(mq)], dtype=object
+        ),
+        "resp_status": np.zeros(mq, np.int64),
+        "resp_body": np.full(mq, "Resultset rows = 2", dtype=object),
+        "latency": my_lat,
+    })
+    t7.compact()
+    t7.stop()
+
+    # pgsql_events / redis_events (r5 protocol tables; px/pgsql_*, redis_*)
+    from pixie_tpu.ingest.socket_tracer import (
+        PGSQL_EVENTS_REL,
+        REDIS_EVENTS_REL,
+    )
+
+    pq = 120
+    t8 = carnot.table_store.create_table("pgsql_events", PGSQL_EVENTS_REL)
+    t8.write_pydict({
+        "time_": NOW - np.arange(pq)[::-1] * 1_000_000,
+        "upid": np.array(
+            [upids[i % len(upids)] for i in range(pq)], dtype=object
+        ),
+        "remote_addr": np.array(
+            [ips[i % len(ips)] for i in range(pq)], dtype=object
+        ),
+        "remote_port": np.full(pq, 5432, np.int64),
+        "trace_role": np.full(pq, 2, np.int64),
+        "req_cmd": np.full(pq, "QUERY", dtype=object),
+        "req": np.array(
+            [f"SELECT * FROM rel{i % 3} WHERE id={i}" for i in range(pq)],
+            dtype=object,
+        ),
+        "resp": np.full(pq, "id\n1\nSELECT 1", dtype=object),
+        "latency": rng.integers(10**5, 10**8, pq),
+    })
+    t8.compact()
+    t8.stop()
+
+    rq = 110
+    t9 = carnot.table_store.create_table("redis_events", REDIS_EVENTS_REL)
+    t9.write_pydict({
+        "time_": NOW - np.arange(rq)[::-1] * 1_000_000,
+        "upid": np.array(
+            [upids[i % len(upids)] for i in range(rq)], dtype=object
+        ),
+        "remote_addr": np.array(
+            [ips[i % len(ips)] for i in range(rq)], dtype=object
+        ),
+        "remote_port": np.full(rq, 6379, np.int64),
+        "trace_role": np.full(rq, 2, np.int64),
+        "req_cmd": np.array(
+            [["GET", "SET", "INCR"][i % 3] for i in range(rq)], dtype=object
+        ),
+        "req_args": np.full(rq, '["k"]', dtype=object),
+        "resp": np.full(rq, "OK", dtype=object),
+        "latency": rng.integers(10**4, 10**7, rq),
+    })
+    t9.compact()
+    t9.stop()
+
     pod_ids = sorted(md.pods)
     t6 = carnot.table_store.create_table("network_stats", NETWORK_STATS_REL)
     t6.write_pydict({
@@ -351,6 +429,14 @@ _SCRIPT_ARGS = {
         "requesting_pod": "pl/svc-0-pod-0",
         "responding_pod": "pl/svc-1-pod-0",
     },
+    "px/service": {"service": "default/svc-0"},
+    "px/pod": {"pod": "default/svc-0-pod-0"},
+    "px/node": {"node": "node-0"},
+    "px/namespace": {"namespace": "default"},
+    "px/services": {"namespace": "default"},
+    "px/mysql_flow_graph": {"namespace": "default"},
+    "px/pgsql_flow_graph": {"namespace": "default"},
+    "px/redis_flow_graph": {"namespace": "default"},
 }
 
 
