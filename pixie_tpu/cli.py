@@ -144,6 +144,40 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_live(args) -> int:
+    """`px live <script>` — the reference's interactive refresh loop
+    (src/pixie_cli/pkg/live/): re-runs the script every --interval and
+    renders sortable, scrollable tables in a curses TUI."""
+    from pixie_tpu.api import Client
+    from pixie_tpu.live import run_live
+    from pixie_tpu.scripts.library import ScriptLibrary
+
+    script_args = {}
+    for kv in args.arg or []:
+        if "=" not in kv:
+            print(f"--arg wants key=value, got {kv!r}", file=sys.stderr)
+            return 2
+        k, _, v = kv.partition("=")
+        script_args[k] = v
+    carnot = _build_demo_cluster(args.warm)
+    conn = Client().connect_to_cluster(carnot)
+    if os.path.exists(args.script) and args.script.endswith(".pxl"):
+        with open(args.script) as f:
+            pxl = f.read()
+        execute = lambda: conn._execute(pxl, script_args or None)
+    else:
+        if args.script not in ScriptLibrary().names():
+            print(f"unknown script {args.script!r}", file=sys.stderr)
+            return 2
+        execute = lambda: conn.run_script(args.script, script_args)
+    run_live(
+        execute,
+        interval_s=args.interval,
+        max_refreshes=args.max_refreshes,
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="px", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -167,6 +201,25 @@ def main(argv=None) -> int:
     )
     pr.add_argument("--limit", type=int, default=50, help="max rows printed")
     pr.set_defaults(fn=cmd_run)
+
+    pl = sub.add_parser(
+        "live", help="interactive live view (re-runs the script)"
+    )
+    pl.add_argument("script", help="script name (px/...) or path to .pxl")
+    pl.add_argument(
+        "--arg", action="append", help="script arg key=value", default=[]
+    )
+    pl.add_argument("--warm", type=float, default=1.5)
+    pl.add_argument(
+        "--interval", type=float, default=2.0, help="refresh seconds"
+    )
+    pl.add_argument(
+        "--max-refreshes",
+        type=int,
+        default=None,
+        help="exit after N refreshes (for scripted runs)",
+    )
+    pl.set_defaults(fn=cmd_live)
 
     args = p.parse_args(argv)
     return args.fn(args)

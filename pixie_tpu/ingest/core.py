@@ -22,11 +22,18 @@ DataPushCallback = Callable[[str, str, dict], None]
 
 class IngestCore:
     def __init__(self):
+        from pixie_tpu.ingest.stirling_error import StirlingErrorConnector
+
         self._sources: list[SourceConnector] = []
         self._push_cb: Optional[DataPushCallback] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._ctx = None
+        # Self-monitoring (ref: stirling_error source connector):
+        # connector init results and transfer errors become queryable
+        # `stirling_error` rows instead of log lines.
+        self.error_connector = StirlingErrorConnector()
+        self._sources.append(self.error_connector)
 
     # -- registration (stirling.h:91-130) -----------------------------------
     def register_source(self, source: SourceConnector) -> None:
@@ -82,17 +89,48 @@ class IngestCore:
     # -- run loop (stirling.cc:802-852) -------------------------------------
     def run(self) -> None:
         assert self._push_cb is not None, "no data push callback registered"
-        for s in self._sources:
-            s.init()
+        for s in list(self._sources):
+            try:
+                s.init()
+                if s is not self.error_connector:
+                    self.error_connector.record(
+                        s.name, 0, context={"event": "init"}
+                    )
+            except Exception as e:
+                # Record ONCE and drop the source: a connector that never
+                # initialized cannot transfer or push.
+                self.error_connector.record(
+                    s.name, 2, error=str(e), context={"event": "init"}
+                )
+                self.deregister_source(s)
         try:
             while not self._stop.is_set():
                 now = time.monotonic()
                 for s in list(self._sources):
                     if s.sampling_expired(now):
-                        s.transfer_data(self._ctx)
+                        try:
+                            s.transfer_data(self._ctx)
+                        except Exception as e:
+                            # One failing connector must not kill the
+                            # whole ingest loop; the failure is queryable
+                            # (ref: stirling_error posture).
+                            self.error_connector.record(
+                                s.name,
+                                2,
+                                error=str(e),
+                                context={"event": "transfer_data"},
+                            )
                         s.reset_sample(now)
                     if s.push_expired(now):
-                        s.push_data(self._push_cb)
+                        try:
+                            s.push_data(self._push_cb)
+                        except Exception as e:
+                            self.error_connector.record(
+                                s.name,
+                                2,
+                                error=str(e),
+                                context={"event": "push_data"},
+                            )
                         s.reset_push(now)
                 next_tick = min(
                     (s.next_tick() for s in list(self._sources)),

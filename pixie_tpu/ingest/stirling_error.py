@@ -1,0 +1,72 @@
+"""Self-monitoring: connector status/errors as a queryable table.
+
+Ref: src/stirling/source_connectors/stirling_error/ — the reference
+reports each source connector's deployment status and runtime errors into
+a `stirling_error` table (stirling_error_table.h:31: time_, upid,
+source_connector, status, error, context) so operators debug collection
+with the SAME query engine the data flows through. Here the ingest core
+records connector init results and transfer_data exceptions; errors stop
+being log-only (VERDICT r4 missing #7).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from pixie_tpu.ingest.source_connector import DataTable, SourceConnector
+from pixie_tpu.types import DataType, Relation, SemanticType
+
+I, S, T = DataType.INT64, DataType.STRING, DataType.TIME64NS
+
+STIRLING_ERROR_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("upid", S, SemanticType.ST_UPID),
+    ("source_connector", S),
+    ("status", I),
+    ("error", S),
+    ("context", S),
+)
+
+# ref: statuspb codes surfaced in the status column
+STATUS_OK = 0
+STATUS_ERROR = 2
+
+
+class StirlingErrorConnector(SourceConnector):
+    """Accumulates status records; flushes like any other connector."""
+
+    name = "stirling_error"
+    sample_period_s = 0.5
+    push_period_s = 0.5
+
+    def __init__(self):
+        super().__init__()
+        self.tables = [DataTable("stirling_error", STIRLING_ERROR_REL)]
+        self._upid = f"1:{os.getpid()}:1"
+
+    def record(
+        self,
+        source: str,
+        status: int,
+        error: str = "",
+        context: dict | None = None,
+    ) -> None:
+        self.tables[0].append_columns(
+            {
+                "time_": np.array([time.time_ns()], np.int64),
+                "upid": np.array([self._upid], dtype=object),
+                "source_connector": np.array([source], dtype=object),
+                "status": np.array([status], np.int64),
+                "error": np.array([error], dtype=object),
+                "context": np.array(
+                    [json.dumps(context or {}, sort_keys=True)], dtype=object
+                ),
+            }
+        )
+
+    def transfer_data_impl(self, ctx) -> None:
+        pass  # records are appended by record(); push flushes them

@@ -1,0 +1,211 @@
+"""`px live`: interactive terminal view of a running script.
+
+Ref: src/pixie_cli/pkg/live/ (the reference's tview-based live TUI:
+re-executes the script on an interval, renders its vis tables with
+sortable columns, scrolling, and table cycling) + pkg/components/
+(sortable table widget). Re-implemented on stdlib curses:
+
+  keys: q quit · TAB next table · arrows/PgUp/PgDn scroll ·
+        </> move sort column · s toggle sort direction · p pause
+
+The rendering core (LiveModel) is decoupled from curses so tests drive
+it headlessly: feed results, sort, scroll, snapshot visible lines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    s = str(v)
+    return s.replace("\n", "\\n")
+
+
+@dataclasses.dataclass
+class _TableView:
+    name: str
+    columns: list
+    rows: list  # list of row tuples
+    sort_col: int = 0
+    sort_desc: bool = True
+    scroll: int = 0
+
+    def sorted_rows(self) -> list:
+        if not self.rows or not (0 <= self.sort_col < len(self.columns)):
+            return self.rows
+
+        def key(row):
+            v = row[self.sort_col]
+            # Mixed types sort by (type class, value) to stay total.
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return (0, v, "")
+            return (1, 0, str(v))
+
+        return sorted(self.rows, key=key, reverse=self.sort_desc)
+
+
+class LiveModel:
+    """State of the live view: tables, selection, sort, scroll."""
+
+    def __init__(self):
+        self.tables: list[_TableView] = []
+        self.selected = 0
+        self.paused = False
+        self.last_refresh_s = 0.0
+        self.refresh_count = 0
+
+    # -- data ---------------------------------------------------------------
+    def update(self, result) -> None:
+        """Fold a new execution result in, preserving view state per
+        table name (the reference keeps sort/scroll across refreshes)."""
+        if self.paused:
+            return
+        old = {t.name: t for t in self.tables}
+        from pixie_tpu.table.row_batch import RowBatch
+
+        tables = []
+        for name in sorted(result.tables):
+            batches = [b for b in result.tables[name] if b.num_rows]
+            if batches:
+                data = RowBatch.concat(batches).to_pydict()
+                cols = list(data.keys())
+                n = len(next(iter(data.values()))) if data else 0
+                rows = [
+                    tuple(data[c][i] for c in cols) for i in range(n)
+                ]
+            else:
+                cols, rows = [], []
+            tv = _TableView(name=name, columns=cols, rows=rows)
+            prev = old.get(name)
+            if prev is not None and prev.columns == cols:
+                tv.sort_col = prev.sort_col
+                tv.sort_desc = prev.sort_desc
+                tv.scroll = prev.scroll
+            tables.append(tv)
+        self.tables = tables
+        self.selected = min(self.selected, max(len(tables) - 1, 0))
+        self.refresh_count += 1
+
+    @property
+    def current(self) -> Optional[_TableView]:
+        return self.tables[self.selected] if self.tables else None
+
+    # -- key handling (the reference's live-view bindings) ------------------
+    def handle_key(self, key: str) -> bool:
+        """Returns False when the view should exit."""
+        t = self.current
+        if key in ("q", "Q"):
+            return False
+        if key == "\t" and self.tables:
+            self.selected = (self.selected + 1) % len(self.tables)
+        elif key == "p":
+            self.paused = not self.paused
+        elif t is None:
+            return True
+        elif key == "KEY_DOWN":
+            t.scroll += 1
+        elif key == "KEY_UP":
+            t.scroll = max(t.scroll - 1, 0)
+        elif key == "KEY_NPAGE":
+            t.scroll += 20
+        elif key == "KEY_PPAGE":
+            t.scroll = max(t.scroll - 20, 0)
+        elif key == "<":
+            t.sort_col = max(t.sort_col - 1, 0)
+        elif key == ">":
+            t.sort_col = min(t.sort_col + 1, len(t.columns) - 1)
+        elif key == "s":
+            t.sort_desc = not t.sort_desc
+        return True
+
+    # -- rendering (curses-independent) -------------------------------------
+    def render_lines(self, width: int = 120, height: int = 30) -> list[str]:
+        """Visible lines for the current table; the curses frontend blits
+        these verbatim, tests assert on them."""
+        t = self.current
+        lines = []
+        tabs = " ".join(
+            (f"[{tv.name}]" if i == self.selected else f" {tv.name} ")
+            for i, tv in enumerate(self.tables)
+        )
+        state = "PAUSED" if self.paused else "LIVE"
+        lines.append(f"{state} #{self.refresh_count} {tabs}"[:width])
+        if t is None:
+            lines.append("(no tables)")
+            return lines
+        rows = t.sorted_rows()
+        t.scroll = max(min(t.scroll, max(len(rows) - 1, 0)), 0)
+        ncols = max(len(t.columns), 1)
+        colw = max(min(24, (width - ncols) // ncols), 6)
+
+        def cells(vals):
+            return "|".join(_fmt(v)[:colw].ljust(colw) for v in vals)
+
+        hdr = []
+        for i, c in enumerate(t.columns):
+            mark = (" ▼" if t.sort_desc else " ▲") if i == t.sort_col else ""
+            hdr.append((c + mark)[:colw].ljust(colw))
+        lines.append("|".join(hdr)[:width])
+        body = rows[t.scroll : t.scroll + max(height - 3, 1)]
+        for row in body:
+            lines.append(cells(row)[:width])
+        lines.append(
+            f"rows {t.scroll + 1}-{t.scroll + len(body)}/{len(rows)} "
+            f"sort={t.columns[t.sort_col] if t.columns else '-'} "
+            f"{'desc' if t.sort_desc else 'asc'}"[:width]
+        )
+        return lines
+
+
+def run_live(
+    execute_fn,
+    interval_s: float = 2.0,
+    max_refreshes: Optional[int] = None,
+) -> None:
+    """Curses frontend: execute_fn() -> result, re-run every interval."""
+    import curses
+
+    model = LiveModel()
+
+    def loop(stdscr):
+        curses.curs_set(0)
+        stdscr.nodelay(True)
+        stdscr.timeout(100)
+        last = 0.0
+        while True:
+            now = time.monotonic()
+            if not model.paused and (
+                now - last >= interval_s or model.refresh_count == 0
+            ):
+                t0 = time.perf_counter()
+                model.update(execute_fn())
+                model.last_refresh_s = time.perf_counter() - t0
+                last = now
+                if (
+                    max_refreshes is not None
+                    and model.refresh_count >= max_refreshes
+                ):
+                    return
+            h, w = stdscr.getmaxyx()
+            stdscr.erase()
+            for y, line in enumerate(model.render_lines(w - 1, h)):
+                if y >= h:
+                    break
+                try:
+                    stdscr.addstr(y, 0, line)
+                except curses.error:
+                    pass
+            stdscr.refresh()
+            try:
+                ch = stdscr.getkey()
+            except curses.error:
+                continue
+            if not model.handle_key(ch):
+                return
+
+    curses.wrapper(loop)

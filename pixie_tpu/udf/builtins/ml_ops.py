@@ -218,3 +218,55 @@ def register(r: Registry) -> None:
             "(ml_ops.h KMeansUDF::Transform).",
         )
     )
+    def _transformer(docs):
+        import numpy as np
+
+        from pixie_tpu.ops.transformer import default_pool
+
+        arr = np.atleast_1d(np.asarray(docs, dtype=object))
+        out = np.empty(len(arr), dtype=object)
+        with default_pool().get() as ex:
+            for i, d in enumerate(arr):
+                out[i] = ex.execute(str(d))
+        return out
+
+    r.register_scalar(
+        ScalarUDF(
+            "transformer",
+            (S,),
+            S,
+            _transformer,
+            Executor.HOST,
+            dict_compatible=True,
+            doc="Sentence embedding from JSON token ids via the pooled "
+            "JAX transformer executor (ml_ops.h TransformerUDF + "
+            "exec/ml/transformer_executor.h re-implemented TPU-native; "
+            "model_pool.h borrow-pool semantics).",
+        )
+    )
+
+    def _sentencepiece(texts):
+        import numpy as np
+
+        from pixie_tpu.ops.transformer import tokenize
+
+        arr = np.atleast_1d(np.asarray(texts, dtype=object))
+        out = np.empty(len(arr), dtype=object)
+        for i, t in enumerate(arr):
+            out[i] = tokenize(str(t))
+        return out
+
+    r.register_scalar(
+        ScalarUDF(
+            "sentencepiece",
+            (S,),
+            S,
+            _sentencepiece,
+            Executor.HOST,
+            dict_compatible=True,
+            doc="string -> JSON token ids (ml_ops.h SentencePieceUDF "
+            "contract; hash-bucketed subwords stand in for the "
+            "/sentencepiece.proto asset that does not ship in-tree).",
+        )
+    )
+

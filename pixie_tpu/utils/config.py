@@ -77,6 +77,15 @@ class _Flags:
             raise AttributeError(name)
         return self.get(name)
 
+    def __setattr__(self, name: str, value: Any) -> None:
+        # `flags.x = v` must be equivalent to set("x", v): a plain
+        # instance attribute would SHADOW __getattr__ forever, silently
+        # decoupling later set() calls from reads.
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self.set(name, value)
+
 
 flags = _Flags()
 

@@ -1,0 +1,108 @@
+"""healthz/statusz HTTP endpoints for fleet debugging.
+
+Ref: src/shared/services/ — every reference service exposes /healthz
+(liveness) and /statusz (human/machine-readable internal state) so
+operators can probe a component without the message bus being up. Here a
+stdlib ThreadingHTTPServer serves:
+
+  /healthz  -> 200 "ok" (503 when the provided liveness probe fails)
+  /statusz  -> JSON: component name, uptime, the metrics registry
+               snapshot, and any extra status the owner provides
+  /metrics  -> Prometheus-ish text rendering of the metrics registry
+
+Brokers and agents attach one via ``serve_health(...)``; loopback by
+default.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from pixie_tpu.utils import metrics_registry
+
+
+class HealthServer:
+    def __init__(
+        self,
+        component: str,
+        status_fn: Optional[Callable[[], dict]] = None,
+        live_fn: Optional[Callable[[], bool]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.component = component
+        self._status_fn = status_fn
+        self._live_fn = live_fn
+        self._start = time.time()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    live = outer._live_fn() if outer._live_fn else True
+                    self._reply(
+                        200 if live else 503,
+                        b"ok" if live else b"unhealthy",
+                        "text/plain",
+                    )
+                elif path == "/statusz":
+                    self._reply(
+                        200,
+                        json.dumps(outer.status(), indent=1).encode(),
+                        "application/json",
+                    )
+                elif path == "/metrics":
+                    self._reply(
+                        200,
+                        metrics_registry().render_text().encode(),
+                        "text/plain",
+                    )
+                else:
+                    self._reply(404, b"not found", "text/plain")
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self.address = self._srv.server_address
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def status(self) -> dict:
+        out = {
+            "component": self.component,
+            "uptime_s": round(time.time() - self._start, 3),
+            "metrics": {
+                k: {"|".join(f"{a}={b}" for a, b in key) or "_": v
+                    for key, v in samples.items()}
+                for k, samples in metrics_registry().collect().items()
+            },
+        }
+        if self._status_fn is not None:
+            try:
+                out["status"] = self._status_fn()
+            except Exception as e:
+                out["status_error"] = str(e)
+        return out
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def serve_health(component: str, **kwargs) -> HealthServer:
+    return HealthServer(component, **kwargs)

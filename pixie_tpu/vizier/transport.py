@@ -27,6 +27,7 @@ import ipaddress
 import logging
 import os
 import socket
+import ssl
 import struct
 import threading
 from typing import Any, Optional
@@ -44,6 +45,53 @@ define_flag(
     "(HMAC-SHA256 challenge/response). Empty restricts the transport to "
     "loopback (ref posture: src/shared/services/ TLS+JWT bootstrap).",
 )
+
+define_flag(
+    "tls_cert",
+    "",
+    help_="PEM certificate chain for transport TLS (ref: the reference "
+    "runs TLS on every plane, src/shared/services/). Servers present it; "
+    "clients present it too when tls_ca demands mutual auth. Empty "
+    "disables TLS (HMAC-only trusted-cluster floor).",
+)
+define_flag(
+    "tls_key", "", help_="PEM private key for tls_cert (empty: key is "
+    "embedded in the cert file)."
+)
+define_flag(
+    "tls_ca",
+    "",
+    help_="PEM CA bundle: servers require client certificates signed by "
+    "it (mutual TLS); clients verify the server against it. Certificates "
+    "are cluster-internal and pinned by this private CA, so hostname "
+    "checking is off (agents dial IPs).",
+)
+
+
+def _tls_server_context() -> Optional[ssl.SSLContext]:
+    if not flags.tls_cert:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(flags.tls_cert, flags.tls_key or None)
+    if flags.tls_ca:
+        ctx.load_verify_locations(flags.tls_ca)
+        ctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS
+    return ctx
+
+
+def _tls_client_context() -> Optional[ssl.SSLContext]:
+    if not (flags.tls_ca or flags.tls_cert):
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False  # private-CA-pinned certs, dialed by IP
+    if flags.tls_ca:
+        ctx.load_verify_locations(flags.tls_ca)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    else:
+        ctx.verify_mode = ssl.CERT_NONE  # HMAC still authenticates
+    if flags.tls_cert:
+        ctx.load_cert_chain(flags.tls_cert, flags.tls_key or None)
+    return ctx
 
 _LEN = struct.Struct(">Q")
 _NONCE_BYTES = 16
@@ -186,10 +234,15 @@ class BusTransportServer:
         self.bus = bus
         self.router = router
         self._secret = flags.cluster_secret
-        if not self._secret and not _is_loopback(host):
+        self._tls = _tls_server_context()
+        # Binding off-loopback needs a real authenticator: the HMAC secret
+        # or mutual TLS (cert + required client CA).
+        mutual_tls = self._tls is not None and bool(flags.tls_ca)
+        if not self._secret and not mutual_tls and not _is_loopback(host):
             raise ValueError(
                 f"refusing to bind transport on non-loopback {host!r} "
-                "without a cluster_secret (set PIXIE_TPU_CLUSTER_SECRET)"
+                "without a cluster_secret (set PIXIE_TPU_CLUSTER_SECRET) "
+                "or mutual TLS (tls_cert + tls_ca)"
             )
         self._srv = socket.create_server((host, port))
         self.address = self._srv.getsockname()
@@ -222,6 +275,12 @@ class BusTransportServer:
                 # Bounded pre-auth hold time: a silent peer must not pin
                 # this thread forever. Cleared once authenticated.
                 conn.settimeout(10.0)
+                if self._tls is not None:
+                    # TLS first; the HMAC challenge/response then runs
+                    # INSIDE the tunnel (defense in depth: the secret
+                    # never rides plaintext, frames get confidentiality
+                    # + integrity the bare HMAC handshake lacked).
+                    conn = self._tls.wrap_socket(conn, server_side=True)
                 if not _server_handshake(conn, self._secret):
                     _log.warning("transport: rejecting unauthenticated peer")
                     return
@@ -365,10 +424,15 @@ class RemoteBus:
     def __init__(self, address):
         self._address = tuple(address)
         self._secret = flags.cluster_secret
-        if not self._secret and not _is_loopback(self._address[0]):
+        self._tls = _tls_client_context()
+        verified_tls = self._tls is not None and bool(flags.tls_ca)
+        if not self._secret and not verified_tls and not _is_loopback(
+            self._address[0]
+        ):
             raise ValueError(
                 f"refusing to connect to non-loopback {self._address[0]!r} "
-                "without a cluster_secret (set PIXIE_TPU_CLUSTER_SECRET)"
+                "without a cluster_secret (set PIXIE_TPU_CLUSTER_SECRET) "
+                "or a verified TLS server (tls_ca)"
             )
         self._sock = self._connect()
         self._send_lock = threading.Lock()
@@ -383,6 +447,10 @@ class RemoteBus:
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self._address)
         try:
+            if self._tls is not None:
+                sock = self._tls.wrap_socket(
+                    sock, server_hostname=str(self._address[0])
+                )
             _client_handshake(sock, self._secret)
         except Exception:
             _close(sock)

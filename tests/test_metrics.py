@@ -170,3 +170,47 @@ def test_broker_streaming_slow_consumer(monkeypatch):
         broker.stop()
         pem.stop()
         kelvin.stop()
+
+
+def test_health_server_endpoints():
+    """healthz/statusz/metrics HTTP surface (ref: src/shared/services/ —
+    every reference service exposes liveness + statusz)."""
+    import http.client
+    import json as _json
+
+    from pixie_tpu.vizier.health import serve_health
+
+    live = {"ok": True}
+    h = serve_health(
+        "broker",
+        status_fn=lambda: {"agents": 3},
+        live_fn=lambda: live["ok"],
+    )
+    try:
+        host, port = h.address
+
+        def get(path):
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.request("GET", path)
+            r = conn.getresponse()
+            body = r.read()
+            conn.close()
+            return r.status, body
+
+        st, body = get("/healthz")
+        assert (st, body) == (200, b"ok")
+        st, body = get("/statusz")
+        assert st == 200
+        data = _json.loads(body)
+        assert data["component"] == "broker"
+        assert data["status"] == {"agents": 3}
+        assert "metrics" in data
+        st, body = get("/metrics")
+        assert st == 200 and b"# TYPE" in body
+        st, _ = get("/nope")
+        assert st == 404
+        live["ok"] = False
+        st, body = get("/healthz")
+        assert (st, body) == (503, b"unhealthy")
+    finally:
+        h.stop()

@@ -142,3 +142,87 @@ def test_kmeans_predict_udf():
     by = dict(zip(d["cluster"], d["n"]))
     want = {0: int((cl[:200] == 0).sum()), 1: int((cl[:200] == 1).sum())}
     assert by == want
+
+
+def test_transformer_executor_and_pool():
+    """JAX transformer executor matches the reference contract
+    (transformer_executor.h): JSON token ids in, JSON embedding out,
+    deterministic, unit-norm, truncated at 64 tokens; the model pool
+    reuses warm executors (model_pool.h)."""
+    import json
+
+    import numpy as np
+
+    from pixie_tpu.ops.transformer import (
+        MAX_LENGTH,
+        ModelPool,
+        TransformerExecutor,
+        tokenize,
+    )
+
+    ex = TransformerExecutor()
+    out = ex.execute("[1, 2, 3]")
+    emb = json.loads(out)
+    assert len(emb) == 64
+    assert abs(np.linalg.norm(emb) - 1.0) < 1e-3
+    # deterministic
+    assert ex.execute("[1, 2, 3]") == out
+    # different inputs separate
+    assert ex.execute("[4, 5, 6]") != out
+    # bad inputs -> "" (ref: Execute error paths)
+    assert ex.execute("not json") == ""
+    assert ex.execute("[]") == ""
+    assert ex.execute('["a"]') == ""
+    # truncation at max_length
+    long = ex.execute(json.dumps(list(range(500))))
+    assert len(json.loads(long)) == 64
+
+    pool = ModelPool()
+    with pool.get() as a:
+        pass
+    with pool.get() as b:
+        assert b is a  # reused, not rebuilt
+    assert pool._built["transformer"] == 1
+
+    ids = json.loads(tokenize("GET /api/v1/users failed with 500"))
+    assert ids and all(isinstance(i, int) and 0 < i < 32768 for i in ids)
+    assert len(ids) <= MAX_LENGTH
+
+
+def test_transformer_udf_through_engine():
+    """px.sentencepiece + px.transformer compose in a PxL query (the
+    reference's log-embedding pipeline shape)."""
+    import json
+
+    import numpy as np
+
+    from pixie_tpu.engine import Carnot
+    from pixie_tpu.types import DataType, Relation, SemanticType
+
+    c = Carnot()
+    rel = Relation.of(
+        ("time_", DataType.TIME64NS, SemanticType.ST_TIME_NS),
+        ("msg", DataType.STRING),
+    )
+    t = c.table_store.create_table("logs", rel)
+    t.write_pydict({
+        "time_": np.arange(4) * 1000,
+        "msg": np.array(
+            ["error connecting to db", "error connecting to db",
+             "request ok", "request ok"], dtype=object
+        ),
+    })
+    t.compact()
+    t.stop()
+    res = c.execute_query(
+        "df = px.DataFrame(table='logs')\n"
+        "df.tokens = px.sentencepiece(df.msg)\n"
+        "df.emb = px.transformer(df.tokens)\n"
+        "px.display(df[['msg', 'emb']], 'out')\n"
+    )
+    rows = res.table("out")
+    embs = [json.loads(e) for e in rows["emb"]]
+    assert all(len(e) == 64 for e in embs)
+    # same text -> same embedding; different text -> different
+    assert embs[0] == embs[1] and embs[2] == embs[3]
+    assert embs[0] != embs[2]
