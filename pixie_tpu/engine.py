@@ -176,10 +176,13 @@ class Carnot:
                     if offloaded is not None:
                         agg_nid, batch = offloaded
                         key = f"device:{frag.fragment_id}:{agg_nid}"
-                        state.inline_batches[key] = [batch]
+                        # Windowed device aggs return one batch PER WINDOW
+                        # (eow-cadenced, like the host AggNode).
+                        batches = batch if isinstance(batch, list) else [batch]
+                        state.inline_batches[key] = batches
                         # StateBatches (PARTIAL offload) carry no relation;
                         # resolve the agg op's declared output instead.
-                        rel = getattr(batch, "relation", None)
+                        rel = getattr(batches[0], "relation", None)
                         if rel is None:
                             rel = frag.resolve_relations(
                                 self.registry,

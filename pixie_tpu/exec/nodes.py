@@ -62,7 +62,13 @@ class MemorySourceNode(SourceNode):
             batch = RowBatch.with_zero_rows(self._table.relation)
         if self.op.column_names is not None:
             batch = batch.select(list(self.op.column_names))
-        self.send(exec_state, batch.with_flags(eow=done, eos=done))
+        # Forward STORED end-of-window markers (producers write them per
+        # ingest window): windowed aggs downstream emit on them; FULL
+        # non-windowed aggs ignore eow, so this is invisible elsewhere
+        # (ref: memory_source_node.h streaming flag forwarding).
+        self.send(
+            exec_state, batch.with_flags(eow=done or batch.eow, eos=done)
+        )
         return True
 
 

@@ -128,12 +128,14 @@ def match_fragment(fragment: PlanFragment, relations) -> Optional[_Match]:
     agg_nid = None
     for nid in fragment.topo_order():
         op = fragment.node(nid)
-        # FULL aggs finalize on device; PARTIAL aggs (the PEM side of a
-        # distributed split) ship raw states to the merge stage instead.
-        if (
-            isinstance(op, AggOp)
-            and op.stage in (AggStage.FULL, AggStage.PARTIAL)
-            and not op.windowed
+        # FULL aggs finalize on device (windowed ones too, r5: the window
+        # id becomes a second group axis and each window emits its own
+        # batch); PARTIAL aggs (the PEM side of a distributed split) ship
+        # raw states to the merge stage — windowed PARTIALs stay on the
+        # host, whose eow-driven StateBatch cadence the merge consumes.
+        if isinstance(op, AggOp) and (
+            op.stage == AggStage.FULL
+            or (op.stage == AggStage.PARTIAL and not op.windowed)
         ):
             agg_nid = nid
             break
@@ -570,12 +572,15 @@ class MeshExecutor:
         if evaluator is None:
             return None
 
+        windowed = m.agg_op.windowed and m.agg_op.stage == AggStage.FULL
         # Host-side any() candidates are syntactic (no predicates, bare
         # column): their arg columns never ship to HBM — exclude them from
         # base_cols up front; if planning falls through after the key plan
         # resolves, they rejoin the device path below.
         any_candidates = set()
-        if not m.predicates and m.agg_op.stage == AggStage.FULL:
+        if not m.predicates and m.agg_op.stage == AggStage.FULL and (
+            not windowed  # reps would need a per-window pass: device path
+        ):
             any_candidates = {
                 out
                 for out, arg_e, uda in specs
@@ -596,6 +601,16 @@ class MeshExecutor:
             key_plan = self._plan_keys(m, table, registry, func_ctx, base_cols)
         if key_plan is None:
             return None
+        base_groups = max(key_plan.num_groups, 1)
+        n_windows = 1
+        if windowed:
+            # Window id = one more (leading) group axis: gid' = wid*G+gid,
+            # windows cut at the cursor's eow markers — the same
+            # boundaries the host AggNode emits on (agg_node.py:242).
+            wk = self._windowize_key_plan(m, table, key_plan, base_groups)
+            if wk is None:
+                return None
+            key_plan, n_windows = wk
         with _timed("host_any"):
             host_any = (
                 self._plan_host_any(m, specs, key_plan, table)
@@ -618,7 +633,7 @@ class MeshExecutor:
         ) + (
             ":host" if key_plan.host_gids is not None
             else (":lut" if isinstance(key_plan.device_expr, tuple) else ":dev")
-        )
+        ) + (f":win{n_windows}" if windowed else "")
         # Version = (min_row_id, end_row_id): writes bump end_row_id and
         # ring-buffer expiry bumps min_row_id, so either invalidates.
         version = (table.min_row_id(), table.end_row_id())
@@ -723,6 +738,24 @@ class MeshExecutor:
             batch = self._partial_state_batch(
                 m, device_specs, key_plan, merged, table
             )
+        elif windowed:
+            # One RowBatch per window, eow-cadenced like the host AggNode.
+            batch = [
+                self._finalize(
+                    m,
+                    specs,
+                    key_plan,
+                    capacity,
+                    merged,
+                    registry,
+                    table,
+                    host_any=host_any,
+                    group_range=(w * base_groups, base_groups),
+                    eow=True,
+                    eos=(w == n_windows - 1),
+                )
+                for w in range(n_windows)
+            ]
         else:
             batch = self._finalize(
                 m,
@@ -1748,6 +1781,65 @@ class MeshExecutor:
                 out[col] = max_card
         return out
 
+    def _windowize_key_plan(
+        self, m: _Match, table, key_plan, base_groups: int
+    ):
+        """(key_plan with gid' = wid*G + gid, n_windows) or None. Needs
+        per-row gids host-side; device key plans are materialized the
+        same way the join path does."""
+        from pixie_tpu.parallel.staging import read_columns_windowed
+
+        _cols, n, wids, n_windows = read_columns_windowed(
+            table,
+            [],
+            m.source_op.start_time,
+            m.source_op.stop_time,
+        )
+        if n_windows * base_groups > (1 << 22):
+            return None  # state tensors would be unreasonable
+        gids = key_plan.host_gids
+        if gids is None:
+            if key_plan.device_expr is None:
+                gids = np.zeros(n, np.int32)  # group-by-none
+            elif isinstance(key_plan.device_expr, ColumnRef):
+                cols2, n2 = read_columns(
+                    table,
+                    [key_plan.device_expr.name],
+                    m.source_op.start_time,
+                    m.source_op.stop_time,
+                )
+                if n2 != n:
+                    return None
+                gids = np.maximum(cols2[key_plan.device_expr.name], 0)
+            elif isinstance(key_plan.device_expr, tuple):
+                _, src_col, lut_codes = key_plan.device_expr
+                cols2, n2 = read_columns(
+                    table,
+                    [src_col],
+                    m.source_op.start_time,
+                    m.source_op.stop_time,
+                )
+                if n2 != n:
+                    return None
+                codes = np.maximum(cols2[src_col], 0)
+                gids = np.asarray(lut_codes)[codes]
+            else:
+                return None
+        if len(gids) != n or len(wids) != n:
+            return None
+        combined = (
+            wids.astype(np.int64) * base_groups + gids.astype(np.int64)
+        )
+        return (
+            dataclasses.replace(
+                key_plan,
+                host_gids=combined.astype(np.int32),
+                device_expr=None,
+                num_groups=n_windows * base_groups,
+            ),
+            n_windows,
+        )
+
     def _plan_host_any(
         self, m: _Match, specs, key_plan, table
     ) -> dict:
@@ -2613,6 +2705,9 @@ class MeshExecutor:
         registry,
         table,
         host_any=None,
+        group_range=None,
+        eow=True,
+        eos=True,
     ):
         host_any = host_any or {}
         device_specs = [s for s in specs if s[0] not in host_any]
@@ -2625,7 +2720,23 @@ class MeshExecutor:
             s[0]: (s, mode, val)
             for s, mode, val in zip(device_specs, modes, values)
         }
-        n = max(key_plan.num_groups, 1) if m.agg_op.groups else 1
+        if group_range is not None:
+            # Windowed finalize: this call covers groups
+            # [off, off+cnt) — one window's slice of the (window x group)
+            # id space.
+            off, cnt = group_range
+            values = [
+                jax.tree.map(lambda a: np.asarray(a)[off : off + cnt], v)
+                for v in values
+            ]
+            by_out = {
+                s[0]: (s, mode, val)
+                for s, mode, val in zip(device_specs, modes, values)
+            }
+            presence = np.asarray(presence)[off : off + cnt]
+            n = cnt if m.agg_op.groups else 1
+        else:
+            n = max(key_plan.num_groups, 1) if m.agg_op.groups else 1
         rel = m.agg_op.output_relation([_pre_agg_relation(m, registry)], registry)
         # Only observed groups are emitted (host-engine semantics): drop
         # slots whose rows were all filtered out / expired. Group-by-none
@@ -2693,7 +2804,7 @@ class MeshExecutor:
                 out_cols.append(DictColumn(d.encode(vals), d))
             else:
                 out_cols.append(np.asarray(out, dtype=host_dtype(schema.data_type)))
-        return RowBatch(rel, out_cols, eow=True, eos=True)
+        return RowBatch(rel, out_cols, eow=eow, eos=eos)
 
 
 def _pre_agg_relation(m: _Match, registry):

@@ -99,26 +99,62 @@ def read_columns(
 ) -> tuple[dict[str, np.ndarray], int]:
     """Materialize needed columns via a cursor (host side). String columns
     come back as their int32 code arrays."""
+    cols, n, _w, _nw = read_columns_windowed(
+        table, columns, start_time, stop_time, want_windows=False
+    )
+    return cols, n
+
+
+def read_columns_windowed(
+    table: Table,
+    columns: list[str],
+    start_time: Optional[int] = None,
+    stop_time: Optional[int] = None,
+    want_windows: bool = True,
+):
+    """Like read_columns, plus per-row WINDOW ids derived from the
+    cursor's end-of-window markers (a batch with eow=True closes the
+    current window — the same boundaries the host AggNode emits on,
+    exec/agg_node.py consume_next_impl). Returns
+    (cols, n, window_ids|None, n_windows)."""
     batches = []
     cur = table.cursor(start_time, stop_time)
     while not cur.done():
         b = cur.next_batch()
         if b is None:
             break
-        if b.num_rows:
+        if b.num_rows or b.eow:
             batches.append(b)
     cols: dict[str, np.ndarray] = {}
     n = sum(b.num_rows for b in batches)
     for name in columns:
         parts = []
         for b in batches:
+            if not b.num_rows:
+                continue
             c = b.col(name)
             parts.append(c.codes if isinstance(c, DictColumn) else np.asarray(c))
         cols[name] = (
             np.concatenate(parts) if parts
             else np.empty(0, np.int32)
         )
-    return cols, n
+    wids = None
+    n_windows = 1
+    if want_windows:
+        parts = []
+        w = 0
+        for b in batches:
+            if b.num_rows:
+                parts.append(np.full(b.num_rows, w, np.int64))
+            if b.eow:
+                w += 1
+        wids = (
+            np.concatenate(parts) if parts else np.empty(0, np.int64)
+        )
+        # Rows after the last eow belong to a final (unclosed) window.
+        n_windows = w + 1 if (not batches or not batches[-1].eow) else w
+        n_windows = max(n_windows, 1)
+    return cols, n, wids, n_windows
 
 
 def int_dict_encode(
