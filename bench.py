@@ -40,6 +40,16 @@ non-headline regressions cannot ship silently. BENCH_GATE_SELFTEST=1
 injects an impossible prior to prove the gate trips (on a deep copy —
 the ledger never records fabricated baselines, ADVICE r4).
 
+Cold staging streams by default (r6): the agg configs' first query runs
+the double-buffered window pipeline (pack ∥ transfer ∥ fold; flag
+``streaming_stage``, env PIXIE_TPU_STREAMING_STAGE=0 to disable,
+PIXIE_TPU_STREAMING_WINDOW_ROWS to size windows), so cold breakdowns gain
+the stream occupancy keys (stage_overlap, stream_windows,
+stage_stream_pack/put/dispatch/drain/...; see
+tools/microbench_stage_overlap.py). Warm runs are unaffected — the
+streamed windows concatenate into the same HBM staged-cache entry the
+monolithic path would have produced.
+
 Env knobs: BENCH_ROWS (configs 2/5; default 256M), BENCH_SMALL_ROWS
 (configs 1/3/4; default 64M), BENCH_HOST_ROWS (config 0; default 8M),
 BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS (comma list, default
@@ -262,9 +272,15 @@ def main() -> None:
         DataType.TIME64NS,
     )
 
+    from pixie_tpu.utils import flags
+
     devices = jax.devices()
     n_chips = len(devices)
     mesh = Mesh(np.array(devices), ("d",))
+    log(
+        f"streaming_stage={flags.streaming_stage} "
+        f"window_rows={flags.streaming_window_rows}"
+    )
     carnot = Carnot(
         device_executor=MeshExecutor(mesh=mesh, block_rows=block_rows)
     )

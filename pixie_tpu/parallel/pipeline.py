@@ -21,6 +21,7 @@ Ref mapping: per-device scan ≙ the PEM pre-blocking fragment
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import time
@@ -29,7 +30,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax>=0.4.35 exposes shard_map at top level
     from jax import shard_map  # type: ignore[attr-defined]
@@ -511,11 +512,17 @@ class MeshExecutor:
         # Offload is best-effort; failures fall back to the host engine but
         # must stay observable (one log per distinct error signature).
         self.fallback_errors: dict[str, str] = {}
+        # Streaming-stage failures fall back to MONOLITHIC staging (still
+        # on-device), tracked separately so fallback_errors keeps meaning
+        # "query left the mesh".
+        self.stream_fallback_errors: dict[str, str] = {}
         # (uda set, capacity) -> (finalize modes, packed-output templates).
         self._finmode_cache: dict[tuple, Any] = {}
         # Host-computed any() representatives, keyed by
         # (table, version, window, key exprs, col); small LRU.
-        self._hostany_cache: dict[tuple, np.ndarray] = {}
+        self._hostany_cache: "collections.OrderedDict[tuple, np.ndarray]" = (
+            collections.OrderedDict()
+        )
 
     # -- public -------------------------------------------------------------
     def try_execute_fragment(
@@ -682,7 +689,8 @@ class MeshExecutor:
                     break
         if staged is not None:
             self._staged_cache.move_to_end(cache_key)
-        else:
+        merged = capacity = None
+        if staged is None:
             with _timed("read_columns"):
                 cols, n = read_columns(
                     table,
@@ -692,48 +700,72 @@ class MeshExecutor:
                 )
             if key_plan.host_gids is not None and len(key_plan.host_gids) != n:
                 return None  # table moved under us; fall back
-            int_dicts = {}
-            with _timed("int_dict_encode"):
-                from pixie_tpu.parallel.staging import int_dict_encode
+            if flags.streaming_stage:
+                # Streamed double-buffered staging: host pack ∥ HBM
+                # transfer ∥ device fold per window. The aggregate is
+                # computed as a side effect of staging, and the window
+                # blocks concatenate into the warm-path cache entry.
+                with _timed("aux"):
+                    aux = self._build_aux(
+                        evaluator, m, key_plan, table, device_specs
+                    )
+                with _timed("stage"):
+                    stream = self._stream_execute(
+                        m, device_specs, evaluator, key_plan, table, cols,
+                        n, f32_cols, cell_cols, aux, cacheable,
+                    )
+                if stream is not None:
+                    merged, capacity, staged = stream
+                    if cacheable and staged is not None:
+                        self._staged_insert(
+                            cache_key, staged, m.source_op.table_name, version
+                        )
+            if merged is None:
+                int_dicts = {}
+                with _timed("int_dict_encode"):
+                    from pixie_tpu.parallel.staging import int_dict_encode
 
-                for col, max_card in cell_cols.items():
-                    enc = int_dict_encode(cols[col], max_card)
-                    if enc is not None:
-                        cols[col], int_dicts[col] = enc
-            try:
-                with _timed("stage"):
-                    staged = self._stage(
-                        cols, n, key_plan, table, f32_cols, int_dicts
+                    for col, max_card in cell_cols.items():
+                        enc = int_dict_encode(cols[col], max_card)
+                        if enc is not None:
+                            cols[col], int_dicts[col] = enc
+                try:
+                    with _timed("stage"):
+                        staged = self._stage(
+                            cols, n, key_plan, table, f32_cols, int_dicts
+                        )
+                except Exception as e:
+                    if "RESOURCE_EXHAUSTED" not in str(e) and (
+                        "Out of memory" not in str(e)
+                    ):
+                        raise  # deterministic failures must not nuke the cache
+                    # Device OOM: drop every cached staging and retry once —
+                    # better than falling back to the host engine for a
+                    # gigarow table.
+                    self._staged_cache.clear()
+                    _STAGED_EVICTIONS.inc(reason="oom")
+                    staged = None
+                if staged is None:
+                    # Retry OUTSIDE the except block: the in-flight exception's
+                    # traceback pins the failed attempt's partially allocated
+                    # device buffers until the handler exits.
+                    with _timed("stage"):
+                        staged = self._stage(
+                            cols, n, key_plan, table, f32_cols, int_dicts
+                        )
+                if cacheable:
+                    self._staged_insert(
+                        cache_key, staged, m.source_op.table_name, version
                     )
-            except Exception as e:
-                if "RESOURCE_EXHAUSTED" not in str(e) and (
-                    "Out of memory" not in str(e)
-                ):
-                    raise  # deterministic failures must not nuke the cache
-                # Device OOM: drop every cached staging and retry once —
-                # better than falling back to the host engine for a
-                # gigarow table.
-                self._staged_cache.clear()
-                _STAGED_EVICTIONS.inc(reason="oom")
-                staged = None
-            if staged is None:
-                # Retry OUTSIDE the except block: the in-flight exception's
-                # traceback pins the failed attempt's partially allocated
-                # device buffers until the handler exits.
-                with _timed("stage"):
-                    staged = self._stage(
-                        cols, n, key_plan, table, f32_cols, int_dicts
-                    )
-            if cacheable:
-                self._staged_insert(
-                    cache_key, staged, m.source_op.table_name, version
+        if merged is None:
+            with _timed("aux"):
+                aux = self._build_aux(
+                    evaluator, m, key_plan, table, device_specs
                 )
-        with _timed("aux"):
-            aux = self._build_aux(evaluator, m, key_plan, table, device_specs)
-        with _timed("program"):
-            merged, capacity = self._run_program(
-                m, device_specs, evaluator, key_plan, staged, aux
-            )
+            with _timed("program"):
+                merged, capacity = self._run_program(
+                    m, device_specs, evaluator, key_plan, staged, aux
+                )
         if m.agg_op.stage == AggStage.PARTIAL:
             batch = self._partial_state_batch(
                 m, device_specs, key_plan, merged, table
@@ -1881,7 +1913,12 @@ class MeshExecutor:
                 arg_e.name,
             )
             rep = self._hostany_cache.get(ck)
-            if rep is None:
+            if rep is not None:
+                # Real LRU: a hit refreshes recency (the r5 version was
+                # FIFO despite the comment — the hottest entry could be
+                # the first evicted).
+                self._hostany_cache.move_to_end(ck)
+            else:
                 want = [arg_e.name] + ([gid_col] if gid_col else [])
                 cols, n = read_columns(
                     table,
@@ -1903,7 +1940,7 @@ class MeshExecutor:
                 rep[g[::-1]] = vals[::-1]
                 self._hostany_cache[ck] = rep
                 while len(self._hostany_cache) > 32:
-                    self._hostany_cache.pop(next(iter(self._hostany_cache)))
+                    self._hostany_cache.popitem(last=False)
             out[out_name] = rep
         return out
 
@@ -2283,6 +2320,232 @@ class MeshExecutor:
         ]
         return "|".join(parts)
 
+    def _make_scan_body(
+        self,
+        specs,
+        evaluator,
+        col_names,
+        narrow_names,
+        int_dict_names,
+        preds,
+        device_key,
+        has_key_lut,
+        capacity,
+        aux,
+        narrow_vec,
+        key_lut,
+        gid_base,
+        use_host_gids,
+    ):
+        """The per-block scan body shared by the monolithic program and the
+        streaming window-fold program. carry = (states tuple, presence);
+        xs = (cols tuple, mask, gids)."""
+
+        def eval_gids(env, blk_mask):
+            if device_key is None:
+                # mask always exists; a count-only query may stage NO
+                # value columns at all.
+                return jnp.zeros_like(blk_mask, dtype=jnp.int32)
+            if has_key_lut:
+                _, src_col, _ = device_key
+                return key_lut[jnp.maximum(env[src_col], 0)]
+            return evaluator.device_eval(device_key, env, aux).astype(
+                jnp.int32
+            )
+
+        def body(carry, xs):
+            from pixie_tpu.ops import segment as _segment
+
+            states, presence = carry
+            blk_cols, blk_mask, blk_gids = xs
+            env = dict(zip(col_names, blk_cols))
+            for ni, nm in enumerate(narrow_names):
+                # Widen frame-of-reference narrowed columns (VPU cast
+                # + add; the transfer savings dwarf this).
+                env[nm] = env[nm].astype(jnp.int64) + narrow_vec[ni]
+            mask = blk_mask
+            for p in preds:
+                mask = mask & evaluator.device_eval(p, env, aux)
+            gids = (
+                blk_gids if use_host_gids
+                else eval_gids(env, blk_mask)
+            )
+            # This pass owns groups [gid_base, gid_base + capacity);
+            # rows outside it are masked and their updates land on a
+            # clipped (masked-out) slot.
+            gids = gids.astype(jnp.int32) - gid_base
+            mask = mask & (gids >= 0) & (gids < capacity)
+            gids = jnp.clip(gids, 0, capacity - 1)
+
+            def eval_col(arg_e, uda):
+                col = evaluator.device_eval(arg_e, env, aux)
+                hkey = (
+                    f"arghash:{arg_e.name}"
+                    if uda.string_args == "hash"
+                    and isinstance(arg_e, ColumnRef)
+                    else None
+                )
+                if hkey is not None and hkey in aux:
+                    lut = aux[hkey]
+                    col = lut[jnp.clip(col, 0, lut.shape[0] - 1)]
+                return col
+
+            # Fused-sum lane: every sum-family UDA contributes f32 limb
+            # rows to ONE shared one-hot einsum (plus the engine's
+            # presence row) — the one-hot generation dominates MXU
+            # segment sums, so per-UDA calls pay it k+1 times (r4).
+            use_fused = _segment.matmul_strategy(capacity)
+            fused_slices: dict[str, tuple[int, int]] = {}
+            totals = None
+            if use_fused:
+                rows = []
+                for out, arg_e, uda in specs:
+                    if uda.fused_rows is None:
+                        continue
+                    if (
+                        uda.cell_update is not None
+                        and isinstance(arg_e, ColumnRef)
+                        and arg_e.name in int_dict_names
+                    ):
+                        continue  # cell lane serves it
+                    col = (
+                        eval_col(arg_e, uda) if uda.reads_args else None
+                    )
+                    r = uda.fused_rows(col, mask)
+                    fused_slices[out] = (len(rows), len(rows) + len(r))
+                    rows.extend(r)
+                rows.append(mask.astype(jnp.float32))  # presence
+                totals = _segment.limb_einsum_sums(rows, gids, capacity)
+                presence = presence + totals[-1].astype(presence.dtype)
+            else:
+                presence = presence + _segment.seg_count(
+                    gids, capacity, mask
+                ).astype(presence.dtype)
+            # Cell lane: per-column (group, code) histograms via one
+            # MXU einsum each; cell-capable UDAs over int-dictionary
+            # columns update per CELL instead of per row (r5).
+            hists: dict[str, Any] = {}
+            for cname in int_dict_names:
+                lut = aux[f"intdict:{cname}"]
+                C = lut.shape[0]
+                if capacity * C > _segment.MATMUL_MAX_SEGMENTS:
+                    # Cache reuse under a bigger pass capacity than
+                    # the staging's max_card assumed: histogram would
+                    # blow the einsum budget — row path (below) takes
+                    # over via a LUT gather instead.
+                    continue
+                flat = gids * C + env[cname].astype(jnp.int32)
+                h = _segment.limb_einsum_sums(
+                    [mask.astype(jnp.float32)], flat, capacity * C
+                )
+                hists[cname] = h[0].astype(jnp.int64).reshape(
+                    capacity, C
+                )
+            new_states = []
+            for (out, arg_e, uda), st in zip(specs, states):
+                if (
+                    uda.cell_update is not None
+                    and isinstance(arg_e, ColumnRef)
+                    and arg_e.name in int_dict_names
+                ):
+                    if arg_e.name in hists:
+                        new_states.append(
+                            uda.cell_update(
+                                st,
+                                hists[arg_e.name],
+                                aux[f"intdict:{arg_e.name}"],
+                            )
+                        )
+                    else:
+                        lut = aux[f"intdict:{arg_e.name}"]
+                        vals = lut[env[arg_e.name].astype(jnp.int32)]
+                        new_states.append(
+                            uda.update(st, gids, vals, mask=mask)
+                        )
+                    continue
+                if out in fused_slices:
+                    a, b = fused_slices[out]
+                    new_states.append(uda.fused_apply(st, totals[a:b]))
+                    continue
+                if not uda.reads_args:
+                    # Column never read; gids is a shape-correct dummy.
+                    new_states.append(
+                        uda.update(st, gids, gids, mask=mask)
+                    )
+                    continue
+                new_states.append(
+                    uda.update(st, gids, eval_col(arg_e, uda), mask=mask)
+                )
+            return (tuple(new_states), presence), None
+
+        return body
+
+    def _merge_pack_outputs(self, specs, fin_modes, states, presence, ndev, axis):
+        """ICI merge + device finalize + single-buffer pack — the program
+        tail shared by the monolithic program and the streaming finish
+        program. One collective per UDA (the Kelvin step); on a 1-device
+        mesh every collective is the identity — skip them (some PJRT
+        backends only lower Sum all-reduces anyway)."""
+        if ndev == 1:
+            merged = list(states)
+        else:
+            presence = jax.lax.psum(presence, axis)
+            merged = []
+            for (out, _, uda), st in zip(specs, states):
+                if uda.merge_kind == MergeKind.PSUM:
+                    merged.append(jax.tree.map(
+                        lambda x: jax.lax.psum(x, axis), st
+                    ))
+                elif uda.merge_kind == MergeKind.PMAX:
+                    merged.append(jax.tree.map(
+                        lambda x: jax.lax.pmax(x, axis), st
+                    ))
+                elif uda.merge_kind == MergeKind.PMIN:
+                    merged.append(jax.tree.map(
+                        lambda x: jax.lax.pmin(x, axis), st
+                    ))
+                else:  # TREE: all_gather states, fold pairwise
+                    gathered = jax.tree.map(
+                        lambda x: jax.lax.all_gather(x, axis), st
+                    )
+                    acc = jax.tree.map(lambda x: x[0], gathered)
+                    for i2 in range(1, ndev):
+                        acc = uda.merge(
+                            acc, jax.tree.map(lambda x: x[i2], gathered)
+                        )
+                    merged.append(acc)
+        # Finalize on device where the UDA allows it, then pack every
+        # output/state leaf into ONE f64 buffer (ints ride exactly via
+        # bitcast) so the host pays a single device fetch per query —
+        # each fetch over a remote link costs ~100ms of round trip, and
+        # fusing finalize also kills the state re-upload the host
+        # quantile computation used to need.
+        outs = []
+        for mode, (_, _, uda), st in zip(fin_modes, specs, merged):
+            if mode == "devfin":
+                outs.append(uda.device_finalize(st))
+            elif mode == "fin":
+                outs.append(uda.finalize(st))
+            else:
+                outs.append(st)
+
+        def pack(x):
+            # int64 must survive exactly (hash codes use all 64 bits)
+            # but TPU bitcast s64<->f64 is broken; split into hi/lo
+            # 32-bit halves, each exactly representable in f64.
+            if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.bool_:
+                v = jnp.ravel(x).astype(jnp.int64)
+                hi = jnp.floor_divide(v, 1 << 32)
+                lo = v - hi * (1 << 32)
+                return jnp.concatenate(
+                    [hi.astype(jnp.float64), lo.astype(jnp.float64)]
+                )
+            return jnp.ravel(x).astype(jnp.float64)
+
+        parts = [pack(x) for x in jax.tree.leaves(tuple(outs))]
+        parts.append(pack(presence))
+        return jnp.concatenate(parts)
+
     def _build_program(
         self, m, specs, evaluator, key_plan, staged, aux_key_order, capacity
     ):
@@ -2324,19 +2587,11 @@ class MeshExecutor:
             end = -2 if narrow_names else -1
             narrow_vec = arrs[-2] if narrow_names else None
             aux = dict(zip(aux_key_order, arrs[i:end]))
-
-            def eval_gids(env, blk_mask):
-                if device_key is None:
-                    # mask always exists; a count-only query may stage NO
-                    # value columns at all.
-                    return jnp.zeros_like(blk_mask, dtype=jnp.int32)
-                if has_key_lut:
-                    _, src_col, _ = device_key
-                    return key_lut[jnp.maximum(env[src_col], 0)]
-                return evaluator.device_eval(device_key, env, aux).astype(
-                    jnp.int32
-                )
-
+            body = self._make_scan_body(
+                specs, evaluator, col_names, narrow_names, int_dict_names,
+                preds, device_key, has_key_lut, capacity, aux, narrow_vec,
+                key_lut, gid_base, has_host_gids,
+            )
             # Implicit presence counter: the host engine only emits observed
             # groups; without this, dictionary slots whose rows were all
             # filtered out (or expired) would surface as phantom zero rows.
@@ -2344,201 +2599,15 @@ class MeshExecutor:
                 tuple(uda.init(capacity) for _, _, uda in specs),
                 jnp.zeros(capacity, jnp.int64),
             )
-
-            def body(carry, xs):
-                from pixie_tpu.ops import segment as _segment
-
-                states, presence = carry
-                blk_cols, blk_mask, blk_gids = xs
-                env = dict(zip(col_names, blk_cols))
-                for ni, nm in enumerate(narrow_names):
-                    # Widen frame-of-reference narrowed columns (VPU cast
-                    # + add; the transfer savings dwarf this).
-                    env[nm] = env[nm].astype(jnp.int64) + narrow_vec[ni]
-                mask = blk_mask
-                for p in preds:
-                    mask = mask & evaluator.device_eval(p, env, aux)
-                gids = (
-                    blk_gids if gids_all is not None
-                    else eval_gids(env, blk_mask)
-                )
-                # This pass owns groups [gid_base, gid_base + capacity);
-                # rows outside it are masked and their updates land on a
-                # clipped (masked-out) slot.
-                gids = gids.astype(jnp.int32) - gid_base
-                mask = mask & (gids >= 0) & (gids < capacity)
-                gids = jnp.clip(gids, 0, capacity - 1)
-
-                def eval_col(arg_e, uda):
-                    col = evaluator.device_eval(arg_e, env, aux)
-                    hkey = (
-                        f"arghash:{arg_e.name}"
-                        if uda.string_args == "hash"
-                        and isinstance(arg_e, ColumnRef)
-                        else None
-                    )
-                    if hkey is not None and hkey in aux:
-                        lut = aux[hkey]
-                        col = lut[jnp.clip(col, 0, lut.shape[0] - 1)]
-                    return col
-
-                # Fused-sum lane: every sum-family UDA contributes f32 limb
-                # rows to ONE shared one-hot einsum (plus the engine's
-                # presence row) — the one-hot generation dominates MXU
-                # segment sums, so per-UDA calls pay it k+1 times (r4).
-                use_fused = _segment.matmul_strategy(capacity)
-                fused_slices: dict[str, tuple[int, int]] = {}
-                totals = None
-                if use_fused:
-                    rows = []
-                    for out, arg_e, uda in specs:
-                        if uda.fused_rows is None:
-                            continue
-                        if (
-                            uda.cell_update is not None
-                            and isinstance(arg_e, ColumnRef)
-                            and arg_e.name in int_dict_names
-                        ):
-                            continue  # cell lane serves it
-                        col = (
-                            eval_col(arg_e, uda) if uda.reads_args else None
-                        )
-                        r = uda.fused_rows(col, mask)
-                        fused_slices[out] = (len(rows), len(rows) + len(r))
-                        rows.extend(r)
-                    rows.append(mask.astype(jnp.float32))  # presence
-                    totals = _segment.limb_einsum_sums(rows, gids, capacity)
-                    presence = presence + totals[-1].astype(presence.dtype)
-                else:
-                    presence = presence + _segment.seg_count(
-                        gids, capacity, mask
-                    ).astype(presence.dtype)
-                # Cell lane: per-column (group, code) histograms via one
-                # MXU einsum each; cell-capable UDAs over int-dictionary
-                # columns update per CELL instead of per row (r5).
-                hists: dict[str, Any] = {}
-                for cname in int_dict_names:
-                    lut = aux[f"intdict:{cname}"]
-                    C = lut.shape[0]
-                    if capacity * C > _segment.MATMUL_MAX_SEGMENTS:
-                        # Cache reuse under a bigger pass capacity than
-                        # the staging's max_card assumed: histogram would
-                        # blow the einsum budget — row path (below) takes
-                        # over via a LUT gather instead.
-                        continue
-                    flat = gids * C + env[cname].astype(jnp.int32)
-                    h = _segment.limb_einsum_sums(
-                        [mask.astype(jnp.float32)], flat, capacity * C
-                    )
-                    hists[cname] = h[0].astype(jnp.int64).reshape(
-                        capacity, C
-                    )
-                new_states = []
-                for (out, arg_e, uda), st in zip(specs, states):
-                    if (
-                        uda.cell_update is not None
-                        and isinstance(arg_e, ColumnRef)
-                        and arg_e.name in int_dict_names
-                    ):
-                        if arg_e.name in hists:
-                            new_states.append(
-                                uda.cell_update(
-                                    st,
-                                    hists[arg_e.name],
-                                    aux[f"intdict:{arg_e.name}"],
-                                )
-                            )
-                        else:
-                            lut = aux[f"intdict:{arg_e.name}"]
-                            vals = lut[env[arg_e.name].astype(jnp.int32)]
-                            new_states.append(
-                                uda.update(st, gids, vals, mask=mask)
-                            )
-                        continue
-                    if out in fused_slices:
-                        a, b = fused_slices[out]
-                        new_states.append(uda.fused_apply(st, totals[a:b]))
-                        continue
-                    if not uda.reads_args:
-                        # Column never read; gids is a shape-correct dummy.
-                        new_states.append(
-                            uda.update(st, gids, gids, mask=mask)
-                        )
-                        continue
-                    new_states.append(
-                        uda.update(st, gids, eval_col(arg_e, uda), mask=mask)
-                    )
-                return (tuple(new_states), presence), None
-
             xs = (
                 tuple(cols[n] for n in col_names),
                 mask_all,
                 gids_all if gids_all is not None else mask_all,
             )
             (states, presence), _ = jax.lax.scan(body, init_states, xs)
-
-            # ICI merge: one collective per UDA (the Kelvin step). On a
-            # 1-device mesh every collective is the identity — skip them
-            # (some PJRT backends only lower Sum all-reduces anyway).
-            if ndev == 1:
-                merged = list(states)
-            else:
-                presence = jax.lax.psum(presence, axis)
-                merged = []
-                for (out, _, uda), st in zip(specs, states):
-                    if uda.merge_kind == MergeKind.PSUM:
-                        merged.append(jax.tree.map(
-                            lambda x: jax.lax.psum(x, axis), st
-                        ))
-                    elif uda.merge_kind == MergeKind.PMAX:
-                        merged.append(jax.tree.map(
-                            lambda x: jax.lax.pmax(x, axis), st
-                        ))
-                    elif uda.merge_kind == MergeKind.PMIN:
-                        merged.append(jax.tree.map(
-                            lambda x: jax.lax.pmin(x, axis), st
-                        ))
-                    else:  # TREE: all_gather states, fold pairwise
-                        gathered = jax.tree.map(
-                            lambda x: jax.lax.all_gather(x, axis), st
-                        )
-                        acc = jax.tree.map(lambda x: x[0], gathered)
-                        for i2 in range(1, ndev):
-                            acc = uda.merge(
-                                acc, jax.tree.map(lambda x: x[i2], gathered)
-                            )
-                        merged.append(acc)
-            # Finalize on device where the UDA allows it, then pack every
-            # output/state leaf into ONE f64 buffer (ints ride exactly via
-            # bitcast) so the host pays a single device fetch per query —
-            # each fetch over a remote link costs ~100ms of round trip, and
-            # fusing finalize also kills the state re-upload the host
-            # quantile computation used to need.
-            outs = []
-            for mode, (_, _, uda), st in zip(fin_modes, specs, merged):
-                if mode == "devfin":
-                    outs.append(uda.device_finalize(st))
-                elif mode == "fin":
-                    outs.append(uda.finalize(st))
-                else:
-                    outs.append(st)
-
-            def pack(x):
-                # int64 must survive exactly (hash codes use all 64 bits)
-                # but TPU bitcast s64<->f64 is broken; split into hi/lo
-                # 32-bit halves, each exactly representable in f64.
-                if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.bool_:
-                    v = jnp.ravel(x).astype(jnp.int64)
-                    hi = jnp.floor_divide(v, 1 << 32)
-                    lo = v - hi * (1 << 32)
-                    return jnp.concatenate(
-                        [hi.astype(jnp.float64), lo.astype(jnp.float64)]
-                    )
-                return jnp.ravel(x).astype(jnp.float64)
-
-            parts = [pack(x) for x in jax.tree.leaves(tuple(outs))]
-            parts.append(pack(presence))
-            return jnp.concatenate(parts)
+            return self._merge_pack_outputs(
+                specs, fin_modes, states, presence, ndev, axis
+            )
 
         n_sharded = len(col_names) + 1 + (1 if has_host_gids else 0)
         n_repl = (
@@ -2557,6 +2626,366 @@ class MeshExecutor:
                 **_SM_CHECK_KW,
             )
         )
+
+    # -- streamed double-buffered staging (r6) -------------------------------
+    # The monolithic path stages the WHOLE table in HBM before the first
+    # FLOP; the cold query is therefore pack + transfer + compute in
+    # sequence (572s of 613s in stage_transfer for the r5 config-1 shape).
+    # The streaming path splits the table into fixed row windows and runs a
+    # three-stage software pipeline — window k+2 host-packs on a background
+    # thread, window k+1 rides an async device_put, window k folds into the
+    # carried UDA states on the mesh — so end-to-end time approaches
+    # max(pack, transfer, compute) + one window of fill/drain. The fold
+    # reuses the exact per-block scan body of the monolithic program; the
+    # finish program applies the same collective-merge/finalize/pack tail.
+
+    def _state_template(self, specs, capacity):
+        """(treedef, leaf avals) of the fold carry (states tuple, presence)."""
+        avals = jax.eval_shape(
+            lambda: (
+                tuple(uda.init(capacity) for _, _, uda in specs),
+                jnp.zeros(capacity, jnp.int64),
+            )
+        )
+        leaves, treedef = jax.tree.flatten(avals)
+        return treedef, leaves
+
+    def _build_stream_init(self, specs, capacity):
+        """Identity states created ON the mesh with a leading device axis
+        (init == merge identity by UDA contract): each device folds its
+        own shard; the finish program merges over ICI."""
+        d = self.mesh.devices.size
+        (axis_name,) = self.mesh.axis_names
+        sharding = NamedSharding(self.mesh, P(axis_name))
+
+        def init():
+            st = (
+                tuple(uda.init(capacity) for _, _, uda in specs),
+                jnp.zeros(capacity, jnp.int64),
+            )
+            return [
+                jnp.broadcast_to(leaf[None], (d,) + leaf.shape)
+                for leaf in jax.tree.leaves(st)
+            ]
+
+        return jax.jit(init, out_shardings=sharding)
+
+    def _build_stream_fold(
+        self,
+        m,
+        specs,
+        evaluator,
+        key_plan,
+        col_names,
+        narrow_names,
+        int_dict_names,
+        aux_key_order,
+        capacity,
+        n_state_leaves,
+        treedef,
+    ):
+        """One window's fold: scan this window's blocks, return the updated
+        per-device states. No collectives — those wait for the finish
+        program, so every fold dispatch is device-local and async."""
+        axis = self.mesh.axis_names[0]
+        has_host_gids = key_plan.host_gids is not None
+        has_key_lut = isinstance(key_plan.device_expr, tuple)
+        device_key = key_plan.device_expr
+        preds = [
+            e for n, e in evaluator.named_exprs if n.startswith("pred")
+        ]
+
+        def shard_fn(*arrs):
+            # Layout: state leaves..., cols..., mask, [gids], [key_lut],
+            # aux..., [narrow_offsets], gid_base.
+            carry = jax.tree.unflatten(
+                treedef, [a[0] for a in arrs[:n_state_leaves]]
+            )
+            i = n_state_leaves
+            cols = {
+                n: a[0]
+                for n, a in zip(col_names, arrs[i : i + len(col_names)])
+            }
+            i += len(col_names)
+            mask_all = arrs[i][0]
+            i += 1
+            gids_all = None
+            if has_host_gids:
+                gids_all = arrs[i][0]
+                i += 1
+            key_lut = None
+            if has_key_lut:
+                key_lut = arrs[i]
+                i += 1
+            gid_base = arrs[-1]
+            end = -2 if narrow_names else -1
+            narrow_vec = arrs[-2] if narrow_names else None
+            aux = dict(zip(aux_key_order, arrs[i:end]))
+            body = self._make_scan_body(
+                specs, evaluator, col_names, narrow_names, int_dict_names,
+                preds, device_key, has_key_lut, capacity, aux, narrow_vec,
+                key_lut, gid_base, has_host_gids,
+            )
+            xs = (
+                tuple(cols[n] for n in col_names),
+                mask_all,
+                gids_all if gids_all is not None else mask_all,
+            )
+            carry, _ = jax.lax.scan(body, carry, xs)
+            return tuple(leaf[None] for leaf in jax.tree.leaves(carry))
+
+        n_sharded = (
+            n_state_leaves + len(col_names) + 1 + (1 if has_host_gids else 0)
+        )
+        n_repl = (
+            (1 if has_key_lut else 0)
+            + len(aux_key_order)
+            + (1 if narrow_names else 0)
+            + 1  # +gid_base
+        )
+        in_specs = tuple([P(axis)] * n_sharded + [P()] * n_repl)
+        out_specs = tuple([P(axis)] * n_state_leaves)
+        return jax.jit(
+            shard_map(
+                shard_fn,
+                mesh=self.mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                **_SM_CHECK_KW,
+            )
+        )
+
+    def _build_stream_finish(self, m, specs, capacity, n_state_leaves, treedef):
+        """The drained pipeline's tail: collective-merge the per-device
+        states, finalize, pack into the single fetched buffer — identical
+        to the monolithic program's ending."""
+        axis = self.mesh.axis_names[0]
+        ndev = self.mesh.devices.size
+        fin_modes, _ = self._finalize_modes(
+            specs, capacity, m.agg_op.stage == AggStage.PARTIAL
+        )
+
+        def shard_fn(*arrs):
+            states, presence = jax.tree.unflatten(
+                treedef, [a[0] for a in arrs]
+            )
+            return self._merge_pack_outputs(
+                specs, fin_modes, states, presence, ndev, axis
+            )
+
+        in_specs = tuple([P(axis)] * n_state_leaves)
+        return jax.jit(
+            shard_map(
+                shard_fn,
+                mesh=self.mesh,
+                in_specs=in_specs,
+                out_specs=P(),
+                **_SM_CHECK_KW,
+            )
+        )
+
+    def _stream_execute(
+        self, m, specs, evaluator, key_plan, table, cols, n,
+        f32_cols, cell_cols, aux, cacheable,
+    ):
+        """Streamed staging + window fold. Returns (merged, capacity,
+        staged_for_cache|None), or None when gated off or on failure (the
+        caller then falls back to monolithic staging, still on-device)."""
+        try:
+            return self._stream_execute_inner(
+                m, specs, evaluator, key_plan, table, cols, n,
+                f32_cols, cell_cols, aux, cacheable,
+            )
+        except Exception as e:
+            import logging
+            import traceback
+
+            key = f"{type(e).__name__}: {e}"
+            if key not in self.stream_fallback_errors:
+                self.stream_fallback_errors[key] = traceback.format_exc()
+                logging.getLogger("pixie_tpu.parallel").warning(
+                    "streaming stage failed, falling back to monolithic "
+                    "staging: %s",
+                    key,
+                )
+            return None
+
+    def _stream_execute_inner(
+        self, m, specs, evaluator, key_plan, table, cols, n,
+        f32_cols, cell_cols, aux, cacheable,
+    ):
+        import concurrent.futures
+        import types as _types
+
+        from pixie_tpu.ops import segment as _segment
+        from pixie_tpu.parallel import staging as _staging
+
+        capacity, n_passes = self._pass_plan(specs, key_plan.num_groups)
+        if n_passes != 1:
+            # Multi-pass gid windows re-scan the staged blocks once per
+            # pass: they need HBM-resident blocks, not a stream.
+            return None
+        plan = _staging.plan_stream(
+            self.mesh,
+            cols,
+            n,
+            flags.streaming_window_rows,
+            block_rows=self.block_rows,
+            f32_cols=f32_cols,
+            cell_cols=cell_cols,
+            num_groups=max(key_plan.num_groups, 1),
+            has_gids=key_plan.host_gids is not None,
+        )
+        aux = dict(aux)  # int-dict LUTs are stream-local; keep caller's aux clean
+        for n2 in sorted(plan.int_dicts):
+            aux[f"intdict:{n2}"] = np.asarray(plan.int_dicts[n2])
+        aux_vals = list(aux.values())
+        aux_key_order = list(aux.keys())
+        col_names = sorted(cols)
+        narrow_names = sorted(plan.narrow_offsets)
+        # Program identity: the monolithic signature over the WINDOW
+        # geometry (every window shares it by construction).
+        shim = _types.SimpleNamespace(
+            blocks={
+                name: _types.SimpleNamespace(
+                    shape=(plan.d, plan.nblk, plan.b),
+                    dtype=plan.block_dtypes[name],
+                )
+                for name in col_names
+            },
+            mask=_types.SimpleNamespace(shape=(plan.d, plan.nblk, plan.b)),
+            narrow_offsets=plan.narrow_offsets,
+            int_dicts=plan.int_dicts,
+        )
+        sig = "stream|" + self._signature(
+            m, specs, key_plan, shim, aux_vals, capacity
+        )
+        treedef, leaves = self._state_template(specs, capacity)
+        entry = self._program_cache.get(sig)
+        if entry is None or entry[1] != len(aux_vals):
+            programs = (
+                self._build_stream_init(specs, capacity),
+                self._build_stream_fold(
+                    m, specs, evaluator, key_plan, col_names, narrow_names,
+                    sorted(plan.int_dicts), aux_key_order, capacity,
+                    len(leaves), treedef,
+                ),
+                self._build_stream_finish(
+                    m, specs, capacity, len(leaves), treedef
+                ),
+            )
+            _, templates = self._finalize_modes(
+                specs, capacity, m.agg_op.stage == AggStage.PARTIAL
+            )
+            self._program_cache[sig] = (programs, len(aux_vals), templates)
+            _PROGRAMS.set(len(self._program_cache))
+        (init_p, fold_p, finish_p), _, templates = self._program_cache[sig]
+
+        (axis_name,) = self.mesh.axis_names
+        sharding = NamedSharding(self.mesh, P(axis_name))
+        has_host_gids = key_plan.host_gids is not None
+        extra_args = []  # constant across windows: key LUT, aux, narrow
+        if isinstance(key_plan.device_expr, tuple):
+            extra_args.append(jnp.asarray(key_plan.device_expr[2]))
+        extra_args.extend(jnp.asarray(v) for v in aux_vals)
+        if plan.narrow_offsets:
+            extra_args.append(
+                jnp.asarray(
+                    [plan.narrow_offsets[n2] for n2 in narrow_names],
+                    jnp.int64,
+                )
+            )
+        gid_base = jnp.int32(0)  # single pass (gated above)
+        gids = key_plan.host_gids
+
+        def prof(key, dt):
+            COLD_PROFILE[key] = COLD_PROFILE.get(key, 0.0) + dt
+
+        win_blocks: list = []
+        win_masks: list = []
+        win_gids: list = []
+        inflight: "collections.deque" = collections.deque()
+        t_wall0 = time.perf_counter()
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="stream-pack"
+        )
+        try:
+            with _segment.platform_hint(self.mesh.devices.flat[0].platform):
+                flat_state = list(init_p())
+                fut = pool.submit(
+                    _staging.pack_stream_window, plan, cols, gids, 0
+                )
+                for w in range(plan.n_windows):
+                    t0 = time.perf_counter()
+                    rows, packed, pgids, nbytes = fut.result()
+                    prof("stage_stream_pack_wait", time.perf_counter() - t0)
+                    if w + 1 < plan.n_windows:
+                        # Window w+1 packs on the background thread while
+                        # window w transfers and folds.
+                        fut = pool.submit(
+                            _staging.pack_stream_window,
+                            plan, cols, gids, w + 1,
+                        )
+                    t0 = time.perf_counter()
+                    dev_cols = {
+                        n2: jax.device_put(packed[n2], sharding)
+                        for n2 in col_names
+                    }
+                    mask = _staging._build_mask(
+                        self.mesh, plan.d, plan.nblk, plan.b, rows
+                    )
+                    dev_g = (
+                        jax.device_put(pgids, sharding)
+                        if pgids is not None
+                        else None
+                    )
+                    prof("stage_stream_put", time.perf_counter() - t0)
+                    prof("stage_bytes", float(nbytes))
+                    args = list(flat_state)
+                    args.extend(dev_cols[n2] for n2 in col_names)
+                    args.append(mask)
+                    if has_host_gids:
+                        args.append(dev_g)
+                    args.extend(extra_args)
+                    args.append(gid_base)
+                    t0 = time.perf_counter()
+                    flat_state = list(fold_p(*args))
+                    prof("stage_stream_dispatch", time.perf_counter() - t0)
+                    if cacheable:
+                        win_blocks.append(dev_cols)
+                        win_masks.append(mask)
+                        win_gids.append(dev_g)
+                    # Double-buffer backpressure: block on window k-2's
+                    # fold so at most two windows are in flight (one
+                    # transferring, one packing) — bounds host-pinned
+                    # buffers and the device transfer queue.
+                    inflight.append(flat_state[-1])
+                    if len(inflight) > 2:
+                        t0 = time.perf_counter()
+                        jax.block_until_ready(inflight.popleft())
+                        prof(
+                            "stage_stream_compute_wait",
+                            time.perf_counter() - t0,
+                        )
+                t0 = time.perf_counter()
+                buf = finish_p(*flat_state)
+                merged = self._unpack_outputs(templates, capacity, buf)
+                prof("stage_stream_drain", time.perf_counter() - t0)
+        finally:
+            pool.shutdown(wait=True)
+            prof("stage_overlap", time.perf_counter() - t_wall0)
+            prof("stream_windows", float(plan.n_windows))
+        staged_for_cache = None
+        if cacheable:
+            # Concatenate the windows into one monolithic staging so warm
+            # queries hit HBM directly (same contract as stage_columns).
+            with _timed("stage_concat"):
+                staged_for_cache = _staging.concat_stream_windows(
+                    self.mesh, plan, win_blocks, win_masks, win_gids,
+                    key_plan.num_groups, key_plan.key_columns,
+                    table.dictionaries,
+                )
+        return merged, capacity, staged_for_cache
 
     @staticmethod
     def _unpack_outputs(templates, capacity, buf):

@@ -276,27 +276,22 @@ def stage_columns(
                     narrow_offsets[name] = off
             packed = shape3(a, 0)
         with timed("stage_transfer"):
+            # device_put is async on local backends; do NOT block per
+            # column — that serializes transfers behind each other and
+            # behind the next column's host pack. One sync below, after
+            # every put is in flight (the PJRT runtime retains the host
+            # buffer until its transfer completes).
             blocks[name] = jax.device_put(packed, sharding)
-            # device_put is async on local backends: block so the
-            # breakdown attributes transfer time here, not to the first
-            # program execution. (On the tunneled axon backend the put
-            # itself streams synchronously.)
-            jax.block_until_ready(blocks[name])
             COLD_PROFILE["stage_bytes"] = COLD_PROFILE.get(
                 "stage_bytes", 0.0
             ) + float(packed.nbytes)
+    with timed("stage_transfer"):
+        if blocks:
+            jax.block_until_ready(list(blocks.values()))
     mask_dev = _build_mask(mesh, d, nblk, b, num_rows)
     gids_dev = None
     if gids is not None:
-        # gids are dense [0, num_groups): ship u8/u16 when they fit (the
-        # compiled programs cast to int32 per block anyway).
-        if num_groups <= 0xFF + 1:
-            g = gids.astype(np.uint8)
-        elif num_groups <= 0xFFFF + 1:
-            g = gids.astype(np.uint16)
-        else:
-            g = gids.astype(np.int32)
-        gids_dev = jax.device_put(shape3(g, 0), sharding)
+        gids_dev = jax.device_put(shape3(_narrow_gids(gids, num_groups), 0), sharding)
     return StagedColumns(
         blocks=blocks,
         mask=mask_dev,
@@ -310,4 +305,258 @@ def stage_columns(
         dictionaries=dict(dictionaries or {}),
         narrow_offsets=narrow_offsets,
         int_dicts=dict(int_dicts or {}),
+    )
+
+
+def _narrow_gids(gids: np.ndarray, num_groups: int) -> np.ndarray:
+    """Dense gids ship u8/u16 when the group count fits (the compiled
+    programs cast to int32 per block anyway)."""
+    if num_groups <= 0xFF + 1:
+        return gids.astype(np.uint8)
+    if num_groups <= 0xFFFF + 1:
+        return gids.astype(np.uint16)
+    return gids.astype(np.int32)
+
+
+# -- streaming, double-buffered staging (the r6 cold-path pipeline) ----------
+#
+# The monolithic path above materializes the WHOLE table in HBM before the
+# first FLOP; at bench scale the cold query is therefore ≈ pack + transfer +
+# compute in sequence. The streaming path splits the table into fixed-size
+# row windows and runs a three-stage software pipeline: window k+2 is
+# host-packed on a background thread, window k+1 is in flight via async
+# jax.device_put, and window k is being folded on the mesh — end-to-end
+# time becomes ≈ max(pack, transfer, compute) + one window of fill/drain.
+# Every window shares one pack recipe (dtypes/offsets/LUTs fixed from the
+# FULL columns) so a single compiled fold program serves them all.
+
+
+@dataclasses.dataclass
+class StreamPlan:
+    """Per-column pack recipe + window geometry, fixed across windows.
+
+    col_plans[name] is one of ("raw", None), ("f32", None),
+    ("narrow", (np_dtype, offset)), ("intdict", (lut, np_dtype)). The
+    recipe is derived from the FULL host columns once, so every window's
+    blocks share dtypes and shapes — required for one compiled fold
+    program to serve all windows, and for the post-stream concatenation
+    to be a valid monolithic staging."""
+
+    col_plans: dict
+    narrow_offsets: dict  # name -> int offset (frame-of-reference)
+    int_dicts: dict  # name -> [C] int64 value LUT
+    block_dtypes: dict  # name -> np.dtype of the staged blocks
+    window_rows: int
+    num_rows: int
+    n_windows: int
+    d: int
+    nblk: int  # blocks per window per device
+    b: int
+    gid_dtype: Optional[np.dtype]
+    num_groups: int
+
+
+def int_dict_lut(arr: np.ndarray, max_card: int) -> Optional[np.ndarray]:
+    """LUT-only variant of int_dict_encode: the sorted value LUT when the
+    column's FULL value set fits max_card, else None. Verified over the
+    whole column, so per-window searchsorted encodes against it are exact
+    (the per-window encode is what rides the background pack thread)."""
+    enc = int_dict_encode(arr, max_card)
+    return None if enc is None else enc[1]
+
+
+def _narrow_int_plan(arr: np.ndarray) -> tuple[np.dtype, Optional[int]]:
+    """_narrow_int's decision without the conversion: (dtype, offset) —
+    offset None means ship as-is. Computed once over the full column so
+    every window narrows identically (stable block dtypes)."""
+    if arr.size == 0 or arr.dtype not in (np.int64, np.int32):
+        return arr.dtype, None
+    lo = int(arr.min())
+    rng = int(arr.max()) - lo
+    if rng <= 0xFF:
+        return np.dtype(np.uint8), lo
+    if rng <= 0xFFFF:
+        return np.dtype(np.uint16), lo
+    if arr.dtype == np.int64 and rng < (1 << 31):
+        return np.dtype(np.int32), lo
+    return arr.dtype, None
+
+
+def plan_stream(
+    mesh: Mesh,
+    cols: dict[str, np.ndarray],
+    num_rows: int,
+    window_rows: int,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    f32_cols: Optional[set] = None,
+    cell_cols: Optional[dict] = None,
+    num_groups: int = 1,
+    has_gids: bool = False,
+) -> StreamPlan:
+    """Fix the pack recipe + window geometry for a streamed staging.
+
+    window_rows is clamped to the table so a small table (or a huge
+    window flag) degenerates to ONE window whose geometry matches what
+    stage_columns would have chosen — the fold then reproduces the
+    monolithic scan bit-for-bit."""
+    d = mesh.devices.size
+    window_rows = max(min(int(window_rows), max(num_rows, 1)), 1)
+    n_windows = max((num_rows + window_rows - 1) // window_rows, 1)
+    b = min(block_rows, _pow2_at_least(max(window_rows // d, 1), floor=256))
+    nblk = max((window_rows + d * b - 1) // (d * b), 1)
+    col_plans: dict = {}
+    narrow_offsets: dict = {}
+    int_dicts: dict = {}
+    block_dtypes: dict = {}
+    for name, a in cols.items():
+        if cell_cols and name in cell_cols:
+            lut = int_dict_lut(a, cell_cols[name])
+            if lut is not None:
+                dt = np.dtype(np.uint8 if len(lut) <= 256 else np.uint16)
+                col_plans[name] = ("intdict", (lut, dt))
+                int_dicts[name] = lut
+                block_dtypes[name] = dt
+                continue
+        if f32_cols and name in f32_cols and a.dtype == np.float64:
+            col_plans[name] = ("f32", None)
+            block_dtypes[name] = np.dtype(np.float32)
+            continue
+        dt, off = _narrow_int_plan(a)
+        if off is not None:
+            col_plans[name] = ("narrow", (dt, off))
+            narrow_offsets[name] = off
+            block_dtypes[name] = dt
+        else:
+            col_plans[name] = ("raw", None)
+            block_dtypes[name] = (
+                np.dtype(a.dtype) if a.size else np.dtype(np.int32)
+            )
+    gid_dtype = None
+    if has_gids:
+        gid_dtype = np.dtype(
+            np.uint8
+            if num_groups <= 0xFF + 1
+            else (np.uint16 if num_groups <= 0xFFFF + 1 else np.int32)
+        )
+    return StreamPlan(
+        col_plans=col_plans,
+        narrow_offsets=narrow_offsets,
+        int_dicts=int_dicts,
+        block_dtypes=block_dtypes,
+        window_rows=window_rows,
+        num_rows=num_rows,
+        n_windows=n_windows,
+        d=d,
+        nblk=nblk,
+        b=b,
+        gid_dtype=gid_dtype,
+        num_groups=num_groups,
+    )
+
+
+def pack_stream_window(
+    plan: StreamPlan,
+    cols: dict[str, np.ndarray],
+    gids: Optional[np.ndarray],
+    w: int,
+):
+    """Host-pack window w per the plan: narrow/f32/int-dict encode + pad +
+    reshape to [D, nblk, B]. Runs on the streaming pipeline's background
+    thread — this is the 'pack' stage that overlaps transfer and compute.
+    Returns (rows, packed_cols, packed_gids, nbytes)."""
+    with timed("stage_stream_pack"):
+        lo = w * plan.window_rows
+        hi = min(lo + plan.window_rows, plan.num_rows)
+        rows = hi - lo
+        total = plan.d * plan.nblk * plan.b
+
+        def shape3(a, dtype):
+            # np.empty + tail-zero, not np.zeros: the rows prefix is about
+            # to be overwritten anyway, and this pack is on the pipeline's
+            # critical path when pack is the slowest stage.
+            out = np.empty(total, dtype=dtype)
+            out[:rows] = a
+            if rows < total:
+                out[rows:] = 0
+            return out.reshape(plan.d, plan.nblk, plan.b)
+
+        packed: dict[str, np.ndarray] = {}
+        nbytes = 0
+        for name, arr in cols.items():
+            a = arr[lo:hi]
+            kind, info = plan.col_plans[name]
+            if kind == "f32":
+                a = a.astype(np.float32)
+            elif kind == "narrow":
+                dt, off = info
+                a = (a - off).astype(dt)
+            elif kind == "intdict":
+                lut, dt = info
+                c = np.searchsorted(lut, a)
+                a = np.minimum(c, len(lut) - 1).astype(dt)
+            packed[name] = shape3(a, plan.block_dtypes[name])
+            nbytes += packed[name].nbytes
+        packed_gids = None
+        if gids is not None:
+            packed_gids = shape3(
+                gids[lo:hi].astype(plan.gid_dtype), plan.gid_dtype
+            )
+            nbytes += packed_gids.nbytes
+        return rows, packed, packed_gids, nbytes
+
+
+@functools.lru_cache(maxsize=16)
+def _concat_builder(mesh: Mesh, n_parts: int):
+    """Jitted device-side concatenation along the block axis, sharding
+    preserved (device-local copies; no collective). Used to assemble the
+    streamed windows into one monolithic StagedColumns for the warm-path
+    HBM cache."""
+    (axis_name,) = mesh.axis_names
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.jit(
+        lambda *xs: jnp.concatenate(xs, axis=1), out_shardings=sharding
+    )
+
+
+def concat_stream_windows(
+    mesh: Mesh,
+    plan: StreamPlan,
+    win_blocks: list,
+    win_masks: list,
+    win_gids: list,
+    key_plan_num_groups: int,
+    key_columns: list,
+    dictionaries: dict,
+) -> StagedColumns:
+    """Assemble per-window device blocks into one StagedColumns so warm
+    queries hit HBM directly (same contract as stage_columns; the row
+    layout is per-window-packed, which the per-window masks encode)."""
+    n_windows = len(win_masks)
+    if n_windows == 1:
+        blocks = dict(win_blocks[0])
+        mask = win_masks[0]
+        gids = win_gids[0]
+    else:
+        cat = _concat_builder(mesh, n_windows)
+        blocks = {
+            name: cat(*[wb[name] for wb in win_blocks])
+            for name in win_blocks[0]
+        }
+        mask = cat(*win_masks)
+        gids = (
+            cat(*win_gids) if win_gids and win_gids[0] is not None else None
+        )
+    return StagedColumns(
+        blocks=blocks,
+        mask=mask,
+        gids=gids,
+        num_rows=plan.num_rows,
+        num_devices=plan.d,
+        block_rows=plan.b,
+        num_groups=max(key_plan_num_groups, 1),
+        capacity=_pow2_at_least(max(key_plan_num_groups, 1)),
+        key_columns=list(key_columns or []),
+        dictionaries=dict(dictionaries or {}),
+        narrow_offsets=dict(plan.narrow_offsets),
+        int_dicts=dict(plan.int_dicts),
     )

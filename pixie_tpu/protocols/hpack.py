@@ -276,7 +276,10 @@ class Decoder:
                 out.append((name, value))
             elif b & 0x20:  # dynamic table size update
                 size, pos = self._read_int(block, pos, 5)
-                self.max_size = size
+                # RFC 7541 §4.2 bounds updates by SETTINGS_HEADER_TABLE_SIZE;
+                # clamp so captured hostile traffic cannot grow a
+                # per-connection decoder's table memory without bound.
+                self.max_size = min(size, 1 << 16)
                 self._evict()
             else:  # literal without indexing / never indexed (0000/0001)
                 index, pos = self._read_int(block, pos, 4)

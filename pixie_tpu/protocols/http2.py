@@ -263,4 +263,10 @@ class Http2Parser(base.ProtocolParser):
             errors += len(resp_keep) - 128
             resp_keep = resp_keep[-128:]
         req_keep = [r for r in requests if id(r) not in used_reqs]
+        # Same bound for unmatched REQUESTS (oldest-first eviction, counted
+        # as errors): a long-lived connection whose response direction is
+        # lost to capture gaps must not accumulate half-streams until close.
+        if len(req_keep) > 128:
+            errors += len(req_keep) - 128
+            req_keep = req_keep[-128:]
         return records, errors, req_keep, resp_keep

@@ -18,6 +18,14 @@ from pixie_tpu.protocols.base import MessageType, ParseState
 
 _MARKERS = b"+-:$*"
 
+# RESP arrays nest recursively; real traffic nests a handful of levels
+# (commands, pub/sub pushes, EXEC results). A hostile buffer of repeated
+# b"*1\r\n" would otherwise recurse once per level and raise
+# RecursionError PAST parse_frame, aborting the socket tracer's sample
+# loop forever (the poisoned buffer is never consumed). Cap the depth and
+# reject as INVALID so resync can discard the garbage (ADVICE r5).
+_MAX_NESTING = 32
+
 # Two-word Redis commands (ref: cmd_args.cc kCmdList two-token entries) —
 # enough to format the common surface; unknown commands fall back to
 # first-token-is-the-command.
@@ -66,8 +74,11 @@ def _read_line(buf: bytes, pos: int) -> tuple[bytes, int]:
     return buf[pos:end], end + 2
 
 
-def _parse_value(buf: bytes, pos: int):
-    """Recursive RESP value parse -> (python value, new pos)."""
+def _parse_value(buf: bytes, pos: int, depth: int = 0):
+    """Recursive RESP value parse -> (python value, new pos). Nesting is
+    bounded by _MAX_NESTING (hostile-input guard, see above)."""
+    if depth > _MAX_NESTING:
+        raise _Invalid()
     if pos >= len(buf):
         raise _NeedsMore()
     marker = buf[pos : pos + 1]
@@ -97,7 +108,7 @@ def _parse_value(buf: bytes, pos: int):
         return None, pos  # null array
     items = []
     for _ in range(n):
-        item, pos = _parse_value(buf, pos)
+        item, pos = _parse_value(buf, pos, depth + 1)
         items.append(item)
     return items, pos
 
@@ -140,7 +151,10 @@ class RedisParser(base.ProtocolParser):
             value, pos = _parse_value(buf, 0)
         except _NeedsMore:
             return ParseState.NEEDS_MORE_DATA, 0, None
-        except _Invalid:
+        except (_Invalid, RecursionError):
+            # RecursionError is belt-and-braces under the _MAX_NESTING cap:
+            # it must map to INVALID (not escape) or one hostile buffer
+            # permanently starves the sample loop.
             return ParseState.INVALID, 0, None
         msg = Message(type=msg_type)
         if msg_type == MessageType.REQUEST:

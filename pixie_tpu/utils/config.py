@@ -107,6 +107,21 @@ define_flag(
     help_="Rows per staged device block (parallel/staging.py).",
 )
 define_flag(
+    "streaming_stage",
+    True,
+    help_="Stream cold-path staging as a double-buffered window pipeline "
+    "(host pack ∥ HBM transfer ∥ device fold) instead of materializing "
+    "the whole table in HBM before the first FLOP (MeshExecutor). The "
+    "monolithic path remains the fallback (multi-pass group windows, "
+    "streaming failures) and still serves warm cache hits.",
+)
+define_flag(
+    "streaming_window_rows",
+    1 << 23,
+    help_="Rows per streamed staging window (clamped to the table size; "
+    "a single-window stream reproduces the monolithic geometry exactly).",
+)
+define_flag(
     "staged_cache_cap",
     4,
     help_="LRU capacity of HBM-resident staged tables (MeshExecutor).",

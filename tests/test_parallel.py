@@ -458,7 +458,19 @@ def test_mesh_staged_superset_reuse(mesh):
 
 def test_stage_oom_retry_policy(mesh):
     """Only resource-exhausted staging failures clear the cache and retry;
-    deterministic errors propagate without nuking other tables' staging."""
+    deterministic errors propagate without nuking other tables' staging.
+    (Monolithic-path policy: streaming_stage is pinned off — the streamed
+    path would answer the query without ever calling _stage.)"""
+    from pixie_tpu.utils import flags
+
+    flags.set("streaming_stage", False)
+    try:
+        _run_stage_oom_retry(mesh)
+    finally:
+        flags.reset("streaming_stage")
+
+
+def _run_stage_oom_retry(mesh):
     ex = MeshExecutor(mesh=mesh, block_rows=1024)
     cd, data = seed_carnot(ex)
     cd.execute_query(SERVICE_STATS_PXL)

@@ -489,3 +489,65 @@ def test_mysql_execute_null_params_and_reuse():
     t.add_recv(4 + len(prep_ok) + 4 + len(ok), _mypkt(1, ok), 600)
     recs = t.process_to_records()
     assert recs[0].req_text == "INSERT INTO t VALUES (99)"
+
+
+# -- r6 hostile-input hardening ----------------------------------------------
+
+
+def test_redis_deep_nesting_rejected_not_crashing():
+    """~4KB of b'*1\\r\\n' used to recurse once per level and raise
+    RecursionError PAST parse_frame, permanently starving the sample loop
+    (the poisoned buffer was never consumed). Depth is now capped and the
+    frame rejected as INVALID so resync can discard it."""
+    p = redis.RedisParser()
+    hostile = b"*1\r\n" * 1000 + b":1\r\n"
+    state, consumed, msg = p.parse_frame(MessageType.RESPONSE, hostile)
+    assert state == ParseState.INVALID
+    # Modest nesting (a transaction of arrays) still parses.
+    ok = b"*1\r\n" * 8 + b":1\r\n"
+    state, consumed, _ = p.parse_frame(MessageType.RESPONSE, ok)
+    assert state == ParseState.SUCCESS and consumed == len(ok)
+
+
+def test_hpack_dynamic_size_update_clamped():
+    """RFC 7541 bounds size updates by SETTINGS_HEADER_TABLE_SIZE; an
+    attacker-supplied update must not grow the decoder's table without
+    bound."""
+    d = hpack.Decoder()
+    # 0x3F starts a 5-bit-prefix varint (value 31 + continuation); pick a
+    # ~1GB update.
+    huge = (1 << 30) - 31
+    block = bytes([0x3F])
+    while True:
+        if huge < 0x80:
+            block += bytes([huge])
+            break
+        block += bytes([0x80 | (huge & 0x7F)])
+        huge >>= 7
+    d.decode(block)
+    assert d.max_size <= 1 << 16
+    # In-bounds updates still apply exactly.
+    d2 = hpack.Decoder()
+    d2.decode(bytes([0x20 | 17]))
+    assert d2.max_size == 17
+
+
+def test_http2_stitch_bounds_unmatched_requests():
+    """Unmatched request half-streams are capped at 128 oldest-first
+    (mirroring the response bound): a connection whose response direction
+    is lost to capture gaps must not accumulate requests until close."""
+    from pixie_tpu.protocols.http import Message
+
+    p = http2.Http2Parser()
+    reqs = []
+    for i in range(300):
+        m = Message(type=MessageType.REQUEST, timestamp_ns=i)
+        m.headers = {"__stream_id__": str(i)}
+        reqs.append(m)
+    records, errors, req_keep, resp_keep = p.stitch(reqs, [])
+    assert not records and not resp_keep
+    assert len(req_keep) == 128
+    assert errors == 300 - 128
+    # newest (highest stream id) survive
+    assert req_keep[0].headers["__stream_id__"] == "172"
+    assert req_keep[-1].headers["__stream_id__"] == "299"

@@ -1,0 +1,197 @@
+"""Stage-overlap microbench: monolithic vs streamed cold staging.
+
+Runs the service_stats-class query cold twice over the same table — once
+with streaming_stage off (monolithic: pack + transfer + compute in
+sequence) and once on (double-buffered window pipeline) — and reports
+per-window pack/transfer/compute occupancy so overlap regressions are
+visible in future rounds. Occupancy is what the breakdown keys measure:
+
+  stage_stream_pack          background-thread host-pack busy time
+  stage_stream_pack_wait     main thread stalled waiting for a pack
+  stage_stream_put           device_put dispatch/stream time
+  stage_stream_dispatch      fold dispatch time
+  stage_stream_compute_wait  backpressure blocks on window k-2's fold
+  stage_stream_drain         final merge/finalize/fetch
+  stage_overlap              wall time of the whole overlapped loop
+
+A healthy pipeline has stage_overlap ≈ max(pack, put, compute) + one
+window of fill/drain; pack_wait ≈ pack - overlap-won time. Prints ONE
+JSON line on stdout.
+
+Env knobs: MB_ROWS (default 4M), MB_WINDOW_ROWS (default 1<<19),
+MB_BLOCK_ROWS (default 1<<17), MB_SERVICES (default 16), JAX_PLATFORMS.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    n_rows = int(os.environ.get("MB_ROWS", 4_000_000))
+    window_rows = int(os.environ.get("MB_WINDOW_ROWS", 1 << 19))
+    block_rows = int(os.environ.get("MB_BLOCK_ROWS", 1 << 17))
+    n_services = int(os.environ.get("MB_SERVICES", 16))
+
+    import jax
+    from jax.sharding import Mesh
+
+    from pixie_tpu.engine import Carnot
+    from pixie_tpu.parallel import MeshExecutor
+    from pixie_tpu.parallel.staging import reset_cold_profile
+    from pixie_tpu.table.column import DictColumn
+    from pixie_tpu.types import DataType, Relation, SemanticType
+    from pixie_tpu.utils import flags
+
+    F, I, S, T = (
+        DataType.FLOAT64,
+        DataType.INT64,
+        DataType.STRING,
+        DataType.TIME64NS,
+    )
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("d",))
+    carnot = Carnot()
+    rel = Relation.of(
+        ("time_", T, SemanticType.ST_TIME_NS),
+        ("service", S, SemanticType.ST_SERVICE_NAME),
+        ("resp_status", I),
+        ("latency", F, SemanticType.ST_DURATION_NS),
+    )
+    table = carnot.table_store.create_table(
+        "http_events", rel, size_limit=1 << 42
+    )
+    svc_dict = table.dictionaries["service"]
+    for i in range(n_services):
+        svc_dict.get_code(f"ns/svc-{i}")
+    rng = np.random.default_rng(42)
+    t0 = time.perf_counter()
+    chunk = 4_000_000
+    for off in range(0, n_rows, chunk):
+        m = min(chunk, n_rows - off)
+        table.write_pydict(
+            {
+                "time_": np.arange(off, off + m, dtype=np.int64) * 1000,
+                "service": DictColumn(
+                    rng.integers(0, n_services, m, dtype=np.uint8).astype(
+                        np.int32
+                    ),
+                    svc_dict,
+                ),
+                "resp_status": rng.choice(
+                    np.array([200, 301, 404, 500], np.int64), m
+                ),
+                "latency": rng.exponential(3e7, m),
+            }
+        )
+    table.compact()
+    table.stop()
+    log(f"table built: {n_rows} rows in {time.perf_counter() - t0:.1f}s")
+
+    # MB_QUERY=stats (config-2 shape) | sketch (config-5 shape: f32-staged
+    # t-digest arg + int-dict count-min column — the stage-dominated cold).
+    queries = {
+        "stats": (
+            "df = px.DataFrame(table='http_events')\n"
+            "df.failure = df.resp_status >= 400\n"
+            "stats = df.groupby(['service']).agg(\n"
+            "    throughput=('time_', px.count),\n"
+            "    error_rate=('failure', px.mean),\n"
+            "    latency=('latency', px.quantiles),\n"
+            ")\n"
+            "px.display(stats, 'service_stats')\n"
+        ),
+        "sketch": (
+            "df = px.DataFrame(table='http_events')\n"
+            "stats = df.groupby(['service']).agg(\n"
+            "    lat=('latency', px.quantiles_tdigest),\n"
+            "    freq=('resp_status', px.count_min),\n"
+            "    throughput=('time_', px.count),\n"
+            ")\n"
+            "px.display(stats, 'service_stats')\n"
+        ),
+    }
+    query = queries[os.environ.get("MB_QUERY", "stats")]
+
+    def cold(streaming: bool):
+        """Staging-bound cold: programs are warmed first, then the staged
+        cache is dropped so the measured run pays read+pack+transfer+
+        execute — the serialized chain the stream overlaps — without the
+        one-time XLA compiles (bench.py's persistent compile cache hides
+        those in the official runs anyway)."""
+        flags.set("streaming_stage", streaming)
+        flags.set("streaming_window_rows", window_rows)
+        ex = MeshExecutor(mesh=mesh, block_rows=block_rows)
+        carnot.device_executor = ex
+        carnot.execute_query(query)  # compile warm-up
+        ex._staged_cache.clear()
+        reset_cold_profile()
+        t0 = time.perf_counter()
+        result = carnot.execute_query(query)
+        wall = time.perf_counter() - t0
+        prof = reset_cold_profile()
+        assert not ex.fallback_errors, ex.fallback_errors
+        if streaming:
+            assert not ex.stream_fallback_errors, ex.stream_fallback_errors
+            assert prof.get("stream_windows"), "stream path did not run"
+        rows = result.table("service_stats")
+        return wall, prof, dict(zip(rows["service"], rows["throughput"]))
+
+    try:
+        # Warm XLA/program caches are per-executor signature; each mode
+        # compiles its own programs, so both colds include their compiles.
+        mono_wall, mono_prof, mono_rows = cold(streaming=False)
+        log(f"monolithic cold {mono_wall:.2f}s {json.dumps({k: round(v, 3) for k, v in sorted(mono_prof.items())})}")
+        stream_wall, stream_prof, stream_rows = cold(streaming=True)
+        log(f"streaming cold {stream_wall:.2f}s {json.dumps({k: round(v, 3) for k, v in sorted(stream_prof.items())})}")
+    finally:
+        flags.reset("streaming_stage")
+        flags.reset("streaming_window_rows")
+
+    assert mono_rows == stream_rows, "stream result != monolithic result"
+    windows = int(stream_prof.get("stream_windows", 1))
+    occupancy = {
+        k: round(stream_prof.get(k, 0.0), 3)
+        for k in (
+            "stage_stream_pack",
+            "stage_stream_pack_wait",
+            "stage_stream_put",
+            "stage_stream_dispatch",
+            "stage_stream_compute_wait",
+            "stage_stream_drain",
+            "stage_overlap",
+        )
+    }
+    per_window_ms = {
+        k.replace("stage_stream_", ""): round(1000 * v / max(windows, 1), 2)
+        for k, v in occupancy.items()
+        if k.startswith("stage_stream_")
+    }
+    print(
+        json.dumps(
+            {
+                "rows": n_rows,
+                "windows": windows,
+                "window_rows": window_rows,
+                "monolithic_cold_s": round(mono_wall, 2),
+                "streaming_cold_s": round(stream_wall, 2),
+                "stream_vs_mono": round(stream_wall / mono_wall, 3),
+                "occupancy_s": occupancy,
+                "per_window_ms": per_window_ms,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
