@@ -67,6 +67,28 @@ def update(state, gids, values, mask=None):
     return jnp.maximum(state, maxes.reshape(num_groups, m))
 
 
+def cell_update(state, hist, lut):
+    """Fold a per-(group, value-code) histogram into the registers.
+
+    ``hist``: [num_groups, C] int64 row counts per cell; ``lut``: [C] the
+    int64 value each code stands for. Every row of a cell carries the
+    same (register, rho) pair, so maxing rho over PRESENT cells
+    (hist > 0 — cardinality ignores multiplicity) reproduces the row-wise
+    scatter exactly while touching num_groups*C elements instead of n
+    rows: approx_count_distinct on small-domain int columns rides the
+    pipeline's MXU cell lane like count-min does.
+    """
+    num_groups, m = state.shape
+    precision = int(m).bit_length() - 1
+    reg, rho = _reg_rho(lut, precision)  # [C] each
+    rho_gc = jnp.where(hist > 0, rho[None, :], 0).astype(jnp.int32)
+    flat = (
+        jnp.arange(num_groups, dtype=jnp.int32)[:, None] * m + reg[None, :]
+    ).reshape(-1)
+    maxes = segment.seg_max(rho_gc.reshape(-1), flat, num_groups * m)
+    return jnp.maximum(state, maxes.reshape(num_groups, m))
+
+
 def merge(a, b):
     return jnp.maximum(a, b)
 

@@ -103,6 +103,23 @@ _PROGRAMS = _M.gauge(
     "device_program_cache_size", "Compiled shard_map programs cached."
 )
 
+# Persistent-compilation-cache hit counter: jax emits a monitoring event
+# per .jax_cache deserialization; the AOT compile thread snapshots it
+# around each compile so the ledger's compile_cache_hit key is honest
+# (a hit = the bucketed signature reproduced a prior round's HLO).
+_PERSISTENT_CACHE_HITS = [0]
+
+
+def _on_jax_monitoring_event(event, *args, **kwargs):
+    if event == "/jax/compilation_cache/cache_hits":
+        _PERSISTENT_CACHE_HITS[0] += 1
+
+
+try:
+    jax.monitoring.register_event_listener(_on_jax_monitoring_event)
+except Exception:  # pragma: no cover - monitoring API drift
+    pass
+
 # Cold-path phase timings live in staging (shared with the transfer
 # layer); re-exported here for callers.
 from pixie_tpu.parallel.staging import (  # noqa: E402
@@ -518,6 +535,11 @@ class MeshExecutor:
         self.stream_fallback_errors: dict[str, str] = {}
         # (uda set, capacity) -> (finalize modes, packed-output templates).
         self._finmode_cache: dict[tuple, Any] = {}
+        # AOT-compiled fold executables (sig -> jax Compiled) + the single
+        # background thread that lowers/compiles them while staging
+        # streams (the r7 compile/staging overlap).
+        self._aot_compiled: dict[str, Any] = {}
+        self._aot_pool = None
         # Host-computed any() representatives, keyed by
         # (table, version, window, key exprs, col); small LRU.
         self._hostany_cache: "collections.OrderedDict[tuple, np.ndarray]" = (
@@ -2320,6 +2342,151 @@ class MeshExecutor:
         ]
         return "|".join(parts)
 
+    # -- per-lane program decomposition (r7) ---------------------------------
+    # The monolithic jit(shard_map(scan+merge+finalize)) recompiled as a
+    # whole whenever ANY part of the query changed. Decomposed units are
+    # cached under their own signatures: the expensive fold executable is
+    # keyed by the scan lane alone (no output names, no finalize modes),
+    # so a query that differs only in finalize reuses it and compiles
+    # only the small finalize unit; init/merge key on the UDA lane set
+    # and are shared across staging geometries entirely.
+
+    def _lane_sig(self, specs) -> str:
+        """UDA lane identity WITHOUT output names: two queries whose agg
+        lanes differ only in what the outputs are called (or how they
+        finalize) share fold/init/merge executables."""
+        return ";".join(
+            f"{uda.name}{uda.arg_types}({arg_e!r})"
+            for _out, arg_e, uda in specs
+        )
+
+    def _uda_set_sig(self, specs) -> str:
+        """Coarser still: the UDA set alone (state shapes + merge kinds
+        derive from it) — keys the init and merge units."""
+        return ",".join(f"{uda.name}{uda.arg_types}" for _o, _e, uda in specs)
+
+    def _fold_signature(
+        self, m, specs, key_plan, staged, aux_vals, capacity
+    ) -> str:
+        """Identity of the FOLD unit alone: scan expressions, UDA update
+        lanes, key mode, block geometry, capacity, aux shapes — finalize
+        modes, agg stage, and output names are excluded (they key the
+        finalize unit). Staging geometry is bucketed (staging
+        .block_geometry), so two tables whose padded shapes land in the
+        same bucket produce the same string — and share one compiled
+        executable in-process plus one .jax_cache entry across runs."""
+        parts = [
+            ",".join(f"{n}:{a.shape}:{a.dtype}" for n, a in
+                     sorted(staged.blocks.items())),
+            f"mask:{staged.mask.shape}",
+            f"cap:{capacity}",
+            f"narrow:{sorted(staged.narrow_offsets)}",
+            f"intdict:{sorted(staged.int_dicts)}",
+            f"hostgids:{key_plan.host_gids is not None}",
+            "preds:" + ";".join(repr(p) for p in m.predicates),
+            "lanes:" + self._lane_sig(specs),
+            "key:" + (
+                "host" if key_plan.host_gids is not None else (
+                    f"lut:{key_plan.device_expr[1]}"
+                    if isinstance(key_plan.device_expr, tuple)
+                    else repr(key_plan.device_expr)
+                )
+            ),
+            "aux:" + ",".join(
+                f"{np.shape(v)}:{np.asarray(v).dtype}" for v in aux_vals
+            ),
+            f"mesh:{self.mesh.devices.shape}",
+        ]
+        return "|".join(parts)
+
+    def _get_program(self, sig: str, build, n_aux: int = 0):
+        """Program-cache lookup-or-build shared by every unit."""
+        entry = self._program_cache.get(sig)
+        if entry is None or entry[1] != n_aux:
+            self._program_cache[sig] = (build(), n_aux, None)
+            _PROGRAMS.set(len(self._program_cache))
+        return self._program_cache[sig][0]
+
+    def _unit_programs(
+        self, m, specs, evaluator, key_plan, staged, aux_key_order,
+        aux_vals, capacity,
+    ):
+        """(init_p, fold_p, merge_p, fin_p, fold_sig) for a staging
+        geometry — each unit cached under its own signature."""
+        treedef, leaves = self._state_template(specs, capacity)
+        n_leaves = len(leaves)
+        lanes = self._uda_set_sig(specs)
+        mesh_s = f"{self.mesh.devices.shape}"
+        col_names = sorted(staged.blocks)
+        narrow_names = sorted(staged.narrow_offsets)
+        int_dict_names = sorted(staged.int_dicts)
+        fold_sig = "fold|" + self._fold_signature(
+            m, specs, key_plan, staged, aux_vals, capacity
+        )
+        init_p = self._get_program(
+            f"init|{lanes}|cap:{capacity}|mesh:{mesh_s}",
+            lambda: self._build_init(specs, capacity),
+        )
+        fold_p = self._get_program(
+            fold_sig,
+            lambda: self._build_fold(
+                specs, evaluator, key_plan, col_names, narrow_names,
+                int_dict_names, aux_key_order, capacity, n_leaves, treedef,
+            ),
+            n_aux=len(aux_vals),
+        )
+        merge_p = self._get_program(
+            f"merge|{lanes}|cap:{capacity}|mesh:{mesh_s}",
+            lambda: self._build_merge(specs, capacity, n_leaves, treedef),
+        )
+        force_state = m.agg_op.stage == AggStage.PARTIAL
+        fin_p = self._get_program(
+            f"fin|{lanes}|cap:{capacity}|state:{force_state}|mesh:{mesh_s}",
+            lambda: self._build_fin(specs, capacity, force_state, treedef),
+        )
+        return init_p, fold_p, merge_p, fin_p, fold_sig
+
+    # -- background AOT compilation (r7) -------------------------------------
+    def _aot_lower_compile(self, program, avals):
+        """jit -> lowered -> compiled, separated so tests can poison it."""
+        return program.lower(*avals).compile()
+
+    def _aot_compile_async(self, sig: str, program, avals):
+        """Future resolving to the AOT-compiled executable of ``program``
+        at ``avals``. The lower+compile runs on a background thread so the
+        cold XLA compile overlaps host pack and HBM transfer instead of
+        preceding them; results cache in _aot_compiled per signature.
+        COLD_PROFILE gains stage_compile (seconds spent compiling,
+        concurrent with staging) and compile_cache_hit (persistent
+        .jax_cache deserializations observed during the compile)."""
+        import concurrent.futures
+
+        done = self._aot_compiled.get(sig)
+        if done is not None:
+            fut = concurrent.futures.Future()
+            fut.set_result(done)
+            return fut
+        if self._aot_pool is None:
+            self._aot_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="aot-compile"
+            )
+
+        def work():
+            hits0 = _PERSISTENT_CACHE_HITS[0]
+            t0 = time.perf_counter()
+            compiled = self._aot_lower_compile(program, avals)
+            COLD_PROFILE["stage_compile"] = COLD_PROFILE.get(
+                "stage_compile", 0.0
+            ) + (time.perf_counter() - t0)
+            if _PERSISTENT_CACHE_HITS[0] > hits0:
+                COLD_PROFILE["compile_cache_hit"] = COLD_PROFILE.get(
+                    "compile_cache_hit", 0.0
+                ) + 1.0
+            self._aot_compiled[sig] = compiled
+            return compiled
+
+        return self._aot_pool.submit(work)
+
     def _make_scan_body(
         self,
         specs,
@@ -2480,40 +2647,50 @@ class MeshExecutor:
 
         return body
 
-    def _merge_pack_outputs(self, specs, fin_modes, states, presence, ndev, axis):
-        """ICI merge + device finalize + single-buffer pack — the program
-        tail shared by the monolithic program and the streaming finish
-        program. One collective per UDA (the Kelvin step); on a 1-device
-        mesh every collective is the identity — skip them (some PJRT
-        backends only lower Sum all-reduces anyway)."""
+    def _merge_states(self, specs, states, presence, ndev, axis):
+        """ICI merge — the collective half of the program tail. One
+        collective per UDA (the Kelvin step); on a 1-device mesh every
+        collective is the identity — skip them (some PJRT backends only
+        lower Sum all-reduces anyway). Returns (merged states, presence),
+        replicated across the mesh."""
         if ndev == 1:
-            merged = list(states)
-        else:
-            presence = jax.lax.psum(presence, axis)
-            merged = []
-            for (out, _, uda), st in zip(specs, states):
-                if uda.merge_kind == MergeKind.PSUM:
-                    merged.append(jax.tree.map(
-                        lambda x: jax.lax.psum(x, axis), st
-                    ))
-                elif uda.merge_kind == MergeKind.PMAX:
-                    merged.append(jax.tree.map(
-                        lambda x: jax.lax.pmax(x, axis), st
-                    ))
-                elif uda.merge_kind == MergeKind.PMIN:
-                    merged.append(jax.tree.map(
-                        lambda x: jax.lax.pmin(x, axis), st
-                    ))
-                else:  # TREE: all_gather states, fold pairwise
-                    gathered = jax.tree.map(
-                        lambda x: jax.lax.all_gather(x, axis), st
+            return list(states), presence
+        presence = jax.lax.psum(presence, axis)
+        merged = []
+        for (out, _, uda), st in zip(specs, states):
+            if uda.merge_kind == MergeKind.PSUM:
+                merged.append(jax.tree.map(
+                    lambda x: jax.lax.psum(x, axis), st
+                ))
+            elif uda.merge_kind == MergeKind.PMAX:
+                merged.append(jax.tree.map(
+                    lambda x: jax.lax.pmax(x, axis), st
+                ))
+            elif uda.merge_kind == MergeKind.PMIN:
+                merged.append(jax.tree.map(
+                    lambda x: jax.lax.pmin(x, axis), st
+                ))
+            else:  # TREE: all_gather states, fold pairwise
+                gathered = jax.tree.map(
+                    lambda x: jax.lax.all_gather(x, axis), st
+                )
+                acc = jax.tree.map(lambda x: x[0], gathered)
+                for i2 in range(1, ndev):
+                    acc = uda.merge(
+                        acc, jax.tree.map(lambda x: x[i2], gathered)
                     )
-                    acc = jax.tree.map(lambda x: x[0], gathered)
-                    for i2 in range(1, ndev):
-                        acc = uda.merge(
-                            acc, jax.tree.map(lambda x: x[i2], gathered)
-                        )
-                    merged.append(acc)
+                merged.append(acc)
+        return merged, presence
+
+    def _merge_pack_outputs(self, specs, fin_modes, states, presence, ndev, axis):
+        """ICI merge + device finalize + single-buffer pack — the fused
+        program tail (_merge_states then _finalize_pack in one trace)."""
+        merged, presence = self._merge_states(
+            specs, states, presence, ndev, axis
+        )
+        return self._finalize_pack(specs, fin_modes, merged, presence)
+
+    def _finalize_pack(self, specs, fin_modes, merged, presence):
         # Finalize on device where the UDA allows it, then pack every
         # output/state leaf into ONE f64 buffer (ints ride exactly via
         # bitcast) so the host pays a single device fetch per query —
@@ -2650,10 +2827,10 @@ class MeshExecutor:
         leaves, treedef = jax.tree.flatten(avals)
         return treedef, leaves
 
-    def _build_stream_init(self, specs, capacity):
+    def _build_init(self, specs, capacity):
         """Identity states created ON the mesh with a leading device axis
         (init == merge identity by UDA contract): each device folds its
-        own shard; the finish program merges over ICI."""
+        own shard; the merge program combines them over ICI."""
         d = self.mesh.devices.size
         (axis_name,) = self.mesh.axis_names
         sharding = NamedSharding(self.mesh, P(axis_name))
@@ -2670,9 +2847,8 @@ class MeshExecutor:
 
         return jax.jit(init, out_shardings=sharding)
 
-    def _build_stream_fold(
+    def _build_fold(
         self,
-        m,
         specs,
         evaluator,
         key_plan,
@@ -2684,9 +2860,12 @@ class MeshExecutor:
         n_state_leaves,
         treedef,
     ):
-        """One window's fold: scan this window's blocks, return the updated
-        per-device states. No collectives — those wait for the finish
-        program, so every fold dispatch is device-local and async."""
+        """The FOLD unit: scan a set of blocks (one stream window, or the
+        whole staged table on the warm path), return the updated
+        per-device states. No collectives — those live in the merge unit,
+        so every fold dispatch is device-local and async, and the fold
+        executable is reused by any query whose scan lane matches
+        (_fold_signature), regardless of finalize."""
         axis = self.mesh.axis_names[0]
         has_host_gids = key_plan.host_gids is not None
         has_key_lut = isinstance(key_plan.device_expr, tuple)
@@ -2755,34 +2934,52 @@ class MeshExecutor:
             )
         )
 
-    def _build_stream_finish(self, m, specs, capacity, n_state_leaves, treedef):
-        """The drained pipeline's tail: collective-merge the per-device
-        states, finalize, pack into the single fetched buffer — identical
-        to the monolithic program's ending."""
+    def _build_merge(self, specs, capacity, n_state_leaves, treedef):
+        """The COLLECTIVE-MERGE unit: per-device states in, replicated
+        merged states out — one collective per UDA, nothing else. Keyed
+        only by (UDA lane set, capacity, mesh), so every query sharing the
+        lane set reuses it across staging geometries."""
         axis = self.mesh.axis_names[0]
         ndev = self.mesh.devices.size
-        fin_modes, _ = self._finalize_modes(
-            specs, capacity, m.agg_op.stage == AggStage.PARTIAL
-        )
 
         def shard_fn(*arrs):
             states, presence = jax.tree.unflatten(
                 treedef, [a[0] for a in arrs]
             )
-            return self._merge_pack_outputs(
-                specs, fin_modes, states, presence, ndev, axis
+            merged, presence = self._merge_states(
+                specs, list(states), presence, ndev, axis
+            )
+            return tuple(
+                jax.tree.leaves((tuple(merged), presence))
             )
 
         in_specs = tuple([P(axis)] * n_state_leaves)
+        out_specs = tuple([P()] * n_state_leaves)
         return jax.jit(
             shard_map(
                 shard_fn,
                 mesh=self.mesh,
                 in_specs=in_specs,
-                out_specs=P(),
+                out_specs=out_specs,
                 **_SM_CHECK_KW,
             )
         )
+
+    def _build_fin(self, specs, capacity, force_state, treedef):
+        """The FINALIZE unit: replicated merged states -> the single
+        packed f64 fetch buffer (device finalize where the UDA allows,
+        else raw state). A plain jit — inputs are replicated, no
+        shard_map needed — so a changed-finalize query compiles ONLY this
+        small unit while reusing the fold and merge executables."""
+        fin_modes, _ = self._finalize_modes(specs, capacity, force_state)
+
+        def fn(*leaves):
+            states, presence = jax.tree.unflatten(treedef, leaves)
+            return self._finalize_pack(
+                specs, fin_modes, list(states), presence
+            )
+
+        return jax.jit(fn)
 
     def _stream_execute(
         self, m, specs, evaluator, key_plan, table, cols, n,
@@ -2843,8 +3040,9 @@ class MeshExecutor:
         aux_key_order = list(aux.keys())
         col_names = sorted(cols)
         narrow_names = sorted(plan.narrow_offsets)
-        # Program identity: the monolithic signature over the WINDOW
-        # geometry (every window shares it by construction).
+        # Program identity: the bucketed WINDOW geometry (every window
+        # shares it by construction, and so does every table whose padded
+        # size lands in the same bucket).
         shim = _types.SimpleNamespace(
             blocks={
                 name: _types.SimpleNamespace(
@@ -2857,54 +3055,153 @@ class MeshExecutor:
             narrow_offsets=plan.narrow_offsets,
             int_dicts=plan.int_dicts,
         )
-        sig = "stream|" + self._signature(
-            m, specs, key_plan, shim, aux_vals, capacity
-        )
         treedef, leaves = self._state_template(specs, capacity)
-        entry = self._program_cache.get(sig)
-        if entry is None or entry[1] != len(aux_vals):
-            programs = (
-                self._build_stream_init(specs, capacity),
-                self._build_stream_fold(
-                    m, specs, evaluator, key_plan, col_names, narrow_names,
-                    sorted(plan.int_dicts), aux_key_order, capacity,
-                    len(leaves), treedef,
-                ),
-                self._build_stream_finish(
-                    m, specs, capacity, len(leaves), treedef
-                ),
-            )
-            _, templates = self._finalize_modes(
-                specs, capacity, m.agg_op.stage == AggStage.PARTIAL
-            )
-            self._program_cache[sig] = (programs, len(aux_vals), templates)
-            _PROGRAMS.set(len(self._program_cache))
-        (init_p, fold_p, finish_p), _, templates = self._program_cache[sig]
+        init_p, fold_p, merge_p, fin_p, fold_sig = self._unit_programs(
+            m, specs, evaluator, key_plan, shim, aux_key_order,
+            aux_vals, capacity,
+        )
+        _, templates = self._finalize_modes(
+            specs, capacity, m.agg_op.stage == AggStage.PARTIAL
+        )
 
         (axis_name,) = self.mesh.axis_names
         sharding = NamedSharding(self.mesh, P(axis_name))
+        repl = NamedSharding(self.mesh, P())
         has_host_gids = key_plan.host_gids is not None
-        extra_args = []  # constant across windows: key LUT, aux, narrow
+        # Constant across windows: key LUT, aux, narrow offsets. Committed
+        # replicated so they match the AOT-compiled executable's shardings.
+        extra_args = []
         if isinstance(key_plan.device_expr, tuple):
-            extra_args.append(jnp.asarray(key_plan.device_expr[2]))
-        extra_args.extend(jnp.asarray(v) for v in aux_vals)
+            extra_args.append(
+                jax.device_put(np.asarray(key_plan.device_expr[2]), repl)
+            )
+        extra_args.extend(
+            jax.device_put(np.asarray(v), repl) for v in aux_vals
+        )
         if plan.narrow_offsets:
             extra_args.append(
-                jnp.asarray(
-                    [plan.narrow_offsets[n2] for n2 in narrow_names],
-                    jnp.int64,
+                jax.device_put(
+                    np.asarray(
+                        [plan.narrow_offsets[n2] for n2 in narrow_names],
+                        np.int64,
+                    ),
+                    repl,
                 )
             )
-        gid_base = jnp.int32(0)  # single pass (gated above)
+        gid_base = jax.device_put(np.int32(0), repl)  # single pass
         gids = key_plan.host_gids
+
+        # Background AOT compile (r7): lower+compile the fold program on
+        # a worker thread while pack/transfer stream — the 200s-class XLA
+        # compile overlaps the staging instead of preceding it. Fold
+        # dispatches are deferred (windows keep transferring) until the
+        # compile future resolves; a compile failure falls back to the
+        # in-line jit path, recorded in stream_fallback_errors.
+        fold_fn = None
+        fut_c = None
+        if flags.aot_compile:
+            avals = [
+                jax.ShapeDtypeStruct(
+                    (plan.d,) + tuple(l.shape), l.dtype, sharding=sharding
+                )
+                for l in leaves
+            ]
+            avals += [
+                jax.ShapeDtypeStruct(
+                    (plan.d, plan.nblk, plan.b),
+                    plan.block_dtypes[n2],
+                    sharding=sharding,
+                )
+                for n2 in col_names
+            ]
+            avals.append(
+                jax.ShapeDtypeStruct(
+                    (plan.d, plan.nblk, plan.b), np.bool_, sharding=sharding
+                )
+            )
+            if has_host_gids:
+                avals.append(
+                    jax.ShapeDtypeStruct(
+                        (plan.d, plan.nblk, plan.b),
+                        plan.gid_dtype,
+                        sharding=sharding,
+                    )
+                )
+            avals += [
+                jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+                for a in extra_args
+            ]
+            avals.append(
+                jax.ShapeDtypeStruct((), gid_base.dtype, sharding=repl)
+            )
+            fut_c = self._aot_compile_async(fold_sig, fold_p, tuple(avals))
+        else:
+            fold_fn = fold_p
 
         def prof(key, dt):
             COLD_PROFILE[key] = COLD_PROFILE.get(key, 0.0) + dt
 
+        def resolve_fold(block: bool) -> bool:
+            """Bind fold_fn once the AOT compile is available (or failed).
+            With block=False this is a non-blocking poll; the final call
+            blocks — by then every window has transferred, so the wait is
+            exactly the non-overlapped compile remainder."""
+            nonlocal fold_fn
+            if fold_fn is not None:
+                return True
+            if not block and not fut_c.done():
+                return False
+            t0 = time.perf_counter()
+            try:
+                fold_fn = fut_c.result()
+            except Exception as e:
+                import logging
+                import traceback
+
+                key = f"aot-compile {type(e).__name__}: {e}"
+                if key not in self.stream_fallback_errors:
+                    self.stream_fallback_errors[key] = traceback.format_exc()
+                    logging.getLogger("pixie_tpu.parallel").warning(
+                        "background AOT compile failed, falling back to "
+                        "in-line jit: %s",
+                        key,
+                    )
+                fold_fn = fold_p
+            prof("stage_compile_wait", time.perf_counter() - t0)
+            return True
+
         win_blocks: list = []
         win_masks: list = []
         win_gids: list = []
+        deferred: list = []  # transferred windows awaiting the compile
         inflight: "collections.deque" = collections.deque()
+        flat_state = None
+
+        def dispatch_fold(dev_cols, mask, dev_g):
+            nonlocal flat_state
+            args = list(flat_state)
+            args.extend(dev_cols[n2] for n2 in col_names)
+            args.append(mask)
+            if has_host_gids:
+                args.append(dev_g)
+            args.extend(extra_args)
+            args.append(gid_base)
+            t0 = time.perf_counter()
+            flat_state = list(fold_fn(*args))
+            prof("stage_stream_dispatch", time.perf_counter() - t0)
+            # Double-buffer backpressure: block on window k-2's fold so
+            # at most two windows are in flight (one transferring, one
+            # packing) — bounds host-pinned buffers and the device
+            # transfer queue.
+            inflight.append(flat_state[-1])
+            if len(inflight) > 2:
+                t0 = time.perf_counter()
+                jax.block_until_ready(inflight.popleft())
+                prof(
+                    "stage_stream_compute_wait",
+                    time.perf_counter() - t0,
+                )
+
         t_wall0 = time.perf_counter()
         pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="stream-pack"
@@ -2941,34 +3238,41 @@ class MeshExecutor:
                     )
                     prof("stage_stream_put", time.perf_counter() - t0)
                     prof("stage_bytes", float(nbytes))
-                    args = list(flat_state)
-                    args.extend(dev_cols[n2] for n2 in col_names)
-                    args.append(mask)
-                    if has_host_gids:
-                        args.append(dev_g)
-                    args.extend(extra_args)
-                    args.append(gid_base)
-                    t0 = time.perf_counter()
-                    flat_state = list(fold_p(*args))
-                    prof("stage_stream_dispatch", time.perf_counter() - t0)
                     if cacheable:
                         win_blocks.append(dev_cols)
                         win_masks.append(mask)
                         win_gids.append(dev_g)
-                    # Double-buffer backpressure: block on window k-2's
-                    # fold so at most two windows are in flight (one
-                    # transferring, one packing) — bounds host-pinned
-                    # buffers and the device transfer queue.
-                    inflight.append(flat_state[-1])
-                    if len(inflight) > 2:
-                        t0 = time.perf_counter()
-                        jax.block_until_ready(inflight.popleft())
-                        prof(
-                            "stage_stream_compute_wait",
-                            time.perf_counter() - t0,
-                        )
+                    if not resolve_fold(block=False):
+                        # Compile still running: keep streaming transfers
+                        # (the windows land in HBM, where the cacheable
+                        # path keeps them anyway) and fold later. Cap
+                        # in-flight transfers at two windows so host
+                        # buffers pinned by async device_put stay bounded.
+                        deferred.append((dev_cols, mask, dev_g))
+                        if len(deferred) >= 2:
+                            t0 = time.perf_counter()
+                            jax.block_until_ready(
+                                list(deferred[-2][0].values())
+                            )
+                            prof(
+                                "stage_stream_transfer_wait",
+                                time.perf_counter() - t0,
+                            )
+                        continue
+                    for d_args in deferred:
+                        dispatch_fold(*d_args)
+                    deferred.clear()
+                    dispatch_fold(dev_cols, mask, dev_g)
+                # Every window is transferred; if the compile is STILL in
+                # flight, this wait is the only non-overlapped compile
+                # time (stage_compile_wait in the breakdown).
+                resolve_fold(block=True)
+                for d_args in deferred:
+                    dispatch_fold(*d_args)
+                deferred.clear()
                 t0 = time.perf_counter()
-                buf = finish_p(*flat_state)
+                merged_flat = merge_p(*flat_state)
+                buf = fin_p(*merged_flat)
                 merged = self._unpack_outputs(templates, capacity, buf)
                 prof("stage_stream_drain", time.perf_counter() - t0)
         finally:
@@ -3019,13 +3323,69 @@ class MeshExecutor:
         return values, presence
 
     def _run_program(self, m, specs, evaluator, key_plan, staged, aux):
-        col_names = sorted(staged.blocks)
+        """Execute the staged aggregation. Default (program_decompose):
+        separately-cached init/fold/merge/finalize units — a query that
+        differs only in finalize (output names, FULL vs PARTIAL, a new
+        quantile over the same lane) reuses the expensive fold
+        executable and compiles only the small finalize unit, and each
+        unit compiles faster than the fused whole. The fused
+        single-dispatch program remains behind the flag."""
         # Int-dictionary LUTs ride the aux lane (replicated args), so
         # dictionary content can change without recompiling.
         for n2 in sorted(staged.int_dicts):
             aux[f"intdict:{n2}"] = np.asarray(staged.int_dicts[n2])
         aux_vals = list(aux.values())
+        aux_key_order = list(aux.keys())
         capacity, n_passes = self._pass_plan(specs, key_plan.num_groups)
+        if not flags.program_decompose:
+            return self._run_program_fused(
+                m, specs, evaluator, key_plan, staged, aux, aux_vals,
+                capacity, n_passes,
+            )
+        col_names = sorted(staged.blocks)
+        init_p, fold_p, merge_p, fin_p, _fold_sig = self._unit_programs(
+            m, specs, evaluator, key_plan, staged, aux_key_order,
+            aux_vals, capacity,
+        )
+        _, templates = self._finalize_modes(
+            specs, capacity, m.agg_op.stage == AggStage.PARTIAL
+        )
+        args = [staged.blocks[n] for n in col_names] + [staged.mask]
+        if key_plan.host_gids is not None:
+            args.append(staged.gids)
+        if isinstance(key_plan.device_expr, tuple):
+            args.append(jnp.asarray(key_plan.device_expr[2]))
+        args.extend(jnp.asarray(v) for v in aux_vals)
+        if staged.narrow_offsets:
+            args.append(
+                jnp.asarray(
+                    [
+                        staged.narrow_offsets[n]
+                        for n in sorted(staged.narrow_offsets)
+                    ],
+                    jnp.int64,
+                )
+            )
+        from pixie_tpu.ops import segment as _segment
+
+        per_pass = []
+        with _segment.platform_hint(self.mesh.devices.flat[0].platform):
+            for p in range(n_passes):
+                flat = list(init_p())
+                flat = fold_p(*flat, *args, jnp.int32(p * capacity))
+                merged_flat = merge_p(*flat)
+                buf = fin_p(*merged_flat)
+                # ONE blocking fetch per pass: completion + transfer.
+                per_pass.append(
+                    self._unpack_outputs(templates, capacity, buf)
+                )
+        return self._recombine_passes(per_pass, specs, capacity, n_passes)
+
+    def _run_program_fused(
+        self, m, specs, evaluator, key_plan, staged, aux, aux_vals,
+        capacity, n_passes,
+    ):
+        col_names = sorted(staged.blocks)
         sig = self._signature(m, specs, key_plan, staged, aux_vals, capacity)
         entry = self._program_cache.get(sig)
         if entry is None or entry[1] != len(aux_vals):
@@ -3067,6 +3427,10 @@ class MeshExecutor:
                 per_pass.append(
                     self._unpack_outputs(templates, capacity, buf)
                 )
+        return self._recombine_passes(per_pass, specs, capacity, n_passes)
+
+    @staticmethod
+    def _recombine_passes(per_pass, specs, capacity, n_passes):
         if n_passes == 1:
             return per_pass[0], capacity
         # Recombine: every leaf (finalized output or state) and the

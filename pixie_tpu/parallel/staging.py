@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pixie_tpu.table.column import DictColumn
 from pixie_tpu.table.table import Table
+from pixie_tpu.utils import flags
 
 DEFAULT_BLOCK_ROWS = 1 << 17
 
@@ -89,6 +90,46 @@ def _pow2_at_least(n: int, floor: int = 8) -> int:
     while c < n:
         c <<= 1
     return c
+
+
+def bucket_block_count(n: int) -> int:
+    """Round a per-device block count up to its signature bucket.
+
+    Buckets are quarter-octave, pow2-scaled: within each octave
+    (2^(k-1), 2^k] counts round up to multiples of 2^(k-3), i.e. the
+    bucket set is {1..8, 10, 12, 14, 16, 20, 24, 28, 32, 40, ...}. That
+    bounds shape variety to O(log) distinct block counts (so compiled
+    programs and the persistent .jax_cache are shared across tables whose
+    padded sizes land in the same bucket) at <= 25% padding waste — a
+    strict pow2 bucket would cost up to 100% extra masked blocks, which
+    at gigarow scale is real HBM and host->HBM transfer."""
+    if n <= 8:
+        return max(n, 1)
+    step = 1 << ((n - 1).bit_length() - 3)
+    return ((n + step - 1) // step) * step
+
+
+def block_geometry(
+    num_rows: int, d: int, block_rows: int
+) -> tuple[int, int]:
+    """(per-device block size b, blocks-per-device nblk) for a staging of
+    ``num_rows`` over ``d`` devices. With ``signature_buckets`` the
+    geometry derives from the pow2-padded row count and nblk rounds up to
+    its bucket (padding rows are masked), so tables in the same bucket
+    produce identical block shapes — and therefore share one compiled
+    program in-process and one .jax_cache entry across processes."""
+    if flags.signature_buckets:
+        padded = _pow2_at_least(max(num_rows, 1), floor=1)
+        b = min(block_rows, _pow2_at_least(max(padded // d, 1), floor=256))
+        nblk = bucket_block_count(
+            max((num_rows + d * b - 1) // (d * b), 1)
+        )
+    else:
+        b = min(
+            block_rows, _pow2_at_least(max(num_rows // d, 1), floor=256)
+        )
+        nblk = max((num_rows + d * b - 1) // (d * b), 1)
+    return b, nblk
 
 
 def read_columns(
@@ -254,8 +295,7 @@ def stage_columns(
     int_dict_encode) to their value LUTs."""
     (axis_name,) = mesh.axis_names
     d = mesh.devices.size
-    b = min(block_rows, _pow2_at_least(max(num_rows // d, 1), floor=256))
-    nblk = max((num_rows + d * b - 1) // (d * b), 1)
+    b, nblk = block_geometry(num_rows, d, block_rows)
     total = d * nblk * b
     sharding = NamedSharding(mesh, P(axis_name))
 
@@ -398,12 +438,17 @@ def plan_stream(
     window_rows is clamped to the table so a small table (or a huge
     window flag) degenerates to ONE window whose geometry matches what
     stage_columns would have chosen — the fold then reproduces the
-    monolithic scan bit-for-bit."""
+    monolithic scan bit-for-bit. With ``signature_buckets`` the clamp is
+    to the POW2-PADDED row count, so every small table whose padded size
+    lands in the same bucket shares one window geometry — and one
+    compiled fold executable."""
     d = mesh.devices.size
-    window_rows = max(min(int(window_rows), max(num_rows, 1)), 1)
+    clamp = max(num_rows, 1)
+    if flags.signature_buckets:
+        clamp = _pow2_at_least(clamp, floor=1)
+    window_rows = max(min(int(window_rows), clamp), 1)
     n_windows = max((num_rows + window_rows - 1) // window_rows, 1)
-    b = min(block_rows, _pow2_at_least(max(window_rows // d, 1), floor=256))
-    nblk = max((window_rows + d * b - 1) // (d * b), 1)
+    b, nblk = block_geometry(window_rows, d, block_rows)
     col_plans: dict = {}
     narrow_offsets: dict = {}
     int_dicts: dict = {}
@@ -518,6 +563,19 @@ def _concat_builder(mesh: Mesh, n_parts: int):
     )
 
 
+@functools.lru_cache(maxsize=64)
+def _zeros_builder(mesh: Mesh, d: int, nblk: int, b: int, dtype_str: str):
+    """Device-allocated zero blocks (sharded, NO host transfer): the
+    bucket padding appended to a concatenated stream staging. Padding
+    blocks are fully masked, so the warm program scans them as no-ops."""
+    (axis_name,) = mesh.axis_names
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.jit(
+        lambda: jnp.zeros((d, nblk, b), np.dtype(dtype_str)),
+        out_shardings=sharding,
+    )
+
+
 def concat_stream_windows(
     mesh: Mesh,
     plan: StreamPlan,
@@ -530,21 +588,46 @@ def concat_stream_windows(
 ) -> StagedColumns:
     """Assemble per-window device blocks into one StagedColumns so warm
     queries hit HBM directly (same contract as stage_columns; the row
-    layout is per-window-packed, which the per-window masks encode)."""
+    layout is per-window-packed, which the per-window masks encode).
+    With ``signature_buckets`` the concatenated block count is padded up
+    to its bucket with device-allocated zero blocks (masked, never
+    transferred) so the warm program's shapes — and its compiled
+    executable + .jax_cache entry — are shared across tables whose
+    window counts land in the same bucket."""
     n_windows = len(win_masks)
-    if n_windows == 1:
+    total_nblk = n_windows * plan.nblk
+    pad_nblk = 0
+    if flags.signature_buckets:
+        pad_nblk = bucket_block_count(total_nblk) - total_nblk
+    if n_windows == 1 and pad_nblk == 0:
         blocks = dict(win_blocks[0])
         mask = win_masks[0]
         gids = win_gids[0]
     else:
-        cat = _concat_builder(mesh, n_windows)
+        n_parts = n_windows + (1 if pad_nblk else 0)
+        cat = _concat_builder(mesh, n_parts)
+
+        def pad(dtype):
+            return _zeros_builder(
+                mesh, plan.d, pad_nblk, plan.b, np.dtype(dtype).str
+            )()
+
+        def cat_padded(parts, dtype):
+            if pad_nblk:
+                parts = list(parts) + [pad(dtype)]
+            return parts[0] if len(parts) == 1 else cat(*parts)
+
         blocks = {
-            name: cat(*[wb[name] for wb in win_blocks])
+            name: cat_padded(
+                [wb[name] for wb in win_blocks], plan.block_dtypes[name]
+            )
             for name in win_blocks[0]
         }
-        mask = cat(*win_masks)
+        mask = cat_padded(list(win_masks), np.bool_)
         gids = (
-            cat(*win_gids) if win_gids and win_gids[0] is not None else None
+            cat_padded(list(win_gids), plan.gid_dtype)
+            if win_gids and win_gids[0] is not None
+            else None
         )
     return StagedColumns(
         blocks=blocks,

@@ -116,6 +116,11 @@ def register(r: Registry) -> None:
             init=lambda g: hll.init(g),
             update=lambda st, gids, col, mask=None: hll.update(st, gids, col, mask),
             merge=hll.merge,
+            # Cell lane: int-dict-staged columns (<=256 distinct) update
+            # registers from the per-(group, code) presence histogram —
+            # the pipeline only routes INT64 columns here, so the LUT
+            # hashes exactly like the row path's raw values.
+            cell_update=hll.cell_update,
             finalize=lambda st: jnp.round(hll.estimate(st)).astype(jnp.int64),
             merge_kind=MergeKind.PMAX,
             doc=(

@@ -122,6 +122,34 @@ define_flag(
     "a single-window stream reproduces the monolithic geometry exactly).",
 )
 define_flag(
+    "signature_buckets",
+    True,
+    help_="Bucket staging geometry so compiled-program signatures are "
+    "coarse: block counts round up to quarter-octave pow2-scaled buckets "
+    "(<=25% padding, masked) and stream-window geometry derives from the "
+    "pow2-padded row count — two tables or stream windows landing in the "
+    "same bucket share ONE compiled executable, and the bucketed shapes "
+    "are process-stable so the persistent .jax_cache hits across runs.",
+)
+define_flag(
+    "aot_compile",
+    True,
+    help_="AOT-compile the streamed-staging fold program "
+    "(jit.lower().compile()) on a background thread while host pack and "
+    "HBM transfer stream, so the cold XLA compile overlaps staging "
+    "instead of preceding it; failures fall back to the in-line jit path "
+    "(MeshExecutor.stream_fallback_errors).",
+)
+define_flag(
+    "program_decompose",
+    True,
+    help_="Run warm/monolithic queries through separately-jitted, "
+    "separately-cached init/fold/merge/finalize program units instead of "
+    "one fused program: a query differing only in finalize reuses the "
+    "expensive fold executable, and each smaller unit compiles faster. "
+    "Off = the fused single-dispatch program (r6 behavior).",
+)
+define_flag(
     "staged_cache_cap",
     4,
     help_="LRU capacity of HBM-resident staged tables (MeshExecutor).",

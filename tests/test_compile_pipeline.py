@@ -1,0 +1,269 @@
+"""The r7 compile wall teardown: bucketed program signatures, background
+AOT compilation, and per-lane program decomposition.
+
+Pins the three contracts:
+- two tables whose padded row counts land in the same geometry bucket
+  produce the SAME program signatures — the second query compiles
+  nothing (program cache and the _PROGRAMS gauge are unchanged);
+- a poisoned background AOT compile falls back to the in-line jit path:
+  the query still completes, with the error recorded in
+  MeshExecutor.stream_fallback_errors;
+- a second query over the same staged table that differs only in
+  finalize (renamed outputs) reuses the fold/merge/init executables;
+  and the decomposed unit pipeline produces results identical to the
+  fused single-program path.
+"""
+
+import collections
+import json
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from pixie_tpu.engine import Carnot
+from pixie_tpu.parallel import MeshExecutor
+from pixie_tpu.parallel import pipeline as _pipeline
+from pixie_tpu.parallel.staging import (
+    block_geometry,
+    bucket_block_count,
+    reset_cold_profile,
+)
+from pixie_tpu.types import DataType, Relation, SemanticType
+from pixie_tpu.utils import flags
+
+F, I, S, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.array(jax.devices("cpu"))
+    assert devs.size == 8, "conftest must provide 8 virtual devices"
+    return Mesh(devs, ("d",))
+
+
+def _make_table(carnot, name, n, seed=7):
+    rel = Relation.of(
+        ("time_", T, SemanticType.ST_TIME_NS),
+        ("service", S),
+        ("resp_status", I),
+        ("latency", F),
+    )
+    t = carnot.table_store.create_table(name, rel)
+    rng = np.random.default_rng(seed)
+    data = {
+        "time_": np.arange(n) * 10**6,
+        "service": rng.choice(["a", "b", "c"], n, p=[0.5, 0.3, 0.2]).astype(
+            object
+        ),
+        "resp_status": rng.choice([200, 400, 500], n, p=[0.8, 0.1, 0.1]),
+        "latency": rng.exponential(30.0, n),
+    }
+    for off in range(0, n, 2048):
+        t.write_pydict({k: v[off : off + 2048] for k, v in data.items()})
+    t.compact()
+    t.stop()
+    return data
+
+
+def _stats_pxl(table, n_name="n", total_name="total"):
+    return (
+        f"df = px.DataFrame(table='{table}')\n"
+        "s = df.groupby(['service']).agg(\n"
+        f"    {n_name}=('time_', px.count),\n"
+        f"    {total_name}=('latency', px.sum),\n"
+        ")\n"
+        "px.display(s, 'out')\n"
+    )
+
+
+def test_bucket_block_count_shape():
+    # pow2 exact through 8, then quarter-octave steps — bounded shape
+    # variety at <= 25% padding waste.
+    assert [bucket_block_count(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 3, 5, 8]
+    assert bucket_block_count(9) == 10
+    assert bucket_block_count(17) == 20
+    assert bucket_block_count(31) == 32
+    assert bucket_block_count(33) == 40
+    assert bucket_block_count(1000) == 1024
+    for n in (9, 33, 100, 999, 12345):
+        b = bucket_block_count(n)
+        assert b >= n and (b - n) / n <= 0.25
+
+
+def test_block_geometry_buckets_row_counts():
+    """Two row counts whose block counts land in the same bucket get
+    identical (b, nblk) — the precondition for sharing a compiled
+    executable. (The streamed cold path buckets coarser still: its window
+    clamp is pow2, so e.g. 20k and 25k rows share one window geometry —
+    covered end-to-end below.)"""
+    flags.set("signature_buckets", True)
+    try:
+        # ceil(20000/8192)=3 and ceil(23000/8192)=3: same bucket
+        assert block_geometry(20_000, 8, 1024) == block_geometry(
+            23_000, 8, 1024
+        )
+        # 73k rows -> 9 blocks -> bucket 10; 78k rows -> 10 blocks
+        assert block_geometry(73_000, 8, 1024) == block_geometry(
+            78_000, 8, 1024
+        ) == (1024, 10)
+        # and across a bucket boundary they differ
+        assert block_geometry(20_000, 8, 1024) != block_geometry(
+            40_000, 8, 1024
+        )
+    finally:
+        flags.reset("signature_buckets")
+
+
+def test_same_bucket_tables_share_programs(mesh):
+    """Cold queries over two different-sized tables in the same bucket
+    compile ONE set of programs: the second query adds no program-cache
+    entries and leaves the _PROGRAMS gauge unchanged."""
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    c = Carnot(device_executor=ex)
+    _make_table(c, "http_a", 20_000, seed=7)
+    data_b = _make_table(c, "http_b", 25_000, seed=11)
+    c.execute_query(_stats_pxl("http_a"))
+    assert not ex.fallback_errors, ex.fallback_errors
+    keys_after_a = set(ex._program_cache)
+    gauge_after_a = _pipeline._PROGRAMS.value()
+    assert any(s.startswith("fold|") for s in keys_after_a)
+    rows = c.execute_query(_stats_pxl("http_b")).table("out")
+    assert not ex.fallback_errors, ex.fallback_errors
+    assert set(ex._program_cache) == keys_after_a, (
+        set(ex._program_cache) - keys_after_a
+    )
+    assert _pipeline._PROGRAMS.value() == gauge_after_a
+    got = dict(zip(rows["service"], rows["n"]))
+    assert got == dict(collections.Counter(data_b["service"].tolist()))
+
+
+def test_aot_poison_falls_back_to_inline_jit(mesh, monkeypatch):
+    """A failing background AOT compile must not fail the query: the
+    stream falls back to the in-line jit fold, records the error in
+    stream_fallback_errors, and produces correct results."""
+
+    def poisoned(self, program, avals):
+        raise RuntimeError("poisoned compile")
+
+    monkeypatch.setattr(MeshExecutor, "_aot_lower_compile", poisoned)
+    flags.set("streaming_stage", True)
+    flags.set("streaming_window_rows", 1024)
+    try:
+        ex = MeshExecutor(mesh=mesh, block_rows=1024)
+        c = Carnot(device_executor=ex)
+        data = _make_table(c, "http_events", 10_000)
+        rows = c.execute_query(_stats_pxl("http_events")).table("out")
+        assert not ex.fallback_errors, ex.fallback_errors
+        aot_errs = [
+            k for k in ex.stream_fallback_errors if k.startswith("aot-compile")
+        ]
+        assert aot_errs and "poisoned compile" in aot_errs[0], (
+            ex.stream_fallback_errors
+        )
+        got = dict(zip(rows["service"], rows["n"]))
+        assert got == dict(collections.Counter(data["service"].tolist()))
+        by_svc = dict(zip(rows["service"], rows["total"]))
+        for svc in "abc":
+            want = data["latency"][data["service"] == svc].sum()
+            assert by_svc[svc] == pytest.approx(want, rel=1e-9)
+    finally:
+        flags.reset("streaming_stage")
+        flags.reset("streaming_window_rows")
+
+
+def test_changed_finalize_reuses_fold(mesh):
+    """A second distinct query over the SAME staged table that differs
+    only in finalize (renamed outputs) triggers zero new fold compiles —
+    the decomposed fold/merge/init units key on the scan lane, not the
+    output names."""
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    c = Carnot(device_executor=ex)
+    data = _make_table(c, "http_events", 10_000)
+    c.execute_query(_stats_pxl("http_events"))  # cold: stream fold
+    c.execute_query(_stats_pxl("http_events"))  # warm: staged-cache fold
+    keys_before = set(ex._program_cache)
+    folds_before = {s for s in keys_before if s.startswith("fold|")}
+    assert folds_before
+    rows = c.execute_query(
+        _stats_pxl("http_events", n_name="throughput", total_name="lat_sum")
+    ).table("out")
+    assert {s for s in ex._program_cache if s.startswith("fold|")} == (
+        folds_before
+    ), "renamed outputs must not recompile the fold"
+    assert set(ex._program_cache) == keys_before  # init/merge/fin shared too
+    got = dict(zip(rows["service"], rows["throughput"]))
+    assert got == dict(collections.Counter(data["service"].tolist()))
+
+
+def test_decomposed_matches_fused(mesh):
+    """The decomposed init/fold/merge/finalize pipeline reproduces the
+    fused single-program results exactly (same primitive sequence, merely
+    split across jit boundaries)."""
+    results = {}
+    for decompose in (True, False):
+        flags.set("program_decompose", decompose)
+        flags.set("streaming_stage", False)  # hit _run_program directly
+        try:
+            ex = MeshExecutor(mesh=mesh, block_rows=1024)
+            c = Carnot(device_executor=ex)
+            _make_table(c, "http_events", 10_000)
+            rows = c.execute_query(
+                "df = px.DataFrame(table='http_events')\n"
+                "df.failure = df.resp_status >= 400\n"
+                "s = df.groupby(['service']).agg(\n"
+                "    n=('time_', px.count),\n"
+                "    total=('latency', px.sum),\n"
+                "    err=('failure', px.mean),\n"
+                "    hi=('latency', px.max),\n"
+                "    q=('latency', px.quantiles),\n"
+                ")\n"
+                "px.display(s, 'out')\n"
+            ).table("out")
+            assert not ex.fallback_errors, ex.fallback_errors
+            results[decompose] = rows
+        finally:
+            flags.reset("program_decompose")
+            flags.reset("streaming_stage")
+    dec, fus = results[True], results[False]
+    di = {s: i for i, s in enumerate(dec["service"])}
+    fi = {s: i for i, s in enumerate(fus["service"])}
+    assert set(di) == set(fi) == {"a", "b", "c"}
+    for svc in "abc":
+        i, j = di[svc], fi[svc]
+        for col in ("n", "total", "err", "hi", "q"):
+            assert dec[col][i] == fus[col][j], (svc, col)
+
+
+def test_hll_cell_lane_matches_host_engine(mesh):
+    """approx_count_distinct over a small-domain int column rides the
+    int-dictionary cell lane (hll.cell_update) and reproduces the host
+    engine's row-wise registers bit-for-bit — identical estimates."""
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    c_dev = Carnot(device_executor=ex)
+    c_host = Carnot(device_executor=None)
+    _make_table(c_dev, "http_events", 10_000)
+    _make_table(c_host, "http_events", 10_000)
+    pxl = (
+        "df = px.DataFrame(table='http_events')\n"
+        "s = df.groupby(['service']).agg(\n"
+        "    nd=('resp_status', px.approx_count_distinct),\n"
+        ")\n"
+        "px.display(s, 'out')\n"
+    )
+    rows_d = c_dev.execute_query(pxl).table("out")
+    assert not ex.fallback_errors, ex.fallback_errors
+    staged = next(iter(ex._staged_cache.values()))
+    assert "resp_status" in staged.int_dicts  # the cell lane engaged
+    rows_h = c_host.execute_query(pxl).table("out")
+    dd = dict(zip(rows_d["service"], rows_d["nd"]))
+    dh = dict(zip(rows_h["service"], rows_h["nd"]))
+    assert dd == dh
+    for svc in "abc":
+        assert dd[svc] == 3  # {200, 400, 500}: exact in the linear regime

@@ -128,9 +128,9 @@ def test_stream_multi_window_matches_monolithic(mesh):
         q_m = json.loads(rows_m["q"][j])
         for key in ("p50", "p99"):
             assert q_s[key] == pytest.approx(q_m[key], rel=0.05)
-    # the fold really ran (stream program cached) and the window count is
+    # the fold really ran (fold unit cached) and the window count is
     # what the geometry dictates
-    assert any(s.startswith("stream|") for s in ex_s._program_cache)
+    assert any(s.startswith("fold|") for s in ex_s._program_cache)
 
 
 def test_stream_sketches_match_monolithic(mesh):
@@ -161,7 +161,7 @@ def test_stream_single_window_degenerate(mesh):
         assert rows_s["n"][i] == rows_m["n"][j]
         assert rows_s["total"][i] == rows_m["total"][j]  # bit-identical
         assert rows_s["hi"][i] == rows_m["hi"][j]
-    assert any(s.startswith("stream|") for s in ex_s._program_cache)
+    assert any(s.startswith("fold|") for s in ex_s._program_cache)
 
 
 def test_stream_non_multiple_and_tiny_tail(mesh):
@@ -198,15 +198,12 @@ def test_stream_populates_warm_cache(mesh):
         c, data = _seed(ex)
         rows_cold = c.execute_query(STATS_PXL).table("out")
         assert len(ex._staged_cache) == 1
-        n_stream_programs = sum(
-            1 for s in ex._program_cache if s.startswith("stream|")
-        )
+        from pixie_tpu.parallel.staging import reset_cold_profile
+
+        reset_cold_profile()
         rows_warm = c.execute_query(STATS_PXL).table("out")
-        # warm run must not have re-streamed (no new stream programs)
-        assert (
-            sum(1 for s in ex._program_cache if s.startswith("stream|"))
-            == n_stream_programs
-        )
+        # warm run must not have re-streamed (no window pipeline ran)
+        assert "stream_windows" not in reset_cold_profile()
         assert rows_warm["n"] == rows_cold["n"]
         assert rows_warm["total"] == rows_cold["total"]
         assert rows_warm["hi"] == rows_cold["hi"]
@@ -256,16 +253,20 @@ def test_stream_multipass_falls_back_to_monolithic(mesh):
         )
         t.compact()
         t.stop()
+        from pixie_tpu.parallel.staging import reset_cold_profile
+
+        reset_cold_profile()
         res = c.execute_query(
             "df = px.DataFrame(table='hc')\n"
             "s = df.groupby(['key']).agg(n=('time_', px.count),\n"
             "    q=('latency', px.quantiles))\n"
             "px.display(s, 'out')\n"
         )
+        prof = reset_cold_profile()
         assert not ex.fallback_errors, ex.fallback_errors
         # the stream was gated (multi-pass), not crashed
         assert not ex.stream_fallback_errors, ex.stream_fallback_errors
-        assert not any(s.startswith("stream|") for s in ex._program_cache)
+        assert "stream_windows" not in prof, sorted(prof)
         d = res.table("out")
         got_n = dict(zip(d["key"], d["n"]))
         import collections

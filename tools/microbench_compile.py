@@ -1,0 +1,223 @@
+"""Compile-wall microbench: monolithic vs decomposed program compile cost
++ bucket-reuse hit rate.
+
+Three measurements over the service_stats-class query shape:
+
+1. FUSED monolithic program (program_decompose=0, streaming off): cold
+   `program` phase time (trace+compile+execute) vs warm execute — the
+   difference is the fused compile cost.
+2. DECOMPOSED units (program_decompose=1, streaming off): the same
+   cold/warm split with the init/fold/merge/finalize pipeline — each
+   unit compiles separately and the fold is the only expensive one.
+3. STREAMED + AOT (streaming on): stage_compile (background compile
+   seconds, concurrent with pack/transfer), stage_compile_wait (the
+   non-overlapped remainder the first fold blocked on), stage_overlap.
+
+Bucket reuse: N tables with DIFFERENT row counts whose padded sizes land
+in the same geometry bucket run the same query; the hit rate is the
+fraction of queries that compiled nothing new (program-cache size
+unchanged). With signature_buckets on this should be (N-1)/N.
+
+Prints ONE JSON line on stdout.
+
+Env knobs: MB_ROWS (default 2M), MB_BUCKET_TABLES (default 3),
+MB_BLOCK_ROWS (default 1<<17), MB_SERVICES (default 16), JAX_PLATFORMS.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+PXL = (
+    "df = px.DataFrame(table='{table}')\n"
+    "df.failure = df.resp_status >= 400\n"
+    "stats = df.groupby(['service']).agg(\n"
+    "    throughput=('time_', px.count),\n"
+    "    error_rate=('failure', px.mean),\n"
+    "    latency=('latency', px.quantiles),\n"
+    ")\n"
+    "px.display(stats, 'service_stats')\n"
+)
+
+
+def main() -> None:
+    n_rows = int(os.environ.get("MB_ROWS", 2_000_000))
+    n_bucket_tables = int(os.environ.get("MB_BUCKET_TABLES", 3))
+    block_rows = int(os.environ.get("MB_BLOCK_ROWS", 1 << 17))
+    n_services = int(os.environ.get("MB_SERVICES", 16))
+
+    import jax
+    from jax.sharding import Mesh
+
+    from pixie_tpu.engine import Carnot
+    from pixie_tpu.parallel import MeshExecutor
+    from pixie_tpu.parallel.staging import reset_cold_profile
+    from pixie_tpu.table.column import DictColumn
+    from pixie_tpu.types import DataType, Relation, SemanticType
+    from pixie_tpu.utils import flags
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("d",))
+    rel = Relation.of(
+        ("time_", DataType.TIME64NS, SemanticType.ST_TIME_NS),
+        ("service", DataType.STRING, SemanticType.ST_SERVICE_NAME),
+        ("resp_status", DataType.INT64),
+        ("latency", DataType.FLOAT64, SemanticType.ST_DURATION_NS),
+    )
+
+    def build_table(carnot, name, rows, seed=42):
+        table = carnot.table_store.create_table(
+            name, rel, size_limit=1 << 42
+        )
+        svc_dict = table.dictionaries["service"]
+        for i in range(n_services):
+            svc_dict.get_code(f"ns/svc-{i}")
+        rng = np.random.default_rng(seed)
+        chunk = 4_000_000
+        for off in range(0, rows, chunk):
+            m = min(chunk, rows - off)
+            table.write_pydict(
+                {
+                    "time_": np.arange(off, off + m, dtype=np.int64) * 1000,
+                    "service": DictColumn(
+                        rng.integers(0, n_services, m, dtype=np.uint8).astype(
+                            np.int32
+                        ),
+                        svc_dict,
+                    ),
+                    "resp_status": rng.choice(
+                        np.array([200, 301, 404, 500], np.int64), m
+                    ),
+                    "latency": rng.exponential(3e7, m),
+                }
+            )
+        table.compact()
+        table.stop()
+        return table
+
+    def cold_warm(decompose: bool) -> dict:
+        """Cold (compile-bearing) vs warm `program` phase, streaming off
+        so the monolithic/decomposed execution path is what's measured."""
+        flags.set("program_decompose", decompose)
+        flags.set("streaming_stage", False)
+        try:
+            ex = MeshExecutor(mesh=mesh, block_rows=block_rows)
+            c = Carnot(device_executor=ex)
+            build_table(c, "http_events", n_rows)
+            q = PXL.format(table="http_events")
+            reset_cold_profile()
+            t0 = time.perf_counter()
+            c.execute_query(q)
+            cold_s = time.perf_counter() - t0
+            prof = reset_cold_profile()
+            assert not ex.fallback_errors, ex.fallback_errors
+            t0 = time.perf_counter()
+            c.execute_query(q)
+            warm_s = time.perf_counter() - t0
+            return {
+                "cold_s": round(cold_s, 3),
+                "cold_program_s": round(prof.get("program", 0.0), 3),
+                "warm_s": round(warm_s, 3),
+                "compile_s_approx": round(
+                    max(prof.get("program", 0.0) - warm_s, 0.0), 3
+                ),
+                "programs_cached": len(ex._program_cache),
+            }
+        finally:
+            flags.reset("program_decompose")
+            flags.reset("streaming_stage")
+
+    log("measuring FUSED monolithic program...")
+    fused = cold_warm(decompose=False)
+    log(f"fused: {fused}")
+    log("measuring DECOMPOSED units...")
+    decomposed = cold_warm(decompose=True)
+    log(f"decomposed: {decomposed}")
+
+    # Streamed cold path with background AOT compile.
+    flags.set("streaming_stage", True)
+    try:
+        ex = MeshExecutor(mesh=mesh, block_rows=block_rows)
+        c = Carnot(device_executor=ex)
+        build_table(c, "http_events", n_rows)
+        reset_cold_profile()
+        t0 = time.perf_counter()
+        c.execute_query(PXL.format(table="http_events"))
+        cold_s = time.perf_counter() - t0
+        prof = reset_cold_profile()
+        streamed = {
+            "cold_s": round(cold_s, 3),
+            "stage_compile_s": round(prof.get("stage_compile", 0.0), 3),
+            "stage_compile_wait_s": round(
+                prof.get("stage_compile_wait", 0.0), 3
+            ),
+            "stage_overlap_s": round(prof.get("stage_overlap", 0.0), 3),
+            "compile_overlapped_s": round(
+                max(
+                    prof.get("stage_compile", 0.0)
+                    - prof.get("stage_compile_wait", 0.0),
+                    0.0,
+                ),
+                3,
+            ),
+        }
+        log(f"streamed+aot: {streamed}")
+    finally:
+        flags.reset("streaming_stage")
+
+    # Bucket reuse: same query over N tables with different row counts in
+    # one geometry bucket; every query after the first should compile
+    # nothing.
+    ex = MeshExecutor(mesh=mesh, block_rows=block_rows)
+    c = Carnot(device_executor=ex)
+    base = n_rows
+    hits = 0
+    sizes = []
+    for i in range(n_bucket_tables):
+        # Shrink by ~2% per table: padded pow2 size (the stream-window
+        # bucket) is identical for all of them.
+        rows = base - (base // 50) * i
+        sizes.append(rows)
+        build_table(c, f"http_b{i}", rows, seed=42 + i)
+        before = len(ex._program_cache)
+        c.execute_query(PXL.format(table=f"http_b{i}"))
+        assert not ex.fallback_errors, ex.fallback_errors
+        if i > 0 and len(ex._program_cache) == before:
+            hits += 1
+    bucket = {
+        "tables": sizes,
+        "reuse_hits": hits,
+        "reuse_rate": round(hits / max(n_bucket_tables - 1, 1), 3),
+        "programs_cached": len(ex._program_cache),
+    }
+    log(f"bucket reuse: {bucket}")
+
+    print(
+        json.dumps(
+            {
+                "bench": "compile_wall",
+                "rows": n_rows,
+                "backend": jax.default_backend(),
+                "devices": len(devices),
+                "fused": fused,
+                "decomposed": decomposed,
+                "streamed_aot": streamed,
+                "bucket_reuse": bucket,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
