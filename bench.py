@@ -61,6 +61,16 @@ measure a REAL compile. Program signatures are bucketed
 into fold/merge/finalize units (PIXIE_TPU_PROGRAM_DECOMPOSE=0,
 PIXIE_TPU_AOT_COMPILE=0 for the r6 behavior).
 
+The sort–compact lane (r8): every config's ledger entry carries
+``rows_per_sec`` (total, next to the per-chip metric the gate tracks)
+and ``reduction_lanes`` — the trace-time lane choices its compiled
+programs made (ops/segment.LANE_COUNTS: hll_sorted_compact vs
+hll_scatter, minmax_sorted_compact vs minmax_scatter, countmin_*), so a
+lane-selection regression is visible in BENCH_DETAIL.json even when the
+throughput delta alone would hide inside gate tolerance. The lane is
+flag-gated (PIXIE_TPU_SORTED_COMPACT=0 for the r5 scatter behavior) and
+logged next to the streaming/compile knobs at startup.
+
 Env knobs: BENCH_ROWS (configs 2/5; default 256M), BENCH_SMALL_ROWS
 (configs 1/3/4; default 64M), BENCH_HOST_ROWS (config 0; default 8M),
 BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS (comma list, default
@@ -292,12 +302,17 @@ def main() -> None:
 
     from pixie_tpu.utils import flags
 
+    from pixie_tpu.ops import segment as segment_ops
+
     devices = jax.devices()
     n_chips = len(devices)
     mesh = Mesh(np.array(devices), ("d",))
     log(
         f"streaming_stage={flags.streaming_stage} "
-        f"window_rows={flags.streaming_window_rows}"
+        f"window_rows={flags.streaming_window_rows} "
+        f"sorted_compact={flags.sorted_compact} "
+        f"sorted_min_rows={segment_ops.SORTED_MIN_ROWS} "
+        f"prewarm_compile={flags.prewarm_compile}"
     )
     carnot = Carnot(
         device_executor=MeshExecutor(mesh=mesh, block_rows=block_rows)
@@ -320,10 +335,20 @@ def main() -> None:
         # fold dispatch actually blocked on.
         snap.setdefault("stage_compile", 0.0)
         snap.setdefault("compile_cache_hit", 0.0)
+        # r8 keys: warm_compile is the background AOT of the
+        # warm/monolithic fold (concurrent with the cold query's tail);
+        # prewarm_hit counts query folds served by a table-create
+        # prewarm (flag prewarm_compile).
+        snap.setdefault("warm_compile", 0.0)
+        snap.setdefault("prewarm_hit", 0.0)
         return {k: round(v, 2) for k, v in sorted(snap.items())}
 
     def cold_run(query):
         reset_cold_profile()
+        # Reduction-lane telemetry is trace-time: reset here so each
+        # config's ledger entry records the lanes ITS programs chose
+        # (sort–compact vs scatter vs matmul; ops/segment.LANE_COUNTS).
+        segment_ops.reduce_lanes(reset=True)
         t0 = time.perf_counter()
         result = carnot.execute_query(query)
         cold_s = time.perf_counter() - t0
@@ -459,7 +484,14 @@ def main() -> None:
             "vs_baseline": round(rps / 1e8, 3),
         }
         ledger.add(
-            {"config": 2, "cold_s": cold2, "cold_breakdown": bd, **headline}
+            {
+                "config": 2,
+                "cold_s": cold2,
+                "cold_breakdown": bd,
+                "rows_per_sec": round(n_rows / best),
+                "reduction_lanes": segment_ops.reduce_lanes(reset=True),
+                **headline,
+            }
         )
         # stdout headline NOW — the driver must capture it even if a later
         # config blows its timeout. Gate reflects configs finished so far
@@ -488,6 +520,8 @@ def main() -> None:
                 "config": 5,
                 "cold_s": cold5,
                 "cold_breakdown": bd,
+                "rows_per_sec": round(n_rows / best),
+                "reduction_lanes": segment_ops.reduce_lanes(reset=True),
                 "metric": "sketch_tdigest_countmin_rows_per_sec_per_chip",
                 "value": round(rps),
                 "unit": "rows/s/chip",
@@ -553,6 +587,8 @@ def main() -> None:
                 "config": 4,
                 "cold_s": cold4,
                 "cold_breakdown": bd,
+                "rows_per_sec": round(n_small / best),
+                "reduction_lanes": segment_ops.reduce_lanes(reset=True),
                 "metric": "flamegraph_stack_merge_rows_per_sec_per_chip",
                 "value": round(n_small / best / n_chips),
                 "unit": "rows/s/chip",
@@ -628,6 +664,8 @@ def main() -> None:
                 "config": 1,
                 "cold_s": cold1,
                 "cold_breakdown": bd,
+                "rows_per_sec": round(n_small / best),
+                "reduction_lanes": segment_ops.reduce_lanes(reset=True),
                 "metric": "http_data_filter_head_rows_per_sec_per_chip",
                 "value": round(n_small / best / n_chips),
                 "unit": "rows/s/chip",
@@ -657,6 +695,8 @@ def main() -> None:
                 "config": 0,
                 "cold_s": cold0,
                 "cold_breakdown": bd,
+                "rows_per_sec": round(n_host / best),
+                "reduction_lanes": segment_ops.reduce_lanes(reset=True),
                 "metric": "http_data_filter_project_rows_per_sec",
                 "value": round(n_host / best),
                 "unit": "rows/s",
@@ -735,6 +775,11 @@ def main() -> None:
                 "config": 3,
                 "cold_s": cold3,
                 "cold_breakdown": bd,
+                "rows_per_sec": round(n_small / best),
+                # The config the r8 sort–compact lane targets: expect
+                # hll_sorted_compact here on TPU (scatter on CPU / below
+                # SORTED_MIN_ROWS).
+                "reduction_lanes": segment_ops.reduce_lanes(reset=True),
                 "metric": "net_flow_group_hll_rows_per_sec_per_chip",
                 "value": round(n_small / best / n_chips),
                 "unit": "rows/s/chip",

@@ -103,6 +103,18 @@ class Carnot:
         # hot source→map/filter→agg chain run as ONE compiled shard_map
         # program on the device mesh; the host exec graph runs the suffix.
         self.device_executor = device_executor
+        if device_executor is not None and hasattr(
+            device_executor, "prewarm_table"
+        ):
+            # r8 cold-path lever: table registration kicks the background
+            # compile prewarm for the table's bucketed stream-window
+            # geometry (flag ``prewarm_compile``; gated inside
+            # prewarm_table so it can be flipped at runtime).
+            self.table_store.add_create_listener(
+                lambda name, table: device_executor.prewarm_table(
+                    table, self.registry
+                )
+            )
         self.compiler = Compiler(registry)
 
     # -- the two entry points (carnot.h:72-81) ------------------------------

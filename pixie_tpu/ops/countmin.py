@@ -16,9 +16,12 @@ Update strategies (r5 re-measured with state-carrying scans):
   share their hash pair.
 - ``update`` — per-row fallback: two native-u32 hashes
   (Kirsch–Mitzenmacher double hashing; a u64 multiply path is ~5x
-  dearer on TPU) and a direct per-depth scatter-add. The r4 sort-based
-  path is gone: a dedup sort still pays a full-length scatter, so it
-  LOSES to the direct scatter (43 vs 27 ns/row, r5 measured).
+  dearer on TPU) and a per-depth bucket count. Above
+  segment.SORTED_MIN_ROWS the counts ride the r8 sort–COMPACT lane
+  (run-length counts compacted to an O(nseg) scatter); the r4 sorted
+  path — whose dedup sort still paid a FULL-length scatter and lost 43
+  vs 27 ns/row (r5) — is what the compaction fixes. Below the threshold
+  (or on CPU) the direct scatter-add remains.
 """
 
 from __future__ import annotations
@@ -56,7 +59,16 @@ def update(state, gids, values, mask=None):
     num_groups, depth, width = state.shape
     nseg = num_groups * width
     outs = []
-    use_sorted = segment.sorted_strategy() and nseg < (1 << 31) - 1
+    # r8: sorted_segment_counts now COMPACTS (run lengths ride a second
+    # sort so the final scatter operand is O(nseg), not O(n)) — the
+    # full-length unique-index scatter that made the r4 sorted path lose
+    # is gone, so the lane re-enables above segment.SORTED_MIN_ROWS.
+    use_sorted = segment.sorted_strategy(
+        gids.shape[0], nseg
+    ) and segment.compact_fits_i32(nseg, 0)
+    segment.lane_count(
+        "countmin_sorted_compact" if use_sorted else "countmin_scatter"
+    )
     for bucket in _buckets(values, depth, width):
         flat = segment.flat_segment_ids(gids, bucket, width)
         if use_sorted:

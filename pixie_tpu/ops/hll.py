@@ -6,13 +6,18 @@ elementwise max — so the cross-device merge lowers to a single `lax.pmax`
 over ICI.
 
 Update strategy: hashing rides the native-u32 pipeline (TPU has no
-64-bit multiplier; a u64 splitmix costs ~5x more per block); the
-register update is a direct scatter-max. r5 re-measured the r4
-sort-dedup path with state-carrying scans: the dedup sort still pays a
-full-length scatter (dropped duplicates are not free), so sort+scatter
-LOSES to the plain scatter (12.6 vs 10.6 ns/row at 4096 groups on a
-v5e). The ~7ns/element scalar scatter is the platform floor for
-register maxes — unlike sums, max does not factor onto the MXU.
+64-bit multiplier; a u64 splitmix costs ~5x more per block). The
+register update is max-reduction over a small packed domain
+(rho < 2^5), which r8 expresses as the sort–COMPACT lane
+(segment.sorted_segment_reduce_compact): pack (register, rho) into one
+i32 key, sort so each register's winning rho sorts first, compact the
+≤ nseg winners to the front with a second sort, and finish with an
+O(nseg) scatter — the full-length ~7ns/row scalar scatter the r5
+sort-DEDUP attempt still paid (and lost to, 12.6 vs 10.6 ns/row) is
+gone from the lane entirely. Below segment.SORTED_MIN_ROWS (or past the
+i32 packing boundary, or on CPU) the direct scatter-max remains the
+lane of record; small-domain columns keep the r7 MXU cell lane
+(cell_update).
 """
 
 from __future__ import annotations
@@ -52,18 +57,25 @@ def update(state, gids, values, mask=None):
     reg, rho = _reg_rho(values, precision)
     flat = segment.flat_segment_ids(gids, reg, m)
     nseg = num_groups * m
-    if segment.sorted_strategy() and (
-        (nseg + 1) << _RHO_BITS < (1 << 31)
+    if segment.sorted_strategy(flat.shape[0], nseg) and (
+        segment.compact_fits_i32(nseg, _RHO_BITS)
     ):
-        # Sort-dedup-scatter register update (TPU fast path): rho packs
-        # into the key so each register's largest rho sorts first.
-        maxes = segment.sorted_segment_max_small(
-            flat, rho, _RHO_BITS, nseg, mask
+        # Sort–compact register update (r8): rho packs into the key so
+        # each register's largest rho sorts first; the winners compact to
+        # the front and the final scatter operand is O(nseg), not O(n).
+        # The i32 packing boundary falls back to the scatter below — a
+        # wrapped key would silently corrupt register ids.
+        segment.lane_count("hll_sorted_compact")
+        maxes = segment.sorted_segment_reduce_compact(
+            flat, rho, _RHO_BITS, nseg, mask, mode="max"
         )
         return jnp.maximum(state, maxes.reshape(num_groups, m))
+    segment.lane_count("hll_scatter")
     if mask is not None:
         rho = jnp.where(mask, rho, 0)
-    maxes = segment.seg_max(rho, flat, nseg, mask=None)  # rho masked to 0
+    # Direct scatter-max regardless of the generic minmax lane: this IS
+    # the fallback for rows/boundaries the compact lane rejected.
+    maxes = jax.ops.segment_max(rho, flat, num_segments=nseg)
     return jnp.maximum(state, maxes.reshape(num_groups, m))
 
 

@@ -20,9 +20,15 @@ import jax.numpy as jnp
 # unit (~160M rows/s measured on v5e — and int64 scatter is ~12x worse at
 # ~13M rows/s, the dominant cost of integer group-by sums in round 3); a
 # one-hot matvec/einsum rides the MXU at ~240M rows/s up to a few thousand
-# segments, with cost scaling ~n*num_segments beyond. CPU prefers scatter.
-# Tests can pin a strategy via set_strategy().
+# segments, with cost scaling ~n*num_segments beyond. Non-sum reductions
+# (max/min) have no einsum form; above SORTED_MIN_ROWS they ride the r8
+# sort–COMPACT lane instead (two i32-class sorts + an O(num_segments)
+# scatter; see sorted_segment_reduce_compact). CPU prefers scatter
+# everywhere. Tests can pin a strategy via set_strategy() /
+# set_sorted_strategy().
 import threading
+
+from pixie_tpu.utils import flags
 
 _FORCE: Optional[str] = None
 _TLS = threading.local()  # per-thread platform hint: agents run in threads
@@ -72,22 +78,55 @@ _FORCE_SORTED: Optional[bool] = None
 
 
 def set_sorted_strategy(v: Optional[bool]) -> None:
-    """Force the sort-based sketch-update path on (True) / off (False);
-    None = default off. r5 re-measured on a v5e with state-carrying
-    scans: a sort+dedup still issues a FULL-LENGTH scatter (dropped
-    duplicates are not free — the scalar unit walks every index), so
-    sort-based updates cost sort (~2.5ns/row) ON TOP of the ~7ns scatter
-    and LOSE everywhere (count-min 43 vs 27 ns/row, HLL 12.6 vs 10.6).
-    The r4 default (sort on TPU) was measured with a harness whose work
-    XLA had folded away; kept only as a test hook."""
+    """Force the sort-based reduction lane on (True) / off (False);
+    None = auto (sorted_strategy below). History: the r4 sort-DEDUP
+    design issued a FULL-LENGTH scatter (dropped duplicates are not free
+    — the scalar unit walks every index) and lost to the direct scatter
+    everywhere (r5: count-min 43 vs 27 ns/row, HLL 12.6 vs 10.6). The r8
+    sort–COMPACT lane removes that full-length scatter entirely
+    (sorted_segment_reduce_compact: the ≤ nseg winners are compacted to
+    the front by a second sort and the final scatter operand has STATIC
+    length nseg), so the lane is back on by default on TPU above
+    SORTED_MIN_ROWS, behind the ``sorted_compact`` flag."""
     global _FORCE_SORTED
     _FORCE_SORTED = v
 
 
-def sorted_strategy() -> bool:
+def sorted_strategy(n_rows: Optional[int] = None, nseg: Optional[int] = None) -> bool:
+    """Should this reduction ride the sort–compact lane?
+
+    Auto policy (no force): TPU-class platforms only (CPU scatters are
+    cheap and its sorts are not), ``sorted_compact`` flag on, at least
+    SORTED_MIN_ROWS rows, and — when the caller knows its segment count —
+    nseg small enough relative to n that the compacted O(nseg) scatter
+    tail is actually negligible (≥4x shorter than the direct scatter)."""
     if _FORCE_SORTED is not None:
         return _FORCE_SORTED
-    return False
+    if not flags.sorted_compact:
+        return False
+    if n_rows is not None and n_rows < SORTED_MIN_ROWS:
+        return False
+    if n_rows is not None and nseg is not None and nseg * 4 > n_rows:
+        return False
+    platform = getattr(_TLS, "hint", None) or jax.default_backend()
+    return platform != "cpu"
+
+
+# -- reduction-lane telemetry: which lane each traced program chose.
+# Incremented at TRACE time (once per compiled program, not per run) so
+# bench.py can record the chosen lane per config next to rows/s.
+LANE_COUNTS: dict[str, int] = {}
+
+
+def lane_count(name: str) -> None:
+    LANE_COUNTS[name] = LANE_COUNTS.get(name, 0) + 1
+
+
+def reduce_lanes(reset: bool = False) -> dict:
+    snap = dict(LANE_COUNTS)
+    if reset:
+        LANE_COUNTS.clear()
+    return snap
 
 
 def _matvec_sum(values_f32, seg_ids, num_segments: int):
@@ -208,46 +247,189 @@ def reconstruct_i64(limb_totals):
     return acc
 
 
-# -- sort-based sketch kernels (TPU fast path) -------------------------------
-# TPU's scalar unit serializes scatters (~7ns/element); a radix sort +
-# deduped unique-index scatter beats it once blocks are big enough to
-# amortize the sort (~4x at 8M rows). Shared by HLL register maxes and
-# count-min bucket counts; the sentinel segment `nseg` collects masked/
-# duplicate rows and lands on a dropped extra slot.
+# -- sort–compact reduction lane (r8, TPU fast path) -------------------------
+# TPU's scalar unit serializes scatters: ~7 ns/element at ANY segment
+# count, and the cost scales with the scatter OPERAND LENGTH, not the
+# unique count — the r4/r5 sort-dedup design still paid a full-length
+# scatter and lost. The r8 lane removes it: sort so each segment's
+# winning value sorts first, mask the first occurrences, then COMPACT
+# the ≤ nseg winners to the front with a second sort keyed
+# (winner ? packed_key : SENTINEL) and finish with a scatter whose
+# operand has STATIC length nseg (~16K registers) instead of n (64M
+# rows). Expected TPU cost: two i32 sorts (0.6–2.4 ns/row measured on a
+# v5e at 2M–32M rows, STATUS r5) + an O(nseg) tail, vs ~7 ns/row for the
+# direct scatter. tools/microbench_sort_reduce.py sweeps rows x segments
+# for all three designs (direct scatter / sort+full-scatter /
+# sort–compact). CPU-measured (this container, 1M–4M rows x 2^10–2^16
+# segs): scatter 39–46 ns/row, sort+full-scatter 119–125, sort–compact
+# 109–120 — compaction beats the full scatter at every shape, but CPU
+# sorts are so slow the direct scatter wins outright, which is why the
+# lane is TPU-gated (re-run the microbench on hardware to refresh the
+# v5e column). Shared by HLL register maxes, count-min bucket counts, and
+# (via the generic two-operand variant) high-cardinality min/max
+# group-bys; the sentinel segment `nseg` collects masked/losing rows and
+# lands on a dropped slot.
 
-SORTED_MIN_ROWS = 1 << 22  # below this, direct scatter wins (r4 measured)
+# Lane threshold: below this the direct scatter wins. r4 measured 1<<22
+# for the sort+FULL-scatter design; the compact lane's scatter tail is
+# O(nseg), so the crossover is just where two sorts beat ~7 ns/row —
+# readjusted to 1<<20 (provisional: re-measure with
+# tools/microbench_sort_reduce.py on hardware).
+SORTED_MIN_ROWS = 1 << 20
+
+
+def compact_fits_i32(nseg: int, value_bits: int) -> bool:
+    """Can (segment, value) pack into one non-negative int32 key with a
+    sentinel segment? Shared overflow gate: callers must fall back to the
+    direct scatter past it (sorted_segment_reduce_compact raises)."""
+    return (nseg + 1) << value_bits < (1 << 31)
+
+
+def sorted_segment_reduce_compact(
+    flat, values, value_bits: int, nseg: int, mask=None, mode: str = "max"
+):
+    """Segment reduction via sort → first-occurrence → COMPACT → O(nseg)
+    scatter. The compaction is the r8 algorithmic idea: XLA scatter cost
+    scales with operand length, so the winners are compacted to the
+    front (second sort keyed ``winner ? packed_key : SENTINEL`` — the
+    packed key already orders by segment) and statically sliced to
+    ``nseg`` before the final scatter, which therefore touches nseg
+    elements instead of n.
+
+    Modes over int32 results:
+      'max' / 'min' — reduce ``values`` (small non-negative ints
+        < 2^value_bits, e.g. HLL rho) per segment. Empty segments hold 0
+        for max (matching sorted_segment_max_small) and
+        (2^value_bits - 1) for min.
+      'count' — rows per segment; ``values``/``value_bits`` ignored.
+
+    Raises ValueError when (nseg+1) << value_bits overflows int32 — the
+    caller must take the direct-scatter lane instead (silent wraparound
+    would corrupt every segment id past the boundary)."""
+    if mode not in ("max", "min", "count"):
+        raise ValueError(f"unknown sort–compact mode {mode!r}")
+    if mode == "count":
+        value_bits = 0
+    if not compact_fits_i32(nseg, value_bits):
+        raise ValueError(
+            "sorted_segment_reduce_compact: (nseg+1) << value_bits "
+            f"overflows int32 (nseg={nseg}, value_bits={value_bits}); "
+            "use the direct-scatter lane"
+        )
+    n = flat.shape[0]
+    vmax = jnp.int32((1 << value_bits) - 1)
+    if n == 0:
+        fill = vmax if mode == "min" else jnp.int32(0)
+        return jnp.full(nseg, fill, jnp.int32)
+    sentinel = jnp.int32(nseg << value_bits)
+    if mode == "count":
+        key = flat.astype(jnp.int32)
+        if mask is not None:
+            key = jnp.where(mask, key, jnp.int32(nseg))
+        ks = jnp.sort(key)
+        idx = jnp.arange(n, dtype=jnp.int32)
+        first = jnp.concatenate([jnp.ones(1, jnp.bool_), ks[1:] != ks[:-1]])
+        # Index of the next run start AFTER each position: reverse cummin
+        # of start positions (n where not a start).
+        start_at = jnp.where(first, idx, jnp.int32(n))
+        nxt = jnp.flip(
+            jax.lax.cummin(
+                jnp.flip(
+                    jnp.concatenate([start_at[1:], jnp.full(1, n, jnp.int32)])
+                )
+            )
+        )
+        runlen = jnp.where(first, nxt - idx, 0)
+        keep = first & (ks < nseg)
+        ckey, ccnt = jax.lax.sort(
+            (jnp.where(keep, ks, jnp.int32(nseg)), runlen), num_keys=1
+        )
+        k = min(nseg, n)
+        seg, cnt = ckey[:k], ccnt[:k]
+        live = seg < nseg
+        return (
+            jnp.zeros(nseg, jnp.int32)
+            .at[jnp.where(live, seg, nseg)]
+            .add(jnp.where(live, cnt, 0), mode="drop")
+        )
+    # max/min: pack (segment, value) into one key so each segment's
+    # winning value sorts FIRST within its run.
+    vkey = (vmax - values) if mode == "max" else values
+    key = (flat.astype(jnp.int32) << value_bits) | vkey.astype(jnp.int32)
+    if mask is not None:
+        key = jnp.where(mask, key, sentinel)
+    ks = jnp.sort(key)
+    flat_s = ks >> value_bits
+    first = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), flat_s[1:] != flat_s[:-1]]
+    )
+    keep = first & (flat_s < nseg)
+    # Compact: the winners' packed keys already order by segment, so one
+    # more sort with losers collapsed onto the sentinel brings the ≤ nseg
+    # winners to the front; the slice length is STATIC.
+    cks = jnp.sort(jnp.where(keep, ks, sentinel))[: min(nseg, n)]
+    seg = cks >> value_bits
+    val = cks & vmax
+    if mode == "max":
+        val = vmax - val
+    live = seg < nseg
+    fill = jnp.int32(0) if mode == "max" else vmax
+    return (
+        jnp.full(nseg, fill, jnp.int32)
+        .at[jnp.where(live, seg, nseg)]
+        .set(jnp.where(live, val, fill), mode="drop")
+    )
+
+
+def sorted_segment_minmax_compact(
+    values, seg_ids, num_segments: int, mask=None, is_min: bool = False
+):
+    """Per-segment min/max of ARBITRARY-dtype values (int64/float64
+    group-by args) via a two-operand lexicographic sort + the same
+    compaction: sort (segment, value) ascending, take the first (min) or
+    last (max) row of each segment's run, compact the winners with a
+    second sort, and scatter nseg elements. Empty segments hold the same
+    identity fill seg_min/seg_max produce, so elementwise state merges
+    are unchanged."""
+    ident = _identity_for(values.dtype, is_min=is_min)
+    n = values.shape[0]
+    if n == 0:
+        return jnp.full(num_segments, ident, values.dtype)
+    seg = seg_ids.astype(jnp.int32)
+    if mask is not None:
+        seg = jnp.where(mask, seg, jnp.int32(num_segments))
+    seg_s, val_s = jax.lax.sort((seg, values), num_keys=2)
+    if is_min:
+        winner = jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), seg_s[1:] != seg_s[:-1]]
+        )
+    else:
+        winner = jnp.concatenate(
+            [seg_s[1:] != seg_s[:-1], jnp.ones(1, jnp.bool_)]
+        )
+    winner = winner & (seg_s < num_segments)
+    ckey, cval = jax.lax.sort(
+        (jnp.where(winner, seg_s, jnp.int32(num_segments)), val_s),
+        num_keys=1,
+    )
+    k = min(num_segments, n)
+    seg_c, val_c = ckey[:k], cval[:k]
+    live = seg_c < num_segments
+    return (
+        jnp.full(num_segments, ident, values.dtype)
+        .at[jnp.where(live, seg_c, num_segments)]
+        .set(jnp.where(live, val_c, ident), mode="drop")
+    )
 
 
 def sorted_segment_counts(flat, nseg: int, mask=None):
-    """Per-segment counts via sort + run-length + unique-index scatter.
+    """Per-segment counts via sort + run-length + compaction (r8: the
+    r4 unique-index scatter was still FULL-length — XLA walks every
+    index — so it lost; the compacted scatter touches nseg elements).
     Exact; int32 result (callers widen)."""
-    n = flat.shape[0]
-    if n == 0:
-        return jnp.zeros(nseg, jnp.int32)
-    if mask is not None:
-        flat = jnp.where(mask, flat, jnp.int32(nseg))
-    ks = jnp.sort(flat)
-    idx = jnp.arange(n, dtype=jnp.int32)
-    first = jnp.concatenate([jnp.ones(1, jnp.bool_), ks[1:] != ks[:-1]])
-    # Index of the next run start AFTER each position: reverse cummin of
-    # start positions (n where not a start).
-    start_at = jnp.where(first, idx, jnp.int32(n))
-    nxt = jnp.flip(
-        jax.lax.cummin(
-            jnp.flip(
-                jnp.concatenate([start_at[1:], jnp.full(1, n, jnp.int32)])
-            )
-        )
+    return sorted_segment_reduce_compact(
+        flat, None, 0, nseg, mask, mode="count"
     )
-    runlen = jnp.where(first, nxt - idx, 0)
-    keep = first & (ks < nseg)
-    seg = jnp.where(keep, ks, jnp.int32(nseg))
-    out = (
-        jnp.zeros(nseg + 1, jnp.int32)
-        .at[seg]
-        .add(jnp.where(keep, runlen, 0), mode="drop")
-    )
-    return out[:-1]
 
 
 def sorted_segment_max_small(flat, values, value_bits: int, nseg: int, mask=None):
@@ -255,7 +437,13 @@ def sorted_segment_max_small(flat, values, value_bits: int, nseg: int, mask=None
     single packed-key sort: key = flat << bits | (max_value - value), so
     each segment's LARGEST value sorts first and the first-occurrence mask
     yields unique scatter indices. Requires (nseg+1) << value_bits < 2^31.
-    Returns int32 maxes (0 for empty segments)."""
+    Returns int32 maxes (0 for empty segments).
+
+    NOTE (r8): the scatter here is still FULL-LENGTH (unique indices are
+    not cheaper — XLA scatter cost scales with operand length), which is
+    why this design lost to the direct scatter in r5. Kept as the
+    sort+full-scatter comparand for tools/microbench_sort_reduce.py;
+    production consumers use sorted_segment_reduce_compact."""
     n = flat.shape[0]
     if n == 0:
         return jnp.zeros(nseg, jnp.int32)
@@ -329,6 +517,14 @@ def seg_count(seg_ids, num_segments: int, mask=None):
 
 
 def seg_min(values, seg_ids, num_segments: int, mask=None):
+    # min has no MXU einsum form; the sort–compact lane replaces the
+    # ~7 ns/row scalar scatter above SORTED_MIN_ROWS (r8).
+    if sorted_strategy(values.shape[0], num_segments):
+        lane_count("minmax_sorted_compact")
+        return sorted_segment_minmax_compact(
+            values, seg_ids, num_segments, mask, is_min=True
+        )
+    lane_count("minmax_scatter")
     if mask is not None:
         fill = _identity_for(values.dtype, is_min=True)
         values = jnp.where(mask, values, fill)
@@ -336,6 +532,12 @@ def seg_min(values, seg_ids, num_segments: int, mask=None):
 
 
 def seg_max(values, seg_ids, num_segments: int, mask=None):
+    if sorted_strategy(values.shape[0], num_segments):
+        lane_count("minmax_sorted_compact")
+        return sorted_segment_minmax_compact(
+            values, seg_ids, num_segments, mask, is_min=False
+        )
+    lane_count("minmax_scatter")
     if mask is not None:
         fill = _identity_for(values.dtype, is_min=False)
         values = jnp.where(mask, values, fill)

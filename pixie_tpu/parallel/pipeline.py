@@ -537,8 +537,15 @@ class MeshExecutor:
         self._finmode_cache: dict[tuple, Any] = {}
         # AOT-compiled fold executables (sig -> jax Compiled) + the single
         # background thread that lowers/compiles them while staging
-        # streams (the r7 compile/staging overlap).
+        # streams (the r7 compile/staging overlap). _aot_futures tracks
+        # in-flight compiles so a query arriving mid-compile attaches to
+        # the running future instead of compiling twice; _prewarmed holds
+        # the fold signatures speculatively compiled at table-create time
+        # (r8 prewarm_compile) so hits are attributable (prewarm_hit).
         self._aot_compiled: dict[str, Any] = {}
+        self._aot_futures: dict[str, Any] = {}
+        self._prewarmed: set[str] = set()
+        self.prewarm_errors: dict[str, str] = {}
         self._aot_pool = None
         # Host-computed any() representatives, keyed by
         # (table, version, window, key exprs, col); small LRU.
@@ -2311,10 +2318,15 @@ class MeshExecutor:
     def _signature(self, m, specs, key_plan, staged, aux_vals, capacity) -> str:
         """Structural identity of the compiled program: expressions, UDA
         set, key mode, block geometry, capacity, aux shapes."""
+        from pixie_tpu.ops import segment as _segment
+
         modes, _ = self._finalize_modes(
             specs, capacity, m.agg_op.stage == AggStage.PARTIAL
         )
+        with _segment.platform_hint(self.mesh.devices.flat[0].platform):
+            sortlane = int(_segment.sorted_strategy(staged.mask.shape[-1]))
         parts = [
+            f"sortlane:{sortlane}",
             "finmodes:" + ",".join(modes),
             f"stage:{m.agg_op.stage.value}",
             ",".join(f"{n}:{a.shape}:{a.dtype}" for n, a in
@@ -2354,16 +2366,26 @@ class MeshExecutor:
     def _lane_sig(self, specs) -> str:
         """UDA lane identity WITHOUT output names: two queries whose agg
         lanes differ only in what the outputs are called (or how they
-        finalize) share fold/init/merge executables."""
+        finalize) share fold/init/merge executables. UDAs that never read
+        their column (count) also drop the arg expression and overload
+        types — the fold never touches the column, so count('time_') and
+        count('latency') are the same lane (this is also what lets
+        table-create prewarm guess the count lane without knowing which
+        column a future query will point it at)."""
         return ";".join(
             f"{uda.name}{uda.arg_types}({arg_e!r})"
+            if uda.reads_args
+            else f"{uda.name}()"
             for _out, arg_e, uda in specs
         )
 
     def _uda_set_sig(self, specs) -> str:
         """Coarser still: the UDA set alone (state shapes + merge kinds
         derive from it) — keys the init and merge units."""
-        return ",".join(f"{uda.name}{uda.arg_types}" for _o, _e, uda in specs)
+        return ",".join(
+            f"{uda.name}{uda.arg_types if uda.reads_args else '()'}"
+            for _o, _e, uda in specs
+        )
 
     def _fold_signature(
         self, m, specs, key_plan, staged, aux_vals, capacity
@@ -2374,8 +2396,18 @@ class MeshExecutor:
         finalize unit). Staging geometry is bucketed (staging
         .block_geometry), so two tables whose padded shapes land in the
         same bucket produce the same string — and share one compiled
-        executable in-process plus one .jax_cache entry across runs."""
+        executable in-process plus one .jax_cache entry across runs.
+
+        The sort–compact lane decision (r8) is part of the identity: it
+        is made at trace time from the per-block row count, so a flag /
+        forced-strategy flip must not reuse a fold traced for the other
+        lane."""
+        from pixie_tpu.ops import segment as _segment
+
+        with _segment.platform_hint(self.mesh.devices.flat[0].platform):
+            sortlane = int(_segment.sorted_strategy(staged.mask.shape[-1]))
         parts = [
+            f"sortlane:{sortlane}",
             ",".join(f"{n}:{a.shape}:{a.dtype}" for n, a in
                      sorted(staged.blocks.items())),
             f"mask:{staged.mask.shape}",
@@ -2451,32 +2483,63 @@ class MeshExecutor:
         """jit -> lowered -> compiled, separated so tests can poison it."""
         return program.lower(*avals).compile()
 
-    def _aot_compile_async(self, sig: str, program, avals):
+    def _aot_compile_async(
+        self, sig: str, program, avals, profile_key: str = "stage_compile"
+    ):
         """Future resolving to the AOT-compiled executable of ``program``
         at ``avals``. The lower+compile runs on a background thread so the
         cold XLA compile overlaps host pack and HBM transfer instead of
-        preceding them; results cache in _aot_compiled per signature.
-        COLD_PROFILE gains stage_compile (seconds spent compiling,
-        concurrent with staging) and compile_cache_hit (persistent
-        .jax_cache deserializations observed during the compile)."""
+        preceding them; results cache in _aot_compiled per signature, and
+        in-flight compiles dedup through _aot_futures (a query arriving
+        while its prewarmed fold is still compiling attaches to the
+        running future instead of compiling twice). COLD_PROFILE gains
+        ``profile_key`` seconds (stage_compile for the stream fold,
+        warm_compile for the warm/monolithic fold, prewarm_compile at
+        table create), compile_cache_hit (persistent .jax_cache
+        deserializations observed during the compile), and prewarm_hit
+        (query folds served by a table-create prewarm, completed or
+        still in flight)."""
         import concurrent.futures
+
+        def record_prewarm_hit():
+            if sig in self._prewarmed and profile_key == "stage_compile":
+                COLD_PROFILE["prewarm_hit"] = COLD_PROFILE.get(
+                    "prewarm_hit", 0.0
+                ) + 1.0
 
         done = self._aot_compiled.get(sig)
         if done is not None:
+            record_prewarm_hit()
             fut = concurrent.futures.Future()
             fut.set_result(done)
             return fut
+        inflight = self._aot_futures.get(sig)
+        if inflight is not None and not (
+            inflight.done() and inflight.exception() is not None
+        ):
+            record_prewarm_hit()
+            return inflight
         if self._aot_pool is None:
             self._aot_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="aot-compile"
             )
 
         def work():
+            from pixie_tpu.ops import segment as _segment
+
             hits0 = _PERSISTENT_CACHE_HITS[0]
             t0 = time.perf_counter()
-            compiled = self._aot_lower_compile(program, avals)
-            COLD_PROFILE["stage_compile"] = COLD_PROFILE.get(
-                "stage_compile", 0.0
+            # Pin the kernel-strategy hint to the MESH platform: this
+            # worker thread has no caller TLS hint, and
+            # jax.default_backend() can disagree with the mesh (CPU exec
+            # graph on a TPU-attached host) — the trace must pick the
+            # same lanes the fold signature assumed.
+            with _segment.platform_hint(
+                self.mesh.devices.flat[0].platform
+            ):
+                compiled = self._aot_lower_compile(program, avals)
+            COLD_PROFILE[profile_key] = COLD_PROFILE.get(
+                profile_key, 0.0
             ) + (time.perf_counter() - t0)
             if _PERSISTENT_CACHE_HITS[0] > hits0:
                 COLD_PROFILE["compile_cache_hit"] = COLD_PROFILE.get(
@@ -2485,7 +2548,215 @@ class MeshExecutor:
             self._aot_compiled[sig] = compiled
             return compiled
 
-        return self._aot_pool.submit(work)
+        fut = self._aot_pool.submit(work)
+        self._aot_futures[sig] = fut
+        return fut
+
+    def _aot_warm_fold(
+        self, m, specs, evaluator, key_plan, staged, aux, capacity
+    ):
+        """Background-AOT the WARM/monolithic fold (r8, second ROADMAP
+        cold-path lever): the streamed windows concatenate into the
+        staged-cache entry at a DIFFERENT geometry than the stream
+        window, so the first warm query used to compile its fold inline.
+        Called at the end of a cold stream, this lowers+compiles that
+        warm-geometry fold on the AOT worker while the cold query
+        finishes — breakdown key ``warm_compile``; a compile or dispatch
+        failure falls back to the in-line jit like the stream fold does.
+        Returns the warm fold signature (None when already compiled or
+        in flight)."""
+        aux_vals = list(aux.values())
+        aux_key_order = list(aux.keys())
+        init_p, fold_p, _merge_p, _fin_p, fold_sig = self._unit_programs(
+            m, specs, evaluator, key_plan, staged, aux_key_order,
+            aux_vals, capacity,
+        )
+        if fold_sig in self._aot_compiled or fold_sig in self._aot_futures:
+            return None  # single-window stream: warm sig == stream sig
+        (axis_name,) = self.mesh.axis_names
+        sharded = NamedSharding(self.mesh, P(axis_name))
+        repl = NamedSharding(self.mesh, P())
+        _treedef, leaves = self._state_template(specs, capacity)
+        d = staged.num_devices
+        avals = [
+            jax.ShapeDtypeStruct(
+                (d,) + tuple(l.shape), l.dtype, sharding=sharded
+            )
+            for l in leaves
+        ]
+        for n2 in sorted(staged.blocks):
+            a = staged.blocks[n2]
+            avals.append(
+                jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+            )
+        avals.append(
+            jax.ShapeDtypeStruct(
+                staged.mask.shape, staged.mask.dtype,
+                sharding=staged.mask.sharding,
+            )
+        )
+        if key_plan.host_gids is not None:
+            g = staged.gids
+            avals.append(
+                jax.ShapeDtypeStruct(g.shape, g.dtype, sharding=g.sharding)
+            )
+        if isinstance(key_plan.device_expr, tuple):
+            lut = np.asarray(key_plan.device_expr[2])
+            avals.append(
+                jax.ShapeDtypeStruct(lut.shape, lut.dtype, sharding=repl)
+            )
+        for v in aux_vals:
+            v = np.asarray(v)
+            avals.append(
+                jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=repl)
+            )
+        if staged.narrow_offsets:
+            avals.append(
+                jax.ShapeDtypeStruct(
+                    (len(staged.narrow_offsets),),
+                    np.dtype(np.int64),
+                    sharding=repl,
+                )
+            )
+        avals.append(
+            jax.ShapeDtypeStruct((), np.dtype(np.int32), sharding=repl)
+        )
+        self._aot_compile_async(
+            fold_sig, fold_p, tuple(avals), profile_key="warm_compile"
+        )
+        return fold_sig
+
+    # -- table-create compile prewarming (r8) --------------------------------
+    def prewarm_table(self, table, registry):
+        """Speculatively compile, at table-CREATE time, the fold a
+        canonical stats query over this table would need (ROADMAP
+        cold-path lever; flag ``prewarm_compile``, default off).
+
+        The canonical shape is groupby(first string column).agg(count,
+        sum of every FLOAT64 column) at the standard streamed-window
+        bucket geometry — the geometry every cold stream window uses
+        once the table exceeds one window, independent of the eventual
+        row count. The fold signature is produced by the SAME
+        _unit_programs path a real query takes, so a matching first
+        query finds its executable in _aot_compiled (or attaches to the
+        in-flight compile) and records the ``prewarm_hit`` breakdown
+        key; a non-matching query just misses — prewarm is opportunistic
+        and never affects correctness. Compile time lands in the
+        ``prewarm_compile`` breakdown key at create time, off every
+        query's critical path. Returns the prewarmed fold signature, or
+        None when gated off / the table has no canonical shape."""
+        if not flags.prewarm_compile:
+            return None
+        try:
+            return self._prewarm_table_inner(table, registry)
+        except Exception as e:
+            import traceback
+
+            key = f"{type(e).__name__}: {e}"
+            if key not in self.prewarm_errors:
+                self.prewarm_errors[key] = traceback.format_exc()
+                import logging
+
+                logging.getLogger("pixie_tpu.parallel").warning(
+                    "table-create compile prewarm failed (ignored): %s", key
+                )
+            return None
+
+    def _prewarm_table_inner(self, table, registry):
+        import types as _types
+
+        from pixie_tpu.parallel import staging as _staging
+
+        rel = table.relation
+        str_cols = [c.name for c in rel if c.data_type == DataType.STRING]
+        f64_cols = [c.name for c in rel if c.data_type == DataType.FLOAT64]
+        if not str_cols or not f64_cols:
+            return None
+        key_col = str_cols[0]
+        count_uda = registry.lookup_uda("count", [DataType.STRING])
+        sum_uda = registry.lookup_uda("sum", [DataType.FLOAT64])
+        if count_uda is None or sum_uda is None:
+            return None
+        # Spec order mirrors the conventional agg listing: count first,
+        # then per-column sums. count's arg never enters the fold
+        # signature (reads_args=False lanes drop it), so any future
+        # count column matches.
+        specs = [("pw_n", ColumnRef(key_col), count_uda)]
+        for cname in f64_cols:
+            specs.append((f"pw_sum_{cname}", ColumnRef(cname), sum_uda))
+        named = [
+            (f"arg:{out}:0", e) for out, e, uda in specs if uda.reads_args
+        ]
+        named.append((f"key:{key_col}", ColumnRef(key_col)))
+        evaluator = ExpressionEvaluator(named, rel, registry, None)
+        # Dictionary-code device key (the string group-by fast path); the
+        # capacity floor (8) covers every group-by of <= 8 groups.
+        key_plan = _KeyPlan(device_expr=ColumnRef(key_col), num_groups=1)
+        capacity, _n_passes = self._pass_plan(specs, 1)
+        d = self.mesh.devices.size
+        window_rows = max(int(flags.streaming_window_rows), 1)
+        b, nblk = _staging.block_geometry(window_rows, d, self.block_rows)
+        blocks = {
+            # String keys stage as frame-of-reference-narrowed uint8
+            # codes while the dictionary stays small (< 256 values).
+            key_col: _types.SimpleNamespace(
+                shape=(d, nblk, b), dtype=np.dtype(np.uint8)
+            )
+        }
+        for cname in f64_cols:
+            blocks[cname] = _types.SimpleNamespace(
+                shape=(d, nblk, b), dtype=np.dtype(np.float64)
+            )
+        shim = _types.SimpleNamespace(
+            blocks=blocks,
+            mask=_types.SimpleNamespace(shape=(d, nblk, b)),
+            narrow_offsets={key_col: 0},
+            int_dicts={},
+        )
+        m_shim = _types.SimpleNamespace(
+            predicates=[],
+            agg_op=_types.SimpleNamespace(stage=AggStage.FULL),
+        )
+        _treedef, leaves = self._state_template(specs, capacity)
+        _init_p, fold_p, _merge_p, _fin_p, fold_sig = self._unit_programs(
+            m_shim, specs, evaluator, key_plan, shim, [], [], capacity
+        )
+        if fold_sig in self._aot_compiled or fold_sig in self._aot_futures:
+            self._prewarmed.add(fold_sig)
+            return fold_sig
+        (axis_name,) = self.mesh.axis_names
+        sharded = NamedSharding(self.mesh, P(axis_name))
+        repl = NamedSharding(self.mesh, P())
+        avals = [
+            jax.ShapeDtypeStruct(
+                (d,) + tuple(l.shape), l.dtype, sharding=sharded
+            )
+            for l in leaves
+        ]
+        avals += [
+            jax.ShapeDtypeStruct(
+                (d, nblk, b), blocks[n2].dtype, sharding=sharded
+            )
+            for n2 in sorted(blocks)
+        ]
+        avals.append(
+            jax.ShapeDtypeStruct(
+                (d, nblk, b), np.dtype(np.bool_), sharding=sharded
+            )
+        )
+        # No host gids (device dictionary key), no key LUT, no aux; one
+        # narrow offset (the key codes) + the gid_base scalar.
+        avals.append(
+            jax.ShapeDtypeStruct((1,), np.dtype(np.int64), sharding=repl)
+        )
+        avals.append(
+            jax.ShapeDtypeStruct((), np.dtype(np.int32), sharding=repl)
+        )
+        self._prewarmed.add(fold_sig)
+        self._aot_compile_async(
+            fold_sig, fold_p, tuple(avals), profile_key="prewarm_compile"
+        )
+        return fold_sig
 
     def _make_scan_body(
         self,
@@ -3289,6 +3560,32 @@ class MeshExecutor:
                     key_plan.num_groups, key_plan.key_columns,
                     table.dictionaries,
                 )
+            if flags.aot_compile:
+                # r8: AOT-compile the WARM fold (the concat geometry —
+                # different from the stream window's) on the background
+                # thread NOW, so the first warm query over this staging
+                # dispatches a ready executable instead of compiling
+                # inline. Best-effort: failures fall back to the in-line
+                # jit path, recorded like stream compile failures.
+                try:
+                    self._aot_warm_fold(
+                        m, specs, evaluator, key_plan, staged_for_cache,
+                        aux, capacity,
+                    )
+                except Exception as e:
+                    import logging
+                    import traceback
+
+                    key = f"warm-aot {type(e).__name__}: {e}"
+                    if key not in self.stream_fallback_errors:
+                        self.stream_fallback_errors[key] = (
+                            traceback.format_exc()
+                        )
+                        logging.getLogger("pixie_tpu.parallel").warning(
+                            "warm-fold AOT compile setup failed, first "
+                            "warm query will jit inline: %s",
+                            key,
+                        )
         return merged, capacity, staged_for_cache
 
     @staticmethod
@@ -3343,7 +3640,7 @@ class MeshExecutor:
                 capacity, n_passes,
             )
         col_names = sorted(staged.blocks)
-        init_p, fold_p, merge_p, fin_p, _fold_sig = self._unit_programs(
+        init_p, fold_p, merge_p, fin_p, fold_sig = self._unit_programs(
             m, specs, evaluator, key_plan, staged, aux_key_order,
             aux_vals, capacity,
         )
@@ -3368,11 +3665,80 @@ class MeshExecutor:
             )
         from pixie_tpu.ops import segment as _segment
 
+        # r8: the warm fold may already be AOT-compiled (kicked on the
+        # background thread at the end of the cold stream, or by a
+        # table-create prewarm). A Compiled requires exactly the avals it
+        # was lowered at, so the replicated extras are committed
+        # explicitly; any dispatch mismatch falls back to the in-line jit
+        # with the error recorded (same contract as the stream fold).
+        fold_exec = (
+            self._aot_compiled.get(fold_sig) if flags.aot_compile else None
+        )
+        cargs = None
+        if fold_exec is not None:
+            repl = NamedSharding(self.mesh, P())
+            cargs = [staged.blocks[n] for n in col_names] + [staged.mask]
+            if key_plan.host_gids is not None:
+                cargs.append(staged.gids)
+            if isinstance(key_plan.device_expr, tuple):
+                cargs.append(
+                    jax.device_put(
+                        np.asarray(key_plan.device_expr[2]), repl
+                    )
+                )
+            cargs.extend(
+                jax.device_put(np.asarray(v), repl) for v in aux_vals
+            )
+            if staged.narrow_offsets:
+                cargs.append(
+                    jax.device_put(
+                        np.asarray(
+                            [
+                                staged.narrow_offsets[n]
+                                for n in sorted(staged.narrow_offsets)
+                            ],
+                            np.int64,
+                        ),
+                        repl,
+                    )
+                )
         per_pass = []
         with _segment.platform_hint(self.mesh.devices.flat[0].platform):
             for p in range(n_passes):
                 flat = list(init_p())
-                flat = fold_p(*flat, *args, jnp.int32(p * capacity))
+                folded = False
+                if fold_exec is not None:
+                    try:
+                        flat = list(
+                            fold_exec(
+                                *flat,
+                                *cargs,
+                                jax.device_put(
+                                    np.int32(p * capacity),
+                                    NamedSharding(self.mesh, P()),
+                                ),
+                            )
+                        )
+                        folded = True
+                    except Exception as e:
+                        import logging
+                        import traceback
+
+                        fold_exec = None
+                        key = f"warm-aot {type(e).__name__}: {e}"
+                        if key not in self.stream_fallback_errors:
+                            self.stream_fallback_errors[key] = (
+                                traceback.format_exc()
+                            )
+                            logging.getLogger(
+                                "pixie_tpu.parallel"
+                            ).warning(
+                                "AOT warm-fold dispatch failed, falling "
+                                "back to in-line jit: %s",
+                                key,
+                            )
+                if not folded:
+                    flat = fold_p(*flat, *args, jnp.int32(p * capacity))
                 merged_flat = merge_p(*flat)
                 buf = fin_p(*merged_flat)
                 # ONE blocking fetch per pass: completion + transfer.

@@ -25,6 +25,16 @@ class TableStore:
         self._relations: dict[str, Relation] = {}
         self._ids: dict[int, str] = {}
         self._next_id = 1
+        # Registration hooks: fn(name, table), fired after a table
+        # registers (r8: the engine attaches the device executor's
+        # compile prewarm here). Best-effort — a failing listener must
+        # never fail table creation.
+        self._listeners: list = []
+
+    def add_create_listener(self, fn) -> None:
+        """Register fn(name, table) to run after every add_table."""
+        with self._lock:
+            self._listeners.append(fn)
 
     def add_table(
         self,
@@ -40,7 +50,19 @@ class TableStore:
             tid = table_id if table_id is not None else self._next_id
             self._next_id = max(self._next_id, tid + 1)
             self._ids[tid] = name
-            return tid
+            listeners = list(self._listeners)
+        # Outside the lock: listeners may call back into the store.
+        for fn in listeners:
+            try:
+                fn(name, table)
+            except Exception:
+                import logging
+
+                logging.getLogger("pixie_tpu.table").warning(
+                    "table-create listener failed for %r", name,
+                    exc_info=True,
+                )
+        return tid
 
     def create_table(self, name: str, relation: Relation, **kwargs) -> Table:
         t = Table(relation, name=name, **kwargs)

@@ -208,6 +208,10 @@ def register(r: Registry) -> None:
             finalize=fin,
             merge_kind=MergeKind.PMIN if is_min else MergeKind.PMAX,
             out_semantic=_preserve_first,
+            # min/max have no MXU einsum form; seg_min/seg_max route
+            # high-cardinality blocks through the r8 sort–compact lane
+            # (two-operand sort + O(groups) scatter) above
+            # segment.SORTED_MIN_ROWS instead of the scalar scatter.
             doc=f"{'Minimum' if is_min else 'Maximum'} value in the group.",
         )
 

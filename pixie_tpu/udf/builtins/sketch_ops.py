@@ -126,7 +126,10 @@ def register(r: Registry) -> None:
             doc=(
                 "Approximate distinct count via HyperLogLog "
                 "(2048 registers, ~2.3% error; pmax-mergeable). Net-new vs "
-                "the reference."
+                "the reference. High-cardinality columns update registers "
+                "via the r8 sort–compact lane above segment.SORTED_MIN_ROWS "
+                "(O(registers) scatter instead of O(rows)); small-domain "
+                "columns keep the MXU cell lane."
             ),
         )
 
@@ -162,7 +165,10 @@ def register(r: Registry) -> None:
             doc=(
                 "Count-min frequency sketch (4x8192; psum-mergeable). "
                 "Finalize emits sketch metadata JSON; use pixie_tpu.ops."
-                "countmin.query for point lookups. Net-new vs the reference."
+                "countmin.query for point lookups. Net-new vs the "
+                "reference. Bucket counts ride the r8 sort–compact lane "
+                "above segment.SORTED_MIN_ROWS; the cell lane serves "
+                "small-domain columns."
             ),
         )
 

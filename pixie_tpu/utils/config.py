@@ -150,6 +150,29 @@ define_flag(
     "Off = the fused single-dispatch program (r6 behavior).",
 )
 define_flag(
+    "sorted_compact",
+    True,
+    help_="Enable the r8 sort–compact segment-reduction lane on TPU-class "
+    "platforms: HLL register maxes, count-min bucket counts, and "
+    "high-cardinality min/max group-bys above segment.SORTED_MIN_ROWS "
+    "ride sort → first-occurrence → compact → O(num_segments) scatter "
+    "instead of the ~7ns/row full-length scalar scatter "
+    "(ops/segment.sorted_segment_reduce_compact). CPU always keeps the "
+    "direct scatter; tests can force either lane via "
+    "segment.set_sorted_strategy().",
+)
+define_flag(
+    "prewarm_compile",
+    False,
+    help_="At table-create time, kick the background AOT machinery for "
+    "the table's bucketed stream-window geometry: a canonical "
+    "count+sum(float64 columns) group-by(first string column) fold is "
+    "lower().compile()d on the AOT thread, so a matching first query "
+    "skips its fold compile (cold-breakdown key prewarm_hit) and the "
+    "persistent .jax_cache deserializes during table setup instead of "
+    "on the query's critical path (MeshExecutor.prewarm_table).",
+)
+define_flag(
     "staged_cache_cap",
     4,
     help_="LRU capacity of HBM-resident staged tables (MeshExecutor).",

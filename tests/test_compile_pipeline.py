@@ -241,6 +241,95 @@ def test_decomposed_matches_fused(mesh):
             assert dec[col][i] == fus[col][j], (svc, col)
 
 
+def test_prewarm_compile_hits_first_query(mesh):
+    """r8 table-create prewarm: registering a table kicks a background
+    AOT compile of the canonical count+sum fold at the standard
+    stream-window geometry; a first query of that shape finds its fold
+    already compiled — prewarm_hit is recorded and the query spends
+    ZERO seconds in stage_compile."""
+    from pixie_tpu.parallel.staging import COLD_PROFILE
+
+    flags.set("prewarm_compile", True)
+    flags.set("streaming_window_rows", 4096)
+    try:
+        ex = MeshExecutor(mesh=mesh, block_rows=1024)
+        c = Carnot(device_executor=ex)
+        data = _make_table(c, "http_events", 10_000)
+        assert ex._prewarmed and not ex.prewarm_errors, ex.prewarm_errors
+        (sig,) = ex._prewarmed
+        ex._aot_futures[sig].result(timeout=120)  # compile off-thread
+        reset_cold_profile()
+        rows = c.execute_query(_stats_pxl("http_events")).table("out")
+        assert not ex.fallback_errors, ex.fallback_errors
+        snap = dict(COLD_PROFILE)
+        assert snap.get("prewarm_hit", 0) >= 1, snap
+        assert snap.get("stage_compile", 0) == 0, snap
+        got = dict(zip(rows["service"], rows["n"]))
+        assert got == dict(collections.Counter(data["service"].tolist()))
+        by_svc = dict(zip(rows["service"], rows["total"]))
+        for svc in "abc":
+            want = data["latency"][data["service"] == svc].sum()
+            assert by_svc[svc] == pytest.approx(want, rel=1e-9)
+    finally:
+        flags.reset("prewarm_compile")
+        flags.reset("streaming_window_rows")
+
+
+def test_prewarm_gated_off_and_robust(mesh):
+    """Flag off -> no-op; a relation without the canonical shape (no
+    string or no float64 column) -> None, never an error."""
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    c = Carnot(device_executor=ex)
+    _make_table(c, "http_events", 100)  # default flag: off
+    assert not ex._prewarmed and not ex._aot_futures
+    flags.set("prewarm_compile", True)
+    try:
+        rel = Relation.of(("time_", T, SemanticType.ST_TIME_NS), ("v", I))
+        c.table_store.create_table("ints_only", rel)
+        assert not ex._prewarmed and not ex.prewarm_errors
+    finally:
+        flags.reset("prewarm_compile")
+
+
+def test_warm_fold_aot_compiles_in_background(mesh):
+    """r8 second cold-path lever: a multi-window cold stream kicks a
+    background AOT compile of the WARM (concatenated) fold geometry —
+    recorded under warm_compile — and the first warm query dispatches
+    that executable instead of jitting inline (no dispatch-mismatch
+    fallbacks recorded)."""
+    from pixie_tpu.parallel.staging import COLD_PROFILE
+
+    flags.set("streaming_stage", True)
+    flags.set("streaming_window_rows", 1024)
+    try:
+        ex = MeshExecutor(mesh=mesh, block_rows=1024)
+        c = Carnot(device_executor=ex)
+        data = _make_table(c, "http_events", 10_000)
+        reset_cold_profile()
+        c.execute_query(_stats_pxl("http_events"))  # cold: streams
+        assert not ex.fallback_errors, ex.fallback_errors
+        # Two distinct AOT jobs: the stream-window fold and the warm
+        # (concat-geometry) fold.
+        assert len(ex._aot_futures) >= 2, set(ex._aot_futures)
+        for fut in list(ex._aot_futures.values()):
+            fut.result(timeout=120)
+        assert COLD_PROFILE.get("warm_compile", 0) > 0, dict(COLD_PROFILE)
+        rows = c.execute_query(_stats_pxl("http_events")).table("out")
+        warm_errs = [
+            k for k in ex.stream_fallback_errors if k.startswith("warm-aot")
+        ]
+        assert not warm_errs, ex.stream_fallback_errors
+        got = dict(zip(rows["service"], rows["n"]))
+        assert got == dict(collections.Counter(data["service"].tolist()))
+        by_svc = dict(zip(rows["service"], rows["total"]))
+        for svc in "abc":
+            want = data["latency"][data["service"] == svc].sum()
+            assert by_svc[svc] == pytest.approx(want, rel=1e-9)
+    finally:
+        flags.reset("streaming_stage")
+        flags.reset("streaming_window_rows")
+
+
 def test_hll_cell_lane_matches_host_engine(mesh):
     """approx_count_distinct over a small-domain int column rides the
     int-dictionary cell lane (hll.cell_update) and reproduces the host

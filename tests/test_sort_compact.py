@@ -1,0 +1,485 @@
+"""The r8 sort–compact segment-reduction lane (ops/segment.py).
+
+Pins the contracts the ISSUE demands on a CPU-only host:
+- the compacted lane is BIT-EXACT with the direct-scatter lane (and with
+  numpy truth) across ragged masks, empty segments, all-duplicate keys,
+  non-pow2 nseg, and n < nseg — for packed max/min/count, the generic
+  min/max variant, HLL register updates, and count-min bucket counts;
+- the final scatter's operands have STATIC length O(nseg), never O(n)
+  (jaxpr inspection — the algorithmic point of the lane);
+- the i32 packing boundary raises (direct call) or falls back (hll)
+  instead of silently corrupting;
+- lane selection: TPU-class platforms only, above SORTED_MIN_ROWS, nseg
+  sufficiently smaller than n, flag- and force-overridable;
+- end-to-end: high-cardinality min/max group-bys and HLL estimates
+  through the device pipeline match the host engine, and streamed
+  multi-window execution matches monolithic staging, with the lane
+  forced on.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from pixie_tpu.engine import Carnot
+from pixie_tpu.ops import countmin, hll, segment
+from pixie_tpu.parallel import MeshExecutor
+from pixie_tpu.types import DataType, Relation, SemanticType
+from pixie_tpu.utils import flags
+
+F, I, S, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.array(jax.devices("cpu"))
+    assert devs.size == 8, "conftest must provide 8 virtual devices"
+    return Mesh(devs, ("d",))
+
+
+# Shapes chosen to hit: ragged mask, empty segments (nseg > observed),
+# all-duplicate keys, nseg not a power of two, n < nseg, nseg == 1.
+CASES = [
+    (5000, 37, 5, 0.8),     # non-pow2 nseg, ragged mask
+    (2000, 100, 5, 0.5),    # many empty segments
+    (1000, 1, 5, 0.9),      # single segment (all keys duplicate)
+    (300, 2048, 5, 0.7),    # n < nseg
+    (4096, 33, 3, 1.0),     # no masking, narrow value domain
+]
+
+
+class TestPackedCompact:
+    def _case(self, rng, n, nseg, bits, keep_p):
+        flat = rng.integers(0, nseg, n).astype(np.int32)
+        vals = rng.integers(0, 1 << bits, n).astype(np.int32)
+        mask = rng.random(n) < keep_p
+        return flat, vals, mask
+
+    @pytest.mark.parametrize("n,nseg,bits,keep_p", CASES)
+    def test_max_min_count_match_truth_and_scatter(
+        self, rng, n, nseg, bits, keep_p
+    ):
+        flat, vals, mask = self._case(rng, n, nseg, bits, keep_p)
+        jf, jv, jm = jnp.asarray(flat), jnp.asarray(vals), jnp.asarray(mask)
+        for m_arg, m_np in ((None, np.ones(n, bool)), (jm, mask)):
+            got_max = np.asarray(
+                segment.sorted_segment_reduce_compact(
+                    jf, jv, bits, nseg, m_arg, "max"
+                )
+            )
+            want_max = np.zeros(nseg, np.int32)
+            np.maximum.at(want_max, flat[m_np], vals[m_np])
+            np.testing.assert_array_equal(got_max, want_max)
+            # ...and equals the r4 sort+full-scatter design bit-for-bit.
+            np.testing.assert_array_equal(
+                got_max,
+                np.asarray(
+                    segment.sorted_segment_max_small(
+                        jf, jv, bits, nseg, m_arg
+                    )
+                ),
+            )
+            got_min = np.asarray(
+                segment.sorted_segment_reduce_compact(
+                    jf, jv, bits, nseg, m_arg, "min"
+                )
+            )
+            want_min = np.full(nseg, (1 << bits) - 1, np.int32)
+            np.minimum.at(want_min, flat[m_np], vals[m_np])
+            np.testing.assert_array_equal(got_min, want_min)
+            got_cnt = np.asarray(
+                segment.sorted_segment_counts(jf, nseg, m_arg)
+            )
+            want_cnt = np.bincount(flat[m_np], minlength=nseg)
+            np.testing.assert_array_equal(got_cnt, want_cnt.astype(np.int32))
+
+    def test_empty_input(self):
+        z = jnp.zeros(0, jnp.int32)
+        assert (
+            np.asarray(
+                segment.sorted_segment_reduce_compact(z, z, 5, 7, None, "max")
+            )
+            == 0
+        ).all()
+        assert (
+            np.asarray(
+                segment.sorted_segment_reduce_compact(z, z, 5, 7, None, "min")
+            )
+            == 31
+        ).all()
+        assert (
+            np.asarray(segment.sorted_segment_counts(z, 7)) == 0
+        ).all()
+
+    def test_bad_mode_raises(self):
+        z = jnp.zeros(4, jnp.int32)
+        with pytest.raises(ValueError, match="mode"):
+            segment.sorted_segment_reduce_compact(z, z, 5, 7, None, "sum")
+
+
+class TestGenericMinMaxCompact:
+    @pytest.mark.parametrize("dtype", [np.float64, np.int64])
+    @pytest.mark.parametrize("is_min", [True, False])
+    def test_matches_scatter_lane(self, rng, dtype, is_min):
+        n, G = 4000, 33
+        vals = (rng.normal(size=n) * 1e6).astype(dtype)
+        gids = rng.integers(0, G, n).astype(np.int32)
+        mask = rng.random(n) < 0.7
+        for m in (None, jnp.asarray(mask)):
+            got = np.asarray(
+                segment.sorted_segment_minmax_compact(
+                    jnp.asarray(vals), jnp.asarray(gids), G, m, is_min
+                )
+            )
+            segment.set_sorted_strategy(False)
+            try:
+                fn = segment.seg_min if is_min else segment.seg_max
+                ref = np.asarray(
+                    fn(jnp.asarray(vals), jnp.asarray(gids), G, m)
+                )
+            finally:
+                segment.set_sorted_strategy(None)
+            np.testing.assert_array_equal(got, ref)
+
+    def test_empty_segments_hold_identity(self):
+        # Segment 2 sees no rows; the identity fill must match what the
+        # masked scatter lane produces so elementwise merges agree.
+        vals = jnp.asarray([5.0, -3.0, 8.0])
+        gids = jnp.asarray([0, 0, 1], jnp.int32)
+        mx = np.asarray(
+            segment.sorted_segment_minmax_compact(vals, gids, 3, None, False)
+        )
+        assert mx[0] == 5.0 and mx[1] == 8.0 and mx[2] == -np.inf
+        mn = np.asarray(
+            segment.sorted_segment_minmax_compact(vals, gids, 3, None, True)
+        )
+        assert mn[0] == -3.0 and mn[1] == 8.0 and mn[2] == np.inf
+
+    def test_seg_minmax_route_through_compact_when_forced(self, rng):
+        n, G = 1000, 9
+        vals = jnp.asarray(rng.normal(size=n))
+        gids = jnp.asarray(rng.integers(0, G, n), dtype=jnp.int32)
+        segment.reduce_lanes(reset=True)
+        segment.set_sorted_strategy(True)
+        try:
+            forced = np.asarray(segment.seg_max(vals, gids, G))
+            assert segment.reduce_lanes().get("minmax_sorted_compact", 0) >= 1
+        finally:
+            segment.set_sorted_strategy(None)
+        segment.set_sorted_strategy(False)
+        try:
+            ref = np.asarray(segment.seg_max(vals, gids, G))
+        finally:
+            segment.set_sorted_strategy(None)
+        np.testing.assert_array_equal(forced, ref)
+
+
+class TestOverflowBoundary:
+    def test_fits_boundary_exact(self):
+        # (nseg+1) << 5 < 2^31  <=>  nseg <= 2^26 - 2.
+        assert segment.compact_fits_i32((1 << 26) - 2, 5)
+        assert not segment.compact_fits_i32((1 << 26) - 1, 5)
+        assert segment.compact_fits_i32((1 << 31) - 2, 0)
+        assert not segment.compact_fits_i32((1 << 31) - 1, 0)
+
+    def test_direct_call_raises_not_corrupts(self):
+        z = jnp.zeros(4, jnp.int32)
+        with pytest.raises(ValueError, match="overflows int32"):
+            segment.sorted_segment_reduce_compact(
+                z, z, 5, 1 << 26, None, "max"
+            )
+
+    def test_hll_falls_back_past_boundary(self, rng, monkeypatch):
+        """Past the packing boundary hll.update must take the
+        direct-scatter lane even with the sorted strategy forced on —
+        proven by poisoning the compact kernel and pretending the
+        boundary check failed."""
+        n, g = 2000, 3
+        gids = jnp.asarray(rng.integers(0, g, n), dtype=jnp.int32)
+        vals = jnp.asarray(rng.integers(0, 500, n), dtype=jnp.int64)
+        segment.set_sorted_strategy(False)
+        try:
+            want = np.asarray(hll.update(hll.init(g), gids, vals))
+        finally:
+            segment.set_sorted_strategy(None)
+
+        def poisoned(*a, **k):
+            raise AssertionError(
+                "compact lane must not run past the i32 boundary"
+            )
+
+        monkeypatch.setattr(
+            segment, "sorted_segment_reduce_compact", poisoned
+        )
+        monkeypatch.setattr(
+            segment, "compact_fits_i32", lambda nseg, bits: False
+        )
+        segment.set_sorted_strategy(True)
+        try:
+            got = np.asarray(hll.update(hll.init(g), gids, vals))
+        finally:
+            segment.set_sorted_strategy(None)
+        np.testing.assert_array_equal(got, want)
+
+
+def _scatter_operand_dims(fn, *args):
+    """Max leading dim over every operand of every scatter in fn's jaxpr
+    (recursing into sub-jaxprs)."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    dims = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if "scatter" in eqn.primitive.name:
+                for v in eqn.invars:
+                    shape = getattr(getattr(v, "aval", None), "shape", ())
+                    if shape:
+                        dims.append(shape[0])
+            for val in eqn.params.values():
+                sub = getattr(val, "jaxpr", None)
+                if sub is not None:
+                    walk(sub)
+
+    walk(jaxpr.jaxpr)
+    assert dims, "expected at least one scatter in the compact lane"
+    return max(dims)
+
+
+class TestStaticScatterLength:
+    """The acceptance-critical property on a CPU-only host: the compact
+    lane's final scatter operand has STATIC length O(nseg), independent
+    of the row count n."""
+
+    def test_packed_modes_scatter_is_nseg(self):
+        nseg, n = 512, 8 * 512
+        f = jnp.zeros(n, jnp.int32)
+        v = jnp.zeros(n, jnp.int32)
+        for mode in ("max", "min", "count"):
+            worst = _scatter_operand_dims(
+                lambda f, v: segment.sorted_segment_reduce_compact(
+                    f, v, 5, nseg, None, mode
+                ),
+                f,
+                v,
+            )
+            assert worst <= nseg, (mode, worst, n)
+
+    def test_generic_minmax_scatter_is_nseg(self):
+        nseg, n = 512, 8 * 512
+        v = jnp.zeros(n, jnp.float64)
+        g = jnp.zeros(n, jnp.int32)
+        worst = _scatter_operand_dims(
+            lambda v, g: segment.sorted_segment_minmax_compact(
+                v, g, nseg, None, False
+            ),
+            v,
+            g,
+        )
+        assert worst <= nseg, (worst, n)
+
+    def test_hll_update_scatter_is_nseg(self):
+        g, m = 4, 2048
+        n = 4 * g * m
+        gids = jnp.zeros(n, jnp.int32)
+        vals = jnp.zeros(n, jnp.int64)
+        segment.set_sorted_strategy(True)
+        try:
+            worst = _scatter_operand_dims(
+                lambda st, gi, va: hll.update(st, gi, va),
+                hll.init(g),
+                gids,
+                vals,
+            )
+        finally:
+            segment.set_sorted_strategy(None)
+        assert worst <= g * m, (worst, n)
+
+    def test_countmin_update_scatter_is_nseg(self):
+        g, width = 2, 1024
+        n = 16 * g * width
+        gids = jnp.zeros(n, jnp.int32)
+        vals = jnp.zeros(n, jnp.int64)
+        segment.set_sorted_strategy(True)
+        try:
+            worst = _scatter_operand_dims(
+                lambda st, gi, va: countmin.update(st, gi, va),
+                countmin.init(g, depth=2, width=width),
+                gids,
+                vals,
+            )
+        finally:
+            segment.set_sorted_strategy(None)
+        assert worst <= g * width, (worst, n)
+
+
+class TestLaneSelection:
+    def test_policy(self):
+        n = segment.SORTED_MIN_ROWS
+        with segment.platform_hint("tpu"):
+            assert segment.sorted_strategy(n, 1024)
+            assert not segment.sorted_strategy(n - 1, 1024), "row threshold"
+            # nseg too close to n: the compacted tail stops being
+            # negligible (< 4x shorter than the direct scatter).
+            assert not segment.sorted_strategy(n, n)
+            assert segment.sorted_strategy(n, n // 4)
+            assert not segment.sorted_strategy(n, n // 4 + 1)
+        with segment.platform_hint("cpu"):
+            assert not segment.sorted_strategy(n, 1024), "CPU keeps scatter"
+        flags.set("sorted_compact", False)
+        try:
+            with segment.platform_hint("tpu"):
+                assert not segment.sorted_strategy(n, 1024), "flag gates"
+        finally:
+            flags.reset("sorted_compact")
+        segment.set_sorted_strategy(True)
+        try:
+            with segment.platform_hint("cpu"):
+                assert segment.sorted_strategy(8, 1024), "force overrides"
+        finally:
+            segment.set_sorted_strategy(None)
+
+    def test_hll_selects_compact_above_threshold(self, rng):
+        """The HLL register update picks the compact lane exactly when
+        the policy says so (trace-time lane telemetry)."""
+        g, m = 2, 2048
+        n = segment.SORTED_MIN_ROWS  # >= threshold; nseg*4 < n
+        gids = jnp.zeros(n, jnp.int32)
+        vals = jnp.asarray(rng.integers(0, 1 << 30, n), dtype=jnp.int64)
+        with segment.platform_hint("tpu"):
+            segment.reduce_lanes(reset=True)
+            jax.eval_shape(
+                lambda st, gi, va: hll.update(st, gi, va),
+                jax.eval_shape(lambda: hll.init(g)),
+                gids,
+                vals,
+            )
+            lanes = segment.reduce_lanes(reset=True)
+        assert lanes.get("hll_sorted_compact", 0) >= 1, lanes
+        with segment.platform_hint("cpu"):
+            segment.reduce_lanes(reset=True)
+            jax.eval_shape(
+                lambda st, gi, va: hll.update(st, gi, va),
+                jax.eval_shape(lambda: hll.init(g)),
+                gids,
+                vals,
+            )
+            lanes = segment.reduce_lanes(reset=True)
+        assert lanes.get("hll_scatter", 0) >= 1, lanes
+
+
+def _flows_table(carnot, name, n, ports_card=4000, seed=5):
+    rel = Relation.of(
+        ("time_", T, SemanticType.ST_TIME_NS),
+        ("src", S),
+        ("remote_port", I),
+        ("bytes_sent", I),
+        ("lat", F),
+    )
+    t = carnot.table_store.create_table(name, rel)
+    rng = np.random.default_rng(seed)
+    data = {
+        "time_": np.arange(n) * 10**6,
+        "src": rng.choice(["a", "b", "c", "d"], n).astype(object),
+        # High-cardinality: way past the 256-value int-dict cell lane,
+        # so approx_count_distinct takes the row (register-update) path.
+        "remote_port": rng.integers(1024, 1024 + ports_card, n),
+        "bytes_sent": rng.integers(0, 1 << 20, n),
+        "lat": rng.exponential(30.0, n),
+    }
+    for off in range(0, n, 2048):
+        t.write_pydict({k: v[off : off + 2048] for k, v in data.items()})
+    t.compact()
+    t.stop()
+    return data
+
+
+_PXL = (
+    "df = px.DataFrame(table='flows')\n"
+    "s = df.groupby(['src']).agg(\n"
+    "    hi=('bytes_sent', px.max),\n"
+    "    lo=('bytes_sent', px.min),\n"
+    "    hif=('lat', px.max),\n"
+    "    ports=('remote_port', px.approx_count_distinct),\n"
+    ")\n"
+    "px.display(s, 'out')\n"
+)
+
+
+def _check_against_truth(rows, data):
+    by = {s: i for i, s in enumerate(rows["src"])}
+    for svc in "abcd":
+        sel = data["src"] == svc
+        i = by[svc]
+        assert rows["hi"][i] == int(data["bytes_sent"][sel].max()), svc
+        assert rows["lo"][i] == int(data["bytes_sent"][sel].min()), svc
+        assert rows["hif"][i] == pytest.approx(
+            float(data["lat"][sel].max()), rel=1e-12
+        )
+        true_ports = len(np.unique(data["remote_port"][sel]))
+        assert abs(rows["ports"][i] - true_ports) <= 0.1 * true_ports
+
+
+class TestPipelineEndToEnd:
+    def test_minmax_and_hll_match_host_engine(self, mesh):
+        """With the compact lane FORCED on (the CPU mesh would otherwise
+        keep the scatter), high-cardinality min/max group-bys and HLL
+        estimates through the device pipeline equal the host engine's —
+        the lane swap is invisible end-to-end."""
+        segment.set_sorted_strategy(True)
+        try:
+            ex = MeshExecutor(mesh=mesh, block_rows=1024)
+            c_dev = Carnot(device_executor=ex)
+            data = _flows_table(c_dev, "flows", 20_000)
+            rows_d = c_dev.execute_query(_PXL).table("out")
+            assert not ex.fallback_errors, ex.fallback_errors
+        finally:
+            segment.set_sorted_strategy(None)
+        c_host = Carnot(device_executor=None)
+        _flows_table(c_host, "flows", 20_000)
+        rows_h = c_host.execute_query(_PXL).table("out")
+        _check_against_truth(rows_d, data)
+        dd = {s: i for i, s in enumerate(rows_d["src"])}
+        dh = {s: i for i, s in enumerate(rows_h["src"])}
+        for svc in "abcd":
+            for col in ("hi", "lo", "hif", "ports"):
+                assert rows_d[col][dd[svc]] == rows_h[col][dh[svc]], (
+                    svc,
+                    col,
+                )
+
+    def test_streamed_windows_match_monolithic(self, mesh):
+        """Per-window compaction composes with the streamed scan: the
+        carried UDA states merge elementwise, so a multi-window stream
+        equals monolithic staging bit-for-bit with the lane forced."""
+        segment.set_sorted_strategy(True)
+        results = {}
+        try:
+            for streaming in (True, False):
+                flags.set("streaming_stage", streaming)
+                flags.set("streaming_window_rows", 2048)
+                try:
+                    ex = MeshExecutor(mesh=mesh, block_rows=512)
+                    c = Carnot(device_executor=ex)
+                    data = _flows_table(c, "flows", 20_000)
+                    results[streaming] = c.execute_query(_PXL).table("out")
+                    assert not ex.fallback_errors, ex.fallback_errors
+                finally:
+                    flags.reset("streaming_stage")
+                    flags.reset("streaming_window_rows")
+        finally:
+            segment.set_sorted_strategy(None)
+        st, mono = results[True], results[False]
+        si = {s: i for i, s in enumerate(st["src"])}
+        mi = {s: i for i, s in enumerate(mono["src"])}
+        assert set(si) == set(mi) == {"a", "b", "c", "d"}
+        for svc in "abcd":
+            for col in ("hi", "lo", "hif", "ports"):
+                assert st[col][si[svc]] == mono[col][mi[svc]], (svc, col)
+        _check_against_truth(st, data)
