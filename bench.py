@@ -71,6 +71,21 @@ throughput delta alone would hide inside gate tolerance. The lane is
 flag-gated (PIXIE_TPU_SORTED_COMPACT=0 for the r5 scatter behavior) and
 logged next to the streaming/compile knobs at startup.
 
+Robustness knobs (r9): the fault-injection registry is OFF in benchmarks
+(``PIXIE_TPU_FAULT_INJECT`` empty; tools/microbench_fault_overhead.py
+holds the disabled sites to <1% on the warm path and the transport
+round-trip, recorded under BENCH_DETAIL.json's ``fault_overhead`` key).
+Per-query deadlines (``PIXIE_TPU_QUERY_DEADLINE_S``, 0 = off) and
+partial-result degradation (``PIXIE_TPU_PARTIAL_RESULTS``) only affect
+the broker path, not this single-engine driver. The device circuit
+breaker (``PIXIE_TPU_DEVICE_BREAKER_THRESHOLD``, default 3 consecutive
+failures; ``PIXIE_TPU_DEVICE_BREAKER_COOLDOWN_S``, default 30) trips a
+repeatedly-failing program key to the host engine — a tripped breaker
+during a bench run shows up as device_offload_fallback_breaker_*
+metric increments and a collapsed rows/s, never as silent wrong data.
+Agent reconnect backoff (``PIXIE_TPU_AGENT_BACKOFF_INITIAL_S`` /
+``_MAX_S`` / ``_JITTER``) is transport-layer only.
+
 Env knobs: BENCH_ROWS (configs 2/5; default 256M), BENCH_SMALL_ROWS
 (configs 1/3/4; default 64M), BENCH_HOST_ROWS (config 0; default 8M),
 BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS (comma list, default
@@ -312,7 +327,10 @@ def main() -> None:
         f"window_rows={flags.streaming_window_rows} "
         f"sorted_compact={flags.sorted_compact} "
         f"sorted_min_rows={segment_ops.SORTED_MIN_ROWS} "
-        f"prewarm_compile={flags.prewarm_compile}"
+        f"prewarm_compile={flags.prewarm_compile} "
+        f"fault_inject={flags.fault_inject or 'off'} "
+        f"device_breaker={flags.device_breaker_threshold}"
+        f"@{flags.device_breaker_cooldown_s}s"
     )
     carnot = Carnot(
         device_executor=MeshExecutor(mesh=mesh, block_rows=block_rows)
@@ -341,6 +359,18 @@ def main() -> None:
         # prewarm (flag prewarm_compile).
         snap.setdefault("warm_compile", 0.0)
         snap.setdefault("prewarm_hit", 0.0)
+        # r9 keys (cumulative this process): circuit-breaker activity on
+        # the device offload lane — nonzero means some queries ran on the
+        # host engine behind an open breaker, which explains a collapsed
+        # rows/s without silent wrong data.
+        from pixie_tpu.utils import metrics_registry as _mr
+
+        snap["breaker_trips"] = _mr().counter(
+            "device_offload_fallback_breaker_trips_total"
+        ).value()
+        snap["breaker_open_skips"] = _mr().counter(
+            "device_offload_fallback_breaker_open_total"
+        ).value()
         return {k: round(v, 2) for k, v in sorted(snap.items())}
 
     def cold_run(query):

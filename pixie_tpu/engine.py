@@ -30,6 +30,20 @@ class QueryResult:
     exec_stats: dict[str, dict]  # node name -> stats dict (analyze mode)
     compile_time_ns: int = 0
     exec_time_ns: int = 0
+    # Structured partial-result annotation (r9; ref: the forwarder's
+    # per-agent timeout/cancel annotations, query_result_forwarder.go:395):
+    # None = complete result. Otherwise a dict with keys ``partial``,
+    # ``reasons``, ``agent_errors`` {agent: message}, ``lost_agents``
+    # (heartbeat-expired mid-query), ``timed_out_agents`` (still pending at
+    # the deadline), ``skipped_agents`` (expired before planning; the query
+    # never covered them), ``forward_dropped`` (result messages lost in the
+    # broker's forwarder).
+    degraded: Optional[dict] = None
+
+    @property
+    def ok(self) -> bool:
+        """True when the result is complete (no degraded annotation)."""
+        return self.degraded is None
 
     def table(self, name: str = None) -> dict:
         if name is None:
@@ -143,13 +157,27 @@ class Carnot:
         return result
 
     def execute_plan(
-        self, plan: Plan, analyze: bool = False, manage_router: bool = True
+        self,
+        plan: Plan,
+        analyze: bool = False,
+        manage_router: bool = True,
+        deadline_s: Optional[float] = None,
     ) -> QueryResult:
         """manage_router=False when a broker coordinates several engine
         instances over one shared router: producer registration and query
         cleanup then happen centrally (ref: the GRPCRouter is owned by the
-        receiving agent, registration by connection)."""
+        receiving agent, registration by connection).
+
+        ``deadline_s`` is the propagated per-query hard deadline (r9): all
+        fragments share one absolute deadline computed here, so a stalled
+        fragment raises QueryDeadlineExceeded instead of holding the agent
+        thread to the stall timeout."""
         qid = plan.query_id or str(uuid.uuid4())
+        deadline = (
+            time.monotonic() + deadline_s
+            if deadline_s is not None and deadline_s > 0
+            else None
+        )
         tables: dict[str, list[RowBatch]] = {}
 
         def on_result(table_name: str, batch: RowBatch) -> None:
@@ -180,6 +208,7 @@ class Carnot:
                     instance=self.instance,
                     vizier_ctx=self.vizier_ctx,
                     otel_exporter=self.otel_exporter,
+                    deadline=deadline,
                 )
                 if self.device_executor is not None:
                     offloaded = self.device_executor.try_execute_fragment(

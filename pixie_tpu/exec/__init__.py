@@ -6,13 +6,18 @@ operator nodes, expression evaluator, GRPC router (here: bridge router).
 """
 
 from pixie_tpu.exec.exec_node import ExecNode, ExecNodeStats
-from pixie_tpu.exec.exec_state import ExecState, FunctionContext
+from pixie_tpu.exec.exec_state import (
+    ExecState,
+    FunctionContext,
+    QueryDeadlineExceeded,
+)
 from pixie_tpu.exec.exec_graph import ExecutionGraph
 from pixie_tpu.exec.expression_evaluator import ExpressionEvaluator
 from pixie_tpu.exec.group_encoder import GroupEncoder
-from pixie_tpu.exec.router import BridgeRouter
+from pixie_tpu.exec.router import BridgeCancelled, BridgeRouter
 
 __all__ = [
+    "BridgeCancelled",
     "BridgeRouter",
     "ExecNode",
     "ExecNodeStats",
@@ -21,4 +26,5 @@ __all__ = [
     "ExpressionEvaluator",
     "FunctionContext",
     "GroupEncoder",
+    "QueryDeadlineExceeded",
 ]

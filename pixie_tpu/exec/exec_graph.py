@@ -186,12 +186,21 @@ class ExecutionGraph:
                     self.nodes[nid].close(st)
 
     def _execute_sources(self, timeout_s, yield_fn) -> None:
-        """Round-robin source loop (ref: ExecuteSources, exec_graph.cc:177)."""
+        """Round-robin source loop (ref: ExecuteSources, exec_graph.cc:177).
+
+        Two clocks (r9): the STALL deadline resets whenever any source
+        makes progress (source-health watchdog); the exec state's HARD
+        deadline, propagated broker→agent, never resets — a fragment that
+        keeps trickling batches past the query deadline still aborts, so a
+        stalled query dies everywhere, not just at the client."""
         deadline = time.monotonic() + timeout_s
         running = list(self.sources)
         while running:
             if not self.exec_state.keep_running:
-                break  # a limit aborted the sources
+                break  # a limit aborted the sources / query was cancelled
+            if self.exec_state.deadline_exceeded():
+                self._abort("query deadline exceeded")
+                self.exec_state.check_deadline()  # raises
             progressed = False
             for src in list(running):
                 for _ in range(CONSECUTIVE_GENERATE_CALLS_PER_SOURCE):
@@ -209,9 +218,11 @@ class ExecutionGraph:
             if not progressed:
                 # Yield: wait for bridge/table data (ref: YieldWithTimeout).
                 if time.monotonic() > deadline:
+                    stalled = [s.name for s in running]
+                    self._abort(f"sources stalled ({stalled})")
                     raise TimeoutError(
                         f"query {self.exec_state.query_id}: sources stalled "
-                        f"({[s.name for s in running]})"
+                        f"({stalled})"
                     )
                 if yield_fn is not None:
                     yield_fn()
@@ -219,6 +230,23 @@ class ExecutionGraph:
                     time.sleep(DEFAULT_YIELD_S)
             else:
                 deadline = time.monotonic() + timeout_s
+
+    def _abort(self, reason: str) -> None:
+        """Source-stall/deadline abort: propagate cancellation to sibling
+        nodes (keep_running goes false, every source is abort()ed) and
+        flush eos through bridge sinks so consumer fragments parked on the
+        router aren't left waiting for markers that will never come (r9;
+        ref: the forwarder's cancel path, query_result_forwarder.go:571)."""
+        st = self.exec_state
+        st.cancel(reason)
+        for src in self.sources:
+            src.abort()
+        for node in self.nodes.values():
+            if isinstance(node, BridgeSinkNode):
+                try:
+                    node.flush_cancel(st)
+                except Exception:
+                    pass  # router may already be torn down
 
     # -- stats (ref: exec_node.h:60-128 per-op stats; carnot.cc:369-399) ----
     def stats(self) -> dict:

@@ -8,7 +8,15 @@ function context (metadata state for md UDFs), and query-scoped control
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Optional
+
+
+class QueryDeadlineExceeded(TimeoutError):
+    """A query's propagated hard deadline expired (ref: the forwarder's
+    per-query timeout/cancel, query_result_forwarder.go:571). Distinct
+    from a source-stall TimeoutError so agents can annotate the failure
+    kind for the broker's degraded result."""
 
 
 @dataclasses.dataclass
@@ -40,6 +48,7 @@ class ExecState:
         compute_backend: str = "cpu",
         vizier_ctx: Any = None,
         otel_exporter: Any = None,
+        deadline: Optional[float] = None,
     ):
         self.query_id = query_id
         self.table_store = table_store
@@ -67,6 +76,13 @@ class ExecState:
         # keyed by InlineSourceOp.key.
         self.inline_batches: dict[str, list] = {}
         self._keep_running = True
+        # Hard per-query deadline (time.monotonic() timestamp) propagated
+        # from the broker (r9). None = no deadline; the stall timeout is
+        # then the only guard.
+        self.deadline = deadline
+        # Set by cancel(): why this query was aborted (deadline, broker
+        # cancellation, source stall) — surfaced in errors/annotations.
+        self.cancel_reason: Optional[str] = None
 
     def compute_device(self):
         if self.compute_backend is None:
@@ -85,3 +101,22 @@ class ExecState:
     @property
     def keep_running(self) -> bool:
         return self._keep_running
+
+    # -- cancellation + deadlines (r9) --------------------------------------
+    def cancel(self, reason: str) -> None:
+        """Abort the query: stop sources and record why. Sibling nodes in
+        the graph observe keep_running; the graph's abort path also closes
+        sinks and releases bridge consumers."""
+        if self.cancel_reason is None:
+            self.cancel_reason = reason
+        self._keep_running = False
+
+    def deadline_exceeded(self) -> bool:
+        return self.deadline is not None and time.monotonic() > self.deadline
+
+    def check_deadline(self) -> None:
+        if self.deadline_exceeded():
+            raise QueryDeadlineExceeded(
+                f"query {self.query_id}: deadline exceeded"
+                + (f" ({self.cancel_reason})" if self.cancel_reason else "")
+            )

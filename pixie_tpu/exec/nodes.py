@@ -119,27 +119,73 @@ class InlineSourceNode(SourceNode):
 
 class BridgeSourceNode(SourceNode):
     """Receives batches routed from another fragment
-    (ref: grpc_source_node.h:39 + grpc_router.h:53)."""
+    (ref: grpc_source_node.h:39 + grpc_router.h:53).
+
+    Producer expectations are refreshed from the router mid-query (r9):
+    when the broker unregisters a dead agent's bridges (heartbeat expiry
+    mid-query, ref query_result_forwarder.go:395), this source stops
+    waiting for eos markers that will never arrive and flushes a synthetic
+    eos downstream so blocking consumers (merge aggs) finalize with the
+    partial input they have."""
 
     def __init__(self, op: BridgeSourceOp, output_relation, node_id):
         super().__init__(op, output_relation, node_id)
         self.op: BridgeSourceOp = op
         self._upstream_eos = 0
         self._expected_producers = 1
+        self._had_registrations = False
+        self._forwarded_eos = False
 
     def prepare_impl(self, exec_state) -> None:
         self._expected_producers = exec_state.router.num_producers(
             exec_state.query_id, self.op.bridge_id
         )
+        # Raw registration count at prepare: refreshes only apply when at
+        # least one producer actually registered — a dangling bridge (no
+        # registrations; num_producers floors at 1) must keep the old
+        # stall-until-timeout semantics, not silently self-complete.
+        count = getattr(exec_state.router, "producer_count", None)
+        self._had_registrations = (
+            count is not None
+            and count(exec_state.query_id, self.op.bridge_id) > 0
+        )
+
+    def _refresh_expected(self, exec_state) -> None:
+        if not self._had_registrations:
+            return
+        live = exec_state.router.producer_count(
+            exec_state.query_id, self.op.bridge_id
+        )
+        # Only shrink: registrations all precede fragment launch, so a
+        # smaller live count means producers were lost, never added.
+        if live < self._expected_producers:
+            self._expected_producers = live
 
     def generate_next_impl(self, exec_state) -> bool:
         item = exec_state.router.poll(exec_state.query_id, self.op.bridge_id)
         if item is None:
+            self._refresh_expected(exec_state)
+            if (
+                self._upstream_eos >= self._expected_producers
+                and not self._forwarded_eos
+            ):
+                # Every remaining producer is gone: flush a synthetic eos
+                # so downstream blocking ops finalize (partial results).
+                self._forwarded_eos = True
+                self.send(
+                    exec_state,
+                    RowBatch.with_zero_rows(
+                        self.output_relation, eow=True, eos=True
+                    ),
+                )
+                return True
             return False
         eos = getattr(item, "eos", False)
         if eos:
             self._upstream_eos += 1
             all_done = self._upstream_eos >= self._expected_producers
+            if all_done:
+                self._forwarded_eos = True
             if isinstance(item, RowBatch):
                 item = item.with_flags(eow=all_done and item.eow, eos=all_done)
             else:
@@ -151,7 +197,11 @@ class BridgeSourceNode(SourceNode):
     def has_batches_remaining(self) -> bool:
         if self._aborted:
             return False
-        return self._upstream_eos < self._expected_producers
+        if self._upstream_eos < self._expected_producers:
+            return True
+        # Complete — but if completion came from producer loss (not a real
+        # final eos), stay live until the synthetic eos is flushed.
+        return not self._forwarded_eos
 
 
 class MapNode(ExecNode):
@@ -430,6 +480,23 @@ class BridgeSinkNode(SinkNode):
     def __init__(self, op: BridgeSinkOp, output_relation, node_id):
         super().__init__(op, output_relation, node_id)
         self.op: BridgeSinkOp = op
+        self._pushed_eos = False
 
     def consume_next_impl(self, exec_state, batch, parent_index) -> None:
+        if getattr(batch, "eos", False):
+            self._pushed_eos = True
         exec_state.router.push(exec_state.query_id, self.op.bridge_id, batch)
+
+    def flush_cancel(self, exec_state) -> None:
+        """On fragment abort (stall/deadline, r9): if no eos crossed this
+        bridge yet, push a zero-row eos marker so the consumer fragment
+        finalizes with partial input instead of stalling to its own
+        timeout waiting on a producer that aborted."""
+        if self._pushed_eos:
+            return
+        self._pushed_eos = True
+        exec_state.router.push(
+            exec_state.query_id,
+            self.op.bridge_id,
+            RowBatch.with_zero_rows(self.output_relation, eow=True, eos=True),
+        )

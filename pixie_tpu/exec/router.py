@@ -6,6 +6,20 @@ streams to the right query's GRPCSourceNode, buffering until the node
 registers. Ours is transport-agnostic: in one process it is a dict of
 queues; the DCN transport (multi-host) wraps the same interface around
 serialized batches.
+
+r9 robustness semantics:
+
+- ``unregister_producer`` — the broker calls it when an executing agent's
+  heartbeat expires mid-query (ref: the forwarder cancels the dead agent's
+  stream, query_result_forwarder.go:395): consumers re-reading
+  ``producer_count`` stop waiting for eos markers that will never come and
+  finalize with the rows they have (partial results).
+- ``cancel_query``/tombstones — once a query is cancelled or cleaned up,
+  late pushes from still-running remote fragments are dropped instead of
+  re-creating buffers nobody will ever drain (the defaultdict otherwise
+  leaks one queue per late pusher), and polls raise ``BridgeCancelled`` so
+  consumer fragments parked on the router abort instead of spinning to
+  their stall timeout.
 """
 
 from __future__ import annotations
@@ -13,6 +27,12 @@ from __future__ import annotations
 import collections
 import threading
 from typing import Any, Optional
+
+_TOMBSTONE_CAP = 4096  # bounded memory of finished/cancelled query ids
+
+
+class BridgeCancelled(RuntimeError):
+    """Polled a bridge of a cancelled/finished query."""
 
 
 class BridgeRouter:
@@ -22,31 +42,78 @@ class BridgeRouter:
             collections.defaultdict(collections.deque)
         )
         self._producers: dict[tuple[str, str], int] = collections.defaultdict(int)
+        # Queries whose buffers are gone for good: late pushes drop, polls
+        # raise. Bounded FIFO so a long-lived router cannot grow forever.
+        self._dead: set[str] = set()
+        self._dead_order: collections.deque = collections.deque()
+
+    def _mark_dead_locked(self, query_id: str) -> None:
+        if query_id in self._dead:
+            return
+        self._dead.add(query_id)
+        self._dead_order.append(query_id)
+        while len(self._dead_order) > _TOMBSTONE_CAP:
+            self._dead.discard(self._dead_order.popleft())
 
     def register_producer(self, query_id: str, bridge_id: str) -> None:
         """Each upstream fragment instance that will feed a bridge registers
         so the consumer knows how many eos markers to expect (ref: the
         router's per-source connection tracking)."""
         with self._lock:
+            # A fresh registration resurrects a tombstoned id: re-executing
+            # a plan with an explicit query_id must behave like a new query.
+            if query_id in self._dead:
+                self._dead.discard(query_id)
+                try:
+                    self._dead_order.remove(query_id)
+                except ValueError:
+                    pass
             self._producers[(query_id, bridge_id)] += 1
+
+    def unregister_producer(self, query_id: str, bridge_id: str) -> None:
+        """A registered producer died before sending eos (agent lost):
+        consumers re-reading producer_count stop expecting it."""
+        with self._lock:
+            key = (query_id, bridge_id)
+            if self._producers[key] > 0:
+                self._producers[key] -= 1
 
     def num_producers(self, query_id: str, bridge_id: str) -> int:
         with self._lock:
             return max(1, self._producers[(query_id, bridge_id)])
 
+    def producer_count(self, query_id: str, bridge_id: str) -> int:
+        """Raw live-producer count (may be 0 after losses) — consumers use
+        it to refresh eos expectations mid-query."""
+        with self._lock:
+            return self._producers[(query_id, bridge_id)]
+
     def push(self, query_id: str, bridge_id: str, item: Any) -> None:
         with self._lock:
+            if query_id in self._dead:
+                return  # cancelled/finished: drop, don't re-create buffers
             self._queues[(query_id, bridge_id)].append(item)
 
     def poll(self, query_id: str, bridge_id: str) -> Optional[Any]:
         with self._lock:
+            if query_id in self._dead:
+                raise BridgeCancelled(
+                    f"query {query_id}: bridge {bridge_id} cancelled"
+                )
             q = self._queues[(query_id, bridge_id)]
             return q.popleft() if q else None
 
+    def cancel_query(self, query_id: str) -> None:
+        """Abort a query mid-flight: drop its buffers, tombstone the id so
+        late pushes are dropped and parked consumers get BridgeCancelled."""
+        self.cleanup_query(query_id)
+
     def cleanup_query(self, query_id: str) -> None:
-        """Drop a finished/cancelled query's buffers (ref: router query GC)."""
+        """Drop a finished/cancelled query's buffers (ref: router query GC)
+        and tombstone the id against late producers."""
         with self._lock:
             for key in [k for k in self._queues if k[0] == query_id]:
                 del self._queues[key]
             for key in [k for k in self._producers if k[0] == query_id]:
                 del self._producers[key]
+            self._mark_dead_locked(query_id)

@@ -24,6 +24,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import threading
 import time
 from typing import Any, Optional
 
@@ -81,7 +82,7 @@ from pixie_tpu.table.column import DictColumn, StringDictionary
 from pixie_tpu.table.row_batch import RowBatch
 from pixie_tpu.types import DataType
 from pixie_tpu.udf.udf import Executor, MergeKind
-from pixie_tpu.utils import flags, metrics_registry
+from pixie_tpu.utils import faults, flags, metrics_registry
 
 _M = metrics_registry()
 _OFFLOAD_HITS = _M.counter(
@@ -94,6 +95,16 @@ _OFFLOAD_MISS = _M.counter(
 _OFFLOAD_FALLBACKS = _M.counter(
     "device_offload_fallback_total",
     "Device offload attempts that failed and fell back to the host engine.",
+)
+_BREAKER_TRIPS = _M.counter(
+    "device_offload_fallback_breaker_trips_total",
+    "Circuit-breaker trips: N consecutive device failures sent a program "
+    "key to the host engine for a cooldown.",
+)
+_BREAKER_SKIPS = _M.counter(
+    "device_offload_fallback_breaker_open_total",
+    "Fragments routed straight to the host engine because their program "
+    "key's circuit breaker was open.",
 )
 _STAGED_EVICTIONS = _M.counter(
     "device_staged_cache_evictions_total",
@@ -552,8 +563,68 @@ class MeshExecutor:
         self._hostany_cache: "collections.OrderedDict[tuple, np.ndarray]" = (
             collections.OrderedDict()
         )
+        # Circuit breaker (r9): per program-key [consecutive_failures,
+        # open_until_monotonic]. device_breaker_threshold consecutive
+        # fold/compile failures trip the key to the host engine for
+        # device_breaker_cooldown_s; the first post-cooldown attempt is
+        # the half-open trial — one more failure re-opens immediately,
+        # a success closes the breaker.
+        self._breaker: dict[str, list] = {}
+        self._breaker_lock = threading.Lock()
 
     # -- public -------------------------------------------------------------
+    @staticmethod
+    def _breaker_key(fragment: PlanFragment) -> str:
+        """Structural program key for the circuit breaker: the operator
+        chain + table names, NOT the table version — a poisoned fold shape
+        must stay tripped across data growth, while a different query
+        shape keeps its own healthy breaker."""
+        parts = []
+        for nid in fragment.topo_order():
+            op = fragment.node(nid)
+            parts.append(type(op).__name__)
+            tn = getattr(op, "table_name", None)
+            if tn:
+                parts.append(tn)
+            exprs = getattr(op, "values", None) or getattr(op, "exprs", None)
+            if exprs:
+                parts.append(repr(exprs))
+            groups = getattr(op, "groups", None)
+            if groups:
+                parts.append(repr(groups))
+        return "|".join(parts)
+
+    def _breaker_is_open(self, key: str) -> bool:
+        threshold = flags.device_breaker_threshold
+        if threshold <= 0:
+            return False
+        with self._breaker_lock:
+            st = self._breaker.get(key)
+            return st is not None and st[1] > time.monotonic()
+
+    def _breaker_record(self, key: str, ok: bool) -> None:
+        threshold = flags.device_breaker_threshold
+        if threshold <= 0:
+            return
+        with self._breaker_lock:
+            if ok:
+                self._breaker.pop(key, None)  # success closes the breaker
+                return
+            st = self._breaker.setdefault(key, [0, 0.0])
+            st[0] += 1
+            if st[0] >= threshold:
+                # Trip (or re-trip after a failed half-open trial): route
+                # this key to the host engine for the cooldown.
+                st[1] = time.monotonic() + flags.device_breaker_cooldown_s
+                _BREAKER_TRIPS.inc()
+                import logging
+
+                logging.getLogger("pixie_tpu.parallel").warning(
+                    "device circuit breaker OPEN for %.1fs after %d "
+                    "consecutive failures (key %.80s...)",
+                    flags.device_breaker_cooldown_s, st[0], key,
+                )
+
     def try_execute_fragment(
         self, fragment: PlanFragment, table_store, registry, func_ctx=None
     ) -> Optional[tuple[int, RowBatch]]:
@@ -561,18 +632,32 @@ class MeshExecutor:
         return (agg_node_id, finalized agg RowBatch); else None — including
         when any stage of device planning/tracing fails (host-untraceable
         expressions, dictionary edge cases): offload is an optimization,
-        never a correctness cliff."""
+        never a correctness cliff.
+
+        Circuit breaker (r9): device_breaker_threshold consecutive
+        failures for one program key skip the device entirely for
+        device_breaker_cooldown_s (no repeated staging/compile churn on a
+        poisoned shape), surfaced via the device_offload_fallback metric
+        family (..._breaker_trips_total / ..._breaker_open_total)."""
+        bkey = self._breaker_key(fragment)
+        if self._breaker_is_open(bkey):
+            _BREAKER_SKIPS.inc()
+            _OFFLOAD_FALLBACKS.inc()
+            return None
         try:
             out = self._try_execute_fragment(
                 fragment, table_store, registry, func_ctx
             )
             (_OFFLOAD_HITS if out is not None else _OFFLOAD_MISS).inc()
+            if out is not None:
+                self._breaker_record(bkey, ok=True)
             return out
         except Exception as e:
             import logging
             import traceback
 
             _OFFLOAD_FALLBACKS.inc()
+            self._breaker_record(bkey, ok=False)
             key = f"{type(e).__name__}: {e}"
             if key not in self.fallback_errors:
                 self.fallback_errors[key] = traceback.format_exc()
@@ -600,6 +685,11 @@ class MeshExecutor:
         table = table_store.get_table(m.source_op.table_name)
         if table is None:
             return None
+        # Fault site: poison the device fold dispatch for a matched
+        # fragment (chaos tests prove the fallback is bit-identical on the
+        # host engine and the circuit breaker trips after N hits).
+        if faults.ACTIVE:
+            faults.check("pipeline.fold")
 
         specs = self._agg_specs(m, registry)
         if specs is None:

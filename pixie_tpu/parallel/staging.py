@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pixie_tpu.table.column import DictColumn
 from pixie_tpu.table.table import Table
-from pixie_tpu.utils import flags
+from pixie_tpu.utils import faults, flags
 
 DEFAULT_BLOCK_ROWS = 1 << 17
 
@@ -509,6 +509,11 @@ def pack_stream_window(
     reshape to [D, nblk, B]. Runs on the streaming pipeline's background
     thread — this is the 'pack' stage that overlaps transfer and compute.
     Returns (rows, packed_cols, packed_gids, nbytes)."""
+    # Fault site: a poisoned stream pack (chaos tests prove the query
+    # falls back to monolithic staging, still on-device, and stays
+    # correct — MeshExecutor.stream_fallback_errors records it).
+    if faults.ACTIVE:
+        faults.check("staging.pack")
     with timed("stage_stream_pack"):
         lo = w * plan.window_rows
         hi = min(lo + plan.window_rows, plan.num_rows)

@@ -1,6 +1,15 @@
-"""Shared runtime utilities: metrics registry + flag/config system."""
+"""Shared runtime utilities: metrics registry + flag/config system +
+fault-injection registry."""
 
+from pixie_tpu.utils import faults
 from pixie_tpu.utils.config import define_flag, flags
 from pixie_tpu.utils.metrics import Counter, Gauge, metrics_registry
 
-__all__ = ["Counter", "Gauge", "metrics_registry", "define_flag", "flags"]
+__all__ = [
+    "Counter",
+    "Gauge",
+    "metrics_registry",
+    "define_flag",
+    "flags",
+    "faults",
+]

@@ -220,3 +220,59 @@ define_flag(
     0.5,
     help_="Agent heartbeat period (ref: ~5s, scaled down).",
 )
+
+# -- robustness (r9): deadlines, partial results, backoff, breaker ----------
+define_flag(
+    "query_deadline_s",
+    0.0,
+    help_="Per-query hard deadline propagated broker→agent→exec graph so "
+    "a stalled fragment aborts everywhere, not just at the client "
+    "(QueryDeadlineExceeded). 0 disables; the broker uses "
+    "min(timeout_s, query_deadline_s) when set.",
+)
+define_flag(
+    "partial_results",
+    True,
+    help_="When an agent dies, errors, or misses the deadline mid-query, "
+    "the broker returns the rows it has plus a structured per-agent "
+    "``degraded`` annotation on the QueryResult instead of raising "
+    "(ref: query_result_forwarder.go:395 forwards partial results with "
+    "per-agent timeout/cancel annotations). Off = r8 raise behavior.",
+)
+define_flag(
+    "agent_backoff_initial_s",
+    0.05,
+    help_="Initial delay for agent control-bus reconnect backoff "
+    "(transport.py RemoteBus; doubles per attempt up to "
+    "agent_backoff_max_s, with jitter).",
+)
+define_flag(
+    "agent_backoff_max_s",
+    2.0,
+    help_="Ceiling for the agent reconnect exponential backoff.",
+)
+define_flag(
+    "agent_backoff_jitter",
+    0.25,
+    help_="Fractional jitter applied to each reconnect delay (delay *= "
+    "1 + jitter*U[0,1)) so a restarted broker is not thundering-herded.",
+)
+define_flag(
+    "agent_reconnect_max_tries",
+    64,
+    help_="Reconnect attempts before a RemoteBus gives up and stays "
+    "closed (0 = retry forever).",
+)
+define_flag(
+    "device_breaker_threshold",
+    3,
+    help_="Consecutive device fold/compile failures for one program key "
+    "before the circuit breaker trips that key to the host engine "
+    "(parallel/pipeline.py). 0 disables the breaker.",
+)
+define_flag(
+    "device_breaker_cooldown_s",
+    30.0,
+    help_="Seconds a tripped device program key stays on the host engine "
+    "before a half-open trial is allowed back on the mesh.",
+)

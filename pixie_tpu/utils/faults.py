@@ -1,0 +1,195 @@
+"""Deterministic fault-injection registry (r9 chaos framework).
+
+Ref posture: the reference proves its recovery paths with fault-injecting
+tests around the result forwarder and agent tracker (agent death mid-query
+forwards *partial* results with per-agent annotations,
+query_result_forwarder.go:395,502,571; heartbeat expiry,
+agent_topic_listener.go:41). This module is the injection half of that
+story: production code declares named *sites* at the exact points that can
+fail in the field (transport send/recv, handshake, agent heartbeat/execute,
+broker forwarding, datastore append, staging pack, device fold dispatch),
+and tests/operators arm them deterministically.
+
+Design contract:
+
+- **Zero cost when disabled.** Call sites are gated on the module-level
+  ``ACTIVE`` bool::
+
+      if faults.ACTIVE and faults.fires("transport.send"):
+          raise OSError("fault injected")
+
+  With nothing armed, the cost is one attribute load + branch; no dict
+  lookup, no string formatting, no lock. ``tools/microbench_fault_overhead
+  .py`` holds this to <1% of the warm agg path and the transport
+  round-trip.
+
+- **Deterministic.** Each site owns a ``random.Random`` seeded from
+  ``(seed, site name)``; with ``p=1`` and ``count``/``after``, firing is a
+  pure function of how many times the site was checked — chaos tests never
+  flake on scheduling.
+
+- **Site behavior lives at the call site.** The registry only answers
+  "does this check fire?"; whether that means a dropped frame, a raised
+  exception, or a skipped heartbeat is the caller's choice (``check()`` is
+  the raise-``FaultInjectedError`` convenience).
+
+Arming: programmatic (``arm``/``disarm``/``reset``) or the ``fault_inject``
+flag / ``PIXIE_TPU_FAULT_INJECT`` env::
+
+    fault_inject="transport.send:count=1,agent.heartbeat@pem2:p=0.5:seed=7"
+
+Spec grammar: comma-separated ``site[:key=value]*`` with keys ``p``
+(probability, default 1), ``count`` (max fires, default unlimited),
+``after`` (skip the first N checks), ``seed`` (default 0). Site names may
+carry an ``@scope`` suffix; call sites with a natural instance (an agent
+id) check both the bare and the scoped name via ``fires_scoped``.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from pixie_tpu.utils.config import define_flag, flags
+from pixie_tpu.utils.metrics import metrics_registry
+
+define_flag(
+    "fault_inject",
+    "",
+    help_="Deterministic fault-injection spec: comma-separated "
+    "site[:p=..][:count=..][:after=..][:seed=..] entries "
+    "(pixie_tpu/utils/faults.py). Empty disables all sites at zero cost.",
+)
+
+_FIRED = metrics_registry().counter(
+    "fault_injected_total", "Fault-injection site fires, by site."
+)
+
+
+class FaultInjectedError(RuntimeError):
+    """Raised by ``check()`` when an armed site fires."""
+
+    def __init__(self, site: str):
+        super().__init__(f"fault injected: {site}")
+        self.site = site
+
+
+# Fast gate read by every call site. True iff at least one site is armed.
+ACTIVE = False
+
+_lock = threading.Lock()
+_sites: dict[str, "_Site"] = {}
+
+
+class _Site:
+    __slots__ = ("name", "p", "count", "after", "checks", "fired", "_rng")
+
+    def __init__(self, name, p=1.0, count=None, seed=0, after=0):
+        self.name = name
+        self.p = float(p)
+        self.count = count if count is None else int(count)
+        self.after = int(after)
+        self.checks = 0
+        self.fired = 0
+        self._rng = random.Random(f"{seed}:{name}")
+
+    def _fires(self) -> bool:
+        self.checks += 1
+        if self.checks <= self.after:
+            return False
+        if self.count is not None and self.fired >= self.count:
+            return False
+        if self.p < 1.0 and self._rng.random() >= self.p:
+            return False
+        self.fired += 1
+        return True
+
+
+def arm(
+    site: str,
+    p: float = 1.0,
+    count: "int | None" = None,
+    seed: int = 0,
+    after: int = 0,
+) -> None:
+    """Arm (or re-arm, resetting counters) a site."""
+    global ACTIVE
+    with _lock:
+        _sites[site] = _Site(site, p=p, count=count, seed=seed, after=after)
+        ACTIVE = True
+
+
+def disarm(site: str) -> None:
+    global ACTIVE
+    with _lock:
+        _sites.pop(site, None)
+        ACTIVE = bool(_sites)
+
+
+def reset() -> None:
+    """Disarm every site (tests call this in teardown)."""
+    global ACTIVE
+    with _lock:
+        _sites.clear()
+        ACTIVE = False
+
+
+def fires(site: str) -> bool:
+    """True iff ``site`` is armed and this check fires. Counts the check
+    either way (microbench uses p=0 arming to census site traffic)."""
+    with _lock:
+        s = _sites.get(site)
+        if s is None or not s._fires():
+            return False
+    _FIRED.inc(site=site)
+    return True
+
+
+def fires_scoped(site: str, scope: str) -> bool:
+    """Check the bare site name and its ``site@scope`` variant — lets a
+    test target one agent/connection out of many. Only call under the
+    ``ACTIVE`` gate (builds a string)."""
+    return fires(site) or fires(f"{site}@{scope}")
+
+
+def check(site: str) -> None:
+    """Raise ``FaultInjectedError`` if the armed site fires."""
+    if fires(site):
+        raise FaultInjectedError(site)
+
+
+def stats() -> dict[str, tuple[int, int]]:
+    """{site: (checks, fired)} for currently-armed sites."""
+    with _lock:
+        return {name: (s.checks, s.fired) for name, s in _sites.items()}
+
+
+def configure(spec: str) -> None:
+    """Parse and arm a ``fault_inject``-flag spec (see module docstring)."""
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        site, kwargs = parts[0], {}
+        for kv in parts[1:]:
+            k, _, v = kv.partition("=")
+            k = k.strip()
+            if k == "p":
+                kwargs["p"] = float(v)
+            elif k == "count":
+                kwargs["count"] = int(v)
+            elif k == "after":
+                kwargs["after"] = int(v)
+            elif k == "seed":
+                kwargs["seed"] = int(v)
+            else:
+                raise ValueError(
+                    f"fault_inject: unknown key {k!r} in {entry!r}"
+                )
+        arm(site, **kwargs)
+
+
+# Flag/env arming at import (tests use arm()/reset() directly).
+if flags.fault_inject:
+    configure(flags.fault_inject)

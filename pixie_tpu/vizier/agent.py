@@ -16,11 +16,11 @@ import traceback
 from typing import Optional
 
 from pixie_tpu.engine import Carnot
-from pixie_tpu.exec import BridgeRouter
+from pixie_tpu.exec import BridgeRouter, QueryDeadlineExceeded
 from pixie_tpu.plan.plan import Plan
 from pixie_tpu.vizier.bus import MessageBus, agent_topic
 
-from pixie_tpu.utils import flags
+from pixie_tpu.utils import faults, flags
 
 # scaled-down from the reference's ~5s; PIXIE_TPU_AGENT_HEARTBEAT_INTERVAL_S.
 HEARTBEAT_INTERVAL_S = flags.agent_heartbeat_interval_s
@@ -63,6 +63,12 @@ class Agent:
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self._sub = self.bus.subscribe(agent_topic(self.agent_id))
+        # On a transport reconnect (RemoteBus backoff, r9), re-register so
+        # the broker's tracker re-learns our tables without waiting a full
+        # heartbeat interval (ref: re-registration after NATS reconnect).
+        add_listener = getattr(self.bus, "add_reconnect_listener", None)
+        if add_listener is not None:
+            add_listener(self._register)
         self._register()
         t = threading.Thread(target=self._run_loop, daemon=True)
         hb = threading.Thread(target=self._heartbeat_loop, daemon=True)
@@ -91,6 +97,12 @@ class Agent:
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(HEARTBEAT_INTERVAL_S):
+            # Fault site: a silent agent (chaos tests prove the broker
+            # reaps it from plans and from in-flight queries).
+            if faults.ACTIVE and faults.fires_scoped(
+                "agent.heartbeat", self.agent_id
+            ):
+                continue
             self.bus.publish(
                 AGENT_STATUS_TOPIC,
                 {
@@ -117,8 +129,20 @@ class Agent:
         query_id = msg["query_id"]
         plan: Plan = msg["plan"]  # in-process handoff; DCN would serialize
         try:
+            if faults.ACTIVE:
+                if faults.fires_scoped("agent.execute_hang", self.agent_id):
+                    # Simulate an agent wedged mid-query (alive but never
+                    # reporting): park until the agent stops. Chaos tests
+                    # assert the broker's deadline/reaper handles us.
+                    self._stop.wait(timeout=30.0)
+                    return
+                if faults.fires_scoped("agent.execute", self.agent_id):
+                    raise faults.FaultInjectedError("agent.execute")
             result = self.carnot.execute_plan(
-                plan, analyze=msg.get("analyze", False), manage_router=False
+                plan,
+                analyze=msg.get("analyze", False),
+                manage_router=False,
+                deadline_s=msg.get("deadline_s"),
             )
             for name, batches in result.tables.items():
                 for b in batches:
@@ -146,5 +170,12 @@ class Agent:
                     "type": "fragment_error",
                     "agent_id": self.agent_id,
                     "error": f"{e}\n{traceback.format_exc()}",
+                    # Lets the broker's degraded annotation distinguish a
+                    # propagated-deadline abort from a genuine failure.
+                    "error_kind": (
+                        "deadline"
+                        if isinstance(e, QueryDeadlineExceeded)
+                        else "error"
+                    ),
                 },
             )

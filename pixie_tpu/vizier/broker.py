@@ -27,7 +27,7 @@ from pixie_tpu.vizier.bus import (
     MessageBus,
     agent_topic,
 )
-from pixie_tpu.utils import flags
+from pixie_tpu.utils import faults, flags
 from pixie_tpu.vizier.agent import AGENT_STATUS_TOPIC, RESULTS_TOPIC_PREFIX
 
 
@@ -61,23 +61,53 @@ class AgentTracker:
                         "last_seen": time.monotonic(),
                     }
 
-    def distributed_state(self) -> DistributedState:
+    def planning_view(self) -> tuple[DistributedState, list[str]]:
+        """(alive agents for planning, skipped agent ids) — query planning
+        only covers agents within the heartbeat-expiry window (ref:
+        agent_topic_listener expiry + prune_unavailable_sources_rule); the
+        skipped list rides the query's degraded annotation so callers can
+        see whose data the plan never covered (r9)."""
         now = time.monotonic()
         with self._lock:
-            # Expire silent agents (ref: agent_topic_listener expiry) so
-            # plans skip them (prune_unavailable_sources_rule behavior).
-            alive = {
+            alive, skipped = {}, []
+            for aid, a in self._agents.items():
+                silent = now - a["last_seen"]
+                if silent < AGENT_EXPIRY_S:
+                    alive[aid] = a
+                elif silent < 10 * AGENT_EXPIRY_S:
+                    # Recently expired: keep the record (UNRESPONSIVE in
+                    # the status UDTF) and report it skipped.
+                    skipped.append(aid)
+                # Long-silent agents are forgotten entirely.
+            self._agents = {
                 aid: a
                 for aid, a in self._agents.items()
-                if now - a["last_seen"] < AGENT_EXPIRY_S
+                if now - a["last_seen"] < 10 * AGENT_EXPIRY_S
             }
-            self._agents = dict(alive)
-        return DistributedState(
+        state = DistributedState(
             agents=[
                 AgentInfo(aid, a["tables"], a["is_kelvin"])
                 for aid, a in sorted(alive.items())
             ]
         )
+        return state, sorted(skipped)
+
+    def distributed_state(self) -> DistributedState:
+        return self.planning_view()[0]
+
+    def expired_among(self, agent_ids) -> list[str]:
+        """Subset of ``agent_ids`` whose heartbeat has expired — the
+        broker polls this mid-query to detect agents dying while their
+        fragments run (ref: the forwarder cancelling dead-agent streams,
+        query_result_forwarder.go:395)."""
+        now = time.monotonic()
+        with self._lock:
+            return sorted(
+                aid
+                for aid in agent_ids
+                if aid not in self._agents
+                or now - self._agents[aid]["last_seen"] >= AGENT_EXPIRY_S
+            )
 
     def agents_snapshot(self) -> list[dict]:
         """Rows for the GetAgentStatus UDTF (ref: md_udtfs.h reads the
@@ -160,7 +190,17 @@ class QueryBroker:
         publishing into a full queue block up to the publish timeout, so a
         slow consumer backpressures producers instead of growing broker
         memory. Pass ``on_batch(table_name, row_batch)`` to stream batches
-        to the consumer as they arrive instead of accumulating them."""
+        to the consumer as they arrive instead of accumulating them.
+
+        Graceful degradation (r9; ref: query_result_forwarder.go:395's
+        partial forwarding with per-agent annotations): with
+        ``flags.partial_results`` on, an agent that errors, misses the
+        deadline, or stops heartbeating mid-query no longer fails the
+        whole query — the broker unregisters the dead agent's bridges (so
+        merge fragments finalize with the input they have), keeps the rows
+        it received, and returns them with a structured
+        ``QueryResult.degraded`` annotation. Flag off restores the r8
+        raise-on-failure behavior."""
         qid = str(uuid.uuid4())
         t0 = time.perf_counter_ns()
         logical = self.compiler.compile(
@@ -171,17 +211,30 @@ class QueryBroker:
             query_id=qid,
             exec_funcs=exec_funcs,
         )
-        state = self.tracker.distributed_state()
+        # Plan only over agents inside the heartbeat-expiry window; the
+        # skipped list rides the degraded annotation.
+        state, skipped_agents = self.tracker.planning_view()
         planner = DistributedPlanner(self.registry, self.table_relations)
         plan = planner.plan(logical, state)
         compile_ns = time.perf_counter_ns() - t0
 
-        # Central bridge-producer registration over the shared router.
+        # The broker's deadline is also the propagated per-query deadline:
+        # every fragment aborts at (about) the same wall-clock moment.
+        if flags.query_deadline_s > 0:
+            timeout_s = min(timeout_s, flags.query_deadline_s)
+
+        # Central bridge-producer registration over the shared router,
+        # remembering which instance feeds which bridges so a dead agent's
+        # producers can be unregistered mid-query.
+        bridges_by_instance: dict[str, list[str]] = {}
         for frag in plan.fragments:
+            inst = plan.executing_instance[frag.fragment_id]
             for nid in frag.nodes():
-                if isinstance(frag.node(nid), BridgeSinkOp):
-                    self.router.register_producer(
-                        qid, frag.node(nid).bridge_id
+                op = frag.node(nid)
+                if isinstance(op, BridgeSinkOp):
+                    self.router.register_producer(qid, op.bridge_id)
+                    bridges_by_instance.setdefault(inst, []).append(
+                        op.bridge_id
                     )
 
         results_sub = self.bus.subscribe(
@@ -203,26 +256,55 @@ class QueryBroker:
                     "query_id": qid,
                     "plan": sub_plan,
                     "analyze": analyze,
+                    "deadline_s": timeout_s,
                 },
             )
 
         # Forward results (query_result_forwarder.go:502,571).
+        partial_ok = flags.partial_results
         tables: dict[str, list] = {}
         exec_stats: dict[str, dict] = {}
-        pending = len(by_instance)
+        pending: set = set(by_instance)
         deadline = time.monotonic() + timeout_s
-        errors: list[str] = []
+        agent_errors: dict[str, str] = {}
+        lost_agents: list[str] = []
+        timed_out_agents: list[str] = []
+        forward_dropped = 0
         try:
-            while pending > 0:
+            while pending:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise TimeoutError(
-                        f"query {qid}: {pending} agents still running"
-                    )
+                    timed_out_agents = sorted(pending)
+                    if not partial_ok:
+                        raise TimeoutError(
+                            f"query {qid}: {len(pending)} agents still "
+                            f"running ({timed_out_agents})"
+                        )
+                    for inst in timed_out_agents:
+                        agent_errors.setdefault(
+                            inst, "deadline exceeded: no result"
+                        )
+                    break
                 msg = results_sub.get(timeout=min(remaining, 0.1))
                 if msg is None:
+                    # Reap agents that stopped heartbeating mid-query:
+                    # release their bridges so merge fragments finalize
+                    # with partial input instead of stalling.
+                    if partial_ok:
+                        for inst in self.tracker.expired_among(pending):
+                            pending.discard(inst)
+                            lost_agents.append(inst)
+                            agent_errors.setdefault(
+                                inst, "agent lost: heartbeat expired "
+                                "mid-query"
+                            )
+                            for bid in bridges_by_instance.get(inst, ()):
+                                self.router.unregister_producer(qid, bid)
                     continue
                 if msg["type"] == "result_batch":
+                    if faults.ACTIVE and faults.fires("broker.forward"):
+                        forward_dropped += 1
+                        continue
                     if on_batch is not None:
                         on_batch(msg["table"], msg["batch"])
                     else:
@@ -232,33 +314,81 @@ class QueryBroker:
                 elif msg["type"] == "fragment_done":
                     for k, v in msg.get("exec_stats", {}).items():
                         exec_stats[f"{msg['agent_id']}/{k}"] = v
-                    pending -= 1
+                    pending.discard(msg["agent_id"])
                 elif msg["type"] == "fragment_error":
-                    errors.append(f"{msg['agent_id']}: {msg['error']}")
-                    pending -= 1
+                    aid = msg["agent_id"]
+                    agent_errors[aid] = msg["error"]
+                    if msg.get("error_kind") == "deadline":
+                        timed_out_agents.append(aid)
+                    pending.discard(aid)
+                    if partial_ok:
+                        # The failed fragments produced no (or partial)
+                        # bridge output: release their producer slots so
+                        # downstream merge fragments finalize with what
+                        # they have instead of stalling on eos markers
+                        # that will never come.
+                        for bid in bridges_by_instance.get(aid, ()):
+                            self.router.unregister_producer(qid, bid)
         finally:
             results_sub.unsubscribe()
+            # cleanup_query also tombstones the id: late pushes from
+            # still-running fragments are dropped and their polls abort
+            # (BridgeCancelled) instead of leaking buffers.
             self.router.cleanup_query(qid)
         if results_sub.dropped:
             # Result messages were dropped after the flow-control timeout:
-            # the stream is incomplete — fail loudly rather than return
-            # partial data as success (ref: the forwarder cancels the
-            # query, query_result_forwarder.go:571).
+            # the stream is incomplete because the CONSUMER is too slow —
+            # that is a local flow-control failure, not a degraded cluster;
+            # fail loudly rather than return partial data as success
+            # (ref: the forwarder cancels the query,
+            # query_result_forwarder.go:571).
             raise RuntimeError(
                 f"query {qid}: consumer too slow — {results_sub.dropped} "
                 "result messages dropped after "
                 f"{flags.broker_publish_timeout_s}s of backpressure"
             )
-        if errors:
+        if agent_errors and not partial_ok:
             raise RuntimeError(
-                f"query {qid} failed on agents:\n" + "\n".join(errors)
+                f"query {qid} failed on agents:\n"
+                + "\n".join(f"{a}: {e}" for a, e in sorted(agent_errors.items()))
             )
+        degraded = None
+        if partial_ok and (
+            agent_errors
+            or lost_agents
+            or timed_out_agents
+            or skipped_agents
+            or forward_dropped
+        ):
+            reasons = []
+            if lost_agents:
+                reasons.append("agent_lost")
+            if timed_out_agents:
+                reasons.append("deadline")
+            if agent_errors and set(agent_errors) - set(lost_agents) - set(
+                timed_out_agents
+            ):
+                reasons.append("agent_error")
+            if skipped_agents:
+                reasons.append("agents_skipped")
+            if forward_dropped:
+                reasons.append("forward_dropped")
+            degraded = {
+                "partial": True,
+                "reasons": reasons,
+                "agent_errors": dict(sorted(agent_errors.items())),
+                "lost_agents": sorted(lost_agents),
+                "timed_out_agents": sorted(set(timed_out_agents)),
+                "skipped_agents": list(skipped_agents),
+                "forward_dropped": forward_dropped,
+            }
         return QueryResult(
             query_id=qid,
             tables=tables,
             exec_stats=exec_stats,
             compile_time_ns=compile_ns,
             exec_time_ns=time.perf_counter_ns() - t1,
+            degraded=degraded,
         )
 
     def stop(self) -> None:

@@ -184,6 +184,12 @@ class ScriptRunner:
             timeout_s=self._timeout_s,
             script_args=script.configs.get("args"),
         )
+        if getattr(result, "degraded", None) is not None:
+            # Partial results (r9) still store/sink, but the degradation
+            # is surfaced where cron failures already are.
+            self.last_errors[script.script_id] = (
+                f"degraded: {','.join(result.degraded['reasons'])}"
+            )
         if self._sink is not None:
             self._sink(script, result)
         elif self._result_store is not None:

@@ -19,6 +19,8 @@ import threading
 import zlib
 from typing import Optional
 
+from pixie_tpu.utils import faults
+
 
 class Datastore:
     """In-memory backend (and the interface contract)."""
@@ -32,6 +34,11 @@ class Datastore:
             return self._data.get(key)
 
     def set(self, key: str, value: bytes) -> None:
+        # Fault site BEFORE any mutation: an injected append failure must
+        # leave the in-memory view and the log consistent (chaos tests
+        # assert the failed write is absent from both).
+        if faults.ACTIVE:
+            faults.check("datastore.append")
         with self._lock:
             self._data[key] = bytes(value)
             self._on_write(key, value)

@@ -26,6 +26,7 @@ import hmac
 import ipaddress
 import logging
 import os
+import random
 import socket
 import ssl
 import struct
@@ -33,7 +34,7 @@ import threading
 from typing import Any, Optional
 
 from pixie_tpu.exec.router import BridgeRouter
-from pixie_tpu.utils import flags
+from pixie_tpu.utils import faults, flags, metrics_registry
 from pixie_tpu.utils.config import define_flag
 from pixie_tpu.vizier import wire
 from pixie_tpu.vizier.bus import MessageBus
@@ -44,6 +45,23 @@ define_flag(
     help_="Pre-shared secret authenticating transport connections "
     "(HMAC-SHA256 challenge/response). Empty restricts the transport to "
     "loopback (ref posture: src/shared/services/ TLS+JWT bootstrap).",
+)
+
+define_flag(
+    "transport_handshake_timeout_s",
+    10.0,
+    help_="Socket timeout covering the TLS+HMAC handshake on both ends "
+    "(was hard-coded 10s server-side). A silent peer's half-open "
+    "connection is closed at the timeout instead of pinning a thread.",
+)
+
+_RECONNECTS = metrics_registry().counter(
+    "transport_reconnect_total",
+    "Successful RemoteBus plane reconnects after a connection failure.",
+)
+_DEDUP_DROPS = metrics_registry().counter(
+    "transport_dedup_dropped_total",
+    "Duplicate/replayed frames dropped by per-connection seq dedup.",
 )
 
 define_flag(
@@ -170,6 +188,8 @@ def _server_handshake(conn: socket.socket, secret: str) -> bool:
     """Mutual challenge/response (server side). Server challenges first;
     the client's response proves it holds the secret before any frame is
     acted on; the server's counter-MAC proves the same to the client."""
+    if faults.ACTIVE and faults.fires("transport.handshake"):
+        return False
     nonce = os.urandom(_NONCE_BYTES)
     _send_frame(conn, {"kind": "challenge", "nonce": nonce})
     frame = _recv_frame(conn, max_len=_HANDSHAKE_MAX_FRAME, pre_auth=True)
@@ -186,6 +206,8 @@ def _server_handshake(conn: socket.socket, secret: str) -> bool:
 
 
 def _client_handshake(sock: socket.socket, secret: str) -> None:
+    if faults.ACTIVE and faults.fires("transport.handshake"):
+        raise ConnectionError("fault injected: transport.handshake")
     frame = _recv_frame(sock, max_len=_HANDSHAKE_MAX_FRAME, pre_auth=True)
     if frame is None or frame.get("kind") != "challenge" or not isinstance(
         frame.get("nonce"), bytes
@@ -270,11 +292,17 @@ class BusTransportServer:
         send_lock = threading.Lock()
         conn_dead = threading.Event()  # per-connection: stops forwarders
         subs: dict[str, tuple] = {}  # topic -> (bus sub, stop event)
+        # Per-connection dedup watermark: clients stamp a monotonically
+        # increasing ``seq`` on every frame; a replayed/duplicated frame
+        # (retry ambiguity, injected duplication) is dropped here so
+        # result rows and producer registrations stay exactly-once.
+        last_seq = -1
         try:
             try:
                 # Bounded pre-auth hold time: a silent peer must not pin
-                # this thread forever. Cleared once authenticated.
-                conn.settimeout(10.0)
+                # this thread forever (the half-open socket is closed in
+                # the finally below). Cleared once authenticated.
+                conn.settimeout(flags.transport_handshake_timeout_s)
                 if self._tls is not None:
                     # TLS first; the HMAC challenge/response then runs
                     # INSIDE the tunnel (defense in depth: the secret
@@ -299,8 +327,22 @@ class BusTransportServer:
                     return  # closed under us (shutdown or peer reset)
                 if frame is None:
                     return
+                frames = [frame]
+                if (
+                    faults.ACTIVE
+                    and frame.get("kind") in ("publish", "bridge_push")
+                    and faults.fires("transport.recv_dup")
+                ):
+                    frames.append(frame)  # injected duplicate delivery
                 try:
-                    self._dispatch(frame, conn, send_lock, conn_dead, subs)
+                    for fr in frames:
+                        seq = fr.get("seq")
+                        if isinstance(seq, int):
+                            if seq <= last_seq:
+                                _DEDUP_DROPS.inc()
+                                continue
+                            last_seq = seq
+                        self._dispatch(fr, conn, send_lock, conn_dead, subs)
                 except (KeyError, TypeError) as e:
                     # Wire-valid but schema-invalid (missing/mis-typed
                     # fields): same hostile-peer treatment as WireError.
@@ -314,6 +356,10 @@ class BusTransportServer:
                 stop.set()
                 sub.unsubscribe()
             _close(conn)
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
 
     def _dispatch(self, frame, conn, send_lock, conn_dead, subs) -> None:
         kind = frame["kind"]
@@ -417,7 +463,16 @@ class RemoteBus:
     vs gRPC data streams): result-stream publishes and bridge pushes ride
     a DATA connection that may block under broker flow control; heartbeats,
     registration, and subscriptions ride the CONTROL connection so
-    backpressure can never starve liveness and get the agent pruned."""
+    backpressure can never starve liveness and get the agent pruned.
+
+    Reconnection (r9; ref: the NATS client's reconnect-with-backoff that
+    the reference's agents lean on): a failed plane redials with
+    exponential backoff + jitter (``agent_backoff_*`` flags), re-issues
+    server-side subscriptions, and invokes registered reconnect listeners
+    (the Agent re-registers its tables). Failed sends retry on the fresh
+    connection — a frame is only ever retried when the old socket died
+    before it was sent, and every frame carries a per-plane monotonic
+    ``seq`` the server dedups on, so result rows stay exactly-once."""
 
     DATA_TOPIC_PREFIXES = ("results/",)
 
@@ -436,58 +491,192 @@ class RemoteBus:
             )
         self._sock = self._connect()
         self._send_lock = threading.Lock()
+        self._seq = 0  # control-plane frame sequence (dedup watermark)
         self._data_sock = None  # opened on first data-plane send
         self._data_lock = threading.Lock()
+        self._data_seq = 0
         self._subs_lock = threading.Lock()
         self._subs: dict[str, list[_RemoteSubscription]] = {}
         self._stop = threading.Event()
+        # Reentrant: a reconnect listener may publish, whose send failure
+        # would re-enter _reconnect on the same thread.
+        self._reconnect_lock = threading.RLock()
+        self._reconnect_listeners: list = []
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
+    def add_reconnect_listener(self, fn) -> None:
+        """``fn()`` runs after each successful control-plane reconnect
+        (the Agent re-registers itself + its tables)."""
+        self._reconnect_listeners.append(fn)
+
     def _connect(self) -> socket.socket:
-        sock = socket.create_connection(self._address)
+        sock = socket.create_connection(
+            self._address, timeout=flags.transport_handshake_timeout_s
+        )
         try:
+            # The handshake runs under the timeout: a silent/half-open
+            # server cannot park this thread; the socket is closed on the
+            # way out instead of leaking.
             if self._tls is not None:
                 sock = self._tls.wrap_socket(
                     sock, server_hostname=str(self._address[0])
                 )
             _client_handshake(sock, self._secret)
+            sock.settimeout(None)
         except Exception:
             _close(sock)
             raise
         return sock
 
+    def _backoff_delays(self):
+        """Exponential backoff delays with jitter, bounded by
+        agent_reconnect_max_tries (0 = forever)."""
+        delay = flags.agent_backoff_initial_s
+        max_tries = flags.agent_reconnect_max_tries
+        attempt = 0
+        while max_tries <= 0 or attempt < max_tries:
+            attempt += 1
+            yield delay * (1.0 + flags.agent_backoff_jitter * random.random())
+            delay = min(delay * 2.0, flags.agent_backoff_max_s)
+
+    def _reconnect(self, dead_sock) -> bool:
+        """Replace the control connection after ``dead_sock`` failed.
+        Returns True once a live connection exists (possibly made by a
+        competing thread), False when giving up (closed or out of
+        tries)."""
+        with self._reconnect_lock:
+            if self._stop.is_set():
+                return False
+            if self._sock is not dead_sock:
+                return True  # another thread already replaced it
+            _close(dead_sock)
+            for delay in self._backoff_delays():
+                if self._stop.is_set():
+                    return False
+                try:
+                    sock = self._connect()
+                except (OSError, ConnectionError) as e:
+                    _log.warning(
+                        "transport: reconnect to %s failed (%s); retrying "
+                        "in %.3fs", self._address, e, delay,
+                    )
+                    if self._stop.wait(delay):
+                        return False
+                    continue
+                self._sock = sock
+                # The data plane redials lazily on its next send.
+                with self._data_lock:
+                    if self._data_sock is not None:
+                        _close(self._data_sock)
+                        self._data_sock = None
+                _RECONNECTS.inc(plane="control")
+                # Restore server-side subscription state, then let
+                # listeners (agent re-registration) run on the new conn.
+                # Direct sends (no retry recursion): if the fresh conn
+                # dies mid-resubscribe, keep backing off.
+                with self._subs_lock:
+                    topics = sorted(self._subs)
+                try:
+                    for t in topics:
+                        self._send_stamped(
+                            sock, {"kind": "subscribe", "topic": t}
+                        )
+                except OSError:
+                    continue  # new conn died instantly: keep backing off
+                for fn in list(self._reconnect_listeners):
+                    try:
+                        fn()
+                    except Exception:
+                        _log.exception("transport: reconnect listener failed")
+                return True
+            _log.error(
+                "transport: giving up on %s after %d reconnect attempts",
+                self._address, flags.agent_reconnect_max_tries,
+            )
+            return False
+
     def _read_loop(self) -> None:
         while not self._stop.is_set():
+            sock = self._sock
             try:
-                frame = _recv_frame(self._sock)
+                frame = _recv_frame(sock)
             except OSError:
-                return
+                frame = None
             except wire.WireError as e:
-                # Desynced/corrupt stream: close the socket so the agent's
-                # next operation fails loudly (and the server's forwarders
-                # stop writing into a deaf connection) instead of leaving a
-                # live-looking connection with dead subscriptions.
-                _log.warning("transport: closing desynced connection: %s", e)
-                _close(self._sock)
-                return
+                # Desynced/corrupt stream: drop the connection (the only
+                # way to re-sync framing) and redial.
+                _log.warning("transport: dropping desynced connection: %s", e)
+                frame = None
             if frame is None:
-                return
+                if self._stop.is_set() or not self._reconnect(sock):
+                    return
+                continue
             if frame.get("kind") == "message":
                 with self._subs_lock:
                     targets = list(self._subs.get(frame["topic"], ()))
                 for sub in targets:
                     sub._deliver(frame["msg"])
 
-    def _send(self, obj: dict) -> None:
+    def _send_stamped(self, sock, obj: dict) -> None:
+        """One stamped control-plane send on ``sock``, no retry."""
         with self._send_lock:
-            _send_frame(self._sock, obj)
+            obj = dict(obj)
+            obj["seq"] = self._seq
+            self._seq += 1
+            _send_frame(sock, obj)
+
+    def _send(self, obj: dict) -> None:
+        while True:
+            sock = self._sock
+            if faults.ACTIVE and faults.fires("transport.send"):
+                # Simulated peer reset BEFORE the frame hits the wire: the
+                # frame is lost with the connection, so the retry below is
+                # exactly-once.
+                _close(sock)
+            try:
+                self._send_stamped(sock, obj)
+                return
+            except OSError:
+                if self._stop.is_set() or not self._reconnect(sock):
+                    raise
 
     def _send_data(self, obj: dict) -> None:
-        with self._data_lock:
-            if self._data_sock is None:
-                self._data_sock = self._connect()
-            _send_frame(self._data_sock, obj)
+        attempts = self._backoff_delays()
+        redialing = False
+        while True:
+            if faults.ACTIVE and faults.fires("transport.send_data"):
+                with self._data_lock:
+                    if self._data_sock is not None:
+                        _close(self._data_sock)
+                        self._data_sock = None
+                    redialing = True
+            try:
+                with self._data_lock:
+                    if self._data_sock is None:
+                        self._data_sock = self._connect()
+                        self._data_seq = 0
+                        if redialing:
+                            _RECONNECTS.inc(plane="data")
+                    obj = dict(obj)
+                    obj["seq"] = self._data_seq
+                    self._data_seq += 1
+                    _send_frame(self._data_sock, obj)
+                return
+            except (OSError, ConnectionError):
+                with self._data_lock:
+                    if self._data_sock is not None:
+                        _close(self._data_sock)
+                        self._data_sock = None
+                redialing = True
+                if self._stop.is_set():
+                    raise
+                try:
+                    delay = next(attempts)
+                except StopIteration:
+                    raise
+                if self._stop.wait(delay):
+                    raise
 
     def publish(self, topic: str, msg: Any) -> None:
         frame = {"kind": "publish", "topic": topic, "msg": msg}

@@ -1,0 +1,600 @@
+"""Chaos suite: deterministic fault injection + graceful degradation (r9).
+
+Mirrors the reference's recovery contracts: agent death mid-query forwards
+*partial* results with per-agent annotations (query_result_forwarder.go:
+395,502,571), heartbeat expiry prunes agents from plans
+(agent_topic_listener.go:41), and transports reconnect with backoff. Every
+scenario is driven by seeded injection sites (pixie_tpu/utils/faults.py),
+so nothing here flakes on scheduling; no test sleeps longer than 0.5s at a
+time.
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from pixie_tpu.engine import Carnot
+from pixie_tpu.exec import (
+    BridgeCancelled,
+    BridgeRouter,
+    ExecState,
+    ExecutionGraph,
+    QueryDeadlineExceeded,
+)
+from pixie_tpu.plan.operators import BridgeSinkOp, BridgeSourceOp
+from pixie_tpu.plan.plan import Plan, PlanFragment
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.table.table_store import TableStore
+from pixie_tpu.types import DataType, Relation
+from pixie_tpu.udf.registry import default_registry
+from pixie_tpu.utils import faults, flags, metrics_registry
+from pixie_tpu.vizier import Agent, MessageBus, QueryBroker
+from pixie_tpu.vizier import agent as agent_mod
+from pixie_tpu.vizier import broker as broker_mod
+from pixie_tpu.vizier.datastore import FileDatastore
+from pixie_tpu.vizier.transport import (
+    BusTransportServer,
+    RemoteBus,
+    RemoteRouter,
+)
+
+F, I, S, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+REL = Relation.of(("time_", T), ("service", S), ("latency", F))
+TABLES = {"http_events": REL}
+
+AGG_QUERY = (
+    "df = px.DataFrame(table='http_events')\n"
+    "stats = df.groupby(['service']).agg(\n"
+    "    total=('latency', px.sum), n=('latency', px.count))\n"
+    "px.display(stats, 'out')\n"
+)
+
+N_ROWS = 2000
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture
+def flagset():
+    """flags.set with automatic restore."""
+    saved = {}
+
+    def set_(name, value):
+        if name not in saved:
+            saved[name] = flags.get(name)
+        flags.set(name, value)
+
+    yield set_
+    for name, value in saved.items():
+        flags.set(name, value)
+
+
+def _make_store(seed_offset, n=N_ROWS):
+    rng = np.random.default_rng(5 + seed_offset)
+    ts = TableStore()
+    t = ts.create_table("http_events", REL)
+    t.write_pydict(
+        {
+            "time_": np.arange(n) + seed_offset,
+            "service": rng.choice(["a", "b", "c"], n).astype(object),
+            # Integer-valued latencies: float sums are exact regardless of
+            # reduction order, so host-vs-device rows compare bit-equal.
+            "latency": rng.integers(1, 100, n).astype(np.float64),
+        }
+    )
+    t.stop()
+    return ts
+
+
+def _rows(res, name="out"):
+    batches = [b for b in res.tables.get(name, []) if b.num_rows]
+    if not batches:
+        return {}
+    return RowBatch.concat(batches).to_pydict()
+
+
+def _sorted_rows(res, name="out"):
+    """Order-insensitive row tuples (device and host paths may emit
+    groups in different orders); values still compare bit-exact."""
+    d = _rows(res, name)
+    if not d:
+        return []
+    cols = sorted(d)
+    return sorted(zip(*[d[c] for c in cols]))
+
+
+def _wait_agents(broker, count, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(broker.tracker.distributed_state().agents) >= count:
+            return
+        time.sleep(0.02)
+    pytest.fail(f"{count} agents never registered")
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_registry_count_after_and_reset():
+    faults.arm("x", count=2, after=1)
+    assert faults.ACTIVE
+    assert not faults.fires("x")  # first check skipped by after=1
+    assert faults.fires("x")
+    assert faults.fires("x")
+    assert not faults.fires("x")  # count exhausted
+    assert faults.stats()["x"] == (4, 2)
+    faults.reset()
+    assert not faults.ACTIVE
+    assert not faults.fires("x")
+
+
+def test_registry_probability_is_seeded_deterministic():
+    faults.arm("p", p=0.5, seed=7)
+    first = [faults.fires("p") for _ in range(64)]
+    faults.arm("p", p=0.5, seed=7)  # re-arm resets the stream
+    second = [faults.fires("p") for _ in range(64)]
+    assert first == second
+    assert any(first) and not all(first)
+
+
+def test_spec_parsing_and_check():
+    faults.configure("a:count=1,b:p=0.25:seed=3:after=2")
+    with pytest.raises(faults.FaultInjectedError):
+        faults.check("a")
+    faults.check("a")  # exhausted: no raise
+    assert "b" in faults.stats()
+    with pytest.raises(ValueError):
+        faults.configure("a:bogus=1")
+
+
+def test_scoped_sites_target_one_instance():
+    faults.arm("site@pem2", count=1)
+    assert not faults.fires_scoped("site", "pem1")
+    assert faults.fires_scoped("site", "pem2")
+    assert not faults.fires_scoped("site", "pem2")
+
+
+# -- cluster chaos -----------------------------------------------------------
+
+
+@pytest.fixture
+def cluster(monkeypatch):
+    # Fast heartbeats: agents stay comfortably inside any expiry window a
+    # test picks, so only deliberately-silenced agents ever expire.
+    monkeypatch.setattr(agent_mod, "HEARTBEAT_INTERVAL_S", 0.05)
+    bus = MessageBus()
+    router = BridgeRouter()
+    broker = QueryBroker(bus, router, table_relations=TABLES)
+    agents = [
+        Agent("pem1", bus, router, table_store=_make_store(0)),
+        Agent("pem2", bus, router, table_store=_make_store(10**6)),
+        Agent("kelvin", bus, router, is_kelvin=True),
+    ]
+    for a in agents:
+        a.start()
+    _wait_agents(broker, 3)
+    yield broker, agents
+    broker.stop()
+    for a in agents:
+        a.stop()
+
+
+def test_agent_error_mid_query_yields_partial_with_annotation(cluster):
+    """An agent whose fragment errors no longer fails the query: the rows
+    from surviving agents come back with a structured degraded
+    annotation (no bare RuntimeError/TimeoutError)."""
+    broker, _ = cluster
+    faults.arm("agent.execute@pem2", count=1)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is not None and not res.ok
+    assert "agent_error" in res.degraded["reasons"]
+    assert "pem2" in res.degraded["agent_errors"]
+    assert "fault injected" in res.degraded["agent_errors"]["pem2"]
+    rows = _rows(res)
+    assert sum(rows["n"]) == N_ROWS  # pem1's shard only, complete
+
+
+def test_agent_killed_mid_query_yields_partial(cluster, monkeypatch):
+    """Kill pem2 mid-query (heartbeats stop + fragment hangs): the broker
+    reaps it inside the wait loop, releases its bridges so the merge
+    finalizes with partial input, and annotates the loss."""
+    broker, _ = cluster
+    monkeypatch.setattr(broker_mod, "AGENT_EXPIRY_S", 0.4)
+    faults.arm("agent.heartbeat@pem2")  # silent from now on
+    faults.arm("agent.execute_hang@pem2", count=1)  # wedged mid-query
+    t0 = time.monotonic()
+    res = broker.execute_script(AGG_QUERY, timeout_s=20)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10, "reaper should beat the query timeout"
+    assert res.degraded is not None
+    assert res.degraded["lost_agents"] == ["pem2"]
+    assert "agent_lost" in res.degraded["reasons"]
+    rows = _rows(res)
+    assert sum(rows["n"]) == N_ROWS  # pem1's shard survived
+
+
+def test_deadline_expiry_returns_partial_not_timeout_error(cluster):
+    """A wedged (but heartbeating) agent hits the propagated deadline:
+    partial return + annotation instead of a bare TimeoutError."""
+    broker, _ = cluster
+    faults.arm("agent.execute_hang@pem2", count=1)
+    t0 = time.monotonic()
+    res = broker.execute_script(AGG_QUERY, timeout_s=1.0)
+    assert time.monotonic() - t0 < 5
+    assert res.degraded is not None
+    assert "deadline" in res.degraded["reasons"]
+    assert "pem2" in res.degraded["timed_out_agents"]
+
+
+def test_deadline_flag_caps_timeout(cluster, flagset):
+    flagset("query_deadline_s", 0.8)
+    broker, _ = cluster
+    faults.arm("agent.execute_hang@pem2", count=1)
+    t0 = time.monotonic()
+    res = broker.execute_script(AGG_QUERY, timeout_s=60)
+    assert time.monotonic() - t0 < 5, "flag must cap the 60s timeout"
+    assert res.degraded is not None
+
+
+def test_partial_results_off_restores_raises(cluster, flagset):
+    flagset("partial_results", False)
+    broker, _ = cluster
+    faults.arm("agent.execute@pem1", count=1)
+    # r8 behavior: a failed agent raises. Depending on timing the raise is
+    # the agent-error RuntimeError or (because the erroring agent's bridge
+    # is deliberately NOT released when partial results are off) the merge
+    # fragment's TimeoutError — loud either way, never silent partial data.
+    with pytest.raises((RuntimeError, TimeoutError)):
+        broker.execute_script(AGG_QUERY, timeout_s=1.5)
+
+
+def test_skipped_agents_ride_the_annotation(cluster, monkeypatch):
+    """Satellite: planning consults the heartbeat window; expired agents
+    are skipped AND reported in the degraded annotation."""
+    broker, agents = cluster
+    monkeypatch.setattr(broker_mod, "AGENT_EXPIRY_S", 0.3)
+    agents[1].stop()  # pem2 goes silent
+    time.sleep(0.4)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is not None
+    assert "pem2" in res.degraded["skipped_agents"]
+    assert "agents_skipped" in res.degraded["reasons"]
+    rows = _rows(res)
+    assert sum(rows["n"]) == N_ROWS
+
+
+def test_broker_forward_drop_is_annotated(cluster):
+    broker, _ = cluster
+    faults.arm("broker.forward", count=1)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is not None
+    assert res.degraded["forward_dropped"] == 1
+    assert "forward_dropped" in res.degraded["reasons"]
+
+
+# -- exec-graph deadline + cancellation --------------------------------------
+
+
+def test_exec_graph_deadline_preempts_stall_timeout():
+    """The propagated hard deadline aborts a stalled fragment in ~deadline
+    seconds, not exec_source_stall_s (conftest pins that to 180s)."""
+    c = Carnot()
+    frag = PlanFragment(0)
+    src = frag.add(BridgeSourceOp(bridge_id="in", relation=REL), [])
+    frag.add(BridgeSinkOp(bridge_id="mid"), [src])
+    plan = Plan("q-deadline")
+    plan.fragments.append(frag)
+    plan.executing_instance[0] = "local"
+    t0 = time.monotonic()
+    with pytest.raises(QueryDeadlineExceeded):
+        c.execute_plan(plan, deadline_s=0.3)
+    assert time.monotonic() - t0 < 5
+
+
+def test_stall_abort_flushes_eos_to_bridge_sinks():
+    """Satellite: a deadline-aborted fragment pushes eos through its
+    bridge sinks so consumer fragments parked on the router finalize
+    instead of stalling to their own timeout."""
+    router = BridgeRouter()
+    router.register_producer("q1", "in")  # registered but never pushes
+    frag = PlanFragment(0)
+    src = frag.add(BridgeSourceOp(bridge_id="in", relation=REL), [])
+    frag.add(BridgeSinkOp(bridge_id="mid"), [src])
+    state = ExecState(
+        "q1",
+        TableStore(),
+        default_registry(),
+        router=router,
+        deadline=time.monotonic() + 0.25,
+    )
+    graph = ExecutionGraph(frag, state)
+    with pytest.raises(QueryDeadlineExceeded):
+        graph.execute()
+    assert state.cancel_reason is not None
+    item = router.poll("q1", "mid")
+    assert item is not None and item.eos and item.num_rows == 0
+
+
+def test_router_tombstones_drop_late_pushes():
+    r = BridgeRouter()
+    r.register_producer("q", "b")
+    r.push("q", "b", 1)
+    r.cleanup_query("q")
+    r.push("q", "b", 2)  # late push after cleanup: dropped, no leak
+    with pytest.raises(BridgeCancelled):
+        r.poll("q", "b")
+    # A fresh registration for the same id resurrects it (plan re-run).
+    r.register_producer("q", "b")
+    r.push("q", "b", 3)
+    assert r.poll("q", "b") == 3
+
+
+# -- transport chaos ---------------------------------------------------------
+
+
+@pytest.fixture
+def tcp_cluster(flagset, monkeypatch):
+    """Broker + kelvin on a local bus; one PEM connected over real TCP."""
+    flagset("agent_backoff_initial_s", 0.01)
+    flagset("agent_backoff_max_s", 0.1)
+    monkeypatch.setattr(agent_mod, "HEARTBEAT_INTERVAL_S", 0.05)
+    bus = MessageBus()
+    router = BridgeRouter()
+    server = BusTransportServer(bus, router)
+    broker = QueryBroker(bus, router, table_relations=TABLES)
+    kelvin = Agent("kelvin", bus, router, is_kelvin=True)
+    kelvin.start()
+    rbus = RemoteBus(server.address)
+    rrouter = RemoteRouter(rbus)
+    pem = Agent("pem1", rbus, rrouter, table_store=_make_store(0))
+    pem.start()
+    _wait_agents(broker, 2)
+    yield broker, rbus
+    broker.stop()
+    pem.stop()
+    kelvin.stop()
+    rbus.close()
+    server.stop()
+
+
+def _reconnects(plane):
+    return metrics_registry().counter("transport_reconnect_total").value(
+        plane=plane
+    )
+
+
+def test_transport_drop_reconnects_with_backoff(tcp_cluster):
+    """Injected control-plane connection death: the RemoteBus redials with
+    backoff, re-subscribes, re-registers the agent, and later queries
+    succeed with exactly-once rows."""
+    broker, rbus = tcp_cluster
+    before = _reconnects("control")
+    faults.arm("transport.send", count=1)  # kill the next control send
+    # Deterministic trigger: this publish (or a racing heartbeat) hits the
+    # armed site, loses its socket, and retries through the backoff path.
+    rbus.publish("nudge", {"poke": 1})
+    deadline = time.monotonic() + 15  # generous: CI hosts may be saturated
+    while _reconnects("control") == before:
+        assert time.monotonic() < deadline, "reconnect never happened"
+        time.sleep(0.02)
+    _wait_agents(broker, 2, timeout=15)  # re-registration post-reconnect
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    rows = _rows(res)
+    assert sum(rows["n"]) == N_ROWS
+
+
+def test_transport_data_drop_retries_exactly_once(tcp_cluster):
+    """Injected data-plane connection death mid-query: the frame is lost
+    with the socket BEFORE it hits the wire, the plane redials, and the
+    retried send keeps result rows exactly-once."""
+    broker, rbus = tcp_cluster
+    before = _reconnects("data")
+    faults.arm("transport.send_data", count=1)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    rows = _rows(res)
+    assert sum(rows["n"]) == N_ROWS  # exactly once, no dup/missing rows
+    assert _reconnects("data") > before
+
+
+def test_transport_duplicate_frames_deduped(tcp_cluster):
+    """Injected duplicate delivery on the server: per-connection seq dedup
+    drops the copies — result rows stay exactly-once."""
+    broker, rbus = tcp_cluster
+    dedup = metrics_registry().counter("transport_dedup_dropped_total")
+    before = dedup.value()
+    faults.arm("transport.recv_dup", count=5)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    rows = _rows(res)
+    assert sum(rows["n"]) == N_ROWS
+    # Wait for all 5 injected duplicates to be dropped (heartbeats keep
+    # flowing, so the remaining dups land within a few intervals).
+    deadline = time.monotonic() + 15
+    while faults.stats()["transport.recv_dup"][1] < 5:
+        assert time.monotonic() < deadline
+        time.sleep(0.02)
+    deadline = time.monotonic() + 15
+    while dedup.value() - before < 5:
+        assert time.monotonic() < deadline, "duplicates were not deduped"
+        time.sleep(0.02)
+
+
+def test_handshake_timeout_closes_server_side(flagset):
+    """Satellite: the handshake timeout is flag-driven and a silent peer's
+    half-open socket is closed at the timeout, not leaked."""
+    flagset("transport_handshake_timeout_s", 0.3)
+    bus = MessageBus()
+    router = BridgeRouter()
+    server = BusTransportServer(bus, router)
+    try:
+        raw = socket.create_connection(server.address)
+        raw.settimeout(5.0)
+        t0 = time.monotonic()
+        got = b""
+        try:
+            while True:
+                chunk = raw.recv(4096)  # challenge, then EOF at timeout
+                if not chunk:
+                    break
+                got += chunk
+        except OSError:
+            pytest.fail("server did not close the half-open connection")
+        assert time.monotonic() - t0 < 3
+        assert b"challenge" in got  # server got as far as its challenge
+        raw.close()
+    finally:
+        server.stop()
+
+
+def test_handshake_timeout_client_side(flagset):
+    flagset("transport_handshake_timeout_s", 0.3)
+    silent = socket.create_server(("127.0.0.1", 0))
+    try:
+        t0 = time.monotonic()
+        with pytest.raises((OSError, ConnectionError)):
+            RemoteBus(silent.getsockname())
+        assert time.monotonic() - t0 < 3
+    finally:
+        silent.close()
+
+
+# -- device circuit breaker + staging --------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices("cpu"))
+    assert devs.size == 8, "conftest must provide 8 virtual devices"
+    return Mesh(devs, ("d",))
+
+
+def _seed_device_carnot(mesh):
+    from pixie_tpu.parallel import MeshExecutor
+
+    dev = MeshExecutor(mesh=mesh, block_rows=1024)
+    c = Carnot(device_executor=dev)
+    t = c.table_store.create_table("http_events", REL)
+    rng = np.random.default_rng(13)
+    n = 4000
+    t.write_pydict(
+        {
+            "time_": np.arange(n),
+            "service": rng.choice(["a", "b", "c"], n).astype(object),
+            "latency": rng.integers(1, 100, n).astype(np.float64),
+        }
+    )
+    t.compact()
+    t.stop()
+    return c, dev
+
+
+def test_device_fold_poison_trips_breaker_and_recovers(mesh, flagset):
+    """Acceptance: injected device-fold poison (1) falls back to the host
+    engine with bit-identical rows, (2) trips the circuit breaker after N
+    consecutive failures so the device is not even attempted, (3) recovers
+    after the cooldown."""
+    flagset("device_breaker_threshold", 2)
+    flagset("device_breaker_cooldown_s", 0.3)
+    c, dev = _seed_device_carnot(mesh)
+    m = metrics_registry()
+    hits = m.counter("device_offload_total")
+    trips = m.counter("device_offload_fallback_breaker_trips_total")
+    skips = m.counter("device_offload_fallback_breaker_open_total")
+
+    hits0 = hits.value()
+    baseline = _sorted_rows(c.execute_query(AGG_QUERY))
+    assert hits.value() > hits0, "baseline must run on the device"
+
+    faults.arm("pipeline.fold", count=2)
+    trips0, skips0 = trips.value(), skips.value()
+    r1 = _sorted_rows(c.execute_query(AGG_QUERY))
+    assert r1 == baseline, "host fallback must be bit-identical"
+    r2 = _sorted_rows(c.execute_query(AGG_QUERY))
+    assert r2 == baseline
+    assert trips.value() == trips0 + 1, "2 consecutive failures trip"
+
+    # Breaker open: the device is skipped outright — the fold site is not
+    # even checked (checks stay at 2) and the skip counter moves.
+    r3 = _sorted_rows(c.execute_query(AGG_QUERY))
+    assert r3 == baseline
+    assert skips.value() == skips0 + 1
+    assert faults.stats()["pipeline.fold"][0] == 2, (
+        "open breaker must not attempt device dispatch"
+    )
+
+    time.sleep(0.35)  # cooldown elapses -> half-open trial
+    hits1 = hits.value()
+    r4 = _sorted_rows(c.execute_query(AGG_QUERY))
+    assert r4 == baseline
+    assert hits.value() > hits1, "post-cooldown query recovered to device"
+
+
+def test_breaker_reopens_on_failed_halfopen_trial(mesh, flagset):
+    flagset("device_breaker_threshold", 1)
+    flagset("device_breaker_cooldown_s", 0.2)
+    c, dev = _seed_device_carnot(mesh)
+    skips = metrics_registry().counter(
+        "device_offload_fallback_breaker_open_total"
+    )
+    baseline = _sorted_rows(c.execute_query(AGG_QUERY))
+    faults.arm("pipeline.fold", count=2)
+    _sorted_rows(c.execute_query(AGG_QUERY))  # failure #1 -> trips (threshold 1)
+    time.sleep(0.25)
+    _sorted_rows(c.execute_query(AGG_QUERY))  # half-open trial fails -> re-opens
+    skips0 = skips.value()
+    r = _sorted_rows(c.execute_query(AGG_QUERY))  # still open: skipped
+    assert skips.value() == skips0 + 1
+    assert r == baseline
+    assert faults.stats()["pipeline.fold"][0] == 2
+
+
+def test_staging_pack_poison_falls_back_to_monolithic(mesh, flagset):
+    """A poisoned stream pack falls back to monolithic staging (still
+    on-device) and the query stays correct."""
+    flagset("streaming_stage", True)
+    c, dev = _seed_device_carnot(mesh)
+    c2, _ = _seed_device_carnot(mesh)  # uninjected twin for truth
+    truth = _sorted_rows(c2.execute_query(AGG_QUERY))
+    faults.arm("staging.pack", count=1)
+    res = _sorted_rows(c.execute_query(AGG_QUERY))
+    assert res == truth
+    assert any(
+        "FaultInjected" in k for k in dev.stream_fallback_errors
+    ), f"stream fallback not recorded: {list(dev.stream_fallback_errors)}"
+
+
+# -- datastore ---------------------------------------------------------------
+
+
+def test_datastore_append_fault_keeps_store_consistent(tmp_path):
+    path = str(tmp_path / "kv.log")
+    ds = FileDatastore(path)
+    ds.set("a", b"1")
+    faults.arm("datastore.append", count=1)
+    with pytest.raises(faults.FaultInjectedError):
+        ds.set("b", b"2")
+    assert ds.get("b") is None, "failed append must not mutate the view"
+    ds.set("c", b"3")  # store keeps working after the fault
+    ds.close()
+    ds2 = FileDatastore(path)  # replay sees only complete records
+    assert ds2.get("a") == b"1"
+    assert ds2.get("b") is None
+    assert ds2.get("c") == b"3"
+    ds2.close()

@@ -1,0 +1,213 @@
+"""Fault-injection overhead microbench (r9 acceptance gate).
+
+Proves the disabled injection sites cost <1% on (a) the warm device agg
+path and (b) the transport round-trip. Method:
+
+1. ``per_check_ns`` — cost of the call-site idiom with nothing armed
+   (``faults.ACTIVE and faults.fires(site)``: one attribute load + branch)
+   and with a foreign site armed (dict lookup under the registry lock, the
+   worst case a production query sees while an operator injects elsewhere).
+2. Site census — every shipped site armed at ``p=0`` (counts checks,
+   never fires) while one warm query / one transport round-trip runs, so
+   checks-per-operation is measured, not guessed.
+3. ``overhead_pct = checks_per_op * per_check_ns / op_ns * 100`` for both
+   paths, plus a direct A/B of the warm query with the registry idle vs a
+   foreign site armed.
+
+Prints ONE JSON line on stdout. With MB_WRITE_BENCH_DETAIL=1, merges the
+headline numbers into BENCH_DETAIL.json under the ``fault_overhead`` key.
+
+Env knobs: MB_ROWS (default 200k), MB_WARM_RUNS (default 20),
+MB_RTT_MSGS (default 400), JAX_PLATFORMS.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Shipped sites (keep in sync with `grep -r "faults.fires\|faults.check"`).
+SITES = (
+    "transport.send",
+    "transport.send_data",
+    "transport.recv_dup",
+    "transport.handshake",
+    "agent.heartbeat",
+    "agent.execute",
+    "agent.execute_hang",
+    "broker.forward",
+    "datastore.append",
+    "staging.pack",
+    "pipeline.fold",
+)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _per_check_ns(iters: int = 1_000_000) -> tuple[float, float]:
+    """(disabled_ns, armed_elsewhere_ns) per call-site check."""
+    from pixie_tpu.utils import faults
+
+    faults.reset()
+
+    def loop(n):
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            if faults.ACTIVE and faults.fires("mb.never"):
+                raise AssertionError
+        return (time.perf_counter_ns() - t0) / n
+
+    disabled = loop(iters)
+    faults.arm("mb.other", p=0.0)  # foreign site armed: ACTIVE gate passes
+    armed = loop(iters)
+    faults.reset()
+    return disabled, armed
+
+
+def main() -> None:
+    n_rows = int(os.environ.get("MB_ROWS", 200_000))
+    warm_runs = int(os.environ.get("MB_WARM_RUNS", 20))
+    rtt_msgs = int(os.environ.get("MB_RTT_MSGS", 400))
+
+    import jax
+    from jax.sharding import Mesh
+
+    from pixie_tpu.engine import Carnot
+    from pixie_tpu.exec import BridgeRouter
+    from pixie_tpu.parallel import MeshExecutor
+    from pixie_tpu.types import DataType, Relation
+    from pixie_tpu.utils import faults
+    from pixie_tpu.vizier.bus import MessageBus
+    from pixie_tpu.vizier.transport import BusTransportServer, RemoteBus
+
+    disabled_ns, armed_ns = _per_check_ns()
+    log(f"per-check: disabled {disabled_ns:.1f}ns, foreign-armed {armed_ns:.1f}ns")
+
+    # -- warm device agg path ------------------------------------------------
+    F, I, S, T = (
+        DataType.FLOAT64,
+        DataType.INT64,
+        DataType.STRING,
+        DataType.TIME64NS,
+    )
+    rel = Relation.of(("time_", T), ("service", S), ("latency", F))
+    mesh = Mesh(np.array(jax.devices()), ("d",))
+    dev = MeshExecutor(mesh=mesh)
+    c = Carnot(device_executor=dev)
+    t = c.table_store.create_table("http_events", rel)
+    rng = np.random.default_rng(3)
+    t.write_pydict(
+        {
+            "time_": np.arange(n_rows),
+            "service": rng.choice(["a", "b", "c", "d"], n_rows).astype(object),
+            "latency": rng.exponential(10.0, n_rows),
+        }
+    )
+    t.compact()
+    t.stop()
+    query = (
+        "df = px.DataFrame(table='http_events')\n"
+        "s = df.groupby(['service']).agg(\n"
+        "    total=('latency', px.sum), n=('latency', px.count))\n"
+        "px.display(s, 'out')\n"
+    )
+
+    def run_warm(k):
+        times = []
+        for _ in range(k):
+            t0 = time.perf_counter_ns()
+            c.execute_query(query)
+            times.append(time.perf_counter_ns() - t0)
+        return float(np.median(times))
+
+    c.execute_query(query)  # cold: stage + compile
+    run_warm(3)
+    faults.reset()
+    warm_idle_ns = run_warm(warm_runs)
+    faults.arm("mb.other", p=0.0)
+    warm_armed_ns = run_warm(warm_runs)
+    # Census: every shipped site armed at p=0 counts checks without firing.
+    faults.reset()
+    for s in SITES:
+        faults.arm(s, p=0.0)
+    c.execute_query(query)
+    warm_checks = sum(ck for ck, _ in faults.stats().values())
+    faults.reset()
+    warm_overhead_pct = 100.0 * warm_checks * armed_ns / warm_idle_ns
+    warm_ab_pct = 100.0 * (warm_armed_ns - warm_idle_ns) / warm_idle_ns
+    log(
+        f"warm agg: {warm_idle_ns/1e6:.2f}ms, {warm_checks} site checks "
+        f"-> {warm_overhead_pct:.4f}% modeled, {warm_ab_pct:+.2f}% A/B"
+    )
+
+    # -- transport round-trip ------------------------------------------------
+    bus = MessageBus()
+    router = BridgeRouter()
+    server = BusTransportServer(bus, router)
+    rbus = RemoteBus(server.address)
+    sub = bus.subscribe("mb/topic")
+
+    def rtt(k):
+        t0 = time.perf_counter_ns()
+        for i in range(k):
+            rbus.publish("mb/topic", {"i": i})
+            got = sub.get(timeout=5.0)
+            assert got is not None
+        return (time.perf_counter_ns() - t0) / k
+
+    rtt(50)  # warm
+    faults.reset()
+    rtt_idle_ns = rtt(rtt_msgs)
+    for s in SITES:
+        faults.arm(s, p=0.0)
+    rtt(rtt_msgs)
+    stats = faults.stats()
+    rtt_checks = sum(ck for ck, _ in stats.values()) / rtt_msgs
+    faults.reset()
+    rtt_overhead_pct = 100.0 * rtt_checks * armed_ns / rtt_idle_ns
+    log(
+        f"transport rtt: {rtt_idle_ns/1e3:.1f}us, {rtt_checks:.2f} checks/rt "
+        f"-> {rtt_overhead_pct:.4f}%"
+    )
+    rbus.close()
+    server.stop()
+
+    out = {
+        "fault_check_disabled_ns": round(disabled_ns, 2),
+        "fault_check_armed_elsewhere_ns": round(armed_ns, 2),
+        "warm_query_ms": round(warm_idle_ns / 1e6, 3),
+        "warm_checks_per_query": int(warm_checks),
+        "warm_overhead_pct": round(warm_overhead_pct, 5),
+        "warm_ab_delta_pct": round(warm_ab_pct, 3),
+        "transport_rtt_us": round(rtt_idle_ns / 1e3, 2),
+        "transport_checks_per_rtt": round(rtt_checks, 2),
+        "transport_overhead_pct": round(rtt_overhead_pct, 5),
+        "pass_under_1pct": bool(
+            warm_overhead_pct < 1.0 and rtt_overhead_pct < 1.0
+        ),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+
+    if os.environ.get("MB_WRITE_BENCH_DETAIL") == "1":
+        path = os.path.join(os.path.dirname(__file__), "..", "BENCH_DETAIL.json")
+        with open(path) as f:
+            detail = json.load(f)
+        detail["fault_overhead"] = out
+        with open(path, "w") as f:
+            json.dump(detail, f, indent=1)
+            f.write("\n")
+        log("BENCH_DETAIL.json updated (fault_overhead)")
+
+    if not out["pass_under_1pct"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
