@@ -35,9 +35,10 @@ class QueryResult:
     # None = complete result. Otherwise a dict with keys ``partial``,
     # ``reasons``, ``agent_errors`` {agent: message}, ``lost_agents``
     # (heartbeat-expired mid-query), ``timed_out_agents`` (still pending at
-    # the deadline), ``skipped_agents`` (expired before planning; the query
-    # never covered them), ``forward_dropped`` (result messages lost in the
-    # broker's forwarder).
+    # the deadline), ``skipped_agents`` (planning never covered them),
+    # ``skipped`` (r10: [{agent_id, reason}] with reason
+    # ``heartbeat_expired`` or ``breaker_open``), ``forward_dropped``
+    # (result messages lost in the broker's forwarder).
     degraded: Optional[dict] = None
 
     @property

@@ -571,6 +571,8 @@ class MeshExecutor:
         # a success closes the breaker.
         self._breaker: dict[str, list] = {}
         self._breaker_lock = threading.Lock()
+        # Last successful device-fold wall time (ms) for the health plane.
+        self.last_fold_ms: "float | None" = None
 
     # -- public -------------------------------------------------------------
     @staticmethod
@@ -578,21 +580,55 @@ class MeshExecutor:
         """Structural program key for the circuit breaker: the operator
         chain + table names, NOT the table version — a poisoned fold shape
         must stay tripped across data growth, while a different query
-        shape keeps its own healthy breaker."""
-        parts = []
-        for nid in fragment.topo_order():
-            op = fragment.node(nid)
-            parts.append(type(op).__name__)
-            tn = getattr(op, "table_name", None)
-            if tn:
-                parts.append(tn)
-            exprs = getattr(op, "values", None) or getattr(op, "exprs", None)
-            if exprs:
-                parts.append(repr(exprs))
-            groups = getattr(op, "groups", None)
-            if groups:
-                parts.append(repr(groups))
-        return "|".join(parts)
+        shape keeps its own healthy breaker. Shared with the broker's
+        health plane (plan/program_key.py) so heartbeat-reported breaker
+        keys match what planning computes."""
+        from pixie_tpu.plan.program_key import fragment_program_key
+
+        return fragment_program_key(fragment)
+
+    def breaker_snapshot(self) -> dict[str, dict]:
+        """Per-program-key breaker state for the health plane:
+        ``key -> {state: open|half_open|degrading, failures,
+        open_remaining_s}``. Healthy keys are absent (success pops the
+        entry), so the snapshot is empty on a healthy executor and
+        heartbeats stay small."""
+        threshold = flags.device_breaker_threshold
+        if threshold <= 0:
+            return {}
+        now = time.monotonic()
+        out = {}
+        with self._breaker_lock:
+            for key, (fails, open_until) in self._breaker.items():
+                if open_until > now:
+                    state = "open"
+                elif open_until > 0:
+                    # Cooldown elapsed; the next attempt is the half-open
+                    # trial — planners should treat the key as usable.
+                    state = "half_open"
+                else:
+                    state = "degrading"  # failures below the trip threshold
+                out[key] = {
+                    "state": state,
+                    "failures": fails,
+                    "open_remaining_s": round(max(0.0, open_until - now), 3),
+                }
+        return out
+
+    def health_snapshot(self) -> dict:
+        """Device-executor health riding agent heartbeats (r10): breaker
+        state per program key, open keys (what planning matches on),
+        background-compile queue depth, and the last device-fold wall
+        time."""
+        snap = self.breaker_snapshot()
+        return {
+            "breaker": snap,
+            "breaker_open": sorted(
+                k for k, v in snap.items() if v["state"] == "open"
+            ),
+            "staging_depth": len(self._aot_futures),
+            "last_fold_ms": self.last_fold_ms,
+        }
 
     def _breaker_is_open(self, key: str) -> bool:
         threshold = flags.device_breaker_threshold
@@ -645,12 +681,14 @@ class MeshExecutor:
             _OFFLOAD_FALLBACKS.inc()
             return None
         try:
+            t0 = time.perf_counter_ns()
             out = self._try_execute_fragment(
                 fragment, table_store, registry, func_ctx
             )
             (_OFFLOAD_HITS if out is not None else _OFFLOAD_MISS).inc()
             if out is not None:
                 self._breaker_record(bkey, ok=True)
+                self.last_fold_ms = (time.perf_counter_ns() - t0) / 1e6
             return out
         except Exception as e:
             import logging

@@ -1,0 +1,32 @@
+"""Structural program keys: one stable string per query *shape*.
+
+Extracted from the device circuit breaker (r9, parallel/pipeline.py) so
+the broker's health plane (r10) can compute the SAME key the agents trip
+their breakers on: operator chain + table names + agg/map expressions,
+NOT the table version — a poisoned fold shape stays recognizable across
+data growth, while a different query shape keys independently. Agents
+report per-key breaker state in their heartbeats; ``execute_script``
+matches the planned per-agent fragments against those keys and routes
+around agents whose breaker is open for this exact program shape.
+"""
+
+from __future__ import annotations
+
+
+def fragment_program_key(fragment) -> str:
+    """Stable structural key for one plan fragment (the unit both the
+    device executor and the distributed planner hand around)."""
+    parts = []
+    for nid in fragment.topo_order():
+        op = fragment.node(nid)
+        parts.append(type(op).__name__)
+        tn = getattr(op, "table_name", None)
+        if tn:
+            parts.append(tn)
+        exprs = getattr(op, "values", None) or getattr(op, "exprs", None)
+        if exprs:
+            parts.append(repr(exprs))
+        groups = getattr(op, "groups", None)
+        if groups:
+            parts.append(repr(groups))
+    return "|".join(parts)

@@ -276,3 +276,17 @@ define_flag(
     help_="Seconds a tripped device program key stays on the host engine "
     "before a half-open trial is allowed back on the mesh.",
 )
+
+# -- robustness (r10): acked delivery + cluster health plane -----------------
+# (transport_ack_* / transport_window_block_s are declared next to their
+# use in vizier/transport.py.)
+define_flag(
+    "health_plane",
+    True,
+    help_="Broker-side cluster health view (vizier/broker.py): agent "
+    "heartbeats carry device-breaker state, staging depth, and fold "
+    "latency; execute_script skips agents whose OPEN breaker matches the "
+    "query's program shape at planning time (recorded in "
+    "degraded.skipped with reason breaker_open) instead of discovering "
+    "them sick mid-query. Half-open breakers plan normally.",
+)

@@ -7,8 +7,14 @@ query_result_forwarder.go:395,502,571; heartbeat expiry,
 agent_topic_listener.go:41). This module is the injection half of that
 story: production code declares named *sites* at the exact points that can
 fail in the field (transport send/recv, handshake, agent heartbeat/execute,
-broker forwarding, datastore append, staging pack, device fold dispatch),
-and tests/operators arm them deterministically.
+broker forwarding, datastore append, staging pack, device fold dispatch;
+r10 acked-delivery sites: ``transport.ack_drop`` — the server's cumulative
+ack frame is lost on the wire, ``transport.replay_dup`` — the reconnect
+replay ignores the server's applied watermark and re-sends delivered
+frames, ``transport.conn_kill_midflight`` — the server kills the
+connection AFTER applying a frame but before acking it, the
+previously-ambiguous retry case; scope it ``@control``/``@data`` to target
+one plane), and tests/operators arm them deterministically.
 
 Design contract:
 
@@ -136,7 +142,13 @@ def reset() -> None:
 
 def fires(site: str) -> bool:
     """True iff ``site`` is armed and this check fires. Counts the check
-    either way (microbench uses p=0 arming to census site traffic)."""
+    either way for ARMED sites (microbench uses p=0 arming to census site
+    traffic). The un-armed probe is a lock-free dict read (~30ns): a
+    query running while an operator injects into a DIFFERENT site must
+    not pay the registry lock on every check (<1% overhead gate; dict
+    reads are atomic in CPython, and arming re-checks under the lock)."""
+    if _sites.get(site) is None:
+        return False
     with _lock:
         s = _sites.get(site)
         if s is None or not s._fires():

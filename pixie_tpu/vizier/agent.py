@@ -59,6 +59,11 @@ class Agent:
         self._sub = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        # Registration epoch: bumped on every (re-)registration so the
+        # broker's tracker can drop stale stragglers from a superseded
+        # incarnation (an old connection's buffered heartbeat must not
+        # resurrect pre-reconnect state; r10 satellite).
+        self._epoch = 0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -84,14 +89,31 @@ class Agent:
             self._sub.unsubscribe()
 
     # -- registration + heartbeat (registration.*, heartbeat.{h,cc}) --------
+    def _health(self) -> "dict | None":
+        """Device-executor health riding every heartbeat (r10): breaker
+        state per program key, staging/compile queue depth, last fold
+        latency. None when this agent has no device executor (host-only
+        agents have nothing to trip)."""
+        dev = getattr(self.carnot, "device_executor", None)
+        snap = getattr(dev, "health_snapshot", None)
+        if snap is None:
+            return None
+        try:
+            return snap()
+        except Exception:
+            return None  # health is advisory; never fail the heartbeat
+
     def _register(self) -> None:
+        self._epoch += 1
         self.bus.publish(
             AGENT_STATUS_TOPIC,
             {
                 "type": "register",
                 "agent_id": self.agent_id,
+                "epoch": self._epoch,
                 "is_kelvin": self.is_kelvin,
                 "tables": sorted(self.carnot.table_store.table_names()),
+                "health": self._health(),
             },
         )
 
@@ -108,9 +130,11 @@ class Agent:
                 {
                     "type": "heartbeat",
                     "agent_id": self.agent_id,
+                    "epoch": self._epoch,
                     "is_kelvin": self.is_kelvin,
                     "tables": sorted(self.carnot.table_store.table_names()),
                     "ts": time.monotonic(),
+                    "health": self._health(),
                 },
             )
 

@@ -11,10 +11,11 @@ agent_topic_listener.go:41,322 — 1-minute expiry, scaled down here).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import uuid
-from typing import Optional
+from typing import Callable, Optional
 
 from pixie_tpu.compiler import Compiler
 from pixie_tpu.distributed import AgentInfo, DistributedPlanner, DistributedState
@@ -22,6 +23,7 @@ from pixie_tpu.engine import QueryResult
 from pixie_tpu.exec import BridgeRouter
 from pixie_tpu.plan.operators import BridgeSinkOp
 from pixie_tpu.plan.plan import Plan
+from pixie_tpu.plan.program_key import fragment_program_key
 from pixie_tpu.types import Relation
 from pixie_tpu.vizier.bus import (
     MessageBus,
@@ -35,9 +37,17 @@ from pixie_tpu.vizier.agent import AGENT_STATUS_TOPIC, RESULTS_TOPIC_PREFIX
 # PIXIE_TPU_AGENT_EXPIRY_S (read once at import).
 AGENT_EXPIRY_S = flags.agent_expiry_s
 
+_log = logging.getLogger("pixie_tpu.broker")
+
 
 class AgentTracker:
-    """Liveness + table topology from register/heartbeat messages."""
+    """Liveness + table topology + device health from register/heartbeat
+    messages, keyed on ``agent_id`` with ONLY the latest registration
+    epoch retained (r10 satellite): a reconnecting agent re-registers
+    with a bumped epoch, and any straggler message from its superseded
+    incarnation (an old connection's buffered heartbeat arriving late)
+    is dropped instead of resurrecting pre-reconnect table/health
+    state."""
 
     def __init__(self, bus: MessageBus):
         self._bus = bus
@@ -54,11 +64,17 @@ class AgentTracker:
             if msg is None:
                 continue
             if msg.get("type") in ("register", "heartbeat"):
+                epoch = msg.get("epoch", 0)
                 with self._lock:
+                    cur = self._agents.get(msg["agent_id"])
+                    if cur is not None and epoch < cur["epoch"]:
+                        continue  # stale straggler from an old incarnation
                     self._agents[msg["agent_id"]] = {
                         "is_kelvin": msg["is_kelvin"],
                         "tables": frozenset(msg.get("tables", ())),
                         "last_seen": time.monotonic(),
+                        "epoch": epoch,
+                        "health": msg.get("health"),
                     }
 
     def planning_view(self) -> tuple[DistributedState, list[str]]:
@@ -109,9 +125,40 @@ class AgentTracker:
                 or now - self._agents[aid]["last_seen"] >= AGENT_EXPIRY_S
             )
 
+    def health_view(self) -> dict[str, dict]:
+        """Aggregated broker-side cluster health (r10): agent_id ->
+        liveness + registration epoch + the latest device-health payload
+        from its heartbeat (breaker state per program key, staging depth,
+        last fold latency). Consumed by execute_script's breaker-aware
+        planning and the health HTTP endpoint."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                aid: {
+                    "alive": now - a["last_seen"] < AGENT_EXPIRY_S,
+                    "epoch": a["epoch"],
+                    "is_kelvin": a["is_kelvin"],
+                    "health": a.get("health"),
+                }
+                for aid, a in sorted(self._agents.items())
+            }
+
+    def open_breaker_keys(self) -> dict[str, frozenset]:
+        """agent_id -> program keys with an OPEN device breaker (from the
+        latest heartbeat). Half-open keys are absent: a half-open breaker
+        admits its trial, so the planner schedules normally."""
+        out = {}
+        with self._lock:
+            for aid, a in self._agents.items():
+                health = a.get("health") or {}
+                keys = health.get("breaker_open") or ()
+                if keys:
+                    out[aid] = frozenset(keys)
+        return out
+
     def agents_snapshot(self) -> list[dict]:
         """Rows for the GetAgentStatus UDTF (ref: md_udtfs.h reads the
-        agent manager's registry)."""
+        agent manager's registry), plus r10 health-plane columns."""
         now = time.monotonic()
         with self._lock:
             return [
@@ -130,6 +177,10 @@ class AgentTracker:
                     # standalone fallback in md_udtfs.py (ADVICE r3).
                     "last_heartbeat_ns": int((now - a["last_seen"]) * 1e9),
                     "kelvin": a["is_kelvin"],
+                    "epoch": a["epoch"],
+                    "breaker_open": len(
+                        (a.get("health") or {}).get("breaker_open") or ()
+                    ),
                 }
                 for i, (aid, a) in enumerate(sorted(self._agents.items()))
             ]
@@ -172,6 +223,68 @@ class QueryBroker:
         # the metadata service; here the caller provides them (or agents'
         # heartbeats name tables and the caller maps relations).
         self.table_relations = dict(table_relations or {})
+        self._health_srv = None
+
+    def start_health_server(self, host: str = "127.0.0.1", port: int = 0):
+        """Expose the aggregated cluster health view over HTTP (r10):
+        /statusz carries ``cluster_health`` (per-agent breaker state,
+        staging depth, fold latency, liveness) and /agentz the
+        GetAgentStatus-shaped snapshot. Returns the HealthServer (its
+        ``.address`` is the bound (host, port))."""
+        from pixie_tpu.vizier.health import serve_health
+
+        self._health_srv = serve_health(
+            "broker",
+            status_fn=lambda: {
+                "agents": self.tracker.agents_snapshot(),
+                "cluster_health": self.tracker.health_view(),
+            },
+            extra_routes={
+                "/agentz": lambda: self.tracker.agents_snapshot(),
+            },
+            host=host,
+            port=port,
+        )
+        return self._health_srv
+
+    def _plan_around_open_breakers(
+        self, planner, logical, plan, state
+    ) -> tuple[Plan, list[str]]:
+        """Health-plane planning step (r10): if any data-holding agent's
+        heartbeat reports an OPEN device breaker for the exact program
+        key of a fragment this plan assigns to it, replan without that
+        agent — it would be discovered sick mid-query anyway (host
+        fallback at best, breaker churn at worst). Returns the plan to
+        run plus the proactively-skipped agent ids. Falls back to the
+        original plan when every capable agent is sick (degraded data
+        beats no data) or the replan is impossible."""
+        open_keys = self.tracker.open_breaker_keys()
+        if not open_keys:
+            return plan, []
+        kelvins = {a.agent_id for a in state.agents if a.is_kelvin}
+        sick = set()
+        for frag in plan.fragments:
+            inst = plan.executing_instance[frag.fragment_id]
+            if inst in open_keys and inst not in kelvins:
+                if fragment_program_key(frag) in open_keys[inst]:
+                    sick.add(inst)
+        if not sick:
+            return plan, []
+        healthy = DistributedState(
+            agents=[a for a in state.agents if a.agent_id not in sick]
+        )
+        try:
+            replanned = planner.plan(logical, healthy)
+        except ValueError:
+            # No healthy agent holds the needed tables: run the original
+            # plan rather than fail the query outright.
+            _log.warning(
+                "health plane: every capable agent has an open breaker "
+                "for this query shape (%s); planning over them anyway",
+                sorted(sick),
+            )
+            return plan, []
+        return replanned, sorted(sick)
 
     def execute_script(
         self,
@@ -182,6 +295,7 @@ class QueryBroker:
         analyze: bool = False,
         exec_funcs=None,
         on_batch=None,
+        on_event: Optional[Callable[[str, dict], None]] = None,
     ) -> QueryResult:
         """The ExecuteScript path (server.go:308 → launch_query.go:36).
 
@@ -200,8 +314,34 @@ class QueryBroker:
         merge fragments finalize with the input they have), keeps the rows
         it received, and returns them with a structured
         ``QueryResult.degraded`` annotation. Flag off restores the r8
-        raise-on-failure behavior."""
+        raise-on-failure behavior.
+
+        Streaming degradation events (r10): pass ``on_event(query_id,
+        event)`` to learn about mid-query degradation INLINE instead of
+        only from the final annotation — it fires when an agent is
+        skipped at planning ({"type": "agent_skipped", "agent_id",
+        "reason"}), lost ({"type": "agent_lost", "agent_id", "error"}),
+        timed out ({"type": "agent_timeout", "agent_id"}), or errors
+        ({"type": "agent_error", "agent_id", "error", "error_kind"}) —
+        the same entries the final annotation aggregates. Exceptions from
+        the callback are logged and swallowed; the final annotation is
+        unchanged.
+
+        Health-plane planning (r10, flag ``health_plane``): agents whose
+        heartbeats report an OPEN device breaker for this query's program
+        shape are skipped proactively at planning time and recorded in
+        ``degraded.skipped`` with reason ``breaker_open`` — instead of
+        being discovered sick mid-query. Half-open breakers plan
+        normally (they admit their trial)."""
         qid = str(uuid.uuid4())
+
+        def emit(event: dict) -> None:
+            if on_event is None:
+                return
+            try:
+                on_event(qid, event)
+            except Exception:
+                _log.exception("on_event callback failed (ignored)")
         t0 = time.perf_counter_ns()
         logical = self.compiler.compile(
             query,
@@ -213,9 +353,26 @@ class QueryBroker:
         )
         # Plan only over agents inside the heartbeat-expiry window; the
         # skipped list rides the degraded annotation.
-        state, skipped_agents = self.tracker.planning_view()
+        state, expired_agents = self.tracker.planning_view()
         planner = DistributedPlanner(self.registry, self.table_relations)
         plan = planner.plan(logical, state)
+        # Health plane: route around agents whose device breaker is open
+        # for this query's program shape.
+        breaker_skipped: list[str] = []
+        if flags.health_plane:
+            plan, breaker_skipped = self._plan_around_open_breakers(
+                planner, logical, plan, state
+            )
+        skipped = [
+            {"agent_id": aid, "reason": "heartbeat_expired"}
+            for aid in expired_agents
+        ] + [
+            {"agent_id": aid, "reason": "breaker_open"}
+            for aid in breaker_skipped
+        ]
+        skipped_agents = sorted(expired_agents + breaker_skipped)
+        for entry in skipped:
+            emit({"type": "agent_skipped", **entry})
         compile_ns = time.perf_counter_ns() - t0
 
         # The broker's deadline is also the propagated per-query deadline:
@@ -284,6 +441,7 @@ class QueryBroker:
                         agent_errors.setdefault(
                             inst, "deadline exceeded: no result"
                         )
+                        emit({"type": "agent_timeout", "agent_id": inst})
                     break
                 msg = results_sub.get(timeout=min(remaining, 0.1))
                 if msg is None:
@@ -297,6 +455,13 @@ class QueryBroker:
                             agent_errors.setdefault(
                                 inst, "agent lost: heartbeat expired "
                                 "mid-query"
+                            )
+                            emit(
+                                {
+                                    "type": "agent_lost",
+                                    "agent_id": inst,
+                                    "error": agent_errors[inst],
+                                }
                             )
                             for bid in bridges_by_instance.get(inst, ()):
                                 self.router.unregister_producer(qid, bid)
@@ -320,6 +485,14 @@ class QueryBroker:
                     agent_errors[aid] = msg["error"]
                     if msg.get("error_kind") == "deadline":
                         timed_out_agents.append(aid)
+                    emit(
+                        {
+                            "type": "agent_error",
+                            "agent_id": aid,
+                            "error": msg["error"],
+                            "error_kind": msg.get("error_kind", "error"),
+                        }
+                    )
                     pending.discard(aid)
                     if partial_ok:
                         # The failed fragments produced no (or partial)
@@ -371,6 +544,8 @@ class QueryBroker:
                 reasons.append("agent_error")
             if skipped_agents:
                 reasons.append("agents_skipped")
+            if breaker_skipped:
+                reasons.append("breaker_open")
             if forward_dropped:
                 reasons.append("forward_dropped")
             degraded = {
@@ -380,6 +555,9 @@ class QueryBroker:
                 "lost_agents": sorted(lost_agents),
                 "timed_out_agents": sorted(set(timed_out_agents)),
                 "skipped_agents": list(skipped_agents),
+                # Structured skip entries (r10): who planning left out
+                # and WHY (heartbeat_expired | breaker_open).
+                "skipped": skipped,
                 "forward_dropped": forward_dropped,
             }
         return QueryResult(
@@ -393,3 +571,6 @@ class QueryBroker:
 
     def stop(self) -> None:
         self.tracker.stop()
+        if self._health_srv is not None:
+            self._health_srv.stop()
+            self._health_srv = None

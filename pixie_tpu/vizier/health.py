@@ -33,10 +33,14 @@ class HealthServer:
         live_fn: Optional[Callable[[], bool]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        extra_routes: Optional[dict[str, Callable[[], object]]] = None,
     ):
         self.component = component
         self._status_fn = status_fn
         self._live_fn = live_fn
+        # Owner-provided JSON endpoints, e.g. the broker's /agentz view
+        # of the cluster health plane (r10).
+        self._extra_routes = dict(extra_routes or {})
         self._start = time.time()
         outer = self
 
@@ -72,6 +76,16 @@ class HealthServer:
                         metrics_registry().render_text().encode(),
                         "text/plain",
                     )
+                elif path in outer._extra_routes:
+                    try:
+                        body = json.dumps(
+                            outer._extra_routes[path](), indent=1
+                        ).encode()
+                        code = 200
+                    except Exception as e:
+                        body = json.dumps({"error": str(e)}).encode()
+                        code = 500
+                    self._reply(code, body, "application/json")
                 else:
                     self._reply(404, b"not found", "text/plain")
 

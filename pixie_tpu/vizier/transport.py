@@ -22,6 +22,7 @@ send-only; merge-side consumption happens in the broker process's router.
 
 from __future__ import annotations
 
+import collections
 import hmac
 import ipaddress
 import logging
@@ -31,6 +32,8 @@ import socket
 import ssl
 import struct
 import threading
+import time
+import uuid
 from typing import Any, Optional
 
 from pixie_tpu.exec.router import BridgeRouter
@@ -55,14 +58,178 @@ define_flag(
     "connection is closed at the timeout instead of pinning a thread.",
 )
 
+define_flag(
+    "transport_ack_window",
+    256,
+    help_="Max in-flight (sent-but-unacked) frames a RemoteBus plane "
+    "keeps for cross-reconnect replay (Kafka idempotent-producer shape: "
+    "identity + epoch + per-plane seq surviving reconnects, cumulative "
+    "acks bounding the window). 0 disables acked delivery entirely — no "
+    "window bookkeeping, no server acks (r9 retry-on-fresh-connection "
+    "behavior, but with the per-identity dedup watermark kept).",
+)
+define_flag(
+    "transport_ack_window_mb",
+    8.0,
+    help_="Byte bound on the in-flight window (encoded frame bytes); "
+    "whichever of frames/bytes fills first blocks the sender.",
+)
+define_flag(
+    "transport_ack_interval",
+    32,
+    help_="Server emits a cumulative ack at least every N applied "
+    "seq-carrying frames (piggybacked on the receive loop).",
+)
+define_flag(
+    "transport_ack_interval_ms",
+    25.0,
+    help_="Server ack flush period: acks for a quiet tail of frames are "
+    "batched at most this long before a standalone ack frame is sent.",
+)
+define_flag(
+    "transport_window_block_s",
+    10.0,
+    help_="How long a sender blocks on a full in-flight window "
+    "(backpressure) before TransportBackpressureError is raised — a "
+    "structured transport error, never silent loss.",
+)
+
 _RECONNECTS = metrics_registry().counter(
     "transport_reconnect_total",
     "Successful RemoteBus plane reconnects after a connection failure.",
 )
 _DEDUP_DROPS = metrics_registry().counter(
     "transport_dedup_dropped_total",
-    "Duplicate/replayed frames dropped by per-connection seq dedup.",
+    "Duplicate/replayed frames dropped by the server's per-identity "
+    "(agent_id, plane) seq watermark.",
 )
+_REPLAYS = metrics_registry().counter(
+    "transport_replayed_total",
+    "Unacked window frames replayed onto a fresh connection.",
+)
+_ACKS_SENT = metrics_registry().counter(
+    "transport_ack_sent_total",
+    "Cumulative ack frames emitted by the server.",
+)
+_SESSION_REJECTS = metrics_registry().counter(
+    "transport_session_rejected_total",
+    "Session frames rejected for a stale epoch (zombie connections).",
+)
+
+
+class TransportBackpressureError(ConnectionError):
+    """The in-flight ack window stayed full past transport_window_block_s:
+    the peer is not draining (or acks are lost). Structured so callers can
+    distinguish backpressure from a dead connection."""
+
+    def __init__(self, plane: str, frames: int, nbytes: int):
+        super().__init__(
+            f"transport {plane} plane: in-flight window full "
+            f"({frames} frames / {nbytes} bytes) for "
+            f"{flags.transport_window_block_s}s — peer not acking"
+        )
+        self.plane = plane
+        self.frames = frames
+        self.nbytes = nbytes
+
+
+class _AckWindow:
+    """Client-side bounded window of stamped-but-unacked frames, one per
+    plane. The seq counter is per-IDENTITY, not per-connection: it never
+    resets for the life of the RemoteBus, so the server's (agent_id,
+    plane) watermark stays meaningful across reconnects. After a
+    reconnect, ``replay_frames`` returns everything above the server's
+    applied watermark — the replay source that closes the r9 retry
+    ambiguity (frames the OLD connection may have delivered are either
+    trimmed here via the server's watermark, or dropped server-side by
+    per-identity dedup)."""
+
+    def __init__(self, plane: str):
+        self.plane = plane
+        self._cv = threading.Condition()
+        # (seq, encoded bytes, stamped frame) in ascending-seq order.
+        self._entries: "collections.deque" = collections.deque()
+        self._bytes = 0
+        self.next_seq = 0
+        self.acked = -1
+
+    @property
+    def enabled(self) -> bool:
+        return flags.transport_ack_window > 0
+
+    def stamp(self, obj: dict) -> dict:
+        frame = dict(obj)
+        frame["seq"] = self.next_seq
+        self.next_seq += 1
+        return frame
+
+    def depth(self) -> tuple[int, int]:
+        with self._cv:
+            return len(self._entries), self._bytes
+
+    def add(self, frame: dict, nbytes: int, force: bool = False) -> None:
+        """Track a stamped frame until acked. Blocks (backpressure) while
+        the window is full, up to transport_window_block_s, then raises
+        TransportBackpressureError. ``force`` skips the bound (internal
+        reconnect frames must not deadlock inside the replay path)."""
+        max_frames = flags.transport_ack_window
+        max_bytes = int(flags.transport_ack_window_mb * (1 << 20))
+        with self._cv:
+            if not force:
+                deadline = time.monotonic() + flags.transport_window_block_s
+                while self._entries and (
+                    len(self._entries) >= max_frames
+                    or self._bytes + nbytes > max_bytes
+                ):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TransportBackpressureError(
+                            self.plane, len(self._entries), self._bytes
+                        )
+                    self._cv.wait(remaining)
+            self._entries.append((frame["seq"], nbytes, frame))
+            self._bytes += nbytes
+
+    def ack(self, seq: int) -> None:
+        """Cumulative ack: release every entry with seq' <= seq."""
+        with self._cv:
+            if seq <= self.acked:
+                return
+            self.acked = seq
+            while self._entries and self._entries[0][0] <= seq:
+                _, nb, _ = self._entries.popleft()
+                self._bytes -= nb
+            self._cv.notify_all()
+
+    def wait_drained(self, deadline: float) -> bool:
+        """Block until every in-flight frame is acked (graceful close)
+        or ``deadline`` (monotonic) passes. True iff drained."""
+        with self._cv:
+            while self._entries:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            return True
+
+    def replay_frames(self, server_applied_seq: int) -> list[dict]:
+        """Frames to resend on a fresh connection: everything above the
+        server's per-identity applied watermark. Entries at or below it
+        WERE delivered by the old connection — trimmed here (and were a
+        replay to happen anyway, the server's watermark drops it; the
+        transport.replay_dup fault site forces exactly that path)."""
+        with self._cv:
+            if not (faults.ACTIVE and faults.fires("transport.replay_dup")):
+                while (
+                    self._entries
+                    and self._entries[0][0] <= server_applied_seq
+                ):
+                    _, nb, _ = self._entries.popleft()
+                    self._bytes -= nb
+                if server_applied_seq > self.acked:
+                    self.acked = server_applied_seq
+                self._cv.notify_all()
+            return [f for _, _, f in self._entries]
 
 define_flag(
     "tls_cert",
@@ -228,6 +395,18 @@ def _client_handshake(sock: socket.socket, secret: str) -> None:
         raise ConnectionError("transport handshake: server failed to authenticate")
 
 
+def _no_delay(sock: socket.socket) -> None:
+    """Disable Nagle: the control plane is small back-to-back frames
+    (session → replay → resubscribe → register), and Nagle + delayed-ACK
+    holds every second small write for ~40ms — long enough for a broker
+    to launch a query before the resubscribe lands. The reference's
+    planes (gRPC, NATS) both run with TCP_NODELAY for the same reason."""
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass  # non-TCP transports (tests with socketpairs)
+
+
 def _close(sock: socket.socket) -> None:
     """shutdown() before close(): a reader blocked in recv on either end
     only wakes on FIN, which close() alone does not send while another
@@ -271,6 +450,14 @@ class BusTransportServer:
         self._stop = threading.Event()
         self._conns: list[socket.socket] = []
         self._threads: list[threading.Thread] = []
+        # Per-identity delivery state surviving reconnects (the tentpole):
+        # (agent_id, plane) -> {"epoch", "last_seq" (dedup watermark),
+        # "applied_seq" (ack watermark), "conn"}. A fresh connection
+        # presenting a session with a HIGHER epoch takes the identity over
+        # (the old socket is closed and its loop exits before it can
+        # interleave); a stale epoch is rejected outright.
+        self._idents: dict[tuple[str, str], dict] = {}
+        self._idents_lock = threading.Lock()
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
@@ -281,6 +468,7 @@ class BusTransportServer:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            _no_delay(conn)
             self._conns.append(conn)
             t = threading.Thread(
                 target=self._conn_loop, args=(conn,), daemon=True
@@ -288,15 +476,116 @@ class BusTransportServer:
             t.start()
             self._threads.append(t)
 
+    def _establish_session(self, conn, send_lock, frame) -> Optional[dict]:
+        """Register a session frame against the identity registry. Returns
+        the shared per-identity entry, or None when the epoch is stale
+        (the connection must be dropped; a zombie socket's identity was
+        already taken over by a newer epoch)."""
+        wire.validate_frame(frame)
+        key = (frame["agent_id"], frame["plane"])
+        epoch = frame["epoch"]
+        old_conn = None
+        with self._idents_lock:
+            entry = self._idents.get(key)
+            if entry is not None and epoch <= entry["epoch"]:
+                stale_epoch = entry["epoch"]
+                entry = None
+            else:
+                if entry is None:
+                    entry = self._idents[key] = {
+                        "epoch": epoch,
+                        "last_seq": -1,
+                        "applied_seq": -1,
+                        "conn": conn,
+                        "lock": threading.Lock(),
+                    }
+                else:
+                    old_conn = entry["conn"]
+                    # Under the entry lock so the takeover serializes
+                    # with the zombie's claim-and-dispatch step.
+                    with entry["lock"]:
+                        entry["epoch"] = epoch
+                        entry["conn"] = conn
+        if entry is None:
+            _SESSION_REJECTS.inc()
+            _log.warning(
+                "transport: rejecting stale epoch %d for %s (current %d)",
+                epoch, key, stale_epoch,
+            )
+            try:
+                with send_lock:
+                    _send_frame(
+                        conn,
+                        {
+                            "kind": "session_reject",
+                            "reason": f"stale epoch {epoch}",
+                        },
+                    )
+            except OSError:
+                pass
+            return None
+        if old_conn is not None and old_conn is not conn:
+            _close(old_conn)  # the superseded zombie cannot interleave
+        with send_lock:
+            _send_frame(
+                conn,
+                {"kind": "session_ok", "last_seq": entry["applied_seq"]},
+            )
+        return entry
+
+    def _maybe_ack(self, conn, send_lock, entry, ack_state, force) -> None:
+        """Cumulative ack of everything dispatched so far; batched every
+        transport_ack_interval frames, flushed every
+        transport_ack_interval_ms by the per-connection ack loop."""
+        applied = entry["applied_seq"]
+        if applied <= ack_state["acked"]:
+            return
+        if (
+            not force
+            and applied - ack_state["acked"] < flags.transport_ack_interval
+        ):
+            return
+        if faults.ACTIVE and faults.fires("transport.ack_drop"):
+            return  # the ack frame is lost on the wire; a later one covers
+        with send_lock:
+            _send_frame(conn, {"kind": "ack", "seq": applied})
+        ack_state["acked"] = applied
+        _ACKS_SENT.inc()
+
+    def _ack_loop(self, conn, send_lock, conn_dead, entry, ack_state):
+        """Flush a quiet tail of unacked frames so the client's window
+        drains even when no further traffic piggybacks an ack."""
+        while not (self._stop.is_set() or conn_dead.is_set()):
+            if conn_dead.wait(flags.transport_ack_interval_ms / 1000.0):
+                return
+            if entry["conn"] is not conn:
+                return  # superseded by a newer epoch
+            try:
+                self._maybe_ack(conn, send_lock, entry, ack_state, force=True)
+            except OSError:
+                return
+
     def _conn_loop(self, conn: socket.socket) -> None:
         send_lock = threading.Lock()
         conn_dead = threading.Event()  # per-connection: stops forwarders
         subs: dict[str, tuple] = {}  # topic -> (bus sub, stop event)
-        # Per-connection dedup watermark: clients stamp a monotonically
-        # increasing ``seq`` on every frame; a replayed/duplicated frame
-        # (retry ambiguity, injected duplication) is dropped here so
-        # result rows and producer registrations stay exactly-once.
-        last_seq = -1
+        # Dedup watermark (r10: per-IDENTITY, surviving reconnects):
+        # clients stamp a monotonically increasing per-plane ``seq`` on
+        # every frame; a replayed/duplicated frame (reconnect replay,
+        # injected duplication) is dropped at the watermark so result rows
+        # and producer registrations stay exactly-once ACROSS connections.
+        # A client that never sends a session frame gets a per-connection
+        # entry (legacy r9 semantics).
+        entry = {
+            "epoch": -1,
+            "last_seq": -1,
+            "applied_seq": -1,
+            "conn": conn,
+            "lock": threading.Lock(),
+        }
+        want_ack = False
+        plane = "legacy"  # session-declared plane (fault-site scope)
+        ack_state = {"acked": -1}
         try:
             try:
                 # Bounded pre-auth hold time: a silent peer must not pin
@@ -316,17 +605,48 @@ class BusTransportServer:
             except (wire.WireError, OSError, ConnectionError) as e:
                 _log.warning("transport: handshake failed: %s", e)
                 return
+            frame = None
+            first = True
             while not self._stop.is_set():
-                try:
-                    frame = _recv_frame(conn)
-                except wire.WireError as e:
-                    # Hostile or corrupted peer: drop just this connection.
-                    _log.warning("transport: dropping connection: %s", e)
-                    return
-                except OSError:
-                    return  # closed under us (shutdown or peer reset)
+                if frame is None:
+                    try:
+                        frame = _recv_frame(conn)
+                    except wire.WireError as e:
+                        # Hostile or corrupted peer: drop this connection.
+                        _log.warning("transport: dropping connection: %s", e)
+                        return
+                    except OSError:
+                        return  # closed under us (shutdown or peer reset)
                 if frame is None:
                     return
+                if first:
+                    first = False
+                    if frame.get("kind") == "session":
+                        try:
+                            entry = self._establish_session(
+                                conn, send_lock, frame
+                            )
+                        except (wire.WireError, OSError) as e:
+                            _log.warning(
+                                "transport: bad session frame: %s", e
+                            )
+                            return
+                        if entry is None:
+                            return  # stale epoch
+                        want_ack = bool(frame.get("want_ack"))
+                        plane = frame["plane"]
+                        if want_ack:
+                            at = threading.Thread(
+                                target=self._ack_loop,
+                                args=(
+                                    conn, send_lock, conn_dead, entry,
+                                    ack_state,
+                                ),
+                                daemon=True,
+                            )
+                            at.start()
+                        frame = None
+                        continue
                 frames = [frame]
                 if (
                     faults.ACTIVE
@@ -337,12 +657,40 @@ class BusTransportServer:
                 try:
                     for fr in frames:
                         seq = fr.get("seq")
-                        if isinstance(seq, int):
-                            if seq <= last_seq:
-                                _DEDUP_DROPS.inc()
-                                continue
-                            last_seq = seq
+                        # Supersede check + dedup + watermark claim are
+                        # one atomic step per identity: a zombie racing
+                        # its replacement's replay must either claim the
+                        # seq first (the replay copy is then dropped) or
+                        # see itself superseded — never apply twice.
+                        with entry["lock"]:
+                            if entry["conn"] is not conn:
+                                return
+                            dup = (
+                                isinstance(seq, int)
+                                and seq <= entry["last_seq"]
+                            )
+                            if isinstance(seq, int) and not dup:
+                                entry["last_seq"] = seq
+                        if dup:
+                            _DEDUP_DROPS.inc()
+                            continue
                         self._dispatch(fr, conn, send_lock, conn_dead, subs)
+                        if isinstance(seq, int):
+                            # Ack watermark moves only AFTER dispatch: an
+                            # acked frame is an applied frame.
+                            entry["applied_seq"] = seq
+                        if (
+                            faults.ACTIVE
+                            and fr.get("kind") in ("publish", "bridge_push")
+                            and faults.fires_scoped(
+                                "transport.conn_kill_midflight", plane
+                            )
+                        ):
+                            # The frame IS applied but the client will
+                            # never see its ack — the previously-ambiguous
+                            # retry case. The client must replay it and
+                            # the per-identity watermark must drop it.
+                            return
                 except (KeyError, TypeError) as e:
                     # Wire-valid but schema-invalid (missing/mis-typed
                     # fields): same hostile-peer treatment as WireError.
@@ -350,6 +698,14 @@ class BusTransportServer:
                         "transport: dropping connection on bad frame: %s", e
                     )
                     return
+                if want_ack:
+                    try:
+                        self._maybe_ack(
+                            conn, send_lock, entry, ack_state, force=False
+                        )
+                    except OSError:
+                        return
+                frame = None
         finally:
             conn_dead.set()
             for sub, stop in subs.values():
@@ -465,18 +821,26 @@ class RemoteBus:
     registration, and subscriptions ride the CONTROL connection so
     backpressure can never starve liveness and get the agent pruned.
 
-    Reconnection (r9; ref: the NATS client's reconnect-with-backoff that
-    the reference's agents lean on): a failed plane redials with
-    exponential backoff + jitter (``agent_backoff_*`` flags), re-issues
-    server-side subscriptions, and invokes registered reconnect listeners
-    (the Agent re-registers its tables). Failed sends retry on the fresh
-    connection — a frame is only ever retried when the old socket died
-    before it was sent, and every frame carries a per-plane monotonic
-    ``seq`` the server dedups on, so result rows stay exactly-once."""
+    Acked, replayable delivery (r10; ref: Kafka's idempotent producer —
+    producer id + epoch + per-partition seq surviving reconnects — and
+    the NATS client's pending window replayed after reconnect): each
+    RemoteBus owns a stable identity (``agent_id``) and a monotonically
+    increasing epoch presented at session setup; the server keeps
+    per-(identity, plane) seq watermarks that survive the connection, so
+    a frame the OLD connection may (or may not) have delivered is no
+    longer ambiguous — the client replays its bounded in-flight window
+    (``transport_ack_window`` frames / ``transport_ack_window_mb``)
+    above the server's applied watermark, and any half the old
+    connection did deliver is silently dropped at the watermark. The
+    server acks cumulatively (batched every ``transport_ack_interval``
+    frames / ``transport_ack_interval_ms``); a full window blocks the
+    sender up to ``transport_window_block_s`` then raises
+    TransportBackpressureError. Stale-epoch connections are rejected so
+    a zombie socket can't interleave with its replacement."""
 
     DATA_TOPIC_PREFIXES = ("results/",)
 
-    def __init__(self, address):
+    def __init__(self, address, agent_id: Optional[str] = None):
         self._address = tuple(address)
         self._secret = flags.cluster_secret
         self._tls = _tls_client_context()
@@ -489,12 +853,17 @@ class RemoteBus:
                 "without a cluster_secret (set PIXIE_TPU_CLUSTER_SECRET) "
                 "or a verified TLS server (tls_ca)"
             )
-        self._sock = self._connect()
+        # Stable delivery identity + per-process epoch counter: every
+        # (re)connect on either plane presents a strictly higher epoch,
+        # so the server can reject zombies deterministically.
+        self._ident = agent_id or f"rbus-{uuid.uuid4().hex}"
+        self._epoch = 0
+        self._epoch_lock = threading.Lock()
+        self._ctrl_window = _AckWindow("control")
+        self._data_window = _AckWindow("data")
         self._send_lock = threading.Lock()
-        self._seq = 0  # control-plane frame sequence (dedup watermark)
         self._data_sock = None  # opened on first data-plane send
         self._data_lock = threading.Lock()
-        self._data_seq = 0
         self._subs_lock = threading.Lock()
         self._subs: dict[str, list[_RemoteSubscription]] = {}
         self._stop = threading.Event()
@@ -502,6 +871,7 @@ class RemoteBus:
         # would re-enter _reconnect on the same thread.
         self._reconnect_lock = threading.RLock()
         self._reconnect_listeners: list = []
+        self._sock, _ = self._connect("control")
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
@@ -510,10 +880,14 @@ class RemoteBus:
         (the Agent re-registers itself + its tables)."""
         self._reconnect_listeners.append(fn)
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, plane: str) -> tuple[socket.socket, int]:
+        """Dial + authenticate + establish the delivery session for one
+        plane. Returns (socket, server_applied_seq): the server's
+        per-identity watermark bounds what the window must replay."""
         sock = socket.create_connection(
             self._address, timeout=flags.transport_handshake_timeout_s
         )
+        _no_delay(sock)
         try:
             # The handshake runs under the timeout: a silent/half-open
             # server cannot park this thread; the socket is closed on the
@@ -523,11 +897,34 @@ class RemoteBus:
                     sock, server_hostname=str(self._address[0])
                 )
             _client_handshake(sock, self._secret)
+            with self._epoch_lock:
+                self._epoch += 1
+                epoch = self._epoch
+            _send_frame(
+                sock,
+                {
+                    "kind": "session",
+                    "agent_id": self._ident,
+                    "plane": plane,
+                    "epoch": epoch,
+                    "want_ack": flags.transport_ack_window > 0,
+                },
+            )
+            resp = _recv_frame(
+                sock, max_len=_HANDSHAKE_MAX_FRAME, pre_auth=True
+            )
+            if resp is None or resp.get("kind") != "session_ok":
+                reason = (
+                    resp.get("reason", "no session_ok from server")
+                    if isinstance(resp, dict)
+                    else "connection closed before session_ok"
+                )
+                raise ConnectionError(f"transport session rejected: {reason}")
             sock.settimeout(None)
         except Exception:
             _close(sock)
             raise
-        return sock
+        return sock, int(resp.get("last_seq", -1))
 
     def _backoff_delays(self):
         """Exponential backoff delays with jitter, bounded by
@@ -555,7 +952,7 @@ class RemoteBus:
                 if self._stop.is_set():
                     return False
                 try:
-                    sock = self._connect()
+                    sock, server_applied = self._connect("control")
                 except (OSError, ConnectionError) as e:
                     _log.warning(
                         "transport: reconnect to %s failed (%s); retrying "
@@ -564,26 +961,58 @@ class RemoteBus:
                     if self._stop.wait(delay):
                         return False
                     continue
-                self._sock = sock
+                # Socket swap + window replay are one atomic step under
+                # the send lock: any sender that windowed a frame did so
+                # while HOLDING that lock, so a replay that runs after it
+                # always covers the frame — no seq can be overtaken (a
+                # skipped seq would be deduped away forever once a later
+                # one lands).
+                replay_failed = False
+                with self._send_lock:
+                    self._sock = sock
+                    if self._ctrl_window.enabled:
+                        try:
+                            for fr in self._ctrl_window.replay_frames(
+                                server_applied
+                            ):
+                                _send_frame(sock, fr)
+                                _REPLAYS.inc(plane="control")
+                        except OSError:
+                            replay_failed = True
+                if replay_failed:
+                    continue  # fresh conn died mid-replay: keep backing off
                 # The data plane redials lazily on its next send.
                 with self._data_lock:
                     if self._data_sock is not None:
                         _close(self._data_sock)
                         self._data_sock = None
-                _RECONNECTS.inc(plane="control")
-                # Restore server-side subscription state, then let
+                # Restore server-side subscription state (per-connection
+                # server state, re-issued with fresh seqs), then let
                 # listeners (agent re-registration) run on the new conn.
-                # Direct sends (no retry recursion): if the fresh conn
-                # dies mid-resubscribe, keep backing off.
                 with self._subs_lock:
                     topics = sorted(self._subs)
                 try:
                     for t in topics:
                         self._send_stamped(
-                            sock, {"kind": "subscribe", "topic": t}
+                            sock,
+                            {"kind": "subscribe", "topic": t},
+                            force=True,
                         )
                 except OSError:
                     continue  # new conn died instantly: keep backing off
+                # An acked frame is a DISPATCHED frame, so waiting for
+                # the resubscriptions' ack closes the window where the
+                # tracker still shows this agent alive but its topic
+                # forwarders don't exist yet (a query launched there
+                # would silently miss it). The reconnect lock gives this
+                # thread exclusive read access, so drain inline; bounded
+                # — on timeout the plane still works, just with the r9
+                # eventually-consistent subscription restore.
+                if self._ctrl_window.enabled and topics:
+                    self._drain_until_acked(
+                        sock, self._ctrl_window.next_seq - 1
+                    )
+                _RECONNECTS.inc(plane="control")
                 for fn in list(self._reconnect_listeners):
                     try:
                         fn()
@@ -595,6 +1024,45 @@ class RemoteBus:
                 self._address, flags.agent_reconnect_max_tries,
             )
             return False
+
+    def _handle_frame(self, frame: dict) -> None:
+        """One server->client control frame (shared by the read loop and
+        the reconnect-time inline drain)."""
+        kind = frame.get("kind")
+        if kind == "message":
+            with self._subs_lock:
+                targets = list(self._subs.get(frame["topic"], ()))
+            for sub in targets:
+                sub._deliver(frame["msg"])
+        elif kind == "ack" and isinstance(frame.get("seq"), int):
+            self._ctrl_window.ack(frame["seq"])
+
+    def _drain_until_acked(self, sock, seq: int) -> None:
+        """Read frames off ``sock`` until the server's cumulative ack
+        covers ``seq`` (bounded by ~4 ack intervals). Only called under
+        the reconnect lock — every other reader is parked waiting for it,
+        so this thread has exclusive read access. A timeout mid-frame can
+        desync the stream; the resulting WireError on the next read drops
+        the connection and redials, so it self-heals."""
+        timeout = max(0.05, 4 * flags.transport_ack_interval_ms / 1000.0)
+        deadline = time.monotonic() + timeout
+        try:
+            while self._ctrl_window.acked < seq:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                sock.settimeout(remaining)
+                frame = _recv_frame(sock)
+                if frame is None:
+                    return
+                self._handle_frame(frame)
+        except (OSError, wire.WireError):
+            return
+        finally:
+            try:
+                sock.settimeout(None)
+            except OSError:
+                pass
 
     def _read_loop(self) -> None:
         while not self._stop.is_set():
@@ -612,38 +1080,122 @@ class RemoteBus:
                 if self._stop.is_set() or not self._reconnect(sock):
                     return
                 continue
-            if frame.get("kind") == "message":
-                with self._subs_lock:
-                    targets = list(self._subs.get(frame["topic"], ()))
-                for sub in targets:
-                    sub._deliver(frame["msg"])
+            self._handle_frame(frame)
 
-    def _send_stamped(self, sock, obj: dict) -> None:
-        """One stamped control-plane send on ``sock``, no retry."""
+    def _data_redial_locked(self, redialing: bool) -> None:
+        """Dial + session + window replay for the data plane. Caller
+        holds ``_data_lock`` and has verified ``_data_sock is None``."""
+        sock, server_applied = self._connect("data")
+        self._data_sock = sock
+        if redialing:
+            _RECONNECTS.inc(plane="data")
+        if self._data_window.enabled:
+            # Replay unacked frames above the server's applied watermark;
+            # delivered-but-unacked halves are trimmed (or, under the
+            # transport.replay_dup fault, deduped server-side).
+            for fr in self._data_window.replay_frames(server_applied):
+                _send_frame(sock, fr)
+                _REPLAYS.inc(plane="data")
+            threading.Thread(
+                target=self._data_read_loop, args=(sock,), daemon=True
+            ).start()
+
+    def _data_read_loop(self, sock) -> None:
+        """Drain server acks off one data-plane socket (the data plane
+        was send-only before r10). On socket death, proactively redial +
+        replay when unacked frames are stranded in the window — a tail
+        frame (e.g. a fragment_done publish) may have been buffered into
+        a dying socket with no follow-up send to trigger the replay."""
+        while not self._stop.is_set():
+            try:
+                frame = _recv_frame(sock)
+            except (OSError, wire.WireError):
+                break
+            if frame is None:
+                break
+            if frame.get("kind") == "ack" and isinstance(
+                frame.get("seq"), int
+            ):
+                self._data_window.ack(frame["seq"])
+        with self._data_lock:
+            if self._data_sock is sock:
+                _close(sock)
+                self._data_sock = None
+            else:
+                return  # a sender already replaced the socket
+        if self._stop.is_set() or self._data_window.depth()[0] == 0:
+            return
+        attempts = self._backoff_delays()
+        while not self._stop.is_set():
+            try:
+                with self._data_lock:
+                    if self._data_sock is None:
+                        self._data_redial_locked(redialing=True)
+                return
+            except (OSError, ConnectionError):
+                with self._data_lock:
+                    if self._data_sock is not None:
+                        _close(self._data_sock)
+                        self._data_sock = None
+                try:
+                    delay = next(attempts)
+                except StopIteration:
+                    return
+                if self._stop.wait(delay):
+                    return
+
+    def _send_stamped(self, sock, obj: dict, force: bool = False) -> None:
+        """One stamped + windowed control-plane send on ``sock``, no
+        retry. The frame enters the in-flight window BEFORE the send: a
+        send that dies mid-wire leaves the frame replayable. Stamp +
+        window + transmit are atomic under the send lock — required for
+        in-order seq delivery (the watermark dedup is only correct if a
+        lower seq can never legitimately arrive after a higher one)."""
         with self._send_lock:
-            obj = dict(obj)
-            obj["seq"] = self._seq
-            self._seq += 1
-            _send_frame(sock, obj)
+            frame = self._ctrl_window.stamp(obj)
+            payload = wire.encode(frame)
+            if self._ctrl_window.enabled:
+                self._ctrl_window.add(frame, len(payload), force=force)
+            sock.sendall(_LEN.pack(len(payload)) + payload)
 
     def _send(self, obj: dict) -> None:
         while True:
-            sock = self._sock
-            if faults.ACTIVE and faults.fires("transport.send"):
-                # Simulated peer reset BEFORE the frame hits the wire: the
-                # frame is lost with the connection, so the retry below is
-                # exactly-once.
-                _close(sock)
             try:
-                self._send_stamped(sock, obj)
+                with self._send_lock:
+                    # self._sock is read under the lock: after a competing
+                    # thread's reconnect (socket swap + replay hold this
+                    # lock), we see the fresh socket, never the zombie.
+                    sock = self._sock
+                    if faults.ACTIVE and faults.fires("transport.send"):
+                        # Simulated peer reset BEFORE the frame hits the
+                        # wire: the frame is lost with the connection; with
+                        # the window off the retry below is exactly-once,
+                        # with it on the reconnect replay re-sends it (and
+                        # dedup drops any server-applied copy).
+                        _close(sock)
+                    frame = self._ctrl_window.stamp(obj)
+                    payload = wire.encode(frame)
+                    windowed = self._ctrl_window.enabled
+                    if windowed:
+                        self._ctrl_window.add(frame, len(payload))
+                    sock.sendall(_LEN.pack(len(payload)) + payload)
                 return
+            except TransportBackpressureError:
+                raise  # structured: peer alive but not draining acks
             except OSError:
                 if self._stop.is_set() or not self._reconnect(sock):
                     raise
+                if windowed:
+                    # The frame entered the window while we held the send
+                    # lock; every reconnect replay runs under that lock
+                    # afterwards, so whichever thread reconnected has
+                    # already retransmitted it in seq order.
+                    return
 
     def _send_data(self, obj: dict) -> None:
         attempts = self._backoff_delays()
         redialing = False
+        windowed_frame = None
         while True:
             if faults.ACTIVE and faults.fires("transport.send_data"):
                 with self._data_lock:
@@ -654,15 +1206,23 @@ class RemoteBus:
             try:
                 with self._data_lock:
                     if self._data_sock is None:
-                        self._data_sock = self._connect()
-                        self._data_seq = 0
-                        if redialing:
-                            _RECONNECTS.inc(plane="data")
-                    obj = dict(obj)
-                    obj["seq"] = self._data_seq
-                    self._data_seq += 1
-                    _send_frame(self._data_sock, obj)
+                        self._data_redial_locked(redialing)
+                    if windowed_frame is not None:
+                        # Our frame was already windowed on a previous
+                        # attempt: whichever redial made the socket live
+                        # replayed (or the server acked) it.
+                        return
+                    frame = self._data_window.stamp(obj)
+                    payload = wire.encode(frame)
+                    if self._data_window.enabled:
+                        self._data_window.add(frame, len(payload))
+                        windowed_frame = frame
+                    self._data_sock.sendall(
+                        _LEN.pack(len(payload)) + payload
+                    )
                 return
+            except TransportBackpressureError:
+                raise  # structured: the peer is alive but not draining
             except (OSError, ConnectionError):
                 with self._data_lock:
                     if self._data_sock is not None:
@@ -677,6 +1237,13 @@ class RemoteBus:
                     raise
                 if self._stop.wait(delay):
                     raise
+
+    def window_depths(self) -> dict[str, tuple[int, int]]:
+        """{plane: (frames, bytes)} currently in-flight (health plane)."""
+        return {
+            "control": self._ctrl_window.depth(),
+            "data": self._data_window.depth(),
+        }
 
     def publish(self, topic: str, msg: Any) -> None:
         frame = {"kind": "publish", "topic": topic, "msg": msg}
@@ -711,6 +1278,18 @@ class RemoteBus:
                 pass
 
     def close(self) -> None:
+        # Graceful drain first (acked mode): closing with frames still
+        # in flight triggers an RST the moment the server writes an ack
+        # at the dead socket — which destroys the server's receive
+        # buffer, losing frames it never got to apply. Waiting for the
+        # cumulative ack proves everything was applied; bounded, so a
+        # dead peer can't park close() past the backpressure budget.
+        if self._ctrl_window.enabled and not self._stop.is_set():
+            deadline = time.monotonic() + min(
+                flags.transport_window_block_s, 5.0
+            )
+            self._ctrl_window.wait_drained(deadline)
+            self._data_window.wait_drained(deadline)
         self._stop.set()
         _close(self._sock)
         with self._data_lock:

@@ -95,6 +95,60 @@ class WireError(ValueError):
     """Malformed or disallowed wire content."""
 
 
+# -- transport control-frame schema (r10) ------------------------------------
+# The connection-level protocol's closed frame vocabulary: ``kind`` ->
+# {required field: type}. Adding a frame kind is an explicit schema change,
+# like editing a proto. ``seq`` (per-identity-plane monotonic sequence) is
+# optional on any client frame; the delivery-session frames are:
+#
+#   session        client->server, once, right after the auth handshake:
+#                  stable identity + plane + strictly-increasing epoch
+#                  (stale epochs are rejected; zombie sockets cannot
+#                  interleave with their replacement).
+#   session_ok     server->client: the server's per-(identity, plane)
+#                  APPLIED watermark — the client replays its in-flight
+#                  window strictly above it.
+#   session_reject server->client, then close: epoch was stale.
+#   ack            server->client: cumulative — every frame with
+#                  seq' <= seq is applied; the client's window releases
+#                  them (Kafka idempotent-producer / NATS pending-window
+#                  shape).
+FRAME_FIELDS: dict[str, dict[str, type]] = {
+    "challenge": {"nonce": bytes},
+    "hello": {"mac": bytes, "nonce": bytes},
+    "welcome": {"mac": bytes},
+    "session": {"agent_id": str, "plane": str, "epoch": int},
+    "session_ok": {"last_seq": int},
+    "session_reject": {"reason": str},
+    "ack": {"seq": int},
+    "publish": {"topic": str},
+    "subscribe": {"topic": str},
+    "unsubscribe": {"topic": str},
+    "message": {"topic": str},
+    "bridge_register": {"query_id": str, "bridge_id": str},
+    "bridge_push": {"query_id": str, "bridge_id": str},
+}
+
+
+def validate_frame(frame: Any) -> dict:
+    """Schema-check one decoded control frame: known ``kind`` and
+    correctly-typed required fields (bool is not an int here). Raises
+    WireError — callers treat that as a hostile/broken peer."""
+    if not isinstance(frame, dict) or not isinstance(frame.get("kind"), str):
+        raise WireError("frame is not a kind-tagged message")
+    spec = FRAME_FIELDS.get(frame["kind"])
+    if spec is None:
+        raise WireError(f"unknown frame kind {frame['kind']!r}")
+    for field, typ in spec.items():
+        v = frame.get(field)
+        if not isinstance(v, typ) or (typ is int and isinstance(v, bool)):
+            raise WireError(
+                f"frame {frame['kind']!r}: field {field!r} must be "
+                f"{typ.__name__}, got {type(v).__name__}"
+            )
+    return frame
+
+
 class _Encoder:
     def __init__(self):
         self.blobs: list[bytes] = []

@@ -472,6 +472,228 @@ def test_handshake_timeout_client_side(flagset):
         silent.close()
 
 
+# -- acked delivery across reconnects (r10) ----------------------------------
+
+
+def test_conn_kill_midflight_replay_is_exactly_once(tcp_cluster):
+    """Acceptance: the server APPLIES a data-plane frame then kills the
+    socket before acking — the previously-ambiguous retry case (the old
+    connection DID deliver it). The client replays its window after
+    reconnect; the per-identity watermark drops the delivered half, so
+    result rows are bit-identical to an unfaulted run (no loss, no dup)."""
+    broker, rbus = tcp_cluster
+    truth = _sorted_rows(broker.execute_script(AGG_QUERY, timeout_s=30))
+    assert truth, "baseline must produce rows"
+    before = _reconnects("data")
+    faults.arm("transport.conn_kill_midflight@data", count=1)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    assert _sorted_rows(res) == truth, "replay must be exactly-once"
+    assert faults.stats()["transport.conn_kill_midflight@data"][1] == 1
+    assert _reconnects("data") > before
+
+
+def test_conn_kill_midflight_control_plane(tcp_cluster):
+    """Same ambiguity on the control plane: the killed connection had
+    applied a control publish; replay + per-identity dedup keep the
+    stream exactly-once and later queries run clean."""
+    broker, rbus = tcp_cluster
+    before = _reconnects("control")
+    faults.arm("transport.conn_kill_midflight@control", count=1)
+    rbus.publish("nudge", {"poke": 1})  # applied, then the conn dies
+    deadline = time.monotonic() + 15
+    # Wait for the reconnect to COMPLETE (the metric now fires only after
+    # the server acked the restored subscriptions), not just for the kill:
+    # a query launched into the resubscribe gap would lose its fragment
+    # publish and ride the deadline/degraded path instead.
+    while _reconnects("control") == before:
+        assert time.monotonic() < deadline, "reconnect never completed"
+        time.sleep(0.02)
+    _wait_agents(broker, 2, timeout=15)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    assert sum(_rows(res)["n"]) == N_ROWS
+
+
+def test_ack_drop_is_covered_by_later_cumulative_acks(tcp_cluster):
+    """Lost ack frames are harmless: acks are cumulative, so a later one
+    covers the dropped range; rows stay exactly-once and the client's
+    window eventually drains."""
+    broker, rbus = tcp_cluster
+    faults.arm("transport.ack_drop", count=3)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    assert sum(_rows(res)["n"]) == N_ROWS
+    deadline = time.monotonic() + 15
+    while any(f for f, _ in rbus.window_depths().values()):
+        assert time.monotonic() < deadline, "window never drained"
+        time.sleep(0.02)
+
+
+def test_replay_dup_forced_duplicates_are_deduped(flagset):
+    """Force the replay to IGNORE the server's applied watermark
+    (transport.replay_dup): already-delivered frames are re-sent and the
+    per-identity seq watermark must drop every one of them. Deterministic:
+    the test confirms both frames were APPLIED (delivered to a local
+    subscriber) before killing the connection, so the session watermark
+    and the dedup outcome are fixed."""
+    flagset("transport_ack_interval", 10**9)  # no acks: window keeps all
+    flagset("transport_ack_interval_ms", 10**9)
+    bus = MessageBus()
+    router = BridgeRouter()
+    server = BusTransportServer(bus, router)
+    rbus = RemoteBus(server.address)
+    dedup = metrics_registry().counter("transport_dedup_dropped_total")
+    try:
+        sub = bus.subscribe("t")
+        rbus.publish("t", {"i": 0})
+        rbus.publish("t", {"i": 1})
+        assert sub.get(timeout=5) == {"i": 0}  # applied, never acked
+        assert sub.get(timeout=5) == {"i": 1}
+        before = dedup.value()
+        faults.arm("transport.send", count=1)  # kill on the next send
+        faults.arm("transport.replay_dup")  # and replay WITHOUT trimming
+        rbus.publish("t", {"i": 2})
+        got = sub.get(timeout=10)
+        assert got == {"i": 2}, f"third frame must arrive once, got {got}"
+        deadline = time.monotonic() + 10
+        while dedup.value() - before < 2:
+            assert time.monotonic() < deadline, (
+                "both replayed duplicates must hit the watermark"
+            )
+            time.sleep(0.02)
+        assert sub.get(timeout=0.3) is None, "no duplicate deliveries"
+    finally:
+        faults.reset()
+        rbus.close()
+        server.stop()
+
+
+def test_replay_after_data_kill_keeps_rows_exactly_once(
+    tcp_cluster, flagset
+):
+    """Cluster-level: kill the data socket between the fragment's bridge
+    push and its completion message with acks disabled mid-window — the
+    replay (whichever half raced ahead, watermark-dropped or
+    conn-superseded) keeps merge input exactly-once."""
+    broker, rbus = tcp_cluster
+    flagset("transport_ack_interval", 10**9)
+    flagset("transport_ack_interval_ms", 10**9)
+    before = _reconnects("data")
+    faults.arm("transport.send_data", count=1, after=1)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    assert sum(_rows(res)["n"]) == N_ROWS, "dups must not reach the merge"
+    assert _reconnects("data") > before
+
+
+def test_window_full_raises_structured_backpressure_error(flagset):
+    """A full in-flight window with a peer that never acks blocks the
+    sender for transport_window_block_s, then surfaces a structured
+    TransportBackpressureError — not silent loss, not a hang."""
+    from pixie_tpu.vizier.transport import TransportBackpressureError
+
+    flagset("transport_ack_window", 2)
+    flagset("transport_window_block_s", 0.2)
+    flagset("transport_ack_interval", 10**9)
+    flagset("transport_ack_interval_ms", 10**9)
+    bus = MessageBus()
+    router = BridgeRouter()
+    server = BusTransportServer(bus, router)
+    rbus = RemoteBus(server.address)
+    try:
+        rbus.publish("t", {"i": 0})
+        rbus.publish("t", {"i": 1})
+        t0 = time.monotonic()
+        with pytest.raises(TransportBackpressureError) as ei:
+            rbus.publish("t", {"i": 2})
+        assert 0.15 < time.monotonic() - t0 < 5
+        assert ei.value.plane == "control"
+        assert ei.value.frames == 2
+    finally:
+        rbus.close()
+        server.stop()
+
+
+def test_stale_epoch_session_is_rejected():
+    """A second client presenting the same identity with a non-higher
+    epoch is refused at session setup (zombie sockets cannot interleave);
+    the original connection keeps working."""
+    bus = MessageBus()
+    router = BridgeRouter()
+    server = BusTransportServer(bus, router)
+    rb1 = RemoteBus(server.address, agent_id="dup-ident")
+    try:
+        with pytest.raises(ConnectionError, match="stale epoch"):
+            RemoteBus(server.address, agent_id="dup-ident")
+        rejects = metrics_registry().counter(
+            "transport_session_rejected_total"
+        )
+        assert rejects.value() >= 1
+        sub = bus.subscribe("still-works")
+        rb1.publish("still-works", {"ok": 1})
+        assert sub.get(timeout=5) == {"ok": 1}
+    finally:
+        rb1.close()
+        server.stop()
+
+
+def test_ack_window_disabled_keeps_exactly_once_on_prewire_loss(
+    tcp_cluster, flagset
+):
+    """transport_ack_window=0 disables all ack/window bookkeeping (the
+    <1%-overhead configuration); the r9 retry-on-fresh-connection path
+    still keeps rows exactly-once for frames lost BEFORE the wire."""
+    broker, rbus = tcp_cluster
+    flagset("transport_ack_window", 0)
+    faults.arm("transport.send_data", count=1)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    assert sum(_rows(res)["n"]) == N_ROWS
+
+
+# -- agent tracker epoch keying (r10 satellite) ------------------------------
+
+
+def test_tracker_drops_stale_epoch_stragglers():
+    """Two registrations racing a reconnect: the tracker keys on
+    agent_id and keeps ONLY the latest epoch — a buffered heartbeat from
+    the superseded incarnation must not resurrect its table set or make
+    the agent double-appear."""
+    bus = MessageBus()
+    broker = QueryBroker(bus, BridgeRouter(), table_relations=TABLES)
+    try:
+        bus.publish(
+            agent_mod.AGENT_STATUS_TOPIC,
+            {"type": "register", "agent_id": "pem1", "epoch": 1,
+             "is_kelvin": False, "tables": ["old_t"]},
+        )
+        bus.publish(
+            agent_mod.AGENT_STATUS_TOPIC,
+            {"type": "register", "agent_id": "pem1", "epoch": 2,
+             "is_kelvin": False, "tables": ["new_t"]},
+        )
+        # The straggler: an old connection's buffered heartbeat lands
+        # AFTER the re-registration.
+        bus.publish(
+            agent_mod.AGENT_STATUS_TOPIC,
+            {"type": "heartbeat", "agent_id": "pem1", "epoch": 1,
+             "is_kelvin": False, "tables": ["old_t"]},
+        )
+        deadline = time.monotonic() + 5
+        while not broker.tracker.agents_snapshot():
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        time.sleep(0.1)  # let the straggler arrive (and be dropped)
+        snap = broker.tracker.agents_snapshot()
+        assert len(snap) == 1, "one agent_id must appear exactly once"
+        assert snap[0]["epoch"] == 2
+        state = broker.tracker.distributed_state()
+        assert [a.tables for a in state.agents] == [frozenset({"new_t"})]
+    finally:
+        broker.stop()
+
+
 # -- device circuit breaker + staging --------------------------------------
 
 

@@ -1,0 +1,340 @@
+"""Cluster health plane (r10): breaker state rides heartbeats into the
+broker's tracker, planning routes around open breakers proactively, and
+the health HTTP endpoint serves the aggregated view.
+
+Ref posture: the reference's agent manager aggregates agent state for the
+query broker's tracker (tracker/agents.go) and every service exposes
+healthz/statusz (src/shared/services/); the proactive skip mirrors
+prune_unavailable_sources_rule, extended with device-health awareness.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec.router import BridgeRouter
+from pixie_tpu.plan.program_key import fragment_program_key
+from pixie_tpu.table.table_store import TableStore
+from pixie_tpu.types import DataType, Relation
+from pixie_tpu.utils import faults, flags
+from pixie_tpu.vizier import Agent, MessageBus, QueryBroker
+from pixie_tpu.vizier import agent as agent_mod
+from pixie_tpu.table.row_batch import RowBatch
+
+F, S, T = DataType.FLOAT64, DataType.STRING, DataType.TIME64NS
+REL = Relation.of(("time_", T), ("service", S), ("latency", F))
+TABLES = {"http_events": REL}
+N_ROWS = 1000
+
+AGG_QUERY = (
+    "df = px.DataFrame(table='http_events')\n"
+    "stats = df.groupby(['service']).agg(\n"
+    "    total=('latency', px.sum), n=('latency', px.count))\n"
+    "px.display(stats, 'out')\n"
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture
+def flagset():
+    saved = {}
+
+    def set_(name, value):
+        if name not in saved:
+            saved[name] = flags.get(name)
+        flags.set(name, value)
+
+    yield set_
+    for name, value in saved.items():
+        flags.set(name, value)
+
+
+class StubDevice:
+    """Device-executor stand-in: never offloads (the host engine runs
+    everything), records the program keys it was asked for, and reports
+    a configurable breaker state through the health plane."""
+
+    def __init__(self):
+        self.keys: list[str] = []
+        self.open_keys: set[str] = set()
+        self.half_open_keys: set[str] = set()
+
+    def try_execute_fragment(self, frag, table_store, registry, func_ctx=None):
+        self.keys.append(fragment_program_key(frag))
+        return None
+
+    def health_snapshot(self):
+        breaker = {
+            k: {"state": "open", "failures": 3, "open_remaining_s": 9.0}
+            for k in self.open_keys
+        }
+        breaker.update(
+            {
+                k: {
+                    "state": "half_open",
+                    "failures": 3,
+                    "open_remaining_s": 0.0,
+                }
+                for k in self.half_open_keys
+            }
+        )
+        return {
+            "breaker": breaker,
+            "breaker_open": sorted(self.open_keys),
+            "staging_depth": 0,
+            "last_fold_ms": 1.25,
+        }
+
+
+def _make_store(seed_offset, n=N_ROWS):
+    rng = np.random.default_rng(5 + seed_offset)
+    ts = TableStore()
+    t = ts.create_table("http_events", REL)
+    t.write_pydict(
+        {
+            "time_": np.arange(n) + seed_offset,
+            "service": rng.choice(["a", "b", "c"], n).astype(object),
+            "latency": rng.integers(1, 100, n).astype(np.float64),
+        }
+    )
+    t.stop()
+    return ts
+
+
+def _rows(res, name="out"):
+    batches = [b for b in res.tables.get(name, []) if b.num_rows]
+    if not batches:
+        return {}
+    return RowBatch.concat(batches).to_pydict()
+
+
+def _wait(pred, timeout=10.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, msg
+        time.sleep(0.02)
+
+
+@pytest.fixture
+def health_cluster(monkeypatch):
+    """Two PEMs with stub device executors + kelvin, all on a local bus."""
+    monkeypatch.setattr(agent_mod, "HEARTBEAT_INTERVAL_S", 0.05)
+    bus = MessageBus()
+    router = BridgeRouter()
+    broker = QueryBroker(bus, router, table_relations=TABLES)
+    stubs = {"pem1": StubDevice(), "pem2": StubDevice()}
+    agents = [
+        Agent(
+            "pem1", bus, router, table_store=_make_store(0),
+            device_executor=stubs["pem1"],
+        ),
+        Agent(
+            "pem2", bus, router, table_store=_make_store(10**6),
+            device_executor=stubs["pem2"],
+        ),
+        Agent("kelvin", bus, router, is_kelvin=True),
+    ]
+    for a in agents:
+        a.start()
+    _wait(
+        lambda: len(broker.tracker.distributed_state().agents) >= 3,
+        msg="agents never registered",
+    )
+    yield broker, agents, stubs
+    broker.stop()
+    for a in agents:
+        a.stop()
+
+
+def _learned_key(broker, stubs):
+    """Run one clean query so the stubs learn this shape's program key."""
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    assert stubs["pem2"].keys, "stub never saw the fragment"
+    return stubs["pem2"].keys[-1]
+
+
+def test_open_breaker_skips_agent_at_planning(health_cluster):
+    """Acceptance: with a breaker forced open on one agent for this
+    query's program shape, a new query skips that agent AT PLANNING TIME
+    (reason recorded in degraded.skipped) rather than tripping
+    mid-query; the broker-side key matches the agent-side key."""
+    broker, _, stubs = health_cluster
+    key = _learned_key(broker, stubs)
+    stubs["pem2"].open_keys = {key}
+    _wait(
+        lambda: "pem2" in broker.tracker.open_breaker_keys(),
+        msg="breaker state never reached the tracker",
+    )
+    events = []
+    res = broker.execute_script(
+        AGG_QUERY, timeout_s=30, on_event=lambda qid, ev: events.append(ev)
+    )
+    assert res.degraded is not None
+    assert {"agent_id": "pem2", "reason": "breaker_open"} in res.degraded[
+        "skipped"
+    ]
+    assert "pem2" in res.degraded["skipped_agents"]
+    assert "breaker_open" in res.degraded["reasons"]
+    assert {"type": "agent_skipped", "agent_id": "pem2",
+            "reason": "breaker_open"} in events
+    rows = _rows(res)
+    assert sum(rows["n"]) == N_ROWS, "only pem1's shard, complete"
+    # pem2 was never asked to execute the sick shape again.
+    assert stubs["pem2"].keys.count(key) == 1
+
+
+def test_half_open_breaker_plans_normally(health_cluster):
+    """A half-open breaker admits its trial: the agent is planned
+    normally and the query is complete."""
+    broker, _, stubs = health_cluster
+    key = _learned_key(broker, stubs)
+    stubs["pem2"].half_open_keys = {key}
+    time.sleep(0.15)  # a couple of heartbeats
+    assert "pem2" not in broker.tracker.open_breaker_keys()
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    assert sum(_rows(res)["n"]) == 2 * N_ROWS
+
+
+def test_unrelated_open_breaker_does_not_skip(health_cluster):
+    """An open breaker for a DIFFERENT program shape is ignored: the
+    skip is shape-targeted, not agent-global."""
+    broker, _, stubs = health_cluster
+    _learned_key(broker, stubs)
+    stubs["pem2"].open_keys = {"SomeOtherOp|other_table"}
+    _wait(lambda: "pem2" in broker.tracker.open_breaker_keys())
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    assert sum(_rows(res)["n"]) == 2 * N_ROWS
+
+
+def test_all_agents_sick_falls_back_to_original_plan(health_cluster):
+    """When EVERY capable agent has an open breaker for the shape, the
+    broker runs the original plan (degraded data beats no data) instead
+    of failing planning."""
+    broker, _, stubs = health_cluster
+    key = _learned_key(broker, stubs)
+    stubs["pem1"].open_keys = {key}
+    stubs["pem2"].open_keys = {key}
+    _wait(
+        lambda: set(broker.tracker.open_breaker_keys()) >= {"pem1", "pem2"}
+    )
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    assert sum(_rows(res)["n"]) == 2 * N_ROWS
+
+
+def test_health_plane_flag_off_disables_skip(health_cluster, flagset):
+    broker, _, stubs = health_cluster
+    key = _learned_key(broker, stubs)
+    stubs["pem2"].open_keys = {key}
+    _wait(lambda: "pem2" in broker.tracker.open_breaker_keys())
+    flagset("health_plane", False)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    assert sum(_rows(res)["n"]) == 2 * N_ROWS
+
+
+def test_on_event_streams_agent_error_inline(health_cluster):
+    """Streaming-degradation satellite: on_event fires for a mid-query
+    agent error with the same information the final annotation carries."""
+    broker, _, _ = health_cluster
+    faults.arm("agent.execute@pem2", count=1)
+    events = []
+    res = broker.execute_script(
+        AGG_QUERY, timeout_s=30, on_event=lambda qid, ev: events.append(ev)
+    )
+    assert res.degraded is not None
+    errs = [e for e in events if e["type"] == "agent_error"]
+    assert len(errs) == 1 and errs[0]["agent_id"] == "pem2"
+    assert "fault injected" in errs[0]["error"]
+    assert errs[0]["error"] == res.degraded["agent_errors"]["pem2"]
+
+
+def test_on_event_callback_errors_are_swallowed(health_cluster):
+    broker, _, _ = health_cluster
+    faults.arm("agent.execute@pem2", count=1)
+
+    def bad_callback(qid, ev):
+        raise RuntimeError("consumer bug")
+
+    res = broker.execute_script(AGG_QUERY, timeout_s=30, on_event=bad_callback)
+    assert res.degraded is not None  # the query itself is unaffected
+    assert sum(_rows(res)["n"]) == N_ROWS
+
+
+def test_health_view_and_snapshot_carry_device_health(health_cluster):
+    broker, _, stubs = health_cluster
+    key = _learned_key(broker, stubs)
+    stubs["pem2"].open_keys = {key}
+    _wait(lambda: "pem2" in broker.tracker.open_breaker_keys())
+    view = broker.tracker.health_view()
+    assert view["pem2"]["alive"]
+    assert view["pem2"]["health"]["breaker_open"] == [key]
+    assert view["pem2"]["health"]["last_fold_ms"] == 1.25
+    assert view["kelvin"]["health"] is None  # no device executor
+    snap = {r["agent_id"]: r for r in broker.tracker.agents_snapshot()}
+    assert snap["pem2"]["breaker_open"] == 1
+    assert snap["pem1"]["breaker_open"] == 0
+    assert snap["pem2"]["epoch"] >= 1
+
+
+def test_health_endpoint_serves_aggregated_view(health_cluster):
+    """health.py endpoint satellite: /statusz carries the cluster health
+    view, /agentz the GetAgentStatus-shaped rows, /healthz liveness."""
+    broker, _, stubs = health_cluster
+    key = _learned_key(broker, stubs)
+    stubs["pem2"].open_keys = {key}
+    _wait(lambda: "pem2" in broker.tracker.open_breaker_keys())
+    srv = broker.start_health_server()
+    host, port = srv.address[:2]
+    base = f"http://{host}:{port}"
+    try:
+        assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok"
+        status = json.load(urllib.request.urlopen(f"{base}/statusz"))
+        ch = status["status"]["cluster_health"]
+        assert ch["pem2"]["health"]["breaker_open"] == [key]
+        assert ch["pem2"]["alive"] is True
+        agents = json.load(urllib.request.urlopen(f"{base}/agentz"))
+        by_id = {r["agent_id"]: r for r in agents}
+        assert by_id["pem2"]["breaker_open"] == 1
+        assert by_id["kelvin"]["kelvin"] is True
+    finally:
+        broker.stop()  # also stops the health server
+
+
+def test_mesh_breaker_snapshot_states(monkeypatch):
+    """MeshExecutor.breaker_snapshot maps raw breaker entries to health
+    states (open while cooling down, half_open after, degrading below
+    the threshold) without needing a device failure."""
+    import jax
+    from jax.sharding import Mesh
+
+    from pixie_tpu.parallel import MeshExecutor
+
+    mesh = Mesh(np.array(jax.devices("cpu")), ("d",))
+    dev = MeshExecutor(mesh=mesh, block_rows=1024)
+    now = time.monotonic()
+    dev._breaker = {
+        "k_open": [3, now + 5.0],
+        "k_half": [3, now - 0.1],
+        "k_degrading": [1, 0.0],
+    }
+    snap = dev.breaker_snapshot()
+    assert snap["k_open"]["state"] == "open"
+    assert snap["k_open"]["open_remaining_s"] > 0
+    assert snap["k_half"]["state"] == "half_open"
+    assert snap["k_degrading"]["state"] == "degrading"
+    health = dev.health_snapshot()
+    assert health["breaker_open"] == ["k_open"]
+    assert health["staging_depth"] == 0

@@ -1,7 +1,8 @@
-"""Fault-injection overhead microbench (r9 acceptance gate).
+"""Fault-injection + acked-transport overhead microbench (r9/r10 gates).
 
 Proves the disabled injection sites cost <1% on (a) the warm device agg
-path and (b) the transport round-trip. Method:
+path and (b) the transport round-trip, and (r10) that the ack-window
+bookkeeping costs <1% when DISABLED (``transport_ack_window=0``). Method:
 
 1. ``per_check_ns`` — cost of the call-site idiom with nothing armed
    (``faults.ACTIVE and faults.fires(site)``: one attribute load + branch)
@@ -13,12 +14,18 @@ path and (b) the transport round-trip. Method:
 3. ``overhead_pct = checks_per_op * per_check_ns / op_ns * 100`` for both
    paths, plus a direct A/B of the warm query with the registry idle vs a
    foreign site armed.
+4. Acked-vs-disabled transport comparison (r10): RTT and one-way
+   windowed throughput with the default ack window vs
+   ``transport_ack_window=0``; the modeled <1% disabled gate re-runs on
+   the window-disabled plane (that configuration IS the r9-equivalent
+   hot path plus the ack bookkeeping branches).
 
 Prints ONE JSON line on stdout. With MB_WRITE_BENCH_DETAIL=1, merges the
-headline numbers into BENCH_DETAIL.json under the ``fault_overhead`` key.
+headline numbers into BENCH_DETAIL.json under the ``fault_overhead`` and
+``ack_overhead`` keys.
 
 Env knobs: MB_ROWS (default 200k), MB_WARM_RUNS (default 20),
-MB_RTT_MSGS (default 400), JAX_PLATFORMS.
+MB_RTT_MSGS (default 400), MB_THRPT_MSGS (default 2000), JAX_PLATFORMS.
 """
 
 import json
@@ -36,6 +43,9 @@ SITES = (
     "transport.send_data",
     "transport.recv_dup",
     "transport.handshake",
+    "transport.ack_drop",
+    "transport.replay_dup",
+    "transport.conn_kill_midflight",
     "agent.heartbeat",
     "agent.execute",
     "agent.execute_hang",
@@ -163,7 +173,7 @@ def main() -> None:
 
     rtt(50)  # warm
     faults.reset()
-    rtt_idle_ns = rtt(rtt_msgs)
+    rtt_idle_ns = rtt(rtt_msgs)  # default window: the acked transport
     for s in SITES:
         faults.arm(s, p=0.0)
     rtt(rtt_msgs)
@@ -172,11 +182,86 @@ def main() -> None:
     faults.reset()
     rtt_overhead_pct = 100.0 * rtt_checks * armed_ns / rtt_idle_ns
     log(
-        f"transport rtt: {rtt_idle_ns/1e3:.1f}us, {rtt_checks:.2f} checks/rt "
-        f"-> {rtt_overhead_pct:.4f}%"
+        f"transport rtt (acked): {rtt_idle_ns/1e3:.1f}us, "
+        f"{rtt_checks:.2f} checks/rt -> {rtt_overhead_pct:.4f}%"
     )
+
+    # -- acked vs disabled ack window (r10) ----------------------------------
+    from pixie_tpu.utils import flags
+
+    thrpt_msgs = int(os.environ.get("MB_THRPT_MSGS", 2000))
+
+    def throughput(rb, topic, sub, n):
+        t0 = time.perf_counter_ns()
+        for i in range(n):
+            rb.publish(topic, {"i": i})
+        got = 0
+        while got < n:
+            if sub.get(timeout=10.0) is None:
+                break
+            got += 1
+        assert got == n, f"throughput run lost messages ({got}/{n})"
+        return n / ((time.perf_counter_ns() - t0) / 1e9)
+
+    thr_sub = bus.subscribe("mb/thr")
+    throughput(rbus, "mb/thr", thr_sub, 200)  # warm
+    thrpt_ack = throughput(rbus, "mb/thr", thr_sub, thrpt_msgs)
     rbus.close()
+
+    saved_window = flags.get("transport_ack_window")
+    flags.set("transport_ack_window", 0)  # disables all ack bookkeeping
+    try:
+        rbus0 = RemoteBus(server.address)
+        sub0 = bus.subscribe("mb/noack")
+
+        def rtt0(k):
+            t0 = time.perf_counter_ns()
+            for i in range(k):
+                rbus0.publish("mb/noack", {"i": i})
+                got = sub0.get(timeout=5.0)
+                assert got is not None
+            return (time.perf_counter_ns() - t0) / k
+
+        rtt0(50)
+        faults.reset()
+        rtt_noack_ns = rtt0(rtt_msgs)
+        for s in SITES:
+            faults.arm(s, p=0.0)
+        rtt0(rtt_msgs)
+        noack_checks = sum(
+            ck for ck, _ in faults.stats().values()
+        ) / rtt_msgs
+        faults.reset()
+        noack_overhead_pct = 100.0 * noack_checks * armed_ns / rtt_noack_ns
+        thr_sub0 = bus.subscribe("mb/thr0")
+        throughput(rbus0, "mb/thr0", thr_sub0, 200)  # warm
+        thrpt_noack = throughput(rbus0, "mb/thr0", thr_sub0, thrpt_msgs)
+        rbus0.close()
+    finally:
+        flags.set("transport_ack_window", saved_window)
     server.stop()
+    ack_overhead = {
+        "rtt_ack_us": round(rtt_idle_ns / 1e3, 2),
+        "rtt_noack_us": round(rtt_noack_ns / 1e3, 2),
+        "rtt_ack_delta_pct": round(
+            100.0 * (rtt_idle_ns - rtt_noack_ns) / rtt_noack_ns, 2
+        ),
+        "thrpt_ack_msgs_s": round(thrpt_ack),
+        "thrpt_noack_msgs_s": round(thrpt_noack),
+        "thrpt_ack_delta_pct": round(
+            100.0 * (thrpt_ack - thrpt_noack) / thrpt_noack, 2
+        ),
+        "noack_modeled_overhead_pct": round(noack_overhead_pct, 5),
+        "pass_under_1pct": bool(noack_overhead_pct < 1.0),
+    }
+    log(
+        f"ack window: rtt {ack_overhead['rtt_ack_us']}us acked vs "
+        f"{ack_overhead['rtt_noack_us']}us disabled "
+        f"({ack_overhead['rtt_ack_delta_pct']:+.1f}%), thrpt "
+        f"{ack_overhead['thrpt_ack_msgs_s']}/s vs "
+        f"{ack_overhead['thrpt_noack_msgs_s']}/s; disabled modeled "
+        f"{ack_overhead['noack_modeled_overhead_pct']:.4f}%"
+    )
 
     out = {
         "fault_check_disabled_ns": round(disabled_ns, 2),
@@ -189,21 +274,27 @@ def main() -> None:
         "transport_checks_per_rtt": round(rtt_checks, 2),
         "transport_overhead_pct": round(rtt_overhead_pct, 5),
         "pass_under_1pct": bool(
-            warm_overhead_pct < 1.0 and rtt_overhead_pct < 1.0
+            warm_overhead_pct < 1.0
+            and rtt_overhead_pct < 1.0
+            and ack_overhead["pass_under_1pct"]
         ),
         "platform": jax.devices()[0].platform,
     }
+    out["ack_overhead"] = ack_overhead
     print(json.dumps(out))
 
     if os.environ.get("MB_WRITE_BENCH_DETAIL") == "1":
         path = os.path.join(os.path.dirname(__file__), "..", "BENCH_DETAIL.json")
         with open(path) as f:
             detail = json.load(f)
-        detail["fault_overhead"] = out
+        detail["fault_overhead"] = {
+            k: v for k, v in out.items() if k != "ack_overhead"
+        }
+        detail["ack_overhead"] = ack_overhead
         with open(path, "w") as f:
             json.dump(detail, f, indent=1)
             f.write("\n")
-        log("BENCH_DETAIL.json updated (fault_overhead)")
+        log("BENCH_DETAIL.json updated (fault_overhead, ack_overhead)")
 
     if not out["pass_under_1pct"]:
         sys.exit(1)
