@@ -330,7 +330,9 @@ def main() -> None:
         f"prewarm_compile={flags.prewarm_compile} "
         f"fault_inject={flags.fault_inject or 'off'} "
         f"device_breaker={flags.device_breaker_threshold}"
-        f"@{flags.device_breaker_cooldown_s}s"
+        f"@{flags.device_breaker_cooldown_s}s "
+        f"query_tracing={flags.query_tracing} "
+        f"self_telemetry_interval_s={flags.self_telemetry_interval_s}"
     )
     carnot = Carnot(
         device_executor=MeshExecutor(mesh=mesh, block_rows=block_rows)

@@ -19,6 +19,7 @@ from pixie_tpu.plan.operators import BridgeSinkOp, InlineSourceOp
 from pixie_tpu.plan.plan import Plan, PlanFragment
 from pixie_tpu.table.row_batch import RowBatch
 from pixie_tpu.table.table_store import TableStore
+from pixie_tpu.utils import flags, trace
 
 
 @dataclasses.dataclass
@@ -38,13 +39,57 @@ class QueryResult:
     # the deadline), ``skipped_agents`` (planning never covered them),
     # ``skipped`` (r10: [{agent_id, reason}] with reason
     # ``heartbeat_expired`` or ``breaker_open``), ``forward_dropped``
-    # (result messages lost in the broker's forwarder).
+    # (result messages lost in the broker's forwarder), ``trace_id``
+    # (r11: joins the annotation to the query's span tree).
     degraded: Optional[dict] = None
+    # Finished trace spans for this query (r11), merged across agents by
+    # trace_id — wire-shaped dicts (utils/trace.py Span.to_dict). None
+    # when query_tracing is off.
+    trace_spans: Optional[list] = None
 
     @property
     def ok(self) -> bool:
         """True when the result is complete (no degraded annotation)."""
         return self.degraded is None
+
+    @property
+    def profile(self) -> Optional[dict]:
+        """The assembled query trace (r11): a span forest covering
+        broker, every participating agent, each exec node, and per-window
+        device phases — with degraded agents marked. None when tracing
+        was off for the query."""
+        if self.trace_spans is None:
+            return None
+        roots = trace.build_tree(self.trace_spans)
+        agents = sorted(
+            {
+                s["instance"]
+                for s in self.trace_spans
+                if s.get("name") == "agent.execute"
+            }
+        )
+        out = {
+            "trace_id": self.query_id,
+            "span_count": len(self.trace_spans),
+            "agents": agents,
+            "roots": roots,
+        }
+        if self.degraded is not None:
+            # Mark agents whose span subtree is missing or truncated.
+            out["degraded"] = {
+                "reasons": list(self.degraded.get("reasons", ())),
+                "lost_agents": list(self.degraded.get("lost_agents", ())),
+                "timed_out_agents": list(
+                    self.degraded.get("timed_out_agents", ())
+                ),
+                "skipped_agents": list(
+                    self.degraded.get("skipped_agents", ())
+                ),
+                "error_agents": sorted(
+                    self.degraded.get("agent_errors", {})
+                ),
+            }
+        return out
 
     def table(self, name: str = None) -> dict:
         if name is None:
@@ -118,6 +163,15 @@ class Carnot:
         # hot source→map/filter→agg chain run as ONE compiled shard_map
         # program on the device mesh; the host exec graph runs the suffix.
         self.device_executor = device_executor
+        # Self-telemetry tables (r11): every engine instance owns
+        # query_spans/engine_metrics tables so PxL can query the engine
+        # about itself (ref: stirling_error/probe_status dogfooding).
+        # Created eagerly so the compiler sees their relations; rows land
+        # on demand (execute_plan flush) or via the ingest connector.
+        if flags.query_tracing:
+            from pixie_tpu.ingest.self_telemetry import ensure_tables
+
+            ensure_tables(self.table_store)
         if device_executor is not None and hasattr(
             device_executor, "prewarm_table"
         ):
@@ -143,18 +197,33 @@ class Carnot:
         exec_funcs=None,
     ) -> QueryResult:
         qid = query_id or str(uuid.uuid4())
-        t0 = time.perf_counter_ns()
-        plan = self.compiler.compile(
-            query,
-            self.table_store.relation_map(),
-            now_ns=now_ns,
-            script_args=script_args,
-            query_id=qid,
-            exec_funcs=exec_funcs,
+        # Local root span (r11): a standalone engine produces the same
+        # trace shape the broker path does, rooted at the query_id. When
+        # an ambient context exists (an agent executing a broker plan
+        # calls execute_plan directly), this path is not taken.
+        root = trace.begin(
+            "query", trace_id=qid, parent_id="", instance=self.instance
         )
-        compile_ns = time.perf_counter_ns() - t0
-        result = self.execute_plan(plan, analyze=analyze)
+        t0 = time.perf_counter_ns()
+        with trace.context_of(root):
+            with trace.span("compile", instance=self.instance):
+                plan = self.compiler.compile(
+                    query,
+                    self.table_store.relation_map(),
+                    now_ns=now_ns,
+                    script_args=script_args,
+                    query_id=qid,
+                    exec_funcs=exec_funcs,
+                )
+            compile_ns = time.perf_counter_ns() - t0
+            result = self.execute_plan(plan, analyze=analyze)
         result.compile_time_ns = compile_ns
+        if root is not None:
+            trace.finish(root)
+            result.trace_spans = sorted(
+                (s.to_dict() for s in trace.spans_for(qid)),
+                key=lambda s: s["start_unix_ns"],
+            )
         return result
 
     def execute_plan(
@@ -192,52 +261,80 @@ class Carnot:
                     if isinstance(op, BridgeSinkOp):
                         self.router.register_producer(qid, op.bridge_id)
 
+        # Self-telemetry read path (r11): a plan reading the engine's own
+        # query_spans/engine_metrics tables gets the freshest buffered
+        # spans/metric samples flushed in before sources open — PxL can
+        # profile a query that finished microseconds ago without waiting
+        # for the periodic ingest connector.
+        if flags.query_tracing:
+            from pixie_tpu.ingest import self_telemetry
+
+            if self_telemetry.plan_reads_telemetry(plan):
+                self_telemetry.flush_into(self.table_store)
+
         exec_stats: dict[str, dict] = {}
         t0 = time.perf_counter_ns()
         try:
             # Producer fragments run before consumers (the reference runs
             # them concurrently across agents; one engine instance runs its
             # own fragments in dependency order — bridge queues buffer).
+            ambient = trace.current()
             for frag in plan.fragment_topo_order():
-                state = ExecState(
-                    qid,
-                    self.table_store,
-                    self.registry,
-                    router=self.router,
-                    metadata_state=self.metadata_state,
-                    result_callback=on_result,
+                fspan = trace.span(
+                    "fragment",
+                    # Without an ambient context (bare execute_plan), the
+                    # fragment spans still join the query's trace: the
+                    # query_id is the trace_id.
+                    trace_id=None if ambient else qid,
                     instance=self.instance,
-                    vizier_ctx=self.vizier_ctx,
-                    otel_exporter=self.otel_exporter,
-                    deadline=deadline,
+                    attrs={"fragment_id": frag.fragment_id},
                 )
-                if self.device_executor is not None:
-                    offloaded = self.device_executor.try_execute_fragment(
-                        frag, self.table_store, self.registry, state.func_ctx
+                with fspan:
+                    state = ExecState(
+                        qid,
+                        self.table_store,
+                        self.registry,
+                        router=self.router,
+                        metadata_state=self.metadata_state,
+                        result_callback=on_result,
+                        instance=self.instance,
+                        vizier_ctx=self.vizier_ctx,
+                        otel_exporter=self.otel_exporter,
+                        deadline=deadline,
                     )
-                    if offloaded is not None:
-                        agg_nid, batch = offloaded
-                        key = f"device:{frag.fragment_id}:{agg_nid}"
-                        # Windowed device aggs return one batch PER WINDOW
-                        # (eow-cadenced, like the host AggNode).
-                        batches = batch if isinstance(batch, list) else [batch]
-                        state.inline_batches[key] = batches
-                        # StateBatches (PARTIAL offload) carry no relation;
-                        # resolve the agg op's declared output instead.
-                        rel = getattr(batches[0], "relation", None)
-                        if rel is None:
-                            rel = frag.resolve_relations(
-                                self.registry,
-                                lambda op: self.table_store.get_relation(
-                                    op.table_name
-                                ),
-                            )[agg_nid]
-                        frag = _splice_inline_source(frag, agg_nid, key, rel)
-                graph = ExecutionGraph(frag, state)
-                graph.execute()
-                if analyze:
-                    for name, s in graph.stats().items():
-                        exec_stats[f"f{frag.fragment_id}/{name}"] = s
+                    if self.device_executor is not None:
+                        offloaded = self.device_executor.try_execute_fragment(
+                            frag, self.table_store, self.registry,
+                            state.func_ctx,
+                        )
+                        if offloaded is not None:
+                            agg_nid, batch = offloaded
+                            key = f"device:{frag.fragment_id}:{agg_nid}"
+                            # Windowed device aggs return one batch PER
+                            # WINDOW (eow-cadenced, like the host AggNode).
+                            batches = (
+                                batch if isinstance(batch, list) else [batch]
+                            )
+                            state.inline_batches[key] = batches
+                            # StateBatches (PARTIAL offload) carry no
+                            # relation; resolve the agg op's declared
+                            # output instead.
+                            rel = getattr(batches[0], "relation", None)
+                            if rel is None:
+                                rel = frag.resolve_relations(
+                                    self.registry,
+                                    lambda op: self.table_store.get_relation(
+                                        op.table_name
+                                    ),
+                                )[agg_nid]
+                            frag = _splice_inline_source(
+                                frag, agg_nid, key, rel
+                            )
+                    graph = ExecutionGraph(frag, state)
+                    graph.execute()
+                    if analyze:
+                        for name, s in graph.stats().items():
+                            exec_stats[f"f{frag.fragment_id}/{name}"] = s
         finally:
             if manage_router:
                 self.router.cleanup_query(qid)

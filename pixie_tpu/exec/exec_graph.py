@@ -184,6 +184,36 @@ class ExecutionGraph:
             finally:
                 for nid in reversed(order):
                     self.nodes[nid].close(st)
+                self._emit_node_spans()
+
+    def _emit_node_spans(self) -> None:
+        """Per-exec-node trace spans (r11): one span per operator node
+        carrying its lifetime self-time and rows/batches in/out — emitted
+        once at fragment end (never per batch, so the hot ConsumeNext
+        path pays nothing). Parented to the fragment span captured in the
+        exec state's trace context."""
+        from pixie_tpu.utils import trace
+
+        tctx = getattr(self.exec_state, "trace_ctx", None)
+        if not trace.ACTIVE or not tctx:
+            return
+        for node in self.nodes.values():
+            s = node.stats
+            trace.record(
+                f"exec:{node.name}",
+                s.self_time_ns,
+                trace_id=tctx[0],
+                parent_id=tctx[1],
+                instance=self.exec_state.instance,
+                attrs={
+                    "rows_in": s.rows_in,
+                    "rows_out": s.rows_out,
+                    "batches_in": s.batches_in,
+                    "batches_out": s.batches_out,
+                    "bytes_in": s.bytes_in,
+                    "bytes_out": s.bytes_out,
+                },
+            )
 
     def _execute_sources(self, timeout_s, yield_fn) -> None:
         """Round-robin source loop (ref: ExecuteSources, exec_graph.cc:177).

@@ -11,6 +11,8 @@ import dataclasses
 import time
 from typing import Any, Callable, Optional
 
+from pixie_tpu.utils import trace
+
 
 class QueryDeadlineExceeded(TimeoutError):
     """A query's propagated hard deadline expired (ref: the forwarder's
@@ -83,6 +85,10 @@ class ExecState:
         # Set by cancel(): why this query was aborted (deadline, broker
         # cancellation, source stall) — surfaced in errors/annotations.
         self.cancel_reason: Optional[str] = None
+        # Trace context (r11): captured at construction so nodes running
+        # on other threads (and the exec graph's end-of-run per-node span
+        # emission) can parent to the fragment span even off this thread.
+        self.trace_ctx: Optional[tuple] = trace.current()
 
     def compute_device(self):
         if self.compute_backend is None:

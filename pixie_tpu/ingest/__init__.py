@@ -24,6 +24,7 @@ from pixie_tpu.ingest.proc_stats import (
     NetworkStatsConnector,
     ProcessStatsConnector,
 )
+from pixie_tpu.ingest.self_telemetry import SelfTelemetrySourceConnector
 
 __all__ = [
     "DataTable",
@@ -31,6 +32,7 @@ __all__ = [
     "IngestCore",
     "NetworkStatsConnector",
     "ProcessStatsConnector",
+    "SelfTelemetrySourceConnector",
     "SeqGenConnector",
     "SourceConnector",
 ]

@@ -1,0 +1,198 @@
+"""Self-telemetry: the engine's own spans + metrics as queryable tables.
+
+Ref: src/stirling/source_connectors/stirling_error/ and the reference's
+`probe_status` table — the engine reports on ITSELF through the same
+table/query machinery the observability data flows through. Here two
+tables land on every node:
+
+  query_spans     finished trace spans (utils/trace.py): one row per
+                  span with trace_id/span_id/parent_id, timings, status,
+                  and a JSON attrs blob — `px/query_profile` reconstructs
+                  a query's phase breakdown from it.
+  engine_metrics  point-in-time samples of the shared MetricsRegistry
+                  (counters, gauges, histogram _sum/_count series), so
+                  `transport_dedup_dropped_total` and friends are one
+                  PxL filter away.
+
+Two consumption paths share ``flush_into``: the periodic
+SelfTelemetrySourceConnector (registered in an IngestCore, cadence
+``self_telemetry_interval_s``) for PEM deployments, and an on-demand
+flush in Carnot.execute_plan when a plan reads either table — a query
+that finished microseconds ago is immediately profilable.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from pixie_tpu.ingest.source_connector import DataTable, SourceConnector
+from pixie_tpu.types import DataType, Relation, SemanticType
+from pixie_tpu.utils import metrics_registry, trace
+from pixie_tpu.utils.config import define_flag, flags
+
+define_flag(
+    "self_telemetry_interval_s",
+    1.0,
+    help_="Sampling/push period of the self-telemetry source connector "
+    "(ingest/self_telemetry.py): how often finished trace spans and "
+    "metric samples drain into the node's query_spans/engine_metrics "
+    "tables.",
+)
+
+I, F, S, T = (
+    DataType.INT64,
+    DataType.FLOAT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+QUERY_SPANS_TABLE = "query_spans"
+ENGINE_METRICS_TABLE = "engine_metrics"
+
+QUERY_SPANS_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),  # span START time
+    ("trace_id", S),
+    ("span_id", S),
+    ("parent_id", S),
+    ("name", S),
+    ("instance", S),
+    ("status", S),
+    ("duration_ns", I),
+    ("attrs", S),  # JSON-encoded key/value attributes
+)
+
+ENGINE_METRICS_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("name", S),
+    ("kind", S),
+    ("labels", S),  # JSON-encoded label set
+    ("value", F),
+)
+
+
+def ensure_tables(store) -> None:
+    """Create the self-telemetry tables in a TableStore when missing."""
+    if store.get_table(QUERY_SPANS_TABLE) is None:
+        store.create_table(QUERY_SPANS_TABLE, QUERY_SPANS_REL)
+    if store.get_table(ENGINE_METRICS_TABLE) is None:
+        store.create_table(ENGINE_METRICS_TABLE, ENGINE_METRICS_REL)
+
+
+def plan_reads_telemetry(plan) -> bool:
+    """True when any fragment's memory source reads a self-telemetry
+    table (the on-demand flush trigger in Carnot.execute_plan)."""
+    from pixie_tpu.plan.operators import MemorySourceOp
+
+    for frag in plan.fragments:
+        for nid in frag.nodes():
+            op = frag.node(nid)
+            if isinstance(op, MemorySourceOp) and op.table_name in (
+                QUERY_SPANS_TABLE,
+                ENGINE_METRICS_TABLE,
+            ):
+                return True
+    return False
+
+
+def spans_to_columns(spans) -> dict:
+    """Finished spans -> query_spans column dict."""
+    return {
+        "time_": np.array(
+            [s.start_unix_ns for s in spans], np.int64
+        ),
+        "trace_id": np.array([s.trace_id for s in spans], dtype=object),
+        "span_id": np.array([s.span_id for s in spans], dtype=object),
+        "parent_id": np.array([s.parent_id for s in spans], dtype=object),
+        "name": np.array([s.name for s in spans], dtype=object),
+        "instance": np.array([s.instance for s in spans], dtype=object),
+        "status": np.array([s.status for s in spans], dtype=object),
+        "duration_ns": np.array([s.duration_ns for s in spans], np.int64),
+        "attrs": np.array(
+            [json.dumps(s.attrs, sort_keys=True, default=str)
+             for s in spans],
+            dtype=object,
+        ),
+    }
+
+
+def metrics_to_columns(now_ns: int) -> dict:
+    """One sample row per (metric, label set) from the shared registry.
+    Histograms expose their ``_sum``/``_count`` series (bucket vectors
+    stay on /metrics where the exposition format carries them)."""
+    reg = metrics_registry()
+    times, names, kinds, labels, values = [], [], [], [], []
+
+    def add(name, kind, key, value):
+        times.append(now_ns)
+        names.append(name)
+        kinds.append(kind)
+        labels.append(json.dumps(dict(key), sort_keys=True))
+        values.append(float(value))
+
+    for name, samples in reg.collect().items():
+        for key, val in samples.items():
+            if isinstance(val, dict):  # histogram state
+                add(f"{name}_sum", "histogram", key, val["sum"])
+                add(f"{name}_count", "histogram", key, val["count"])
+            else:
+                add(name, "scalar", key, val)
+    return {
+        "time_": np.array(times, np.int64),
+        "name": np.array(names, dtype=object),
+        "kind": np.array(kinds, dtype=object),
+        "labels": np.array(labels, dtype=object),
+        "value": np.array(values, np.float64),
+    }
+
+
+def flush_into(store, include_metrics: bool = True) -> int:
+    """Drain the finished-span buffer (and sample the metrics registry)
+    directly into a TableStore's self-telemetry tables. Returns the
+    number of span rows written. Shared by the on-demand read path and
+    available to embedders that run no IngestCore."""
+    ensure_tables(store)
+    written = 0
+    spans = trace.drain()
+    if spans:
+        store.get_table(QUERY_SPANS_TABLE).write_pydict(
+            spans_to_columns(spans)
+        )
+        written = len(spans)
+    if include_metrics:
+        cols = metrics_to_columns(time.time_ns())
+        if len(cols["time_"]):
+            store.get_table(ENGINE_METRICS_TABLE).write_pydict(cols)
+    return written
+
+
+class SelfTelemetrySourceConnector(SourceConnector):
+    """Periodically drains finished spans and metric samples into
+    DataTables, pushed like any other connector (ref: stirling_error's
+    connector shape)."""
+
+    name = "self_telemetry"
+
+    def __init__(self, interval_s: "float | None" = None):
+        period = (
+            interval_s
+            if interval_s is not None
+            else flags.self_telemetry_interval_s
+        )
+        self.sample_period_s = period
+        self.push_period_s = period
+        super().__init__()
+        self.tables = [
+            DataTable(QUERY_SPANS_TABLE, QUERY_SPANS_REL),
+            DataTable(ENGINE_METRICS_TABLE, ENGINE_METRICS_REL),
+        ]
+
+    def transfer_data_impl(self, ctx) -> None:
+        spans = trace.drain()
+        if spans:
+            self.tables[0].append_columns(spans_to_columns(spans))
+        cols = metrics_to_columns(time.time_ns())
+        if len(cols["time_"]):
+            self.tables[1].append_columns(cols)

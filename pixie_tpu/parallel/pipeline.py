@@ -82,7 +82,7 @@ from pixie_tpu.table.column import DictColumn, StringDictionary
 from pixie_tpu.table.row_batch import RowBatch
 from pixie_tpu.types import DataType
 from pixie_tpu.udf.udf import Executor, MergeKind
-from pixie_tpu.utils import faults, flags, metrics_registry
+from pixie_tpu.utils import faults, flags, metrics_registry, trace
 
 _M = metrics_registry()
 _OFFLOAD_HITS = _M.counter(
@@ -573,6 +573,11 @@ class MeshExecutor:
         self._breaker_lock = threading.Lock()
         # Last successful device-fold wall time (ms) for the health plane.
         self.last_fold_ms: "float | None" = None
+        # Per-program-key fold-latency reservoir (r11): the health plane
+        # publishes live p50/p99 per query shape on every heartbeat, so
+        # /statusz shows per-phase percentiles without running a query.
+        self._fold_lat: dict[str, "collections.deque"] = {}
+        self._fold_lat_lock = threading.Lock()
 
     # -- public -------------------------------------------------------------
     @staticmethod
@@ -615,11 +620,36 @@ class MeshExecutor:
                 }
         return out
 
+    def _record_fold_latency(self, key: str, ms: float) -> None:
+        with self._fold_lat_lock:
+            dq = self._fold_lat.get(key)
+            if dq is None:
+                dq = self._fold_lat[key] = collections.deque(maxlen=256)
+            dq.append(ms)
+
+    def fold_latency_snapshot(self) -> dict[str, dict]:
+        """program_key -> {p50_ms, p99_ms, n} over the recent fold-latency
+        reservoir (r11; rides heartbeats into the broker's health plane
+        and /statusz)."""
+        out = {}
+        with self._fold_lat_lock:
+            items = [(k, sorted(dq)) for k, dq in self._fold_lat.items()]
+        for key, lat in items:
+            if not lat:
+                continue
+            out[key] = {
+                "p50_ms": round(lat[len(lat) // 2], 3),
+                "p99_ms": round(lat[min(len(lat) - 1,
+                                        int(len(lat) * 0.99))], 3),
+                "n": len(lat),
+            }
+        return out
+
     def health_snapshot(self) -> dict:
         """Device-executor health riding agent heartbeats (r10): breaker
         state per program key, open keys (what planning matches on),
-        background-compile queue depth, and the last device-fold wall
-        time."""
+        background-compile queue depth, the last device-fold wall time,
+        and (r11) per-program-key fold-latency percentiles."""
         snap = self.breaker_snapshot()
         return {
             "breaker": snap,
@@ -628,6 +658,7 @@ class MeshExecutor:
             ),
             "staging_depth": len(self._aot_futures),
             "last_fold_ms": self.last_fold_ms,
+            "fold_latency": self.fold_latency_snapshot(),
         }
 
     def _breaker_is_open(self, key: str) -> bool:
@@ -688,7 +719,18 @@ class MeshExecutor:
             (_OFFLOAD_HITS if out is not None else _OFFLOAD_MISS).inc()
             if out is not None:
                 self._breaker_record(bkey, ok=True)
-                self.last_fold_ms = (time.perf_counter_ns() - t0) / 1e6
+                elapsed_ns = time.perf_counter_ns() - t0
+                self.last_fold_ms = elapsed_ns / 1e6
+                self._record_fold_latency(bkey, self.last_fold_ms)
+                if trace.ACTIVE:
+                    # The whole device offload (stage hit/miss + fold +
+                    # finalize) as one span; per-phase children come from
+                    # the staging/stream profiling hooks.
+                    trace.record(
+                        "device.execute",
+                        elapsed_ns,
+                        attrs={"program_key": bkey[:120]},
+                    )
             return out
         except Exception as e:
             import logging
@@ -3539,6 +3581,12 @@ class MeshExecutor:
 
         def prof(key, dt):
             COLD_PROFILE[key] = COLD_PROFILE.get(key, 0.0) + dt
+            # r11: per-stream-window device phases join the query's span
+            # tree (pack/transfer/compile/fold per window) instead of
+            # living only in the COLD_PROFILE dict. Counter-valued keys
+            # (bytes, window counts) are not durations — skipped.
+            if trace.ACTIVE and key not in ("stage_bytes", "stream_windows"):
+                trace.phase(f"device.{key}", dt)
 
         def resolve_fold(block: bool) -> bool:
             """Bind fold_fn once the AOT compile is available (or failed).

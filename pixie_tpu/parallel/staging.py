@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pixie_tpu.table.column import DictColumn
 from pixie_tpu.table.table import Table
-from pixie_tpu.utils import faults, flags
+from pixie_tpu.utils import faults, flags, trace
 
 DEFAULT_BLOCK_ROWS = 1 << 17
 
@@ -44,7 +44,10 @@ def reset_cold_profile() -> dict:
 
 
 class timed:
-    """with timed('stage'): ... — accumulates into COLD_PROFILE."""
+    """with timed('stage'): ... — accumulates into COLD_PROFILE, and
+    (r11) emits the same interval as a ``device.<key>`` trace span under
+    the running query's ambient context, so cold-path phase timings stop
+    being a bare dict and join the query's span tree."""
 
     def __init__(self, key: str):
         self.key = key
@@ -53,9 +56,10 @@ class timed:
         self.t0 = time.perf_counter()
 
     def __exit__(self, *exc):
-        COLD_PROFILE[self.key] = COLD_PROFILE.get(self.key, 0.0) + (
-            time.perf_counter() - self.t0
-        )
+        dt = time.perf_counter() - self.t0
+        COLD_PROFILE[self.key] = COLD_PROFILE.get(self.key, 0.0) + dt
+        if trace.ACTIVE:
+            trace.phase(f"device.{self.key}", dt)
         return False
 
 

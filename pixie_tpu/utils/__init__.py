@@ -1,15 +1,23 @@
 """Shared runtime utilities: metrics registry + flag/config system +
-fault-injection registry."""
+fault-injection registry + distributed query tracing."""
 
 from pixie_tpu.utils import faults
+from pixie_tpu.utils import trace
 from pixie_tpu.utils.config import define_flag, flags
-from pixie_tpu.utils.metrics import Counter, Gauge, metrics_registry
+from pixie_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    metrics_registry,
+)
 
 __all__ = [
     "Counter",
     "Gauge",
+    "Histogram",
     "metrics_registry",
     "define_flag",
     "flags",
     "faults",
+    "trace",
 ]

@@ -60,6 +60,82 @@ class Gauge(_Metric):
         self.inc(-amount, **labels)
 
 
+# Fixed exponential buckets: 0.1ms .. ~26s upper bounds (x2 per step),
+# +Inf implicit. Chosen for span/ack latencies: sub-ms transport acks land
+# in the low buckets, multi-second cold folds in the high ones.
+DEFAULT_BUCKETS = tuple(0.0001 * 2.0**i for i in range(18))
+
+
+class Histogram(_Metric):
+    """Prometheus-style cumulative histogram (ref: prometheus-cpp
+    Histogram in src/common/metrics/). ``observe()`` is the only write;
+    exposition emits ``<name>_bucket{le=...}`` (cumulative, +Inf last),
+    ``<name>_sum`` and ``<name>_count`` per label set."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str, buckets=None):
+        super().__init__(name, help_)
+        self.buckets = tuple(
+            sorted(float(b) for b in (buckets or DEFAULT_BUCKETS))
+        )
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            st = self._values.get(key)
+            if st is None:
+                st = self._values[key] = {
+                    "counts": [0] * (len(self.buckets) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+            i = 0
+            while i < len(self.buckets) and v > self.buckets[i]:
+                i += 1
+            st["counts"][i] += 1
+            st["sum"] += v
+            st["count"] += 1
+
+    def value(self, **labels) -> float:
+        """Observation count (the scalar a histogram most naturally is)."""
+        with self._lock:
+            st = self._values.get(self._key(labels))
+            return float(st["count"]) if st else 0.0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            st = self._values.get(self._key(labels))
+            return float(st["sum"]) if st else 0.0
+
+    def quantile(self, q: float, **labels) -> float:
+        """Bucket-interpolated quantile estimate (the health plane's live
+        p50/p99 view); 0.0 with no observations."""
+        with self._lock:
+            st = self._values.get(self._key(labels))
+            if not st or not st["count"]:
+                return 0.0
+            counts = list(st["counts"])
+            total = st["count"]
+        target = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            if cum + c >= target:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = (
+                    self.buckets[i]
+                    if i < len(self.buckets)
+                    else self.buckets[-1] * 2.0
+                )
+                frac = (target - cum) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            cum += c
+        return self.buckets[-1] * 2.0
+
+
 class _Bound:
     def __init__(self, metric: _Metric, key: tuple):
         self._metric = metric
@@ -86,6 +162,18 @@ class MetricsRegistry:
 
     def gauge(self, name: str, help_: str = "") -> Gauge:
         return self._get_or_create(name, help_, Gauge)
+
+    def histogram(self, name: str, help_: str = "", buckets=None) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, buckets=buckets)
+                self._metrics[name] = m
+            elif not isinstance(m, Histogram):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}"
+                )
+            return m
 
     def _get_or_create(self, name: str, help_: str, cls):
         with self._lock:
@@ -124,8 +212,24 @@ class MetricsRegistry:
                 out.append(f"# HELP {m.name} {m.help}")
             out.append(f"# TYPE {m.name} {m.kind}")
             for key, val in m.samples():
-                if key:
-                    lbl = ",".join(f'{k}="{esc(v)}"' for k, v in key)
+                lbl = ",".join(f'{k}="{esc(v)}"' for k, v in key)
+                if isinstance(m, Histogram):
+                    # Cumulative bucket series + _sum/_count, +Inf last.
+                    cum = 0
+                    for le, c in zip(
+                        list(m.buckets) + ["+Inf"],
+                        val["counts"],
+                    ):
+                        cum += c
+                        le_s = le if le == "+Inf" else f"{le:g}"
+                        blbl = ",".join(
+                            filter(None, [lbl, f'le="{le_s}"'])
+                        )
+                        out.append(f"{m.name}_bucket{{{blbl}}} {cum:g}")
+                    suffix = f"{{{lbl}}}" if lbl else ""
+                    out.append(f"{m.name}_sum{suffix} {val['sum']:g}")
+                    out.append(f"{m.name}_count{suffix} {val['count']:g}")
+                elif lbl:
                     out.append(f"{m.name}{{{lbl}}} {val:g}")
                 else:
                     out.append(f"{m.name} {val:g}")

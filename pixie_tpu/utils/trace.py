@@ -1,0 +1,442 @@
+"""Lightweight distributed query tracing (r11).
+
+Ref posture: Dapper (Sigelman et al., 2010) — per-query trace trees of
+spans with (trace_id, span_id, parent_id) propagated across process
+boundaries — exported in the OpenTelemetry data model, and dogfooded the
+way the reference lands `stirling_error`/`probe_status` into its own
+TableStore: finished spans are buffered here and periodically drained
+into the node's `query_spans` table (ingest/self_telemetry.py) so PxL
+scripts can query the engine about itself.
+
+Design contract (mirrors utils/faults.py):
+
+- **Near-zero cost when disabled.** Call sites gate on the module-level
+  ``ACTIVE`` bool::
+
+      if trace.ACTIVE:
+          with trace.span("compile"): ...
+
+  or call ``span()``/``record()`` directly — every entry point re-checks
+  ``ACTIVE`` and returns a no-op immediately. The microbench
+  (tools/microbench_fault_overhead.py ``trace_overhead`` key) holds the
+  disabled path to <1% of the warm agg path and the transport RTT.
+
+- **The query_id IS the trace_id.** The broker roots each query's trace
+  at its query_id, so spans, inline degradation events, and the final
+  ``degraded`` annotation are joinable on one key.
+
+- **Propagation is explicit across processes, ambient within a
+  thread.** A thread-local context stack makes nested ``span()`` calls
+  parent automatically; crossing a boundary (broker → agent message,
+  transport frame) carries ``{"trace_id", "span_id"}`` explicitly and
+  the far side re-enters the context with ``context(trace_id, span_id)``.
+
+- **Finished spans are data.** ``Span.to_dict()`` is wire-encodable
+  (str/int/dict only); agents ship their spans back on ``fragment_done``
+  and the broker merges by span_id (in-process clusters share this
+  module's buffer, so dedup-by-id keeps the merge exact).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from pixie_tpu.utils.config import define_flag, flags
+from pixie_tpu.utils.metrics import metrics_registry
+
+define_flag(
+    "query_tracing",
+    True,
+    help_="Distributed query tracing: every query gets a Dapper-style "
+    "span tree covering broker, each participating agent, each exec "
+    "node, and per-window device stage/fold phases, assembled in "
+    "QueryResult.profile and landed in the node's own query_spans table "
+    "(utils/trace.py). Off = spans are never created (<1% residual "
+    "overhead, gated by tools/microbench_fault_overhead.py).",
+)
+define_flag(
+    "trace_buffer_cap",
+    8192,
+    help_="Finished-span ring buffer capacity per process; the oldest "
+    "spans are evicted when self-telemetry ingestion falls behind.",
+)
+define_flag(
+    "trace_otel_export",
+    False,
+    help_="Export each query's finished spans as an OTLP resourceSpans "
+    "payload through the engine's pluggable OTel exporter (the "
+    "exec/otel_sink_node.py path) in addition to the query_spans table.",
+)
+
+_SPAN_SECONDS = metrics_registry().histogram(
+    "span_duration_seconds",
+    "Finished trace-span durations by span name.",
+)
+
+# Fast gate read by every call site (one attribute load + branch when
+# tracing is off). Synced with the ``query_tracing`` flag at import and by
+# set_enabled()/refresh().
+ACTIVE = False
+
+_BUF_LOCK = threading.Lock()
+_FINISHED: "collections.deque[Span]" = collections.deque(
+    maxlen=flags.trace_buffer_cap
+)
+_tls = threading.local()
+
+
+def set_enabled(on: bool) -> None:
+    """Flip tracing at runtime (also updates the ``query_tracing`` flag
+    so flag introspection stays truthful)."""
+    global ACTIVE
+    ACTIVE = bool(on)
+    flags.set("query_tracing", bool(on))
+
+
+def refresh() -> None:
+    """Re-read the ``query_tracing`` flag into the ACTIVE gate."""
+    global ACTIVE
+    ACTIVE = bool(flags.query_tracing)
+
+
+def new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclasses.dataclass
+class Span:
+    """One finished (or in-flight) operation in a trace tree."""
+
+    trace_id: str
+    span_id: str
+    parent_id: str  # "" at the root
+    name: str
+    start_unix_ns: int
+    duration_ns: int = 0
+    status: str = "ok"
+    instance: str = ""
+    attrs: dict = dataclasses.field(default_factory=dict)
+    _start_pc_ns: int = 0  # perf_counter origin (not serialized)
+    _finished: bool = False
+
+    def to_dict(self) -> dict:
+        """Wire-encodable form (plain str/int values + a str->scalar
+        attrs map) — rides bus messages and transport frames as-is."""
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_unix_ns": self.start_unix_ns,
+            "duration_ns": self.duration_ns,
+            "status": self.status,
+            "instance": self.instance,
+            "attrs": dict(self.attrs),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Span":
+        return Span(
+            trace_id=str(d.get("trace_id", "")),
+            span_id=str(d.get("span_id", "")),
+            parent_id=str(d.get("parent_id", "")),
+            name=str(d.get("name", "")),
+            start_unix_ns=int(d.get("start_unix_ns", 0)),
+            duration_ns=int(d.get("duration_ns", 0)),
+            status=str(d.get("status", "ok")),
+            instance=str(d.get("instance", "")),
+            attrs=dict(d.get("attrs") or {}),
+        )
+
+
+# -- thread-local context ----------------------------------------------------
+def current() -> Optional[tuple[str, str]]:
+    """(trace_id, span_id) of the innermost active span on this thread,
+    or None outside any trace."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def _push(ctx: tuple[str, str]) -> None:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(ctx)
+
+
+def _pop() -> None:
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        stack.pop()
+
+
+class context:
+    """Adopt an externally-propagated span context on this thread (the
+    agent re-enters the broker's root span; a worker thread re-enters
+    its query's fragment span). No-op with a None/empty context."""
+
+    def __init__(self, trace_id: Optional[str], span_id: str = ""):
+        self._ctx = (trace_id, span_id) if trace_id else None
+
+    def __enter__(self):
+        if self._ctx is not None:
+            _push(self._ctx)
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            _pop()
+        return False
+
+
+def context_of(span: "Optional[Span]") -> context:
+    if span is None:
+        return context(None)
+    return context(span.trace_id, span.span_id)
+
+
+# -- span lifecycle ----------------------------------------------------------
+def begin(
+    name: str,
+    trace_id: Optional[str] = None,
+    parent_id: Optional[str] = None,
+    instance: str = "",
+    attrs: Optional[dict] = None,
+) -> Optional[Span]:
+    """Start a span WITHOUT making it ambient (explicit-parent style for
+    long scopes where a with-block is awkward, e.g. the broker's root
+    span). Returns None when tracing is off; pair with ``finish()``."""
+    if not ACTIVE:
+        return None
+    cur = current()
+    if trace_id is None:
+        trace_id = cur[0] if cur else new_id()
+    if parent_id is None:
+        parent_id = cur[1] if cur else ""
+    s = Span(
+        trace_id=trace_id,
+        span_id=new_id(),
+        parent_id=parent_id,
+        name=name,
+        start_unix_ns=time.time_ns(),
+        instance=instance,
+        attrs=dict(attrs or {}),
+    )
+    s._start_pc_ns = time.perf_counter_ns()
+    return s
+
+
+def finish(
+    span: Optional[Span],
+    status: Optional[str] = None,
+    attrs: Optional[dict] = None,
+) -> None:
+    """Stamp the duration and buffer a span started with ``begin()``.
+    Idempotent; None-safe (the disabled path passes None through)."""
+    if span is None or span._finished:
+        return
+    span._finished = True
+    span.duration_ns = time.perf_counter_ns() - span._start_pc_ns
+    if status is not None:
+        span.status = status
+    if attrs:
+        span.attrs.update(attrs)
+    _record(span)
+
+
+class span:
+    """``with trace.span("compile"): ...`` — an ambient child span: nested
+    spans on this thread parent to it automatically. ``.set(k=v)`` adds
+    attributes; an exception propagating out marks status=error."""
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: Optional[str] = None,
+        parent_id: Optional[str] = None,
+        instance: str = "",
+        attrs: Optional[dict] = None,
+    ):
+        self._name = name
+        self._trace_id = trace_id
+        self._parent_id = parent_id
+        self._instance = instance
+        self._attrs = attrs
+        self.span: Optional[Span] = None
+
+    def __enter__(self):
+        self.span = begin(
+            self._name,
+            trace_id=self._trace_id,
+            parent_id=self._parent_id,
+            instance=self._instance,
+            attrs=self._attrs,
+        )
+        if self.span is not None:
+            _push((self.span.trace_id, self.span.span_id))
+        return self
+
+    def set(self, **attrs) -> None:
+        if self.span is not None:
+            self.span.attrs.update(attrs)
+
+    def __exit__(self, exc_type, exc, tb):
+        if self.span is not None:
+            _pop()
+            finish(self.span, status="error" if exc_type else None)
+        return False
+
+
+def record(
+    name: str,
+    duration_ns: int,
+    trace_id: Optional[str] = None,
+    parent_id: Optional[str] = None,
+    start_unix_ns: Optional[int] = None,
+    status: str = "ok",
+    instance: str = "",
+    attrs: Optional[dict] = None,
+) -> Optional[Span]:
+    """Buffer an already-measured span (exec-node stats, transport ack
+    latencies, device phase timings). Inherits the ambient context for
+    missing trace/parent ids; drops the span when tracing is off OR no
+    trace context is resolvable (orphan phases outside any query)."""
+    if not ACTIVE:
+        return None
+    cur = current()
+    if trace_id is None:
+        if cur is None:
+            return None
+        trace_id = cur[0]
+    if parent_id is None:
+        parent_id = cur[1] if cur else ""
+    if start_unix_ns is None:
+        start_unix_ns = time.time_ns() - int(duration_ns)
+    s = Span(
+        trace_id=trace_id,
+        span_id=new_id(),
+        parent_id=parent_id,
+        name=name,
+        start_unix_ns=start_unix_ns,
+        duration_ns=int(duration_ns),
+        status=status,
+        instance=instance,
+        attrs=dict(attrs or {}),
+    )
+    s._finished = True
+    _record(s)
+    return s
+
+
+def phase(name: str, duration_s: float, **attrs) -> None:
+    """Device/staging phase helper: a measured sub-span under the ambient
+    context (parallel/pipeline.py folds its COLD_PROFILE keys through
+    here, so per-window pack/transfer/compile/fold become spans)."""
+    record(name, int(duration_s * 1e9), attrs=attrs or None)
+
+
+def _record(s: Span) -> None:
+    with _BUF_LOCK:
+        _FINISHED.append(s)
+    _SPAN_SECONDS.observe(s.duration_ns / 1e9, name=s.name)
+
+
+# -- buffer access -----------------------------------------------------------
+def drain() -> list[Span]:
+    """Remove and return every buffered finished span (the self-telemetry
+    connector's consumption path — single consumer per process)."""
+    with _BUF_LOCK:
+        out = list(_FINISHED)
+        _FINISHED.clear()
+    return out
+
+
+def spans_for(trace_id: str) -> list[Span]:
+    """Copies of the buffered spans belonging to one trace (the buffer
+    keeps them for self-telemetry ingestion)."""
+    with _BUF_LOCK:
+        return [s for s in _FINISHED if s.trace_id == trace_id]
+
+
+def buffered_count() -> int:
+    with _BUF_LOCK:
+        return len(_FINISHED)
+
+
+def clear() -> None:
+    """Drop all buffered spans (tests)."""
+    with _BUF_LOCK:
+        _FINISHED.clear()
+
+
+# -- profile assembly --------------------------------------------------------
+def build_tree(spans: "list[dict | Span]") -> list[dict]:
+    """Assemble span dicts into a parent->children forest, children sorted
+    by start time. Unknown parents (dropped/evicted spans) root their
+    subtree so a degraded trace still renders."""
+    nodes: dict[str, dict] = {}
+    ordered = []
+    for s in spans:
+        d = dict(s.to_dict() if isinstance(s, Span) else s)
+        d["children"] = []
+        prev = nodes.get(d["span_id"])
+        if prev is None:
+            nodes[d["span_id"]] = d
+            ordered.append(d)
+    roots = []
+    for d in ordered:
+        parent = nodes.get(d["parent_id"]) if d["parent_id"] else None
+        if parent is None or parent is d:
+            roots.append(d)
+        else:
+            parent["children"].append(d)
+    for d in ordered:
+        d["children"].sort(key=lambda c: c["start_unix_ns"])
+    roots.sort(key=lambda c: c["start_unix_ns"])
+    return roots
+
+
+def spans_to_otel(spans: "list[dict | Span]", service: str = "pixie_tpu"):
+    """OTLP/JSON resourceSpans payload for a span list — same data model
+    the exec/otel_sink_node.py sink emits, so any exporter accepting its
+    payloads accepts these."""
+    from pixie_tpu.exec.otel_sink_node import _attr_list
+
+    out = []
+    for s in spans:
+        d = s.to_dict() if isinstance(s, Span) else s
+        out.append(
+            {
+                "name": d["name"],
+                "traceId": d["trace_id"],
+                "spanId": d["span_id"],
+                "parentSpanId": d["parent_id"],
+                "startTimeUnixNano": str(int(d["start_unix_ns"])),
+                "endTimeUnixNano": str(
+                    int(d["start_unix_ns"]) + int(d["duration_ns"])
+                ),
+                "attributes": _attr_list(
+                    list(dict(d.get("attrs") or {}).items())
+                    + [("status", d.get("status", "ok")),
+                       ("instance", d.get("instance", ""))]
+                ),
+            }
+        )
+    return {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": _attr_list([("service.name", service)])
+                },
+                "scopeSpans": [{"spans": out}],
+            }
+        ]
+    }
+
+
+refresh()

@@ -20,7 +20,7 @@ from pixie_tpu.exec import BridgeRouter, QueryDeadlineExceeded
 from pixie_tpu.plan.plan import Plan
 from pixie_tpu.vizier.bus import MessageBus, agent_topic
 
-from pixie_tpu.utils import faults, flags
+from pixie_tpu.utils import faults, flags, trace
 
 # scaled-down from the reference's ~5s; PIXIE_TPU_AGENT_HEARTBEAT_INTERVAL_S.
 HEARTBEAT_INTERVAL_S = flags.agent_heartbeat_interval_s
@@ -149,9 +149,30 @@ class Agent:
                     target=self._execute_fragment, args=(msg,), daemon=True
                 ).start()
 
+    def _trace_spans_for(self, trace_id: str) -> "list | None":
+        """Wire-ready copies of this process's buffered spans for one
+        trace, shipped on fragment_done/fragment_error so the broker can
+        assemble the cross-agent profile (dedup by span_id covers the
+        in-process case where broker and agents share a buffer)."""
+        if not trace.ACTIVE:
+            return None
+        return [s.to_dict() for s in trace.spans_for(trace_id)]
+
     def _execute_fragment(self, msg: dict) -> None:
         query_id = msg["query_id"]
         plan: Plan = msg["plan"]  # in-process handoff; DCN would serialize
+        # Adopt the broker's propagated trace context (Dapper-style): this
+        # agent's execute span — and the exec-node/device spans nested
+        # under it — join the query's trace tree.
+        tctx = msg.get("trace") or {}
+        trace_id = tctx.get("trace_id") or query_id
+        span = trace.begin(
+            "agent.execute",
+            trace_id=trace_id,
+            parent_id=tctx.get("span_id", ""),
+            instance=self.agent_id,
+            attrs={"agent_id": self.agent_id},
+        )
         try:
             if faults.ACTIVE:
                 if faults.fires_scoped("agent.execute_hang", self.agent_id):
@@ -162,12 +183,17 @@ class Agent:
                     return
                 if faults.fires_scoped("agent.execute", self.agent_id):
                     raise faults.FaultInjectedError("agent.execute")
-            result = self.carnot.execute_plan(
-                plan,
-                analyze=msg.get("analyze", False),
-                manage_router=False,
-                deadline_s=msg.get("deadline_s"),
+            with trace.context_of(span):
+                result = self.carnot.execute_plan(
+                    plan,
+                    analyze=msg.get("analyze", False),
+                    manage_router=False,
+                    deadline_s=msg.get("deadline_s"),
+                )
+            rows_out = sum(
+                b.num_rows for bs in result.tables.values() for b in bs
             )
+            trace.finish(span, attrs={"rows_out": rows_out})
             for name, batches in result.tables.items():
                 for b in batches:
                     self.bus.publish(
@@ -185,9 +211,11 @@ class Agent:
                     "type": "fragment_done",
                     "agent_id": self.agent_id,
                     "exec_stats": result.exec_stats,
+                    "spans": self._trace_spans_for(trace_id),
                 },
             )
         except Exception as e:  # surfaced to the forwarder (ref: error chunks)
+            trace.finish(span, status="error", attrs={"error": str(e)[:200]})
             self.bus.publish(
                 RESULTS_TOPIC_PREFIX + query_id,
                 {
@@ -201,5 +229,6 @@ class Agent:
                         if isinstance(e, QueryDeadlineExceeded)
                         else "error"
                     ),
+                    "spans": self._trace_spans_for(trace_id),
                 },
             )

@@ -29,7 +29,7 @@ from pixie_tpu.vizier.bus import (
     MessageBus,
     agent_topic,
 )
-from pixie_tpu.utils import faults, flags
+from pixie_tpu.utils import faults, flags, metrics_registry, trace
 from pixie_tpu.vizier.agent import AGENT_STATUS_TOPIC, RESULTS_TOPIC_PREFIX
 
 
@@ -38,6 +38,25 @@ from pixie_tpu.vizier.agent import AGENT_STATUS_TOPIC, RESULTS_TOPIC_PREFIX
 AGENT_EXPIRY_S = flags.agent_expiry_s
 
 _log = logging.getLogger("pixie_tpu.broker")
+
+# Broker-side query counters on the shared registry so /metrics reflects
+# them (r11 satellite — ad-hoc totals were invisible to the endpoint).
+_M = metrics_registry()
+_QUERIES = _M.counter(
+    "broker_queries_total", "Queries executed through the broker."
+)
+_DEGRADED = _M.counter(
+    "broker_degraded_queries_total",
+    "Queries that returned a partial result with a degraded annotation.",
+)
+_FORWARD_DROPPED = _M.counter(
+    "broker_forward_dropped_total",
+    "Result messages dropped in the broker's forwarder (fault site "
+    "broker.forward).",
+)
+_QUERY_SECONDS = _M.histogram(
+    "broker_query_seconds", "End-to-end broker query latency."
+)
 
 
 class AgentTracker:
@@ -156,6 +175,19 @@ class AgentTracker:
                     out[aid] = frozenset(keys)
         return out
 
+    def fold_latency_view(self) -> dict[str, dict]:
+        """program_key -> {agent_id: {p50_ms, p99_ms, n}} from the latest
+        heartbeats (r11): the per-program-key fold-latency histograms the
+        device executors publish, aggregated for /statusz so operators see
+        live per-phase percentiles without running a query."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            for aid, a in sorted(self._agents.items()):
+                fl = (a.get("health") or {}).get("fold_latency") or {}
+                for key, st in fl.items():
+                    out.setdefault(key, {})[aid] = st
+        return out
+
     def agents_snapshot(self) -> list[dict]:
         """Rows for the GetAgentStatus UDTF (ref: md_udtfs.h reads the
         agent manager's registry), plus r10 health-plane columns."""
@@ -224,6 +256,9 @@ class QueryBroker:
         # heartbeats name tables and the caller maps relations).
         self.table_relations = dict(table_relations or {})
         self._health_srv = None
+        # Pluggable OTel exporter for finished query traces (flag
+        # trace_otel_export); callers set it to an OTLP/HTTP callable.
+        self.otel_exporter = None
 
     def start_health_server(self, host: str = "127.0.0.1", port: int = 0):
         """Expose the aggregated cluster health view over HTTP (r10):
@@ -238,6 +273,9 @@ class QueryBroker:
             status_fn=lambda: {
                 "agents": self.tracker.agents_snapshot(),
                 "cluster_health": self.tracker.health_view(),
+                # Live per-program-key fold-latency percentiles from the
+                # agents' heartbeat-carried histograms (r11).
+                "fold_latency": self.tracker.fold_latency_view(),
             },
             extra_routes={
                 "/agentz": lambda: self.tracker.agents_snapshot(),
@@ -334,34 +372,61 @@ class QueryBroker:
         being discovered sick mid-query. Half-open breakers plan
         normally (they admit their trial)."""
         qid = str(uuid.uuid4())
+        _QUERIES.inc()
+        # The query_id is the trace_id (utils/trace.py): spans, inline
+        # degradation events, and the degraded annotation join on it.
+        root = trace.begin(
+            "query",
+            trace_id=qid,
+            parent_id="",
+            instance="broker",
+            attrs={"query_bytes": len(query)},
+        )
+        root_span_id = root.span_id if root is not None else ""
 
         def emit(event: dict) -> None:
             if on_event is None:
                 return
             try:
-                on_event(qid, event)
+                # trace_id-stamped (r11 satellite): inline events and the
+                # query's spans are joinable on the same key.
+                on_event(qid, {"trace_id": qid, **event})
             except Exception:
                 _log.exception("on_event callback failed (ignored)")
         t0 = time.perf_counter_ns()
-        logical = self.compiler.compile(
-            query,
-            self.table_relations,
-            now_ns=now_ns,
-            script_args=script_args,
-            query_id=qid,
-            exec_funcs=exec_funcs,
-        )
+        with trace.span(
+            "compile", trace_id=qid, parent_id=root_span_id,
+            instance="broker",
+        ):
+            logical = self.compiler.compile(
+                query,
+                self.table_relations,
+                now_ns=now_ns,
+                script_args=script_args,
+                query_id=qid,
+                exec_funcs=exec_funcs,
+            )
         # Plan only over agents inside the heartbeat-expiry window; the
         # skipped list rides the degraded annotation.
-        state, expired_agents = self.tracker.planning_view()
-        planner = DistributedPlanner(self.registry, self.table_relations)
-        plan = planner.plan(logical, state)
-        # Health plane: route around agents whose device breaker is open
-        # for this query's program shape.
-        breaker_skipped: list[str] = []
-        if flags.health_plane:
-            plan, breaker_skipped = self._plan_around_open_breakers(
-                planner, logical, plan, state
+        with trace.span(
+            "plan", trace_id=qid, parent_id=root_span_id, instance="broker"
+        ) as plan_span:
+            state, expired_agents = self.tracker.planning_view()
+            planner = DistributedPlanner(self.registry, self.table_relations)
+            plan = planner.plan(logical, state)
+            # Health plane: route around agents whose device breaker is
+            # open for this query's program shape.
+            breaker_skipped: list[str] = []
+            if flags.health_plane:
+                plan, breaker_skipped = self._plan_around_open_breakers(
+                    planner, logical, plan, state
+                )
+            plan_span.set(
+                fragments=len(plan.fragments),
+                agents=len({
+                    plan.executing_instance[f.fragment_id]
+                    for f in plan.fragments
+                }),
             )
         skipped = [
             {"agent_id": aid, "reason": "heartbeat_expired"}
@@ -414,6 +479,9 @@ class QueryBroker:
                     "plan": sub_plan,
                     "analyze": analyze,
                     "deadline_s": timeout_s,
+                    # Trace-context propagation (Dapper): the agent's
+                    # execute span parents to the broker's root span.
+                    "trace": {"trace_id": qid, "span_id": root_span_id},
                 },
             )
 
@@ -427,6 +495,10 @@ class QueryBroker:
         lost_agents: list[str] = []
         timed_out_agents: list[str] = []
         forward_dropped = 0
+        # Spans shipped back by agents on fragment_done/fragment_error,
+        # keyed by span_id: in-process agents share this module's buffer,
+        # so the final merge dedups instead of double-counting.
+        agent_spans: dict[str, dict] = {}
         try:
             while pending:
                 remaining = deadline - time.monotonic()
@@ -469,6 +541,7 @@ class QueryBroker:
                 if msg["type"] == "result_batch":
                     if faults.ACTIVE and faults.fires("broker.forward"):
                         forward_dropped += 1
+                        _FORWARD_DROPPED.inc()
                         continue
                     if on_batch is not None:
                         on_batch(msg["table"], msg["batch"])
@@ -479,10 +552,14 @@ class QueryBroker:
                 elif msg["type"] == "fragment_done":
                     for k, v in msg.get("exec_stats", {}).items():
                         exec_stats[f"{msg['agent_id']}/{k}"] = v
+                    for s in msg.get("spans") or ():
+                        agent_spans[s["span_id"]] = s
                     pending.discard(msg["agent_id"])
                 elif msg["type"] == "fragment_error":
                     aid = msg["agent_id"]
                     agent_errors[aid] = msg["error"]
+                    for s in msg.get("spans") or ():
+                        agent_spans[s["span_id"]] = s
                     if msg.get("error_kind") == "deadline":
                         timed_out_agents.append(aid)
                     emit(
@@ -559,15 +636,58 @@ class QueryBroker:
                 # and WHY (heartbeat_expired | breaker_open).
                 "skipped": skipped,
                 "forward_dropped": forward_dropped,
+                # Joins the annotation to the query's spans and inline
+                # events (r11 satellite; trace_id == query_id).
+                "trace_id": qid,
             }
+            _DEGRADED.inc()
+        exec_ns = time.perf_counter_ns() - t1
+        _QUERY_SECONDS.observe((compile_ns + exec_ns) / 1e9)
+        trace_spans = None
+        if root is not None:
+            trace.finish(
+                root,
+                status="degraded" if degraded else "ok",
+                attrs=(
+                    {"degraded_reasons": ",".join(degraded["reasons"])}
+                    if degraded
+                    else None
+                ),
+            )
+            # Merge broker-side spans with agent-shipped ones by span_id
+            # (one trace_id across the cluster; agents that died mid-query
+            # simply contribute fewer spans — the profile marks them via
+            # the degraded annotation).
+            merged = {
+                s.span_id: s.to_dict() for s in trace.spans_for(qid)
+            }
+            merged.update(agent_spans)
+            trace_spans = sorted(
+                merged.values(), key=lambda s: s["start_unix_ns"]
+            )
+            if flags.trace_otel_export and trace_spans:
+                self._export_otel_spans(trace_spans)
         return QueryResult(
             query_id=qid,
             tables=tables,
             exec_stats=exec_stats,
             compile_time_ns=compile_ns,
-            exec_time_ns=time.perf_counter_ns() - t1,
+            exec_time_ns=exec_ns,
             degraded=degraded,
+            trace_spans=trace_spans,
         )
+
+    def _export_otel_spans(self, spans: list[dict]) -> None:
+        """Optional OTel export of a finished query trace through the
+        same payload shape the exec/otel_sink_node.py sink emits. The
+        exporter is pluggable (``self.otel_exporter``); unset drops."""
+        exporter = getattr(self, "otel_exporter", None)
+        if exporter is None:
+            return
+        try:
+            exporter(trace.spans_to_otel(spans, service="broker"))
+        except Exception:
+            _log.exception("otel span export failed (ignored)")
 
     def stop(self) -> None:
         self.tracker.stop()

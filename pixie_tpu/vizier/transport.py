@@ -37,7 +37,7 @@ import uuid
 from typing import Any, Optional
 
 from pixie_tpu.exec.router import BridgeRouter
-from pixie_tpu.utils import faults, flags, metrics_registry
+from pixie_tpu.utils import faults, flags, metrics_registry, trace
 from pixie_tpu.utils.config import define_flag
 from pixie_tpu.vizier import wire
 from pixie_tpu.vizier.bus import MessageBus
@@ -115,6 +115,12 @@ _SESSION_REJECTS = metrics_registry().counter(
     "transport_session_rejected_total",
     "Session frames rejected for a stale epoch (zombie connections).",
 )
+_ACK_LATENCY = metrics_registry().histogram(
+    "transport_ack_latency_seconds",
+    "Client-observed send->cumulative-ack latency per windowed frame, "
+    "by plane (reconnect replays keep the ORIGINAL send time: the span "
+    "covers first transmission to final acknowledgement).",
+)
 
 
 class TransportBackpressureError(ConnectionError):
@@ -147,7 +153,10 @@ class _AckWindow:
     def __init__(self, plane: str):
         self.plane = plane
         self._cv = threading.Condition()
-        # (seq, encoded bytes, stamped frame) in ascending-seq order.
+        # (seq, encoded bytes, stamped frame, first-send perf_counter_ns)
+        # in ascending-seq order. The send time is stamped ONCE — replays
+        # keep it, so the ack-latency span covers first transmission to
+        # final acknowledgement across reconnects.
         self._entries: "collections.deque" = collections.deque()
         self._bytes = 0
         self.next_seq = 0
@@ -161,7 +170,40 @@ class _AckWindow:
         frame = dict(obj)
         frame["seq"] = self.next_seq
         self.next_seq += 1
+        if trace.ACTIVE and "trace_id" not in frame:
+            # Propagate the sender thread's trace context onto the wire
+            # (wire.py OPTIONAL_FRAME_FIELDS): ack spans for this frame
+            # join the originating query's trace.
+            ctx = trace.current()
+            if ctx is not None:
+                frame["trace_id"], frame["span_id"] = ctx
         return frame
+
+    def _release(self, entry, now_pc_ns: "int | None" = None) -> None:
+        """One windowed frame left the window (cumulative ack or a
+        reconnect's watermark trim — either way the server APPLIED it):
+        emit its send->ack latency exactly once per seq, as a histogram
+        sample always and a trace span when the frame carried (or the
+        window owns) a trace context."""
+        seq, _, frame, send_ns = entry
+        if send_ns == 0:
+            return
+        now = now_pc_ns if now_pc_ns is not None else time.perf_counter_ns()
+        lat_ns = max(0, now - send_ns)
+        _ACK_LATENCY.observe(lat_ns / 1e9, plane=self.plane)
+        if trace.ACTIVE:
+            trace.record(
+                "transport.ack",
+                lat_ns,
+                trace_id=frame.get("trace_id")
+                or f"transport:{self.plane}",
+                parent_id=frame.get("span_id", ""),
+                attrs={
+                    "plane": self.plane,
+                    "seq": seq,
+                    "kind": str(frame.get("kind", "")),
+                },
+            )
 
     def depth(self) -> tuple[int, int]:
         with self._cv:
@@ -187,19 +229,26 @@ class _AckWindow:
                             self.plane, len(self._entries), self._bytes
                         )
                     self._cv.wait(remaining)
-            self._entries.append((frame["seq"], nbytes, frame))
+            self._entries.append(
+                (frame["seq"], nbytes, frame, time.perf_counter_ns())
+            )
             self._bytes += nbytes
 
     def ack(self, seq: int) -> None:
         """Cumulative ack: release every entry with seq' <= seq."""
+        released = []
         with self._cv:
             if seq <= self.acked:
                 return
             self.acked = seq
             while self._entries and self._entries[0][0] <= seq:
-                _, nb, _ = self._entries.popleft()
-                self._bytes -= nb
+                entry = self._entries.popleft()
+                self._bytes -= entry[1]
+                released.append(entry)
             self._cv.notify_all()
+        now = time.perf_counter_ns()
+        for entry in released:
+            self._release(entry, now)
 
     def wait_drained(self, deadline: float) -> bool:
         """Block until every in-flight frame is acked (graceful close)
@@ -218,18 +267,25 @@ class _AckWindow:
         WERE delivered by the old connection — trimmed here (and were a
         replay to happen anyway, the server's watermark drops it; the
         transport.replay_dup fault site forces exactly that path)."""
+        released = []
         with self._cv:
             if not (faults.ACTIVE and faults.fires("transport.replay_dup")):
                 while (
                     self._entries
                     and self._entries[0][0] <= server_applied_seq
                 ):
-                    _, nb, _ = self._entries.popleft()
-                    self._bytes -= nb
+                    entry = self._entries.popleft()
+                    self._bytes -= entry[1]
+                    released.append(entry)
                 if server_applied_seq > self.acked:
                     self.acked = server_applied_seq
                 self._cv.notify_all()
-            return [f for _, _, f in self._entries]
+            frames = [e[2] for e in self._entries]
+        # Watermark-trimmed entries WERE applied by the old connection:
+        # their ack span closes here, once, with the original send time.
+        for entry in released:
+            self._release(entry)
+        return frames
 
 define_flag(
     "tls_cert",

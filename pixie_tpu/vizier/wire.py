@@ -129,11 +129,24 @@ FRAME_FIELDS: dict[str, dict[str, type]] = {
     "bridge_push": {"query_id": str, "bridge_id": str},
 }
 
+# Optional frame fields (r11): type-checked when present, never required.
+# ``seq`` is the per-identity-plane delivery sequence (r10); ``trace_id``/
+# ``span_id`` carry the Dapper-style trace context of the query whose data
+# the frame moves (utils/trace.py), so transport-level send/ack latency
+# spans can be joined back to the originating query's trace.
+OPTIONAL_FRAME_FIELDS: dict[str, type] = {
+    "seq": int,
+    "want_ack": bool,
+    "trace_id": str,
+    "span_id": str,
+}
+
 
 def validate_frame(frame: Any) -> dict:
-    """Schema-check one decoded control frame: known ``kind`` and
-    correctly-typed required fields (bool is not an int here). Raises
-    WireError — callers treat that as a hostile/broken peer."""
+    """Schema-check one decoded control frame: known ``kind``,
+    correctly-typed required fields (bool is not an int here), and
+    correctly-typed optional fields when present. Raises WireError —
+    callers treat that as a hostile/broken peer."""
     if not isinstance(frame, dict) or not isinstance(frame.get("kind"), str):
         raise WireError("frame is not a kind-tagged message")
     spec = FRAME_FIELDS.get(frame["kind"])
@@ -145,6 +158,15 @@ def validate_frame(frame: Any) -> dict:
             raise WireError(
                 f"frame {frame['kind']!r}: field {field!r} must be "
                 f"{typ.__name__}, got {type(v).__name__}"
+            )
+    for field, typ in OPTIONAL_FRAME_FIELDS.items():
+        if field in spec or field not in frame:
+            continue
+        v = frame[field]
+        if not isinstance(v, typ) or (typ is int and isinstance(v, bool)):
+            raise WireError(
+                f"frame {frame['kind']!r}: optional field {field!r} must "
+                f"be {typ.__name__}, got {type(v).__name__}"
             )
     return frame
 

@@ -185,8 +185,10 @@ def test_open_breaker_skips_agent_at_planning(health_cluster):
     ]
     assert "pem2" in res.degraded["skipped_agents"]
     assert "breaker_open" in res.degraded["reasons"]
+    # Events are trace_id-stamped (r11): joinable with the query's spans.
     assert {"type": "agent_skipped", "agent_id": "pem2",
-            "reason": "breaker_open"} in events
+            "reason": "breaker_open",
+            "trace_id": res.query_id} in events
     rows = _rows(res)
     assert sum(rows["n"]) == N_ROWS, "only pem1's shard, complete"
     # pem2 was never asked to execute the sick shape again.

@@ -50,6 +50,54 @@ def test_metrics_counter_gauge_render():
     assert c.value() == 1 and c.value(kind="a") == 2
 
 
+def test_histogram_observe_and_exposition():
+    """r11 satellite: Histogram kind — fixed exponential buckets,
+    observe(), and correct _bucket/_sum/_count Prometheus exposition
+    (cumulative counts, +Inf last)."""
+    from pixie_tpu.utils.metrics import Histogram
+
+    m = metrics_registry()
+    h = m.histogram("test_latency_seconds", "latency", buckets=[0.1, 1.0, 10.0])
+    assert isinstance(h, Histogram)
+    # Re-registering returns the same instance; kind mismatch raises.
+    assert m.histogram("test_latency_seconds") is h
+    with pytest.raises(TypeError):
+        m.counter("test_latency_seconds")
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    h.observe(0.2, plane="data")
+    assert h.value() == 5  # observation count
+    assert h.sum() == pytest.approx(55.55 + 0.5)
+    text = m.render_text()
+    assert "# TYPE test_latency_seconds histogram" in text
+    # Cumulative, unlabeled series: 1 <= 0.1; 3 <= 1; 4 <= 10; 5 <= +Inf.
+    assert 'test_latency_seconds_bucket{le="0.1"} 1' in text
+    assert 'test_latency_seconds_bucket{le="1"} 3' in text
+    assert 'test_latency_seconds_bucket{le="10"} 4' in text
+    assert 'test_latency_seconds_bucket{le="+Inf"} 5' in text
+    assert "test_latency_seconds_count 5" in text
+    # Labeled series carry the label before le.
+    assert 'test_latency_seconds_bucket{plane="data",le="1"} 1' in text
+    assert 'test_latency_seconds_sum{plane="data"} 0.2' in text
+
+
+def test_histogram_default_buckets_exponential_and_quantile():
+    from pixie_tpu.utils.metrics import DEFAULT_BUCKETS
+
+    # Fixed exponential: each bucket doubles the previous bound.
+    for lo, hi in zip(DEFAULT_BUCKETS, DEFAULT_BUCKETS[1:]):
+        assert hi == pytest.approx(2 * lo)
+    m = metrics_registry()
+    h = m.histogram("test_q_seconds", "q")
+    assert h.quantile(0.5) == 0.0  # no observations
+    for _ in range(100):
+        h.observe(0.01)
+    q50 = h.quantile(0.5)
+    # Bucket-resolution estimate: right order of magnitude.
+    assert 0.005 < q50 < 0.03
+    assert h.quantile(0.99) >= q50
+
+
 def test_table_occupancy_gauges():
     from pixie_tpu.table.table_store import TableStore
     from pixie_tpu.types import DataType, Relation

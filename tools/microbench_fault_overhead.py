@@ -239,6 +239,82 @@ def main() -> None:
         rbus0.close()
     finally:
         flags.set("transport_ack_window", saved_window)
+
+    # -- query-tracing overhead (r11) ----------------------------------------
+    # Same method as the fault gate: (a) per-check cost of the disabled
+    # call-site idiom (``if trace.ACTIVE: ...`` — one attribute load +
+    # branch); (b) census of trace sites per operation, measured as the
+    # spans an ENABLED run creates (every span creation is one gated
+    # check); (c) modeled disabled overhead = census * per_check_ns /
+    # op_ns, gated <1%; plus a direct enabled-vs-disabled A/B.
+    from pixie_tpu.utils import trace
+
+    def _trace_check_ns(iters: int = 1_000_000) -> float:
+        trace.set_enabled(False)
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            if trace.ACTIVE:
+                raise AssertionError
+        return (time.perf_counter_ns() - t0) / iters
+
+    trace_check_ns = _trace_check_ns()
+    trace.set_enabled(True)
+    trace.clear()
+    c.execute_query(query)
+    warm_trace_census = trace.buffered_count()
+    trace.clear()
+    warm_traced_ns = run_warm(warm_runs)
+    trace.set_enabled(False)
+    warm_untraced_ns = run_warm(warm_runs)
+
+    rbus_t = RemoteBus(server.address)
+    sub_t = bus.subscribe("mb/trace")
+
+    def rtt_t(k):
+        t0 = time.perf_counter_ns()
+        for i in range(k):
+            rbus_t.publish("mb/trace", {"i": i})
+            got = sub_t.get(timeout=5.0)
+            assert got is not None
+        return (time.perf_counter_ns() - t0) / k
+
+    rtt_t(50)
+    rtt_untraced_ns = rtt_t(rtt_msgs)
+    trace.set_enabled(True)
+    trace.clear()
+    rtt_t(rtt_msgs)
+    # Each windowed frame's ack span is one gated check; stamp() checks
+    # once more per send.
+    rtt_trace_census = trace.buffered_count() / rtt_msgs + 1.0
+    trace.clear()
+    rtt_traced_ns = rtt_t(rtt_msgs)
+    rbus_t.close()
+    trace.set_enabled(True)  # default posture
+    trace.clear()
+
+    warm_trace_pct = 100.0 * warm_trace_census * trace_check_ns / warm_untraced_ns
+    rtt_trace_pct = 100.0 * rtt_trace_census * trace_check_ns / rtt_untraced_ns
+    trace_overhead = {
+        "trace_check_disabled_ns": round(trace_check_ns, 2),
+        "warm_spans_per_query": int(warm_trace_census),
+        "warm_disabled_modeled_pct": round(warm_trace_pct, 5),
+        "warm_enabled_delta_pct": round(
+            100.0 * (warm_traced_ns - warm_untraced_ns) / warm_untraced_ns, 3
+        ),
+        "rtt_checks_per_rtt": round(rtt_trace_census, 2),
+        "rtt_disabled_modeled_pct": round(rtt_trace_pct, 5),
+        "rtt_enabled_delta_pct": round(
+            100.0 * (rtt_traced_ns - rtt_untraced_ns) / rtt_untraced_ns, 3
+        ),
+        "pass_under_1pct": bool(warm_trace_pct < 1.0 and rtt_trace_pct < 1.0),
+    }
+    log(
+        f"tracing: {warm_trace_census} spans/warm-query, disabled modeled "
+        f"{warm_trace_pct:.4f}% warm / {rtt_trace_pct:.4f}% rtt; enabled "
+        f"A/B {trace_overhead['warm_enabled_delta_pct']:+.2f}% warm, "
+        f"{trace_overhead['rtt_enabled_delta_pct']:+.2f}% rtt"
+    )
+
     server.stop()
     ack_overhead = {
         "rtt_ack_us": round(rtt_idle_ns / 1e3, 2),
@@ -277,10 +353,12 @@ def main() -> None:
             warm_overhead_pct < 1.0
             and rtt_overhead_pct < 1.0
             and ack_overhead["pass_under_1pct"]
+            and trace_overhead["pass_under_1pct"]
         ),
         "platform": jax.devices()[0].platform,
     }
     out["ack_overhead"] = ack_overhead
+    out["trace_overhead"] = trace_overhead
     print(json.dumps(out))
 
     if os.environ.get("MB_WRITE_BENCH_DETAIL") == "1":
@@ -288,13 +366,19 @@ def main() -> None:
         with open(path) as f:
             detail = json.load(f)
         detail["fault_overhead"] = {
-            k: v for k, v in out.items() if k != "ack_overhead"
+            k: v
+            for k, v in out.items()
+            if k not in ("ack_overhead", "trace_overhead")
         }
         detail["ack_overhead"] = ack_overhead
+        detail["trace_overhead"] = trace_overhead
         with open(path, "w") as f:
             json.dump(detail, f, indent=1)
             f.write("\n")
-        log("BENCH_DETAIL.json updated (fault_overhead, ack_overhead)")
+        log(
+            "BENCH_DETAIL.json updated (fault_overhead, ack_overhead, "
+            "trace_overhead)"
+        )
 
     if not out["pass_under_1pct"]:
         sys.exit(1)
