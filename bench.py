@@ -86,12 +86,26 @@ metric increments and a collapsed rows/s, never as silent wrong data.
 Agent reconnect backoff (``PIXIE_TPU_AGENT_BACKOFF_INITIAL_S`` /
 ``_MAX_S`` / ``_JITTER``) is transport-layer only.
 
+Serving (r12): config 6 (opt-in, BENCH_CONFIGS=...,6) runs the
+tools/soak_serving.py concurrency harness — an in-process broker
+cluster serving BENCH_SOAK_CLIENTS (64) concurrent scripted clients
+with admission control, per-tenant weighted fair queueing, shared
+scans, and an HBM residency budget — and records queries/s, p50/p99
+latency, shared-scan dispatch reduction, evictions, and rejections in
+BENCH_DETAIL.json. The single-engine configs run with serving OFF
+(``PIXIE_TPU_SERVING_ENABLED``/``PIXIE_TPU_SHARED_SCANS``/
+``PIXIE_TPU_HBM_BUDGET_MB`` are logged at startup); shared scans only
+change behavior under concurrency, and the residency pool with no
+byte budget reproduces the old entry-count LRU exactly.
+
 Env knobs: BENCH_ROWS (configs 2/5; default 256M), BENCH_SMALL_ROWS
 (configs 1/3/4; default 64M), BENCH_HOST_ROWS (config 0; default 8M),
 BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS (comma list, default
-"2,5,4,1,0,3" — also the execution order), BENCH_BLOCK_ROWS,
-BENCH_CACHE_DIR, BENCH_NO_DATA_CACHE=1 to force regeneration,
-BENCH_CLEAR_JAX_CACHE=1 to clear the persistent compile cache.
+"2,5,4,1,0,3" — also the execution order; add 6 for the serving soak),
+BENCH_BLOCK_ROWS, BENCH_CACHE_DIR, BENCH_NO_DATA_CACHE=1 to force
+regeneration, BENCH_CLEAR_JAX_CACHE=1 to clear the persistent compile
+cache, BENCH_SOAK_CLIENTS/BENCH_SOAK_REQUESTS/BENCH_SOAK_ROWS for
+config 6.
 """
 
 import copy
@@ -277,7 +291,7 @@ def main() -> None:
         for c in os.environ.get("BENCH_CONFIGS", "2,5,4,1,0,3").split(",")
         if c.strip()
     ]
-    unknown = set(order) - {"0", "1", "2", "3", "4", "5"}
+    unknown = set(order) - {"0", "1", "2", "3", "4", "5", "6"}
     if unknown:
         raise SystemExit(f"BENCH_CONFIGS has unknown entries: {unknown}")
     configs = set(order)
@@ -317,6 +331,10 @@ def main() -> None:
 
     from pixie_tpu.utils import flags
 
+    # Imported for its flag DEFINITION (self_telemetry_interval_s): the
+    # startup log below reads it, and Carnot only imports the module
+    # lazily after that line.
+    import pixie_tpu.ingest.self_telemetry  # noqa: F401
     from pixie_tpu.ops import segment as segment_ops
 
     devices = jax.devices()
@@ -332,7 +350,16 @@ def main() -> None:
         f"device_breaker={flags.device_breaker_threshold}"
         f"@{flags.device_breaker_cooldown_s}s "
         f"query_tracing={flags.query_tracing} "
-        f"self_telemetry_interval_s={flags.self_telemetry_interval_s}"
+        f"self_telemetry_interval_s={flags.self_telemetry_interval_s} "
+        # Serving knobs (r12): this single-engine driver runs with
+        # serving OFF by default; config 6 (BENCH_CONFIGS=6) pins its
+        # own serving flags for the concurrency soak and restores them.
+        f"serving_enabled={flags.serving_enabled} "
+        f"hbm_budget_mb={flags.hbm_budget_mb} "
+        f"shared_scans={flags.shared_scans}"
+        f"@{flags.shared_scan_window_ms}ms "
+        f"admission={flags.admission_max_concurrent}"
+        f"/{flags.admission_max_queue}q"
     )
     carnot = Carnot(
         device_executor=MeshExecutor(mesh=mesh, block_rows=block_rows)
@@ -818,6 +845,43 @@ def main() -> None:
             }
         )
 
+    # ---- config 6: serving concurrency soak (r12) -------------------------
+    def run_config_6():
+        # Concurrent scripted clients through the broker's serving path
+        # (admission + shared scans + HBM residency) — the soak harness
+        # as a bench config, so p50/p99, dispatch reduction, evictions,
+        # and rejections land in BENCH_DETAIL.json. Opt-in via
+        # BENCH_CONFIGS=...,6 (its own in-process cluster and flags; the
+        # other configs' single-engine numbers are unaffected).
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import soak_serving
+
+        report = soak_serving.run_soak(
+            clients=int(os.environ.get("BENCH_SOAK_CLIENTS", 64)),
+            requests_per_client=int(
+                os.environ.get("BENCH_SOAK_REQUESTS", 4)
+            ),
+            rows=int(os.environ.get("BENCH_SOAK_ROWS", 1_000_000)),
+        )
+        assert report["degraded"] == 0, report
+        assert report["bit_identical"], "concurrent results diverged"
+        assert report["residency"]["within_budget"], report["residency"]
+        ledger.add(
+            {
+                "config": 6,
+                "latency_p50_ms": report["latency_p50_ms"],
+                "latency_p99_ms": report["latency_p99_ms"],
+                "shared_scan": report["shared_scan"],
+                "residency": report["residency"],
+                "completed": report["completed"],
+                "rejected": report["rejected"],
+                "degraded": report["degraded"],
+                "metric": "serving_concurrency_queries_per_sec",
+                "value": report["queries_per_sec"],
+                "unit": "queries/s",
+            }
+        )
+
     runners = {
         "0": run_config_0,
         "1": run_config_1,
@@ -825,6 +889,7 @@ def main() -> None:
         "3": run_config_3,
         "4": run_config_4,
         "5": run_config_5,
+        "6": run_config_6,
     }
     ran = set()
     for c in order:  # BENCH_CONFIGS order IS the execution order

@@ -106,10 +106,6 @@ _BREAKER_SKIPS = _M.counter(
     "Fragments routed straight to the host engine because their program "
     "key's circuit breaker was open.",
 )
-_STAGED_EVICTIONS = _M.counter(
-    "device_staged_cache_evictions_total",
-    "HBM staged-table cache evictions (LRU cap or version change).",
-)
 _PROGRAMS = _M.gauge(
     "device_program_cache_size", "Compiled shard_map programs cached."
 )
@@ -524,14 +520,28 @@ class MeshExecutor:
         # table version is staged once and every matching query hits HBM
         # directly (the reference's analogue is the compacted Arrow cold
         # store living next to the CPU; ours lives next to the MXU).
-        # LRU-capped: distinct time windows/column sets each stage a full
-        # copy, so unbounded growth would OOM the device.
+        # r12: a managed residency pool (serving/residency.py) — per-entry
+        # byte accounting against hbm_budget_mb with high/low watermark
+        # LRU eviction, query-scoped pinning (an in-flight fold's entry
+        # is never evicted), and device_staged_bytes gauges; the
+        # staged_cache_cap entry count remains the secondary bound.
         import collections
 
-        self._staged_cache: "collections.OrderedDict[tuple, Any]" = (
-            collections.OrderedDict()
-        )
-        self._staged_cache_cap = flags.staged_cache_cap
+        from pixie_tpu.serving.residency import ResidencyPool
+
+        self._staged_cache = ResidencyPool()
+        # Shared scans (r12, flag shared_scans): concurrent queries whose
+        # fold signatures match coalesce into one device dispatch; the
+        # followers reuse the leader's merged states and run only their
+        # own finalize (serving/shared_scan.py).
+        from pixie_tpu.serving.shared_scan import SharedScanCoordinator
+
+        self._shared_scans = SharedScanCoordinator()
+        # Optional serving/signatures.FoldSignatureStore: successful
+        # device aggregations with replayable shapes are recorded per
+        # table, and prewarm_table replays them across restarts instead
+        # of guessing the canonical count+sum(f64) shape (r12 satellite).
+        self.fold_signature_store = None
         # Host-densified key plans per (table version, key exprs), LRU.
         self._keyplan_cache: "collections.OrderedDict[tuple, Any]" = (
             collections.OrderedDict()
@@ -659,6 +669,10 @@ class MeshExecutor:
             "staging_depth": len(self._aot_futures),
             "last_fold_ms": self.last_fold_ms,
             "fold_latency": self.fold_latency_snapshot(),
+            # HBM residency (r12): staged/pinned bytes vs hbm_budget_mb
+            # ride heartbeats so the broker's admission controller and
+            # /statusz see device residency without touching the device.
+            "residency": self._staged_cache.snapshot(),
         }
 
     def _breaker_is_open(self, key: str) -> bool:
@@ -887,7 +901,7 @@ class MeshExecutor:
                     staged = v
                     break
         if staged is not None:
-            self._staged_cache.move_to_end(cache_key)
+            self._staged_cache.touch(cache_key)
         merged = capacity = None
         if staged is None:
             with _timed("read_columns"):
@@ -940,9 +954,10 @@ class MeshExecutor:
                         raise  # deterministic failures must not nuke the cache
                     # Device OOM: drop every cached staging and retry once —
                     # better than falling back to the host engine for a
-                    # gigarow table.
-                    self._staged_cache.clear()
-                    _STAGED_EVICTIONS.inc(reason="oom")
+                    # gigarow table. (Entries pinned by concurrent folds
+                    # survive as accounted zombies; their memory was never
+                    # ours to free.)
+                    self._staged_cache.clear(reason="oom")
                     staged = None
                 if staged is None:
                     # Retry OUTSIDE the except block: the in-flight exception's
@@ -956,23 +971,71 @@ class MeshExecutor:
                     self._staged_insert(
                         cache_key, staged, m.source_op.table_name, version
                     )
-        if merged is None:
-            with _timed("aux"):
-                aux = self._build_aux(
-                    evaluator, m, key_plan, table, device_specs
+        # Query-scoped pin (r12): from here until finalize returns, this
+        # query's staged entry cannot be evicted underneath its fold —
+        # not by a concurrent query's byte-watermark eviction, not by a
+        # version bump, not by the OOM clear. Pinning a key absent from
+        # the pool (non-cacheable staging) is a no-op.
+        with self._staged_cache.pin(cache_key if cacheable else None):
+            if merged is None:
+                with _timed("aux"):
+                    aux = self._build_aux(
+                        evaluator, m, key_plan, table, device_specs
+                    )
+                with _timed("program"):
+                    if flags.shared_scans:
+                        # Shared scan (r12): coalesce with any concurrent
+                        # query whose fold signature + aux values match —
+                        # one device dispatch, per-query finalize below.
+                        merged, capacity = self._shared_scan_run(
+                            m, device_specs, evaluator, key_plan, staged,
+                            aux, cache_key,
+                        )
+                    else:
+                        merged, capacity = self._run_program(
+                            m, device_specs, evaluator, key_plan, staged, aux
+                        )
+            elif flags.shared_scans and trace.ACTIVE:
+                # The stream path computed the fold during staging: no
+                # dispatch to share, but keep the span family uniform.
+                trace.record(
+                    "serving.shared_scan",
+                    0,
+                    attrs={"shared_scan_batch_size": 1, "role": "stream"},
                 )
-            with _timed("program"):
-                merged, capacity = self._run_program(
-                    m, device_specs, evaluator, key_plan, staged, aux
+            if (
+                self.fold_signature_store is not None
+                and staged is not None
+                and not windowed
+            ):
+                self._record_fold_shape(
+                    m, device_specs, key_plan, staged, capacity, aux
                 )
-        if m.agg_op.stage == AggStage.PARTIAL:
-            batch = self._partial_state_batch(
-                m, device_specs, key_plan, merged, table
-            )
-        elif windowed:
-            # One RowBatch per window, eow-cadenced like the host AggNode.
-            batch = [
-                self._finalize(
+            if m.agg_op.stage == AggStage.PARTIAL:
+                batch = self._partial_state_batch(
+                    m, device_specs, key_plan, merged, table
+                )
+            elif windowed:
+                # One RowBatch per window, eow-cadenced like the host
+                # AggNode.
+                batch = [
+                    self._finalize(
+                        m,
+                        specs,
+                        key_plan,
+                        capacity,
+                        merged,
+                        registry,
+                        table,
+                        host_any=host_any,
+                        group_range=(w * base_groups, base_groups),
+                        eow=True,
+                        eos=(w == n_windows - 1),
+                    )
+                    for w in range(n_windows)
+                ]
+            else:
+                batch = self._finalize(
                     m,
                     specs,
                     key_plan,
@@ -981,24 +1044,8 @@ class MeshExecutor:
                     registry,
                     table,
                     host_any=host_any,
-                    group_range=(w * base_groups, base_groups),
-                    eow=True,
-                    eos=(w == n_windows - 1),
                 )
-                for w in range(n_windows)
-            ]
-        else:
-            batch = self._finalize(
-                m,
-                specs,
-                key_plan,
-                capacity,
-                merged,
-                registry,
-                table,
-                host_any=host_any,
-            )
-        return m.agg_nid, batch
+            return m.agg_nid, batch
 
     # -- device join-aggregate (inner join fused into the agg) ---------------
     def _try_execute_join_agg(
@@ -1769,8 +1816,11 @@ class MeshExecutor:
             )
         from pixie_tpu.ops import segment as _segment
 
-        with _segment.platform_hint(self.mesh.devices.flat[0].platform):
-            outs = program(*args)
+        # Pin the staged entry for the dispatch + prefix fetch (r12): a
+        # concurrent query's eviction pass must not drop it mid-scan.
+        with self._staged_cache.pin(cache_key):
+            with _segment.platform_hint(self.mesh.devices.flat[0].platform):
+                outs = program(*args)
         written = np.asarray(outs[0])  # [D]
         cap_out = m.limit + staged.block_rows
         ndev = staged.num_devices
@@ -1805,10 +1855,8 @@ class MeshExecutor:
         return m.limit_nid, batch
 
     def _staged_lookup(self, cache_key):
-        staged = self._staged_cache.get(cache_key)
-        if staged is not None:
-            self._staged_cache.move_to_end(cache_key)
-        return staged
+        # ResidencyPool.get LRU-touches on hit.
+        return self._staged_cache.get(cache_key)
 
     def _stage_cached(
         self,
@@ -1846,8 +1894,7 @@ class MeshExecutor:
                 "Out of memory" not in str(e)
             ):
                 raise
-            self._staged_cache.clear()
-            _STAGED_EVICTIONS.inc(reason="oom")
+            self._staged_cache.clear(reason="oom")
             staged = None
         if staged is None:
             staged = self._stage(cols, n, key_plan, table, f32_cols)
@@ -1857,17 +1904,10 @@ class MeshExecutor:
         return staged
 
     def _staged_insert(self, cache_key, staged, table_name, version) -> None:
-        for k in [
-            k
-            for k in self._staged_cache
-            if k[0] == table_name and k[1] != version
-        ]:
-            del self._staged_cache[k]
-            _STAGED_EVICTIONS.inc(reason="version")
-        self._staged_cache[cache_key] = staged
-        while len(self._staged_cache) > self._staged_cache_cap:
-            self._staged_cache.popitem(last=False)
-            _STAGED_EVICTIONS.inc(reason="lru")
+        """Register a staging with the residency pool: version
+        supersession, the byte watermark (hbm_budget_mb), and the LRU
+        entry cap all happen inside (serving/residency.py)."""
+        self._staged_cache.insert(cache_key, staged, table_name, version)
 
     def _build_scan_program(
         self, m: _ScanMatch, evaluator, staged, aux_key_order, out_dtypes
@@ -2837,6 +2877,24 @@ class MeshExecutor:
 
         from pixie_tpu.parallel import staging as _staging
 
+        # r12: when a fold-signature store is wired and holds shapes this
+        # table's real queries recorded (serving/signatures.py), replay
+        # THEM — bit-identical fold signatures through the same
+        # _unit_programs path — instead of guessing the canonical shape.
+        # The canonical guess remains the cold-start fallback.
+        if self.fold_signature_store is not None:
+            sigs = [
+                sig
+                for sig in (
+                    self._prewarm_recorded_shape(table, registry, shape)
+                    for shape in self.fold_signature_store.shapes(
+                        table.name or ""
+                    )
+                )
+                if sig is not None
+            ]
+            if sigs:
+                return sigs[-1]
         rel = table.relation
         str_cols = [c.name for c in rel if c.data_type == DataType.STRING]
         f64_cols = [c.name for c in rel if c.data_type == DataType.FLOAT64]
@@ -2927,6 +2985,118 @@ class MeshExecutor:
             fold_sig, fold_p, tuple(avals), profile_key="prewarm_compile"
         )
         return fold_sig
+
+    def _prewarm_recorded_shape(self, table, registry, shape: dict):
+        """Replay ONE recorded fold shape (serving/signatures.py) through
+        the same _unit_programs path a real query takes: recorded key
+        column + agg lanes + capacity + EXACT staged block dtypes and
+        geometry reproduce the original fold signature bit-for-bit, so
+        the restarted process AOT-compiles (or .jax_cache-deserializes)
+        precisely the executables its workload will ask for. Returns the
+        fold signature, or None when the shape no longer applies (schema
+        drift, mesh resize, missing UDA)."""
+        import types as _types
+
+        try:
+            d, nblk, b = (int(x) for x in shape["geometry"])
+            if d != self.mesh.devices.size:
+                return None
+            key_col = shape["key_col"]
+            rel = table.relation
+            specs = []
+            for i, (uname, col, argts) in enumerate(shape["lanes"]):
+                if col is None:
+                    # reads_args=False lane (count): the arg never enters
+                    # the fold signature; any resolvable overload works.
+                    uda = registry.lookup_uda(uname, [DataType.STRING])
+                    if uda is None:
+                        return None
+                    specs.append((f"pw{i}", ColumnRef(key_col), uda))
+                    continue
+                uda = registry.lookup_uda(
+                    uname, [DataType[t] for t in argts]
+                )
+                if uda is None:
+                    return None
+                specs.append((f"pw{i}", ColumnRef(col), uda))
+            named = [
+                (f"arg:{out}:0", e)
+                for out, e, uda in specs
+                if uda.reads_args
+            ]
+            named.append((f"key:{key_col}", ColumnRef(key_col)))
+            evaluator = ExpressionEvaluator(named, rel, registry, None)
+            key_plan = _KeyPlan(
+                device_expr=ColumnRef(key_col), num_groups=1
+            )
+            capacity = int(shape["capacity"])
+            blocks = {
+                name: _types.SimpleNamespace(
+                    shape=(d, nblk, b), dtype=np.dtype(dt)
+                )
+                for name, dt in shape["blocks"].items()
+            }
+            narrow = list(shape.get("narrow") or ())
+            shim = _types.SimpleNamespace(
+                blocks=blocks,
+                mask=_types.SimpleNamespace(shape=(d, nblk, b)),
+                narrow_offsets={n2: 0 for n2 in narrow},
+                int_dicts={},
+            )
+            m_shim = _types.SimpleNamespace(
+                predicates=[],
+                agg_op=_types.SimpleNamespace(stage=AggStage.FULL),
+            )
+            _treedef, leaves = self._state_template(specs, capacity)
+            _i, fold_p, _mg, _f, fold_sig = self._unit_programs(
+                m_shim, specs, evaluator, key_plan, shim, [], [], capacity
+            )
+            self._prewarmed.add(fold_sig)
+            if fold_sig in self._aot_compiled or (
+                fold_sig in self._aot_futures
+            ):
+                return fold_sig
+            (axis_name,) = self.mesh.axis_names
+            sharded = NamedSharding(self.mesh, P(axis_name))
+            repl = NamedSharding(self.mesh, P())
+            avals = [
+                jax.ShapeDtypeStruct(
+                    (d,) + tuple(l.shape), l.dtype, sharding=sharded
+                )
+                for l in leaves
+            ]
+            avals += [
+                jax.ShapeDtypeStruct(
+                    (d, nblk, b), blocks[n2].dtype, sharding=sharded
+                )
+                for n2 in sorted(blocks)
+            ]
+            avals.append(
+                jax.ShapeDtypeStruct(
+                    (d, nblk, b), np.dtype(np.bool_), sharding=sharded
+                )
+            )
+            if narrow:
+                avals.append(
+                    jax.ShapeDtypeStruct(
+                        (len(narrow),), np.dtype(np.int64), sharding=repl
+                    )
+                )
+            avals.append(
+                jax.ShapeDtypeStruct((), np.dtype(np.int32), sharding=repl)
+            )
+            self._aot_compile_async(
+                fold_sig, fold_p, tuple(avals),
+                profile_key="prewarm_compile",
+            )
+            return fold_sig
+        except Exception as e:
+            import traceback
+
+            key = f"replay {type(e).__name__}: {e}"
+            if key not in self.prewarm_errors:
+                self.prewarm_errors[key] = traceback.format_exc()
+            return None
 
     def _make_scan_body(
         self,
@@ -3794,6 +3964,66 @@ class MeshExecutor:
             values.append(jax.tree.unflatten(treedef, out_leaves))
         presence = unpack_int(capacity)
         return values, presence
+
+    def _shared_scan_run(
+        self, m, specs, evaluator, key_plan, staged, aux, cache_key
+    ):
+        """Run the fold through the shared-scan coordinator (r12, flag
+        ``shared_scans``): concurrent queries whose coalescing key
+        matches share ONE dispatch and each runs only its own finalize.
+
+        The key is everything the merged states depend on: the staged
+        entry's IDENTITY (same arrays, via the cache key + object id),
+        the fold signature (predicates, UDA lanes, key mode, geometry,
+        aux shapes — output names and finalize modes excluded, so
+        queries differing only there coalesce), and a content digest of
+        the replicated aux values + key LUT (equal shapes with different
+        values must not share)."""
+        from pixie_tpu.serving.shared_scan import aux_digest
+
+        aux2 = dict(aux)
+        for n2 in sorted(staged.int_dicts):
+            aux2[f"intdict:{n2}"] = np.asarray(staged.int_dicts[n2])
+        aux_vals = list(aux2.values())
+        capacity, _n_passes = self._pass_plan(specs, key_plan.num_groups)
+        fold_sig = self._fold_signature(
+            m, specs, key_plan, staged, aux_vals, capacity
+        )
+        digest_vals = list(aux_vals)
+        if isinstance(key_plan.device_expr, tuple):
+            digest_vals.append(np.asarray(key_plan.device_expr[2]))
+        key = (cache_key, fold_sig, aux_digest(digest_vals), id(staged))
+        return self._shared_scans.run(
+            key,
+            lambda: self._run_program(
+                m, specs, evaluator, key_plan, staged, aux
+            ),
+        )
+
+    def _record_fold_shape(
+        self, m, specs, key_plan, staged, capacity, aux
+    ) -> None:
+        """Persist this query's fold shape for cross-restart prewarm
+        replay (r12 satellite) when it is inside the replayable profile:
+        device dictionary-code group key, bare-column agg args, no
+        predicates/aux/windows. Best-effort — recording failures never
+        touch the query."""
+        if aux or capacity is None:
+            return
+        try:
+            from pixie_tpu.serving.signatures import shape_from_staged
+
+            shape = shape_from_staged(m, specs, key_plan, staged, capacity)
+            if shape is not None:
+                self.fold_signature_store.record(
+                    m.source_op.table_name, shape
+                )
+        except Exception:
+            import logging
+
+            logging.getLogger("pixie_tpu.parallel").warning(
+                "fold-shape record failed (ignored)", exc_info=True
+            )
 
     def _run_program(self, m, specs, evaluator, key_plan, staged, aux):
         """Execute the staged aggregation. Default (program_decompose):

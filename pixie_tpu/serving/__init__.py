@@ -1,0 +1,36 @@
+"""Multi-query serving engine (r12).
+
+The single-chip hot path (r5-r8) and control plane (r9-r11) assume one
+query owns the chip; the reference's query-broker + script-runner model
+(SURVEY.md §vizier) assumes hundreds of concurrent PxL scripts hitting
+the SAME hot tables. This package is the layer between them:
+
+- ``residency``: the HBM staged-table pool — per-entry byte accounting,
+  query-scoped pinning, LRU eviction with high/low watermarks against
+  ``hbm_budget_mb``. Replaces the entry-count OrderedDict the
+  MeshExecutor carried since r4.
+- ``shared_scan``: concurrent queries whose fold signatures match (the
+  r7 decomposed init/fold/merge/finalize units make compatibility a
+  string compare) coalesce into ONE device fold dispatch; finalize fans
+  out per query (shared-scan engines: Crescando/SharedDB).
+- ``admission``: broker-side admission control — concurrency limit,
+  per-tenant weighted fair queueing, HBM byte-budget check, structured
+  ``AdmissionRejected`` on overload (never a hang).
+- ``signatures``: datastore-backed persistence of observed fold shapes
+  so ``prewarm_compile`` replays real query shapes across restarts
+  instead of guessing the canonical count+sum(f64) shape.
+"""
+
+from pixie_tpu.serving.admission import AdmissionController, AdmissionRejected
+from pixie_tpu.serving.residency import ResidencyPool, staged_nbytes
+from pixie_tpu.serving.shared_scan import SharedScanCoordinator
+from pixie_tpu.serving.signatures import FoldSignatureStore
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "FoldSignatureStore",
+    "ResidencyPool",
+    "SharedScanCoordinator",
+    "staged_nbytes",
+]

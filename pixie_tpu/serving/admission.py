@@ -1,0 +1,320 @@
+"""Broker admission control: concurrency limit + weighted fair queueing.
+
+Ref posture: the reference's query broker accepts every ExecuteScript and
+lets timeouts sort out overload; a broker serving heavy traffic needs a
+front door. This controller gives ``QueryBroker.execute_script`` one:
+
+- **Concurrency limit.** At most ``admission_max_concurrent`` queries
+  execute at once; arrivals past that wait in a bounded queue
+  (``admission_max_queue``) and past THAT are rejected immediately with
+  a structured ``AdmissionRejected`` — overload degrades into fast
+  errors, never into unbounded memory or a hang.
+- **Per-tenant weighted fair queueing.** Waiters are granted in
+  virtual-finish-time order: a tenant's request is stamped
+  ``max(vclock, tenant_last) + 1/weight``, so a tenant's own backlog
+  accrues virtual time linearly while a quiet tenant's first request
+  lands just after the clock — a starved tenant schedules ahead of a
+  heavy tenant's backlog tail, and a 2x-weighted tenant drains twice as
+  fast under contention (classic WFQ/SFQ virtual-clock scheduling).
+- **HBM byte-budget check.** Before admitting, the controller consults
+  the residency pool (when wired): if PINNED bytes already exceed the
+  budget, no eviction can make room for this query's staging — reject
+  with ``reason="hbm_budget"`` instead of letting it OOM the device.
+- **Observability.** Queue depth / active gauges, a wait-time histogram
+  (the r11 Histogram kind), and per-reason rejection counters on the
+  shared /metrics registry; ``snapshot()`` feeds the broker's /statusz
+  (the r10 health plane).
+
+Fault site ``serving.admission_reject`` forces a rejection so chaos
+tests can prove the structured-error path end to end.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Optional
+
+from pixie_tpu.utils import faults, flags, metrics_registry
+
+_M = metrics_registry()
+_QUEUE_DEPTH = _M.gauge(
+    "admission_queue_depth", "Queries waiting in the admission queue."
+)
+_ACTIVE = _M.gauge(
+    "admission_active", "Queries currently admitted and executing."
+)
+_ADMITTED = _M.counter(
+    "admission_admitted_total", "Queries admitted, by tenant."
+)
+_REJECTED = _M.counter(
+    "admission_rejected_total", "Queries rejected, by reason."
+)
+_WAIT_SECONDS = _M.histogram(
+    "admission_wait_seconds",
+    "Time a query spent in the admission queue before grant/rejection.",
+)
+
+
+class AdmissionRejected(RuntimeError):
+    """Structured overload rejection: carries enough for a client to
+    back off intelligently (reason, tenant, live queue depth, how long
+    the request waited)."""
+
+    def __init__(
+        self,
+        tenant: str,
+        reason: str,
+        queue_depth: int = 0,
+        waited_s: float = 0.0,
+        detail: str = "",
+    ):
+        super().__init__(
+            f"admission rejected for tenant {tenant!r}: {reason}"
+            + (f" ({detail})" if detail else "")
+            + f" [queue_depth={queue_depth}, waited={waited_s:.3f}s]"
+        )
+        self.tenant = tenant
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.waited_s = waited_s
+        self.detail = detail
+
+    def to_dict(self) -> dict:
+        return {
+            "tenant": self.tenant,
+            "reason": self.reason,
+            "queue_depth": self.queue_depth,
+            "waited_s": round(self.waited_s, 6),
+            "detail": self.detail,
+        }
+
+
+def parse_tenant_weights(spec: str) -> dict[str, float]:
+    """'tenant:weight,tenant:weight' -> {tenant: weight}; malformed
+    entries are skipped (a typo'd weight must not take the broker down)."""
+    out: dict[str, float] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, w = entry.rpartition(":")
+        try:
+            weight = float(w)
+        except ValueError:
+            continue
+        if name and weight > 0:
+            out[name] = weight
+    return out
+
+
+class _Waiter:
+    __slots__ = ("vtime", "seq", "tenant", "granted", "abandoned")
+
+    def __init__(self, vtime: float, seq: int, tenant: str):
+        self.vtime = vtime
+        self.seq = seq
+        self.tenant = tenant
+        self.granted = False
+        self.abandoned = False  # timed out: skip when popped
+
+    def __lt__(self, other: "_Waiter") -> bool:
+        return (self.vtime, self.seq) < (other.vtime, other.seq)
+
+
+class _Ticket:
+    """Held by an admitted query; release() frees the slot (idempotent).
+    Usable as a context manager."""
+
+    def __init__(self, ctl: "AdmissionController", tenant: str, waited_s):
+        self._ctl = ctl
+        self.tenant = tenant
+        self.waited_s = waited_s
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._ctl._release()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        max_concurrent: Optional[int] = None,
+        max_queue: Optional[int] = None,
+        timeout_s: Optional[float] = None,
+        tenant_weights: Optional[dict[str, float]] = None,
+        budget_fn: Optional[Callable[[], dict]] = None,
+    ):
+        """Unset limits re-read their flags per call, so runtime flag
+        flips apply live. ``budget_fn`` returns a residency snapshot
+        (ResidencyPool.snapshot-shaped: pinned_bytes/budget_bytes)."""
+        self._max_concurrent = max_concurrent
+        self._max_queue = max_queue
+        self._timeout_s = timeout_s
+        self._weights = tenant_weights
+        self._budget_fn = budget_fn
+        self._cv = threading.Condition()
+        self._active = 0
+        self._heap: list[_Waiter] = []
+        self._waiting = 0
+        self._vclock = 0.0
+        self._tenant_vtime: dict[str, float] = {}
+        self._seq = itertools.count()
+
+    # -- limits (flag-backed unless pinned at construction) ------------------
+    def _limit(self) -> int:
+        return (
+            self._max_concurrent
+            if self._max_concurrent is not None
+            else max(int(flags.admission_max_concurrent), 1)
+        )
+
+    def _queue_cap(self) -> int:
+        return (
+            self._max_queue
+            if self._max_queue is not None
+            else max(int(flags.admission_max_queue), 0)
+        )
+
+    def _timeout(self) -> float:
+        return (
+            self._timeout_s
+            if self._timeout_s is not None
+            else float(flags.admission_timeout_s)
+        )
+
+    def _weight(self, tenant: str) -> float:
+        weights = (
+            self._weights
+            if self._weights is not None
+            else parse_tenant_weights(flags.admission_tenant_weights)
+        )
+        return float(weights.get(tenant, 1.0))
+
+    # -- the front door ------------------------------------------------------
+    def acquire(self, tenant: str = "default") -> _Ticket:
+        """Block until admitted (WFQ order) or raise AdmissionRejected.
+        Every exit path is bounded: queue-full and budget rejections are
+        immediate, a queued request rejects at ``admission_timeout_s``."""
+        t0 = time.monotonic()
+        with self._cv:
+            if faults.ACTIVE and faults.fires("serving.admission_reject"):
+                self._reject(tenant, "fault_injected", t0)
+            self._budget_check(tenant, t0)
+            # Prune timed-out waiters off the heap top so a queue of
+            # abandoned entries cannot block the immediate-admit path.
+            while self._heap and self._heap[0].abandoned:
+                heapq.heappop(self._heap)
+            if self._active < self._limit() and not self._heap:
+                self._active += 1
+                self._vclock = max(
+                    self._vclock,
+                    self._tenant_vtime.get(tenant, 0.0),
+                ) + 1.0 / self._weight(tenant)
+                self._tenant_vtime[tenant] = self._vclock
+                self._publish()
+                _ADMITTED.inc(tenant=tenant)
+                _WAIT_SECONDS.observe(0.0)
+                return _Ticket(self, tenant, 0.0)
+            if self._waiting >= self._queue_cap():
+                self._reject(tenant, "queue_full", t0)
+            w = _Waiter(
+                max(self._vclock, self._tenant_vtime.get(tenant, 0.0))
+                + 1.0 / self._weight(tenant),
+                next(self._seq),
+                tenant,
+            )
+            self._tenant_vtime[tenant] = w.vtime
+            heapq.heappush(self._heap, w)
+            self._waiting += 1
+            self._publish()
+            deadline = t0 + self._timeout()
+            while not w.granted:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    w.abandoned = True
+                    self._waiting -= 1
+                    self._publish()
+                    self._reject(tenant, "timeout", t0)
+                self._cv.wait(timeout=remaining)
+            waited = time.monotonic() - t0
+            _ADMITTED.inc(tenant=tenant)
+            _WAIT_SECONDS.observe(waited)
+            return _Ticket(self, tenant, waited)
+
+    def _budget_check(self, tenant: str, t0: float) -> None:
+        """Reject when the HBM residency pool has no reclaimable
+        headroom: pinned bytes (in-flight folds) already at/over budget
+        means eviction cannot make room for this query's staging."""
+        if self._budget_fn is None:
+            return
+        try:
+            snap = self._budget_fn() or {}
+        except Exception:
+            return  # budget view is advisory; never fail admission on it
+        budget = snap.get("budget_bytes") or 0
+        pinned = snap.get("pinned_bytes") or 0
+        if budget > 0 and pinned >= budget:
+            self._reject(
+                tenant,
+                "hbm_budget",
+                t0,
+                detail=f"pinned {pinned}B >= budget {budget}B",
+            )
+
+    def _reject(self, tenant: str, reason: str, t0: float, detail=""):
+        waited = time.monotonic() - t0
+        _REJECTED.inc(reason=reason)
+        _WAIT_SECONDS.observe(waited)
+        raise AdmissionRejected(
+            tenant,
+            reason,
+            queue_depth=self._waiting,
+            waited_s=waited,
+            detail=detail,
+        )
+
+    def _release(self) -> None:
+        with self._cv:
+            self._active -= 1
+            while self._heap and self._active < self._limit():
+                w = heapq.heappop(self._heap)
+                if w.abandoned:
+                    continue
+                w.granted = True
+                self._waiting -= 1
+                self._active += 1
+                self._vclock = max(self._vclock, w.vtime)
+            self._publish()
+            self._cv.notify_all()
+
+    def _publish(self) -> None:
+        _QUEUE_DEPTH.set(self._waiting)
+        _ACTIVE.set(self._active)
+
+    def snapshot(self) -> dict:
+        """Admission state for /statusz (the r10 health plane) and the
+        soak harness."""
+        with self._cv:
+            return {
+                "active": self._active,
+                "queue_depth": self._waiting,
+                "max_concurrent": self._limit(),
+                "max_queue": self._queue_cap(),
+                "vclock": round(self._vclock, 6),
+                "tenants": {
+                    t: round(v, 6)
+                    for t, v in sorted(self._tenant_vtime.items())
+                },
+            }

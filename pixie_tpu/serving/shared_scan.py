@@ -1,0 +1,148 @@
+"""Shared scans: concurrent compatible queries share one fold dispatch.
+
+Ref posture: shared-scan engines (Crescando, SharedDB) batch concurrent
+queries over the same hot table into one scan whose per-query predicates
+evaluate inline. Here the unit of sharing is even cleaner: the r7
+program decomposition split every device aggregation into
+init/fold/merge/finalize units, with the FOLD signature excluding output
+names and finalize modes — so two queries that differ only in what they
+call their outputs, or how they finalize (FULL vs PARTIAL, a different
+quantile over the same sketch lane), already share one compiled fold
+EXECUTABLE. This module makes them share one fold EXECUTION: the first
+arrival (the leader) dispatches; compatible queries arriving while the
+dispatch is in flight (plus an optional pre-dispatch batching window,
+``shared_scan_window_ms``) attach to it and reuse the leader's merged
+UDA states. Finalize fans out per query, so results are bit-identical
+to serial execution — followers consume the exact arrays the leader's
+dispatch produced.
+
+Compatibility is a KEY equality, not a heuristic: the key is the staged
+cache identity (table, version, column set, window, key plan, geometry)
++ the fold signature (predicates, UDA lanes, key mode, aux shapes) + a
+digest of the replicated aux VALUES (two LUTs with equal shapes but
+different contents must not share). Anything that could change the
+merged states is in the key.
+
+Observability: each participating query records a ``serving.shared_scan``
+trace span carrying ``shared_scan_batch_size`` and its role, and the
+shared /metrics registry counts dispatches vs saved dispatches so the
+≥2x dispatch-reduction acceptance bar is measurable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from pixie_tpu.utils import flags, metrics_registry, trace
+
+_M = metrics_registry()
+_DISPATCHES = _M.counter(
+    "serving_shared_scan_dispatches_total",
+    "Device fold dispatches issued through the shared-scan coordinator.",
+)
+_SAVED = _M.counter(
+    "serving_shared_scan_saved_dispatches_total",
+    "Device fold dispatches avoided by joining another query's in-flight "
+    "(or batching-window) shared scan.",
+)
+_BATCH_SIZE = _M.histogram(
+    "serving_shared_scan_batch_size",
+    "Queries served per shared-scan dispatch.",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 64),
+)
+
+
+def aux_digest(aux_vals) -> str:
+    """Content digest of the replicated aux arguments (key LUTs,
+    int-dict LUTs, constants): aux SHAPES are in the fold signature but
+    two queries with equal shapes and different values must not share a
+    dispatch."""
+    h = hashlib.sha1()
+    for v in aux_vals:
+        a = np.asarray(v)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+class _Batch:
+    __slots__ = ("event", "result", "error", "joiners", "closed")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+        self.error: "BaseException | None" = None
+        self.joiners = 1  # the leader
+        self.closed = False  # result published; late arrivals start fresh
+
+
+class SharedScanCoordinator:
+    """Coalesces identical-key compute() calls into one execution.
+
+    ``run(key, compute)`` — the first caller for a key becomes the
+    leader: it (optionally) waits the batching window, executes
+    ``compute()``, publishes the result, and wakes the batch. Callers
+    arriving before publication join the batch and return the leader's
+    result without dispatching. A leader error propagates to every
+    joiner (each would have hit the same error; retrying it N times
+    against a failing device would just churn the breaker)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: dict[Any, _Batch] = {}
+
+    def run(self, key, compute: Callable[[], Any]):
+        with self._lock:
+            batch = self._inflight.get(key)
+            if batch is not None and not batch.closed:
+                batch.joiners += 1
+                leader = False
+            else:
+                batch = self._inflight[key] = _Batch()
+                leader = True
+        if leader:
+            window_s = float(flags.shared_scan_window_ms) / 1e3
+            if window_s > 0:
+                time.sleep(window_s)
+            try:
+                result = compute()
+                err = None
+            except BaseException as e:  # propagate to every joiner
+                result, err = None, e
+            with self._lock:
+                batch.result = result
+                batch.error = err
+                batch.closed = True
+                if self._inflight.get(key) is batch:
+                    del self._inflight[key]
+                size = batch.joiners
+            batch.event.set()
+            _DISPATCHES.inc()
+            _BATCH_SIZE.observe(size)
+            self._span(size, role="leader")
+            if err is not None:
+                raise err
+            return result
+        batch.event.wait()
+        _SAVED.inc()
+        with self._lock:
+            size = batch.joiners
+        self._span(size, role="follower")
+        if batch.error is not None:
+            raise batch.error
+        return batch.result
+
+    @staticmethod
+    def _span(batch_size: int, role: str) -> None:
+        if trace.ACTIVE:
+            trace.record(
+                "serving.shared_scan",
+                0,
+                attrs={"shared_scan_batch_size": batch_size, "role": role},
+            )

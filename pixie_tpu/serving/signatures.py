@@ -1,0 +1,137 @@
+"""Datastore-backed persistence of observed fold shapes (prewarm replay).
+
+The r8 table-create prewarm guesses ONE canonical query shape per table
+(groupby(first string column).agg(count, sum of every f64 column)) and
+compiles its fold at create time. That guess misses every real workload
+quirk: a dashboard that group-bys a different column, min/max lanes, a
+capacity driven by real group cardinality, block dtypes narrowed by the
+actual data range. This store closes the loop: when a device query's
+shape is simple enough to replay (bare-column group key on the device
+dictionary path, bare-column agg args, no predicates/aux), the
+MeshExecutor records the fold-relevant facts — key column, agg lanes,
+capacity, the staged blocks' EXACT dtypes/geometry, the narrowed column
+set — keyed ``foldsig/<table>`` in a vizier datastore (in-memory,
+file-log, or sqlite backend). After a restart, ``prewarm_table`` replays
+every recorded shape through the same ``_unit_programs`` path a real
+query takes, producing bit-identical fold signatures — so the first
+query after restart finds its executable AOT-compiled (or the
+persistent .jax_cache entry deserializing) instead of compiling inline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Optional
+
+_log = logging.getLogger("pixie_tpu.serving")
+
+# Shapes kept per table: enough for a dashboard's query mix; LRU-ish
+# (oldest recorded shape drops first) so a churning workload converges.
+MAX_SHAPES_PER_TABLE = 8
+
+_PREFIX = "foldsig/"
+
+
+class FoldSignatureStore:
+    """Record/replay of observed fold shapes over a vizier datastore.
+
+    A shape is a JSON dict with keys:
+      ``key_col``   group-by column (device dictionary-code key path)
+      ``lanes``     [[uda_name, arg_col|None, arg_dtype_name|None], ...]
+      ``capacity``  padded group capacity the pass plan chose
+      ``blocks``    {col: numpy dtype str} — EXACT staged block dtypes
+      ``narrow``    [cols] staged frame-of-reference narrowed
+      ``geometry``  [d, nblk, b] — the staged block geometry observed
+    Everything the fold signature derives from, nothing it doesn't."""
+
+    def __init__(self, datastore):
+        self._ds = datastore
+        self._lock = threading.Lock()
+
+    def record(self, table_name: str, shape: dict) -> bool:
+        """Append a shape for ``table_name`` (dedup by content; capped at
+        MAX_SHAPES_PER_TABLE, oldest first out). Returns True when the
+        store changed. Never raises — persistence is advisory."""
+        try:
+            blob = json.dumps(shape, sort_keys=True)
+            with self._lock:
+                shapes = self._load(table_name)
+                if blob in shapes:
+                    return False
+                shapes.append(blob)
+                del shapes[:-MAX_SHAPES_PER_TABLE]
+                self._ds.set(
+                    _PREFIX + table_name,
+                    json.dumps(shapes).encode(),
+                )
+            return True
+        except Exception:
+            _log.warning(
+                "fold-signature record failed for %r (ignored)",
+                table_name,
+                exc_info=True,
+            )
+            return False
+
+    def shapes(self, table_name: str) -> list[dict]:
+        """Recorded shapes for a table, oldest first; [] on any error."""
+        try:
+            with self._lock:
+                return [json.loads(b) for b in self._load(table_name)]
+        except Exception:
+            return []
+
+    def tables(self) -> list[str]:
+        try:
+            return [
+                k[len(_PREFIX):] for k in self._ds.keys(prefix=_PREFIX)
+            ]
+        except Exception:
+            return []
+
+    def _load(self, table_name: str) -> list[str]:
+        raw = self._ds.get(_PREFIX + table_name)
+        if not raw:
+            return []
+        out = json.loads(raw.decode())
+        return out if isinstance(out, list) else []
+
+
+def shape_from_staged(m, specs, key_plan, staged, capacity) -> Optional[dict]:
+    """Distill a replayable shape from a successful device aggregation,
+    or None when the query is outside the replayable profile (predicates,
+    aux arguments, LUT/host-gid key paths, windowing — their fold
+    signatures need inputs prewarm cannot reconstruct from a record)."""
+    from pixie_tpu.plan.expressions import ColumnRef
+
+    if m.predicates:
+        return None
+    if key_plan.host_gids is not None or not isinstance(
+        key_plan.device_expr, ColumnRef
+    ):
+        return None
+    if getattr(staged, "int_dicts", None):
+        return None  # int-dict LUTs ride aux: not reconstructible
+    lanes = []
+    for _out, arg_e, uda in specs:
+        if not uda.reads_args:
+            lanes.append([uda.name, None, None])
+            continue
+        if not isinstance(arg_e, ColumnRef):
+            return None
+        lanes.append(
+            [uda.name, arg_e.name, [t.name for t in uda.arg_types]]
+        )
+    mask_shape = tuple(staged.mask.shape)
+    return {
+        "key_col": key_plan.device_expr.name,
+        "lanes": lanes,
+        "capacity": int(capacity),
+        "blocks": {
+            name: str(a.dtype) for name, a in staged.blocks.items()
+        },
+        "narrow": sorted(staged.narrow_offsets),
+        "geometry": [int(x) for x in mask_shape],
+    }

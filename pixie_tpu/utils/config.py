@@ -277,6 +277,77 @@ define_flag(
     "before a half-open trial is allowed back on the mesh.",
 )
 
+# -- serving (r12): HBM residency, shared scans, admission control ----------
+define_flag(
+    "serving_enabled",
+    False,
+    help_="Multi-query serving mode (pixie_tpu/serving/): "
+    "QueryBroker.execute_script routes through admission control "
+    "(concurrency limit + per-tenant weighted fair queueing + HBM "
+    "byte-budget check), rejecting with a structured AdmissionRejected "
+    "on overload instead of queueing unboundedly. Off = the r11 "
+    "one-query-at-a-time relay behavior.",
+)
+define_flag(
+    "hbm_budget_mb",
+    0,
+    help_="HBM byte budget for the staged-table residency pool "
+    "(serving/residency.py). Inserting past the high watermark (95% of "
+    "the budget) evicts LRU unpinned entries until under the low "
+    "watermark (80%); pinned entries (in-flight folds) are never "
+    "evicted. 0 = no byte budget (entry-count staged_cache_cap only).",
+)
+define_flag(
+    "shared_scans",
+    True,
+    help_="Coalesce concurrent compatible queries over the same staged "
+    "table into ONE device fold dispatch (serving/shared_scan.py): "
+    "queries whose fold signatures match (r7 decomposed units — output "
+    "names and finalize modes excluded) share the leader's merged "
+    "states and fan out per-query finalizes. Results are bit-identical "
+    "to serial execution; saved dispatches are counted "
+    "(serving_shared_scan_saved_dispatches_total) and each query's "
+    "trace records shared_scan_batch_size.",
+)
+define_flag(
+    "shared_scan_window_ms",
+    0.0,
+    help_="Batching window before a shared-scan leader dispatches: the "
+    "leader waits this long for compatible queries to join its batch. "
+    "0 (default) coalesces only queries that overlap the dispatch "
+    "itself — no added latency; soak/serving harnesses raise it to "
+    "trade p50 for dispatch reduction.",
+)
+define_flag(
+    "admission_max_concurrent",
+    8,
+    help_="Queries executing concurrently through the broker's admission "
+    "controller (serving/admission.py) before new arrivals queue.",
+)
+define_flag(
+    "admission_max_queue",
+    64,
+    help_="Queued queries the admission controller holds before "
+    "rejecting new arrivals with AdmissionRejected(reason=queue_full).",
+)
+define_flag(
+    "admission_timeout_s",
+    10.0,
+    help_="Longest a query waits in the admission queue before a "
+    "structured AdmissionRejected(reason=timeout) — a rejected query "
+    "returns an error, never hangs.",
+)
+define_flag(
+    "admission_tenant_weights",
+    "",
+    help_="Per-tenant weighted-fair-queueing weights, "
+    "'tenant:weight,tenant:weight'. Unlisted tenants get weight 1.0; a "
+    "tenant's queued queries accrue virtual time at 1/weight, so a "
+    "2x-weighted tenant drains twice as fast under contention and a "
+    "starved tenant's first query always schedules ahead of a heavy "
+    "tenant's backlog tail.",
+)
+
 # -- robustness (r10): acked delivery + cluster health plane -----------------
 # (transport_ack_* / transport_window_block_s are declared next to their
 # use in vizier/transport.py.)

@@ -14,7 +14,13 @@ replay ignores the server's applied watermark and re-sends delivered
 frames, ``transport.conn_kill_midflight`` — the server kills the
 connection AFTER applying a frame but before acking it, the
 previously-ambiguous retry case; scope it ``@control``/``@data`` to target
-one plane), and tests/operators arm them deterministically.
+one plane; r12 serving sites: ``serving.admission_reject`` — the broker's
+admission controller force-rejects the query (chaos tests prove a
+rejected query returns a structured AdmissionRejected, never a hang),
+``serving.evict_pinned_attempt`` — checked whenever an eviction pass in
+the HBM residency pool SKIPS an entry because an in-flight fold has it
+pinned (chaos tests prove the pin held)), and tests/operators arm them
+deterministically.
 
 Design contract:
 

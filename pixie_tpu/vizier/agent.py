@@ -64,6 +64,16 @@ class Agent:
         # incarnation (an old connection's buffered heartbeat must not
         # resurrect pre-reconnect state; r10 satellite).
         self._epoch = 0
+        # Executed-query dedup (r12): the broker re-offers unacked
+        # fragment launches when we re-register after a reconnect gap;
+        # when BOTH the original and the re-offer arrive, the second is
+        # dropped here (one sub-plan per agent per query, so query_id is
+        # the dedup key). Bounded so a long-lived agent never leaks.
+        import collections
+
+        self._seen_queries: "collections.OrderedDict[str, bool]" = (
+            collections.OrderedDict()
+        )
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -145,6 +155,12 @@ class Agent:
             if msg is None:
                 continue
             if msg.get("type") == "execute_fragment":
+                qid = msg.get("query_id")
+                if qid in self._seen_queries:
+                    continue  # re-offered launch we already ran
+                self._seen_queries[qid] = True
+                while len(self._seen_queries) > 512:
+                    self._seen_queries.popitem(last=False)
                 threading.Thread(
                     target=self._execute_fragment, args=(msg,), daemon=True
                 ).start()

@@ -57,6 +57,11 @@ _FORWARD_DROPPED = _M.counter(
 _QUERY_SECONDS = _M.histogram(
     "broker_query_seconds", "End-to-end broker query latency."
 )
+_REOFFERS = _M.counter(
+    "broker_launch_reoffers_total",
+    "execute_fragment launches re-offered to an agent that re-registered "
+    "while a launch was still unacknowledged (reconnect-gap hole, r12).",
+)
 
 
 class AgentTracker:
@@ -74,8 +79,16 @@ class AgentTracker:
         self._lock = threading.Lock()
         self._agents: dict[str, dict] = {}
         self._stop = threading.Event()
+        # fn(agent_id, epoch) fired on every "register" message (r12):
+        # the broker re-offers unacknowledged fragment launches to an
+        # agent that re-registered after a reconnect gap.
+        self._register_listeners: list = []
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def add_register_listener(self, fn) -> None:
+        with self._lock:
+            self._register_listeners.append(fn)
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -95,6 +108,18 @@ class AgentTracker:
                         "epoch": epoch,
                         "health": msg.get("health"),
                     }
+                    listeners = (
+                        list(self._register_listeners)
+                        if msg["type"] == "register"
+                        else ()
+                    )
+                for fn in listeners:
+                    try:
+                        fn(msg["agent_id"], epoch)
+                    except Exception:
+                        _log.exception(
+                            "register listener failed (ignored)"
+                        )
 
     def planning_view(self) -> tuple[DistributedState, list[str]]:
         """(alive agents for planning, skipped agent ids) — query planning
@@ -240,6 +265,7 @@ class QueryBroker:
         router: BridgeRouter,
         registry=None,
         table_relations: Optional[dict[str, Relation]] = None,
+        residency=None,
     ):
         if registry is None:
             from pixie_tpu.udf.registry import default_registry
@@ -259,6 +285,27 @@ class QueryBroker:
         # Pluggable OTel exporter for finished query traces (flag
         # trace_otel_export); callers set it to an OTLP/HTTP callable.
         self.otel_exporter = None
+        # Serving front door (r12, flag serving_enabled): admission
+        # control with per-tenant weighted fair queueing and — when the
+        # embedder wires ``residency`` (a serving.ResidencyPool, e.g. the
+        # in-process agents' device executor pool) — an HBM byte-budget
+        # check before admitting.
+        from pixie_tpu.serving.admission import AdmissionController
+
+        self.residency = residency
+        self.admission = AdmissionController(
+            budget_fn=(
+                residency.snapshot if residency is not None else None
+            )
+        )
+        # Unacknowledged fragment launches per agent (r12 reconnect-gap
+        # fix): a launch published into an agent's reconnect window is
+        # silently lost by an at-most-once bus; when the agent
+        # re-registers, every still-pending launch for it is re-offered
+        # (agents dedup by query_id, so a double delivery is harmless).
+        self._launch_lock = threading.Lock()
+        self._inflight_launches: dict[str, dict[str, dict]] = {}
+        self.tracker.add_register_listener(self._reoffer_launches)
 
     def start_health_server(self, host: str = "127.0.0.1", port: int = 0):
         """Expose the aggregated cluster health view over HTTP (r10):
@@ -276,6 +323,15 @@ class QueryBroker:
                 # Live per-program-key fold-latency percentiles from the
                 # agents' heartbeat-carried histograms (r11).
                 "fold_latency": self.tracker.fold_latency_view(),
+                # Serving plane (r12): admission queue depth / active /
+                # per-tenant virtual clocks, and (when wired) the HBM
+                # residency pool's byte accounting.
+                "admission": self.admission.snapshot(),
+                "residency": (
+                    self.residency.snapshot()
+                    if self.residency is not None
+                    else None
+                ),
             },
             extra_routes={
                 "/agentz": lambda: self.tracker.agents_snapshot(),
@@ -324,6 +380,27 @@ class QueryBroker:
             return plan, []
         return replanned, sorted(sick)
 
+    def _reoffer_launches(self, agent_id: str, epoch: int) -> None:
+        """Register-listener (r12): an agent re-registering while the
+        broker still holds unacknowledged launches for it lost those
+        publishes in its reconnect gap (the bus is at-most-once to
+        CURRENT subscribers) — re-offer them. Agents dedup by query_id,
+        so the common both-delivered case is harmless."""
+        with self._launch_lock:
+            msgs = list(self._inflight_launches.get(agent_id, {}).values())
+        for msg in msgs:
+            _REOFFERS.inc()
+            _log.info(
+                "re-offering query %s launch to re-registered agent %s "
+                "(epoch %d)",
+                msg.get("query_id"), agent_id, epoch,
+            )
+            self.bus.publish(agent_topic(agent_id), msg)
+
+    def _launch_done(self, agent_id: str, query_id: str) -> None:
+        with self._launch_lock:
+            self._inflight_launches.get(agent_id, {}).pop(query_id, None)
+
     def execute_script(
         self,
         query: str,
@@ -334,6 +411,41 @@ class QueryBroker:
         exec_funcs=None,
         on_batch=None,
         on_event: Optional[Callable[[str, dict], None]] = None,
+        tenant: str = "default",
+    ) -> QueryResult:
+        """ExecuteScript front door. With ``flags.serving_enabled`` the
+        query first passes admission control (r12): a concurrency limit
+        with per-tenant weighted fair queueing (``tenant`` is the WFQ
+        key) and an HBM byte-budget check — on overload it raises a
+        structured ``AdmissionRejected`` instead of queueing without
+        bound. Flag off: straight through, the pre-r12 behavior."""
+        if not flags.serving_enabled:
+            return self._execute_script_inner(
+                query, timeout_s, now_ns, script_args, analyze,
+                exec_funcs, on_batch, on_event,
+            )
+        ticket = self.admission.acquire(tenant)  # may raise AdmissionRejected
+        try:
+            return self._execute_script_inner(
+                query, timeout_s, now_ns, script_args, analyze,
+                exec_funcs, on_batch, on_event,
+                tenant=tenant, admission_wait_s=ticket.waited_s,
+            )
+        finally:
+            ticket.release()
+
+    def _execute_script_inner(
+        self,
+        query: str,
+        timeout_s: float = 30.0,
+        now_ns: Optional[int] = None,
+        script_args: Optional[dict] = None,
+        analyze: bool = False,
+        exec_funcs=None,
+        on_batch=None,
+        on_event: Optional[Callable[[str, dict], None]] = None,
+        tenant: Optional[str] = None,
+        admission_wait_s: float = 0.0,
     ) -> QueryResult:
         """The ExecuteScript path (server.go:308 → launch_query.go:36).
 
@@ -375,12 +487,19 @@ class QueryBroker:
         _QUERIES.inc()
         # The query_id is the trace_id (utils/trace.py): spans, inline
         # degradation events, and the degraded annotation join on it.
+        root_attrs = {"query_bytes": len(query)}
+        if tenant is not None:
+            # Admission plane (r12): who the query ran as and how long
+            # it queued, joinable with the admission_wait_seconds
+            # histogram on /metrics.
+            root_attrs["tenant"] = tenant
+            root_attrs["admission_wait_s"] = round(admission_wait_s, 6)
         root = trace.begin(
             "query",
             trace_id=qid,
             parent_id="",
             instance="broker",
-            attrs={"query_bytes": len(query)},
+            attrs=root_attrs,
         )
         root_span_id = root.span_id if root is not None else ""
 
@@ -471,19 +590,23 @@ class QueryBroker:
             sub.executing_instance[frag.fragment_id] = inst
         t1 = time.perf_counter_ns()
         for inst, sub_plan in by_instance.items():
-            self.bus.publish(
-                agent_topic(inst),
-                {
-                    "type": "execute_fragment",
-                    "query_id": qid,
-                    "plan": sub_plan,
-                    "analyze": analyze,
-                    "deadline_s": timeout_s,
-                    # Trace-context propagation (Dapper): the agent's
-                    # execute span parents to the broker's root span.
-                    "trace": {"trace_id": qid, "span_id": root_span_id},
-                },
-            )
+            msg = {
+                "type": "execute_fragment",
+                "query_id": qid,
+                "plan": sub_plan,
+                "analyze": analyze,
+                "deadline_s": timeout_s,
+                # Trace-context propagation (Dapper): the agent's
+                # execute span parents to the broker's root span.
+                "trace": {"trace_id": qid, "span_id": root_span_id},
+            }
+            # Track BEFORE publishing (r12): if the agent re-registers
+            # between our publish and its subscribe, the register
+            # listener re-offers this launch instead of losing it to
+            # the reconnect gap until the reaper degrades the query.
+            with self._launch_lock:
+                self._inflight_launches.setdefault(inst, {})[qid] = msg
+            self.bus.publish(agent_topic(inst), msg)
 
         # Forward results (query_result_forwarder.go:502,571).
         partial_ok = flags.partial_results
@@ -555,8 +678,10 @@ class QueryBroker:
                     for s in msg.get("spans") or ():
                         agent_spans[s["span_id"]] = s
                     pending.discard(msg["agent_id"])
+                    self._launch_done(msg["agent_id"], qid)
                 elif msg["type"] == "fragment_error":
                     aid = msg["agent_id"]
+                    self._launch_done(aid, qid)
                     agent_errors[aid] = msg["error"]
                     for s in msg.get("spans") or ():
                         agent_spans[s["span_id"]] = s
@@ -585,6 +710,13 @@ class QueryBroker:
             # still-running fragments are dropped and their polls abort
             # (BridgeCancelled) instead of leaking buffers.
             self.router.cleanup_query(qid)
+            # Drop any remaining launch records (timed-out/lost agents):
+            # a finished query must never be re-offered.
+            with self._launch_lock:
+                for inst in list(self._inflight_launches):
+                    self._inflight_launches[inst].pop(qid, None)
+                    if not self._inflight_launches[inst]:
+                        del self._inflight_launches[inst]
         if results_sub.dropped:
             # Result messages were dropped after the flow-control timeout:
             # the stream is incomplete because the CONSUMER is too slow —

@@ -1,0 +1,725 @@
+"""The r12 multi-query serving engine.
+
+Pins the serving contracts:
+- ResidencyPool byte accounting stays exact under insert/evict/pin
+  churn, the high/low watermark keeps staged bytes under hbm_budget_mb,
+  and a pinned entry is NEVER evicted (the serving.evict_pinned_attempt
+  fault site proves the skip fired);
+- concurrent queries return results bit-identical to serial execution
+  with shared scans on AND off, and compatible concurrent queries
+  actually coalesce (saved-dispatch counter moves);
+- admission control: concurrency limit + bounded queue, per-tenant WFQ
+  (a starved tenant schedules ahead of a heavy tenant's backlog tail; a
+  2x-weighted tenant drains 2x), and every overload path returns a
+  structured AdmissionRejected — never a hang;
+- the broker re-offers unacknowledged fragment launches to an agent
+  that re-registers after a reconnect gap (no degraded annotation);
+- observed fold shapes persist through a datastore and prewarm replay
+  reproduces the real query's fold signature across a restart.
+"""
+
+import threading
+import time
+import types
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from pixie_tpu.engine import Carnot
+from pixie_tpu.parallel import MeshExecutor
+from pixie_tpu.serving import (
+    AdmissionController,
+    AdmissionRejected,
+    FoldSignatureStore,
+    ResidencyPool,
+    SharedScanCoordinator,
+    staged_nbytes,
+)
+from pixie_tpu.serving.admission import parse_tenant_weights
+from pixie_tpu.table.table_store import TableStore
+from pixie_tpu.types import DataType, Relation, SemanticType
+from pixie_tpu.utils import faults, flags, metrics_registry
+from pixie_tpu.vizier import Agent, MessageBus, QueryBroker
+from pixie_tpu.vizier.bus import agent_topic
+from pixie_tpu.exec import BridgeRouter
+
+F, I, S, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("service", S),
+    ("resp_status", I),
+    ("latency", F),
+)
+
+STATS_PXL = (
+    "df = px.DataFrame(table='http_events')\n"
+    "s = df.groupby(['service']).agg(\n"
+    "    n=('time_', px.count),\n"
+    "    total=('latency', px.sum),\n"
+    ")\n"
+    "px.display(s, 'out')\n"
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.array(jax.devices("cpu"))
+    assert devs.size == 8, "conftest must provide 8 virtual devices"
+    return Mesh(devs, ("d",))
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.reset()
+
+
+def _fake_staged(nbytes: int):
+    return types.SimpleNamespace(
+        blocks={"x": np.zeros(nbytes, np.uint8)}, mask=None, gids=None
+    )
+
+
+def _make_table(carnot, name="http_events", n=4000, seed=7):
+    t = carnot.table_store.create_table(name, REL)
+    rng = np.random.default_rng(seed)
+    data = {
+        "time_": np.arange(n) * 10**6,
+        "service": rng.choice(["a", "b", "c"], n, p=[0.5, 0.3, 0.2]).astype(
+            object
+        ),
+        "resp_status": rng.choice([200, 400, 500], n, p=[0.8, 0.1, 0.1]),
+        "latency": rng.exponential(30.0, n),
+    }
+    t.write_pydict(data)
+    t.compact()
+    t.stop()
+    return data
+
+
+# -- residency pool ----------------------------------------------------------
+
+
+def test_residency_byte_accounting_under_churn():
+    pool = ResidencyPool(cap_entries=64, budget_bytes=0)
+    rng = np.random.default_rng(0)
+    live = {}
+    for i in range(200):
+        op = rng.integers(0, 3)
+        if op == 0 or not live:
+            key = ("t", (0, i), i)
+            st = _fake_staged(int(rng.integers(100, 5000)))
+            pool.insert(key, st, f"tab{i % 5}", (0, i))
+            # insert supersedes older versions of the same table
+            live = {
+                k: v
+                for k, v in live.items()
+                if not (v[0] == f"tab{i % 5}" and v[1] != (0, i))
+            }
+            live[key] = (f"tab{i % 5}", (0, i), staged_nbytes(st))
+        elif op == 1:
+            k = list(live)[int(rng.integers(0, len(live)))]
+            assert pool.get(k) is not None
+        else:
+            pool.clear(reason="test")
+            live = {}
+        assert pool.used_bytes() == sum(v[2] for v in live.values()), i
+        assert len(pool) == len(live)
+
+
+def test_residency_watermark_eviction_keeps_bytes_under_budget():
+    budget = 10_000
+    pool = ResidencyPool(cap_entries=64, budget_bytes=budget)
+    for i in range(20):
+        pool.insert(("k", i), _fake_staged(3000), f"t{i}", (0, 1))
+        assert pool.used_bytes() <= budget
+    # Hysteresis: after the eviction pass the pool sits at or under the
+    # LOW watermark, not just barely under the high one.
+    assert pool.used_bytes() <= budget * 0.80 + 3000
+    ev = metrics_registry().counter("device_staged_cache_evictions_total")
+    assert ev.value(reason="bytes") > 0
+
+
+def test_residency_pinned_never_evicted():
+    budget = 10_000
+    pool = ResidencyPool(cap_entries=64, budget_bytes=budget)
+    pool.insert(("pinned",), _fake_staged(4000), "hot", (0, 1))
+    faults.arm("serving.evict_pinned_attempt", p=0.0)  # census only
+    with pool.pin(("pinned",)):
+        for i in range(10):
+            pool.insert(("k", i), _fake_staged(4000), f"t{i}", (0, 1))
+        # The pinned entry survived every eviction pass...
+        assert pool.get(("pinned",)) is not None
+        # ...and the skip fired at the fault site (proving eviction
+        # actually considered and spared it).
+        checks, _fired = faults.stats()["serving.evict_pinned_attempt"]
+        assert checks > 0
+        assert pool.pinned_bytes() == staged_nbytes(_fake_staged(4000))
+    # After unpin it is ordinary LRU prey again.
+    for i in range(10, 16):
+        pool.insert(("k", i), _fake_staged(4000), f"t{i}", (0, 1))
+    assert pool.get(("pinned",)) is None
+
+
+def test_residency_version_supersession_defers_for_pinned():
+    pool = ResidencyPool(cap_entries=8, budget_bytes=0)
+    pool.insert(("a", 1), _fake_staged(1000), "hot", (0, 1))
+    with pool.pin(("a", 1)):
+        # A write bumps the version: the old staging must leave the key
+        # table (lookups miss) but its bytes stay until the fold unpins.
+        pool.insert(("a", 2), _fake_staged(1200), "hot", (0, 2))
+        assert pool.get(("a", 1)) is None
+        assert pool.used_bytes() == 2200
+        assert pool.snapshot()["zombie_entries"] == 1
+    assert pool.used_bytes() == 1200
+    assert pool.snapshot()["zombie_entries"] == 0
+
+
+# -- shared-scan coordinator -------------------------------------------------
+
+
+def test_shared_scan_coalesces_same_key():
+    coord = SharedScanCoordinator()
+    calls = []
+    barrier = threading.Barrier(4)
+    results = []
+    flags.set("shared_scan_window_ms", 100.0)
+    try:
+
+        def compute():
+            calls.append(1)
+            return ("merged", 42)
+
+        def run():
+            barrier.wait()
+            results.append(coord.run(("k",), compute))
+
+        ts = [threading.Thread(target=run) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert len(calls) == 1  # one dispatch
+        assert results == [("merged", 42)] * 4
+    finally:
+        flags.reset("shared_scan_window_ms")
+
+
+def test_shared_scan_distinct_keys_do_not_share():
+    coord = SharedScanCoordinator()
+    assert coord.run(("a",), lambda: 1) == 1
+    assert coord.run(("b",), lambda: 2) == 2
+
+
+def test_shared_scan_leader_error_propagates_to_joiners():
+    coord = SharedScanCoordinator()
+    flags.set("shared_scan_window_ms", 100.0)
+    errors = []
+    barrier = threading.Barrier(3)
+    try:
+
+        def compute():
+            raise RuntimeError("boom")
+
+        def run():
+            barrier.wait()
+            try:
+                coord.run(("k",), compute)
+            except RuntimeError as e:
+                errors.append(str(e))
+
+        ts = [threading.Thread(target=run) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert errors == ["boom"] * 3
+    finally:
+        flags.reset("shared_scan_window_ms")
+
+
+# -- admission control -------------------------------------------------------
+
+
+def test_admission_concurrency_limit_and_queue():
+    ctl = AdmissionController(
+        max_concurrent=2, max_queue=8, timeout_s=5.0, tenant_weights={}
+    )
+    t1 = ctl.acquire("a")
+    t2 = ctl.acquire("a")
+    granted = []
+
+    def waiter():
+        t = ctl.acquire("a")
+        granted.append(t)
+        t.release()
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.1)
+    assert not granted  # queued behind the limit
+    assert ctl.snapshot()["queue_depth"] == 1
+    t1.release()
+    th.join(timeout=5)
+    assert len(granted) == 1
+    t2.release()
+
+
+def test_admission_queue_full_rejects_structured():
+    ctl = AdmissionController(
+        max_concurrent=1, max_queue=0, timeout_s=5.0, tenant_weights={}
+    )
+    t1 = ctl.acquire("a")
+    with pytest.raises(AdmissionRejected) as ei:
+        ctl.acquire("b")
+    assert ei.value.reason == "queue_full"
+    assert ei.value.tenant == "b"
+    assert ei.value.to_dict()["reason"] == "queue_full"
+    t1.release()
+
+
+def test_admission_timeout_rejects_never_hangs():
+    ctl = AdmissionController(
+        max_concurrent=1, max_queue=8, timeout_s=0.2, tenant_weights={}
+    )
+    t1 = ctl.acquire("a")
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionRejected) as ei:
+        ctl.acquire("b")
+    assert ei.value.reason == "timeout"
+    assert 0.15 <= time.monotonic() - t0 < 3.0
+    t1.release()
+    # The abandoned waiter must not wedge the grant path.
+    t2 = ctl.acquire("c")
+    t2.release()
+
+
+def test_admission_starved_tenant_schedules_before_backlog_tail():
+    ctl = AdmissionController(
+        max_concurrent=1, max_queue=32, timeout_s=10.0, tenant_weights={}
+    )
+    first = ctl.acquire("heavy")
+    order = []
+    lock = threading.Lock()
+
+    def worker(tenant):
+        t = ctl.acquire(tenant)
+        with lock:
+            order.append(tenant)
+        t.release()
+
+    heavy = [
+        threading.Thread(target=worker, args=("heavy",)) for _ in range(5)
+    ]
+    for t in heavy:
+        t.start()
+        time.sleep(0.02)  # deterministic enqueue order: heavy backlog first
+    while ctl.snapshot()["queue_depth"] < 5:
+        time.sleep(0.01)
+    starved = threading.Thread(target=worker, args=("starved",))
+    starved.start()
+    while ctl.snapshot()["queue_depth"] < 6:
+        time.sleep(0.01)
+    first.release()
+    for t in heavy + [starved]:
+        t.join(timeout=10)
+    # WFQ: the starved tenant's first request lands just after the
+    # virtual clock, ahead of the heavy tenant's accumulated backlog.
+    assert "starved" in order[:2], order
+    assert order.index("starved") < len(order) - 1
+
+
+def test_admission_weighted_tenant_drains_faster():
+    ctl = AdmissionController(
+        max_concurrent=1,
+        max_queue=32,
+        timeout_s=10.0,
+        tenant_weights={"fast": 2.0, "slow": 1.0},
+    )
+    first = ctl.acquire("other")
+    order = []
+    lock = threading.Lock()
+
+    def worker(tenant):
+        t = ctl.acquire(tenant)
+        with lock:
+            order.append(tenant)
+        t.release()
+
+    ts = []
+    for tenant in ["fast"] * 6 + ["slow"] * 6:
+        th = threading.Thread(target=worker, args=(tenant,))
+        th.start()
+        ts.append(th)
+        time.sleep(0.02)
+    while ctl.snapshot()["queue_depth"] < 12:
+        time.sleep(0.01)
+    first.release()
+    for th in ts:
+        th.join(timeout=10)
+    # 2x weight -> ~2x share of the first grants.
+    assert order[:6].count("fast") >= 4, order
+
+
+def test_admission_budget_check_rejects_when_pinned_at_budget():
+    ctl = AdmissionController(
+        max_concurrent=4,
+        max_queue=8,
+        timeout_s=5.0,
+        tenant_weights={},
+        budget_fn=lambda: {"budget_bytes": 100, "pinned_bytes": 100},
+    )
+    with pytest.raises(AdmissionRejected) as ei:
+        ctl.acquire("a")
+    assert ei.value.reason == "hbm_budget"
+
+
+def test_admission_fault_site_forces_structured_rejection():
+    ctl = AdmissionController(
+        max_concurrent=4, max_queue=8, timeout_s=5.0, tenant_weights={}
+    )
+    faults.arm("serving.admission_reject", count=1)
+    with pytest.raises(AdmissionRejected) as ei:
+        ctl.acquire("a")
+    assert ei.value.reason == "fault_injected"
+    # After the armed count drains, admission flows again — no hang.
+    t = ctl.acquire("a")
+    t.release()
+
+
+def test_parse_tenant_weights():
+    assert parse_tenant_weights("a:2,b:0.5") == {"a": 2.0, "b": 0.5}
+    assert parse_tenant_weights("") == {}
+    assert parse_tenant_weights("bad,x:nope,ok:3") == {"ok": 3.0}
+
+
+# -- concurrent determinism on the device pipeline ---------------------------
+
+
+def _run_concurrent(carnot, query, n_threads):
+    results = [None] * n_threads
+    errors = []
+    barrier = threading.Barrier(n_threads)
+
+    def run(i):
+        try:
+            barrier.wait()
+            results[i] = carnot.execute_query(query).table("out")
+        except Exception as e:  # pragma: no cover - assertion aid
+            errors.append(e)
+
+    ts = [
+        threading.Thread(target=run, args=(i,)) for i in range(n_threads)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not errors, errors
+    return results
+
+
+def _assert_tables_identical(a, b):
+    assert set(a) == set(b)
+    for col in a:
+        av, bv = np.asarray(a[col]), np.asarray(b[col])
+        assert av.dtype == bv.dtype and np.array_equal(av, bv), col
+
+
+def test_concurrent_queries_bit_identical_shared_scans_on_and_off(mesh):
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    c = Carnot(device_executor=ex)
+    _make_table(c)
+    serial = c.execute_query(STATS_PXL).table("out")  # also warms the cache
+    saved = metrics_registry().counter(
+        "serving_shared_scan_saved_dispatches_total"
+    )
+    flags.set("shared_scans", True)
+    flags.set("shared_scan_window_ms", 150.0)
+    try:
+        before = saved.value()
+        for got in _run_concurrent(c, STATS_PXL, 6):
+            _assert_tables_identical(serial, got)
+        # Compatible concurrent queries actually coalesced: at least one
+        # follower reused a leader's dispatch inside the 150ms window.
+        assert saved.value() > before
+        assert not ex.fallback_errors, ex.fallback_errors
+    finally:
+        flags.reset("shared_scan_window_ms")
+        flags.reset("shared_scans")
+    flags.set("shared_scans", False)
+    try:
+        for got in _run_concurrent(c, STATS_PXL, 6):
+            _assert_tables_identical(serial, got)
+    finally:
+        flags.reset("shared_scans")
+
+
+def test_hbm_budget_respected_by_query_path(mesh):
+    """Stage several distinct tables under a small budget: the pool's
+    staged bytes never exceed hbm_budget_mb (watermark eviction runs
+    inside the query path's _staged_insert)."""
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    c = Carnot(device_executor=ex)
+    budget_mb = 1
+    flags.set("hbm_budget_mb", budget_mb)
+    flags.set("staged_cache_cap", 16)
+    try:
+        for i in range(4):
+            name = f"http_events_{i}"
+            t = c.table_store.create_table(name, REL)
+            rng = np.random.default_rng(i)
+            n = 4000
+            t.write_pydict(
+                {
+                    "time_": np.arange(n) * 10**6,
+                    "service": rng.choice(["a", "b"], n).astype(object),
+                    "resp_status": rng.choice([200, 500], n),
+                    "latency": rng.exponential(30.0, n),
+                }
+            )
+            t.stop()
+            q = STATS_PXL.replace("http_events", name)
+            c.execute_query(q)
+            assert ex._staged_cache.used_bytes() <= budget_mb << 20
+        assert not ex.fallback_errors, ex.fallback_errors
+    finally:
+        flags.reset("hbm_budget_mb")
+        flags.reset("staged_cache_cap")
+
+
+# -- broker serving path -----------------------------------------------------
+
+
+@pytest.fixture
+def cluster():
+    bus = MessageBus()
+    router = BridgeRouter()
+    rng = np.random.default_rng(3)
+
+    def make_store(seed_offset, n=4000):
+        ts = TableStore()
+        t = ts.create_table("http_events", REL)
+        t.write_pydict(
+            {
+                "time_": np.arange(n) + seed_offset,
+                "service": rng.choice(["a", "b", "c"], n).astype(object),
+                "resp_status": rng.choice([200, 500], n),
+                "latency": rng.exponential(10.0, n),
+            }
+        )
+        t.stop()
+        return ts
+
+    broker = QueryBroker(
+        bus, router, table_relations={"http_events": REL}
+    )
+    agents = [
+        Agent("pem1", bus, router, table_store=make_store(0)),
+        Agent("pem2", bus, router, table_store=make_store(10**6)),
+        Agent("kelvin", bus, router, is_kelvin=True),
+    ]
+    for a in agents:
+        a.start()
+    time.sleep(0.15)
+    yield broker, agents, bus
+    broker.stop()
+    for a in agents:
+        a.stop()
+
+
+AGG_QUERY = (
+    "df = px.DataFrame(table='http_events')\n"
+    "stats = df.groupby(['service']).agg(\n"
+    "    total=('latency', px.sum), n=('latency', px.count))\n"
+    "px.display(stats, 'out')\n"
+)
+
+
+def test_broker_overload_rejects_structured_not_hang(cluster):
+    broker, _agents, _bus = cluster
+    flags.set("serving_enabled", True)
+    flags.set("admission_max_concurrent", 1)
+    flags.set("admission_max_queue", 0)
+    try:
+        ticket = broker.admission.acquire("occupant")
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionRejected) as ei:
+            broker.execute_script(AGG_QUERY, timeout_s=10, tenant="user")
+        assert ei.value.reason == "queue_full"
+        assert time.monotonic() - t0 < 2.0  # rejected fast, no hang
+        ticket.release()
+        res = broker.execute_script(AGG_QUERY, timeout_s=30, tenant="user")
+        assert res.degraded is None
+        assert broker.admission.snapshot()["active"] == 0
+    finally:
+        flags.reset("serving_enabled")
+        flags.reset("admission_max_concurrent")
+        flags.reset("admission_max_queue")
+
+
+def test_broker_serving_concurrent_scripts_all_complete(cluster):
+    broker, _agents, _bus = cluster
+    flags.set("serving_enabled", True)
+    flags.set("admission_max_concurrent", 2)
+    flags.set("admission_max_queue", 16)
+    try:
+        results, errors = [], []
+        barrier = threading.Barrier(6)
+
+        def run(i):
+            try:
+                barrier.wait()
+                results.append(
+                    broker.execute_script(
+                        AGG_QUERY, timeout_s=30, tenant=f"t{i % 2}"
+                    )
+                )
+            except Exception as e:
+                errors.append(e)
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert len(results) == 6
+        totals = set()
+        for r in results:
+            assert r.degraded is None, r.degraded
+            from pixie_tpu.table.row_batch import RowBatch
+
+            rows = RowBatch.concat(
+                [b for b in r.tables["out"] if b.num_rows]
+            ).to_pydict()
+            totals.add(sum(rows["n"]))
+        assert totals == {8000}  # every concurrent query saw both shards
+        assert broker.admission.snapshot()["active"] == 0
+    finally:
+        flags.reset("serving_enabled")
+        flags.reset("admission_max_concurrent")
+        flags.reset("admission_max_queue")
+
+
+def test_reconnect_gap_launch_reoffered_on_reregister(cluster):
+    """r12 satellite: a query launched while an agent's subscription is
+    down (mid-reconnect) used to lose the execute_fragment publish until
+    the reaper degraded it. The broker now re-offers unacked launches
+    when the agent re-registers — the query completes clean."""
+    broker, agents, bus = cluster
+    reoffers = metrics_registry().counter("broker_launch_reoffers_total")
+    before = reoffers.value()
+    pem1 = agents[0]
+    pem1._sub.unsubscribe()  # the reconnect gap: deaf to launches
+    holder = {}
+
+    def run():
+        holder["res"] = broker.execute_script(AGG_QUERY, timeout_s=20)
+
+    th = threading.Thread(target=run)
+    th.start()
+    time.sleep(0.5)  # the launch publish happens into the gap
+    # Reconnect: fresh subscription + re-registration (what RemoteBus's
+    # reconnect listener does for real transports).
+    pem1._sub = bus.subscribe(agent_topic(pem1.agent_id))
+    pem1._register()
+    th.join(timeout=30)
+    assert "res" in holder, "query hung"
+    res = holder["res"]
+    assert res.degraded is None, res.degraded
+    from pixie_tpu.table.row_batch import RowBatch
+
+    rows = RowBatch.concat(
+        [b for b in res.tables["out"] if b.num_rows]
+    ).to_pydict()
+    assert sum(rows["n"]) == 8000  # both shards, including the gapped one
+    assert reoffers.value() > before
+
+
+def test_agent_dedups_reoffered_launch(cluster):
+    """Both the original launch AND the re-offer arriving executes the
+    fragment once (query_id dedup): the broker sees one fragment_done."""
+    broker, agents, bus = cluster
+    res = broker.execute_script(AGG_QUERY, timeout_s=20)
+    assert res.degraded is None
+    # Replay the same query_id at pem1: dropped by the dedup set.
+    qid = res.query_id
+    assert qid in agents[0]._seen_queries
+    n_before = len(agents[0]._seen_queries)
+    bus.publish(
+        agent_topic("pem1"),
+        {"type": "execute_fragment", "query_id": qid, "plan": None},
+    )
+    time.sleep(0.3)
+    assert len(agents[0]._seen_queries) == n_before  # no new execution
+
+
+# -- fold-signature persistence ----------------------------------------------
+
+
+def test_fold_signatures_persist_and_prewarm_replays(mesh, tmp_path):
+    from pixie_tpu.parallel.staging import COLD_PROFILE, reset_cold_profile
+    from pixie_tpu.vizier.datastore import FileDatastore
+
+    flags.set("streaming_window_rows", 4096)
+    try:
+        ds = FileDatastore(str(tmp_path / "sigs.log"))
+        store = FoldSignatureStore(ds)
+        ex_a = MeshExecutor(mesh=mesh, block_rows=1024)
+        ex_a.fold_signature_store = store
+        ca = Carnot(device_executor=ex_a)
+        data = _make_table(ca)
+        rows = ca.execute_query(STATS_PXL).table("out")
+        assert not ex_a.fallback_errors, ex_a.fallback_errors
+        shapes = store.shapes("http_events")
+        assert shapes, "real query shape was not recorded"
+        assert shapes[-1]["key_col"] == "service"
+        assert [l[0] for l in shapes[-1]["lanes"]] == ["count", "sum"]
+        ds.close()
+
+        # "Restart": fresh executor + fresh datastore over the same file.
+        ds2 = FileDatastore(str(tmp_path / "sigs.log"))
+        store2 = FoldSignatureStore(ds2)
+        assert store2.shapes("http_events") == shapes  # survived the log
+        flags.set("prewarm_compile", True)
+        ex_b = MeshExecutor(mesh=mesh, block_rows=1024)
+        ex_b.fold_signature_store = store2
+        cb = Carnot(device_executor=ex_b)
+        _make_table(cb)  # create listener replays the RECORDED shape
+        assert ex_b._prewarmed, ex_b.prewarm_errors
+        for sig, fut in list(ex_b._aot_futures.items()):
+            fut.result(timeout=120)
+        reset_cold_profile()
+        rows_b = cb.execute_query(STATS_PXL).table("out")
+        assert not ex_b.fallback_errors, ex_b.fallback_errors
+        snap = dict(COLD_PROFILE)
+        # The replayed signature matched the real query's fold exactly:
+        # the first query after "restart" hit the prewarmed executable.
+        assert snap.get("prewarm_hit", 0) >= 1, snap
+        _assert_tables_identical(rows, rows_b)
+        ds2.close()
+    finally:
+        flags.reset("streaming_window_rows")
+        flags.reset("prewarm_compile")
+
+
+def test_fold_signature_store_caps_and_dedups(tmp_path):
+    from pixie_tpu.vizier.datastore import Datastore
+
+    store = FoldSignatureStore(Datastore())
+    shape = {"key_col": "s", "lanes": [["count", None, None]]}
+    assert store.record("t", shape) is True
+    assert store.record("t", shape) is False  # dedup by content
+    for i in range(20):
+        store.record("t", {**shape, "capacity": i})
+    assert len(store.shapes("t")) == 8  # MAX_SHAPES_PER_TABLE
+    assert store.tables() == ["t"]

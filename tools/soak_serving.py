@@ -1,0 +1,337 @@
+"""Serving soak: concurrent scripted clients at steady QPS (r12).
+
+The acceptance harness for the multi-query serving engine: an in-process
+cluster (broker + PEM-role agent with a device MeshExecutor + Kelvin
+merger) serves N concurrent clients issuing signature-compatible PxL
+scripts against shared hot tables at a steady per-client rate, with
+admission control on and an HBM budget set. It reports:
+
+- p50/p99 end-to-end latency and completed/rejected/degraded counts,
+- shared-scan effectiveness: fold dispatches vs queries through the
+  fold path (the ≥2x dispatch-reduction bar vs the 1-dispatch-per-query
+  serial baseline) and the mean batch size,
+- residency behavior: peak staged bytes (must stay ≤ hbm_budget_mb) and
+  eviction counts,
+- bit-identical correctness: every concurrent result is compared
+  against the serially-executed baseline for its query.
+
+Env knobs: SOAK_CLIENTS (64), SOAK_REQUESTS (4 per client), SOAK_QPS
+(8.0 per client), SOAK_ROWS (100k), SOAK_HBM_BUDGET_MB (64),
+SOAK_WINDOW_MS (25), SOAK_MAX_CONCURRENT (8), SOAK_JSON (path to also
+write the report).
+
+Run: JAX_PLATFORMS=cpu python tools/soak_serving.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# Signature-compatible script set: same predicates, same UDA lanes, same
+# group key — only output names differ, which the r7 fold signature
+# excludes, so ALL of these coalesce onto one fold dispatch when their
+# arrivals overlap. (A distinct-lane control query would not share.)
+def compatible_queries() -> list[str]:
+    out = []
+    for names in (("n", "total"), ("cnt", "s"), ("hits", "sum_lat")):
+        out.append(
+            "df = px.DataFrame(table='http_events')\n"
+            "st = df.groupby(['service']).agg(\n"
+            f"    {names[0]}=('time_', px.count),\n"
+            f"    {names[1]}=('latency', px.sum),\n"
+            ")\n"
+            "px.display(st, 'out')\n"
+        )
+    return out
+
+
+def _table_key(result) -> dict:
+    from pixie_tpu.table.row_batch import RowBatch
+
+    batches = [b for b in result.tables["out"] if b.num_rows]
+    return RowBatch.concat(batches).to_pydict() if batches else {}
+
+
+def _tables_equal(a: dict, b: dict) -> bool:
+    if set(a) != set(b):
+        return False
+    for col in a:
+        av, bv = np.asarray(a[col]), np.asarray(b[col])
+        if av.dtype != bv.dtype or not np.array_equal(av, bv):
+            return False
+    return True
+
+
+def run_soak(
+    clients: int = 64,
+    requests_per_client: int = 4,
+    qps_per_client: float = 8.0,
+    rows: int = 100_000,
+    hbm_budget_mb: int = 64,
+    window_ms: float = 25.0,
+    max_concurrent: int = 8,
+    seed: int = 11,
+) -> dict:
+    """Build the cluster, run the soak (serving flags pinned for the
+    run, restored after), return the report dict."""
+    from pixie_tpu.utils import flags
+
+    soak_flags = {
+        "serving_enabled": True,
+        "hbm_budget_mb": hbm_budget_mb,
+        "shared_scans": True,
+        "shared_scan_window_ms": window_ms,
+        "admission_max_concurrent": max_concurrent,
+        "admission_max_queue": max(4 * clients, 256),
+        "admission_timeout_s": 60.0,
+        "admission_tenant_weights": "dashboards:2.0,batch:1.0",
+    }
+    for name, value in soak_flags.items():
+        flags.set(name, value)
+    try:
+        return _run_soak_inner(
+            clients, requests_per_client, qps_per_client, rows,
+            hbm_budget_mb, window_ms, seed,
+        )
+    finally:
+        # Restore env/default flag values so an embedding caller
+        # (bench.py's concurrency config) is not left in serving mode.
+        for name in soak_flags:
+            flags.reset(name)
+
+
+def _run_soak_inner(
+    clients, requests_per_client, qps_per_client, rows,
+    hbm_budget_mb, window_ms, seed,
+) -> dict:
+    import jax
+    from jax.sharding import Mesh
+
+    from pixie_tpu.exec import BridgeRouter
+    from pixie_tpu.parallel import MeshExecutor
+    from pixie_tpu.serving.admission import AdmissionRejected
+    from pixie_tpu.table.table_store import TableStore
+    from pixie_tpu.types import DataType, Relation, SemanticType
+    from pixie_tpu.utils import metrics_registry
+    from pixie_tpu.vizier import Agent, MessageBus, QueryBroker
+
+    F, I, S, T = (
+        DataType.FLOAT64,
+        DataType.INT64,
+        DataType.STRING,
+        DataType.TIME64NS,
+    )
+    rel = Relation.of(
+        ("time_", T, SemanticType.ST_TIME_NS),
+        ("service", S),
+        ("resp_status", I),
+        ("latency", F),
+    )
+    mesh = Mesh(np.array(jax.devices()), ("d",))
+    ex = MeshExecutor(mesh=mesh)
+    store = TableStore()
+    t = store.create_table("http_events", rel, size_limit=1 << 40)
+    rng = np.random.default_rng(seed)
+    chunk = 1 << 18
+    for off in range(0, rows, chunk):
+        m = min(chunk, rows - off)
+        t.write_pydict(
+            {
+                "time_": np.arange(off, off + m, dtype=np.int64) * 1000,
+                "service": rng.choice(
+                    [f"svc-{i}" for i in range(8)], m
+                ).astype(object),
+                "resp_status": rng.choice([200, 404, 500], m),
+                "latency": rng.exponential(3e7, m),
+            }
+        )
+    t.compact()
+    t.stop()
+
+    bus = MessageBus()
+    router = BridgeRouter()
+    broker = QueryBroker(
+        bus,
+        router,
+        table_relations={"http_events": rel},
+        residency=ex._staged_cache,
+    )
+    agents = [
+        Agent(
+            "pem1", bus, router, table_store=store, device_executor=ex
+        ),
+        Agent("kelvin", bus, router, is_kelvin=True),
+    ]
+    for a in agents:
+        a.start()
+    time.sleep(0.3)
+
+    queries = compatible_queries()
+    reg = metrics_registry()
+    dispatches = reg.counter("serving_shared_scan_dispatches_total")
+    saved = reg.counter("serving_shared_scan_saved_dispatches_total")
+    evictions = reg.counter("device_staged_cache_evictions_total")
+    staged_bytes = reg.gauge("device_staged_bytes")
+
+    # Serial baseline: each distinct script once, results recorded for
+    # the bit-identical check; also warms the staged cache so the soak
+    # measures the serving steady state, not N concurrent cold stages.
+    baselines = []
+    t0 = time.perf_counter()
+    for q in queries:
+        r = broker.execute_script(q, timeout_s=120, tenant="baseline")
+        assert r.degraded is None, f"serial baseline degraded: {r.degraded}"
+        baselines.append(_table_key(r))
+    log(f"serial baseline: {len(queries)} queries in "
+        f"{time.perf_counter() - t0:.2f}s")
+    d0, s0 = dispatches.value(), saved.value()
+
+    # Peak-residency sampler (the gauge is also asserted per insert in
+    # tests; the sampler catches transients between client requests).
+    peak = [0.0]
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            peak[0] = max(peak[0], staged_bytes.value())
+            stop.wait(0.01)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+
+    latencies: list[float] = []
+    rejected = [0]
+    degraded = [0]
+    mismatches = [0]
+    completed = [0]
+    lock = threading.Lock()
+    barrier = threading.Barrier(clients)
+
+    def client(i: int) -> None:
+        crng = np.random.default_rng(1000 + i)
+        tenant = "dashboards" if i % 2 == 0 else "batch"
+        period = 1.0 / qps_per_client
+        barrier.wait()
+        # Jittered start so arrivals are steady, not phase-locked.
+        time.sleep(float(crng.random()) * period)
+        for r in range(requests_per_client):
+            qi = int(crng.integers(0, len(queries)))
+            q0 = time.perf_counter()
+            try:
+                res = broker.execute_script(
+                    queries[qi], timeout_s=120, tenant=tenant
+                )
+                dt = time.perf_counter() - q0
+                with lock:
+                    completed[0] += 1
+                    latencies.append(dt)
+                    if res.degraded is not None:
+                        degraded[0] += 1
+                    if not _tables_equal(baselines[qi], _table_key(res)):
+                        mismatches[0] += 1
+            except AdmissionRejected:
+                with lock:
+                    rejected[0] += 1
+            sleep_left = period - (time.perf_counter() - q0)
+            if sleep_left > 0:
+                time.sleep(sleep_left)
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(clients)
+    ]
+    wall0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - wall0
+    stop.set()
+    sampler_t.join(timeout=2)
+    broker.stop()
+    for a in agents:
+        a.stop()
+
+    d1, s1 = dispatches.value() - d0, saved.value() - s0
+    fold_queries = d1 + s1  # queries that reached the fold path
+    lat = sorted(latencies)
+
+    def pct(p: float) -> float:
+        if not lat:
+            return 0.0
+        return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+    report = {
+        "clients": clients,
+        "requests_per_client": requests_per_client,
+        "qps_per_client": qps_per_client,
+        "wall_s": round(wall, 2),
+        "completed": completed[0],
+        "rejected": rejected[0],
+        "degraded": degraded[0],
+        "bit_identical": mismatches[0] == 0,
+        "queries_per_sec": round(completed[0] / wall, 1) if wall else 0,
+        "latency_p50_ms": round(pct(0.50) * 1e3, 2),
+        "latency_p99_ms": round(pct(0.99) * 1e3, 2),
+        "shared_scan": {
+            "fold_queries": int(fold_queries),
+            "dispatches": int(d1),
+            "saved": int(s1),
+            "dispatch_reduction_x": (
+                round(fold_queries / d1, 2) if d1 else None
+            ),
+            "mean_batch": (
+                round(fold_queries / d1, 2) if d1 else None
+            ),
+        },
+        "residency": {
+            "peak_staged_bytes": int(peak[0]),
+            "budget_bytes": hbm_budget_mb << 20,
+            "within_budget": peak[0] <= (hbm_budget_mb << 20),
+            "evictions": int(evictions.value()),
+        },
+        "admission": broker.admission.snapshot(),
+    }
+    return report
+
+
+def main() -> int:
+    report = run_soak(
+        clients=int(os.environ.get("SOAK_CLIENTS", 64)),
+        requests_per_client=int(os.environ.get("SOAK_REQUESTS", 4)),
+        qps_per_client=float(os.environ.get("SOAK_QPS", 8.0)),
+        rows=int(os.environ.get("SOAK_ROWS", 100_000)),
+        hbm_budget_mb=int(os.environ.get("SOAK_HBM_BUDGET_MB", 64)),
+        window_ms=float(os.environ.get("SOAK_WINDOW_MS", 25.0)),
+        max_concurrent=int(os.environ.get("SOAK_MAX_CONCURRENT", 8)),
+    )
+    print(json.dumps(report, indent=1))
+    path = os.environ.get("SOAK_JSON")
+    if path:
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+    ok = (
+        report["degraded"] == 0
+        and report["bit_identical"]
+        and report["residency"]["within_budget"]
+        and (report["shared_scan"]["dispatch_reduction_x"] or 0) >= 2.0
+    )
+    log(f"soak {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
