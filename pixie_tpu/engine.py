@@ -184,6 +184,18 @@ class Carnot:
                     table, self.registry
                 )
             )
+        if device_executor is not None and hasattr(
+            device_executor, "enable_resident_ingest"
+        ):
+            # r13 cold-path lever: with flag ``resident_ingest``, every
+            # created table gets an HBM ring fed by its appends
+            # (serving/resident.py), so hot tables never cold-stage
+            # their in-window span — stage_transfer ≈ 0 for it.
+            self.table_store.add_create_listener(
+                lambda name, table: device_executor.enable_resident_ingest(
+                    table
+                )
+            )
         self.compiler = Compiler(registry)
 
     # -- the two entry points (carnot.h:72-81) ------------------------------

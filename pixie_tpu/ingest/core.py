@@ -64,24 +64,42 @@ class IngestCore:
                 out[dt.name] = dt.relation
         return out
 
-    def wire_to_table_store(self, store) -> None:
+    def wire_to_table_store(self, store, device_executor=None) -> None:
         """Create the published tables in a TableStore and point the push
         callback at it — the PEM wiring (ref: pem_manager registers
         Stirling's DataPushCallback to TableStore::WriteHot). Tablet tables
         are created on first push (the reference creates tablets on
-        demand)."""
+        demand).
+
+        With ``device_executor`` given (and flag ``resident_ingest``),
+        every wired table — including dynamically-created tablets —
+        gets an HBM-resident ring (r13, serving/resident.py): the
+        ingest loop's appends stage incrementally to the device, so a
+        query over continuous telemetry finds its recent windows
+        already resident and stages only the cold tail. A store whose
+        engine wired its own create listener (engine.py) composes fine:
+        ring enablement is idempotent per table."""
         from pixie_tpu.table.table import Table
+
+        def enable_ring(t) -> None:
+            if device_executor is not None and hasattr(
+                device_executor, "enable_resident_ingest"
+            ):
+                device_executor.enable_resident_ingest(t)
 
         relations = self.publish()
         for name, rel in relations.items():
-            if store.get_table(name) is None:
-                store.create_table(name, rel)
+            t = store.get_table(name)
+            if t is None:
+                t = store.create_table(name, rel)
+            enable_ring(t)
 
         def push(table_name: str, tablet: str, columns: dict) -> None:
             t = store.get_table(table_name, tablet or "")
             if t is None:
                 t = Table(relations[table_name], name=table_name)
                 store.add_table(table_name, t, tablet_id=tablet or "")
+                enable_ring(t)
             t.write_pydict(columns)
 
         self.register_data_push_callback(push)

@@ -542,6 +542,11 @@ class MeshExecutor:
         # table, and prewarm_table replays them across restarts instead
         # of guessing the canonical count+sum(f64) shape (r12 satellite).
         self.fold_signature_store = None
+        # Device-resident incremental ingest (r13, flag resident_ingest):
+        # per-table HBM ring windows fed by table appends
+        # (serving/resident.py), created lazily on enable so the manager
+        # costs nothing when the flag is off.
+        self._resident = None
         # Host-densified key plans per (table version, key exprs), LRU.
         self._keyplan_cache: "collections.OrderedDict[tuple, Any]" = (
             collections.OrderedDict()
@@ -673,7 +678,142 @@ class MeshExecutor:
             # ride heartbeats so the broker's admission controller and
             # /statusz see device residency without touching the device.
             "residency": self._staged_cache.snapshot(),
+            # Resident-ingest rings (r13): windows/bytes per hot table.
+            "resident_ingest": (
+                self._resident.snapshot() if self._resident else {}
+            ),
         }
+
+    # -- device-resident incremental ingest (r13) ----------------------------
+    def enable_resident_ingest(self, table):
+        """Attach an HBM ring to ``table``'s appends (flag
+        ``resident_ingest``; wired from the table store's create
+        listener so every new table opts in automatically). Returns the
+        ring or None."""
+        if not flags.resident_ingest:
+            return None
+        if self._resident is None:
+            from pixie_tpu.serving.resident import ResidentIngestManager
+
+            self._resident = ResidentIngestManager(
+                self.mesh, self.block_rows, self._staged_cache
+            )
+        return self._resident.enable(table)
+
+    def _resident_ring(self, table, src_op):
+        """The table's ring when the resident fast path applies: flag
+        on, a ring exists, and the query has no time bounds (the
+        row-id↔window alignment the ring serves assumes the cursor
+        returns every resident row)."""
+        if self._resident is None or not flags.resident_ingest:
+            return None
+        if src_op.start_time is not None or src_op.stop_time is not None:
+            return None
+        return self._resident.ring_for(src_op.table_name)
+
+    def _decode_fn(self, plan, cp, cache: dict):
+        """Resolve a window decode program: the background-AOT-compiled
+        executable when its compile already landed, else the in-line
+        jit (first call compiles; an AOT failure is recorded in
+        stream_fallback_errors like a fold-compile failure)."""
+        from pixie_tpu.ops import codec as _codec
+
+        sig = f"decode|{cp.sig()}|mesh:{self.mesh.devices.shape}"
+        fn = cache.get(sig)
+        if fn is not None:
+            return fn
+        fn = _codec.decoder(self.mesh, cp, plan.nblk, plan.b)
+        fut = self._aot_futures.get(sig)
+        done = self._aot_compiled.get(sig)
+        if done is not None:
+            fn = done
+        elif fut is not None and fut.done():
+            try:
+                fn = fut.result()
+            except Exception as e:
+                key = f"decode-aot {type(e).__name__}: {e}"
+                if key not in self.stream_fallback_errors:
+                    import traceback
+
+                    self.stream_fallback_errors[key] = (
+                        traceback.format_exc()
+                    )
+        cache[sig] = fn
+        return fn
+
+    def _kick_decode_aot(self, plan) -> None:
+        """Queue the plan's decode programs on the AOT worker so they
+        compile concurrently with the first windows' pack/transfer."""
+        from pixie_tpu.ops import codec as _codec
+
+        if not flags.aot_compile:
+            return
+        for cp in plan.codecs.values():
+            sig = f"decode|{cp.sig()}|mesh:{self.mesh.devices.shape}"
+            if sig in self._aot_compiled or sig in self._aot_futures:
+                continue
+            try:
+                self._aot_compile_async(
+                    sig,
+                    _codec.decoder(self.mesh, cp, plan.nblk, plan.b),
+                    _codec.decode_avals(cp, self.mesh),
+                )
+            except Exception:
+                pass  # best-effort: the in-line jit path still works
+
+    def _put_window_cols(self, plan, packed, col_names, dec_cache):
+        """device_put one window's packed columns: passthrough blocks
+        transfer as-is; CodecPayload columns transfer their (much
+        smaller) encoded arrays and expand on device (stage_decode).
+        Either way the resulting block is bit-identical."""
+        from pixie_tpu.ops import codec as _codec
+
+        (axis_name,) = self.mesh.axis_names
+        sharding = NamedSharding(self.mesh, P(axis_name))
+        dev_cols = {}
+        for n2 in col_names:
+            p = packed[n2]
+            if isinstance(p, _codec.CodecPayload):
+                args = _codec.put_payload(self.mesh, p)
+                t0 = time.perf_counter()
+                dev_cols[n2] = self._decode_fn(plan, p.plan, dec_cache)(
+                    *args
+                )
+                COLD_PROFILE["stage_decode"] = COLD_PROFILE.get(
+                    "stage_decode", 0.0
+                ) + (time.perf_counter() - t0)
+            else:
+                dev_cols[n2] = jax.device_put(p, sharding)
+        return dev_cols
+
+    def _convert_resident_window(self, plan, rw, col_names):
+        """Raw-dtype ring blocks → the plan's block dtypes, ON DEVICE
+        (ops/codec.py converters reproduce the host pack transform bit
+        for bit). Zero wire bytes: this is the resident-ingest hot
+        path."""
+        from pixie_tpu.ops import codec as _codec
+
+        t0 = time.perf_counter()
+        dev_cols = {}
+        for n2 in col_names:
+            blk = rw.blocks[n2]
+            kind = plan.col_plans[n2][0]
+            if kind == "raw" and blk.dtype == plan.block_dtypes[n2]:
+                dev_cols[n2] = blk  # identity: serve the ring block itself
+                continue
+            dev_cols[n2] = _codec.convert_block(
+                self.mesh,
+                plan.col_plans[n2],
+                blk,
+                int_dtype=plan.block_dtypes[n2],
+            )
+        COLD_PROFILE["stage_resident_convert"] = COLD_PROFILE.get(
+            "stage_resident_convert", 0.0
+        ) + (time.perf_counter() - t0)
+        COLD_PROFILE["stage_resident_hits"] = COLD_PROFILE.get(
+            "stage_resident_hits", 0.0
+        ) + 1.0
+        return dev_cols
 
     def _breaker_is_open(self, key: str) -> bool:
         threshold = flags.device_breaker_threshold
@@ -926,6 +1066,7 @@ class MeshExecutor:
                     stream = self._stream_execute(
                         m, device_specs, evaluator, key_plan, table, cols,
                         n, f32_cols, cell_cols, aux, cacheable,
+                        base_row=version[0],
                     )
                 if stream is not None:
                     merged, capacity, staged = stream
@@ -1875,6 +2016,7 @@ class MeshExecutor:
         staged = self._staged_lookup(cache_key)
         if staged is not None:
             return staged
+        base_row = table.min_row_id()
         cols, n = read_columns(
             table,
             sorted(set(cols_needed)),
@@ -1887,6 +2029,18 @@ class MeshExecutor:
             cols[name] = arr
         if key_plan.host_gids is not None and len(key_plan.host_gids) != n:
             return None
+        if not extra_cols:
+            # Resident-ingest fast path (r13): assemble the staging from
+            # HBM ring windows + a compressed cold tail — the scan/join
+            # analogue of the stream loop's per-window substitution.
+            staged = self._try_resident_assemble(
+                table, src_op, cols, n, key_plan, f32_cols, base_row
+            )
+            if staged is not None:
+                self._staged_insert(
+                    cache_key, staged, src_op.table_name, cache_key[1]
+                )
+                return staged
         try:
             staged = self._stage(cols, n, key_plan, table, f32_cols)
         except Exception as e:
@@ -1903,11 +2057,127 @@ class MeshExecutor:
         )
         return staged
 
+    def _try_resident_assemble(
+        self, table, src_op, cols, n, key_plan, f32_cols, base_row
+    ):
+        """Build a StagedColumns from HBM-resident ring windows plus a
+        compressed cold tail (r13). Returns None whenever the fast path
+        does not apply — no ring, misaligned geometry, zero hits — or on
+        any failure (recorded like stream fallbacks; the caller stages
+        monolithically, still correct)."""
+        ring = self._resident_ring(table, src_op)
+        if ring is None or n <= 0 or not cols:
+            return None
+        try:
+            from pixie_tpu.parallel import staging as _staging
+
+            plan = _staging.plan_stream(
+                self.mesh,
+                cols,
+                n,
+                ring.window_rows,
+                block_rows=self.block_rows,
+                f32_cols=f32_cols,
+                cell_cols=None,
+                num_groups=max(key_plan.num_groups, 1),
+                has_gids=key_plan.host_gids is not None,
+            )
+            if plan.window_rows != ring.window_rows or (
+                (plan.d, plan.nblk, plan.b)
+                != (ring.d, ring.nblk, ring.b)
+            ):
+                return None
+            col_names = sorted(cols)
+            hits = {}
+            for w in range(plan.n_windows):
+                rows_w = min(
+                    plan.window_rows, n - w * plan.window_rows
+                )
+                rw = ring.lookup(
+                    base_row + w * plan.window_rows, rows_w, col_names
+                )
+                if rw is not None:
+                    hits[w] = rw
+            if not hits:
+                return None  # all-cold: monolithic staging is simpler
+            if plan.codecs:
+                self._kick_decode_aot(plan)
+            dec_cache: dict = {}
+            gids = key_plan.host_gids
+            win_blocks, win_masks, win_gids = [], [], []
+            for w in range(plan.n_windows):
+                rows_w = min(
+                    plan.window_rows, n - w * plan.window_rows
+                )
+                rows, packed, pgids, nbytes = _staging.pack_stream_window(
+                    plan, cols, gids, w, w in hits
+                )
+                if w in hits:
+                    dev_cols = self._convert_resident_window(
+                        plan, hits[w], col_names
+                    )
+                else:
+                    dev_cols = self._put_window_cols(
+                        plan, packed, col_names, dec_cache
+                    )
+                win_blocks.append(dev_cols)
+                win_masks.append(
+                    _staging._build_mask(
+                        self.mesh, plan.d, plan.nblk, plan.b, rows
+                    )
+                )
+                win_gids.append(
+                    jax.device_put(
+                        pgids,
+                        NamedSharding(
+                            self.mesh, P(self.mesh.axis_names[0])
+                        ),
+                    )
+                    if pgids is not None
+                    else None
+                )
+                COLD_PROFILE["wire_bytes"] = COLD_PROFILE.get(
+                    "wire_bytes", 0.0
+                ) + float(nbytes)
+                COLD_PROFILE["stage_bytes"] = COLD_PROFILE.get(
+                    "stage_bytes", 0.0
+                ) + float(
+                    plan.window_block_nbytes()
+                    + (pgids.nbytes if pgids is not None else 0)
+                )
+            return _staging.concat_stream_windows(
+                self.mesh, plan, win_blocks, win_masks, win_gids,
+                key_plan.num_groups, key_plan.key_columns,
+                table.dictionaries,
+            )
+        except Exception as e:
+            import logging
+            import traceback
+
+            key = f"resident-assemble {type(e).__name__}: {e}"
+            if key not in self.stream_fallback_errors:
+                self.stream_fallback_errors[key] = traceback.format_exc()
+                logging.getLogger("pixie_tpu.parallel").warning(
+                    "resident assembly failed, staging monolithically: %s",
+                    key,
+                )
+            return None
+
     def _staged_insert(self, cache_key, staged, table_name, version) -> None:
         """Register a staging with the residency pool: version
         supersession, the byte watermark (hbm_budget_mb), and the LRU
-        entry cap all happen inside (serving/residency.py)."""
+        entry cap all happen inside (serving/residency.py). Also records
+        the table's observed staged bytes-per-row, which metadata
+        admission control uses to estimate a query's staging cost
+        BEFORE the cold stage (serving/admission.py, r13)."""
         self._staged_cache.insert(cache_key, staged, table_name, version)
+        from pixie_tpu.serving.residency import staged_nbytes
+
+        from pixie_tpu.parallel.staging import record_observed_bpr
+
+        record_observed_bpr(
+            table_name, staged_nbytes(staged), staged.num_rows
+        )
 
     def _build_scan_program(
         self, m: _ScanMatch, evaluator, staged, aux_key_order, out_dtypes
@@ -3594,7 +3864,7 @@ class MeshExecutor:
 
     def _stream_execute(
         self, m, specs, evaluator, key_plan, table, cols, n,
-        f32_cols, cell_cols, aux, cacheable,
+        f32_cols, cell_cols, aux, cacheable, base_row=0,
     ):
         """Streamed staging + window fold. Returns (merged, capacity,
         staged_for_cache|None), or None when gated off or on failure (the
@@ -3602,7 +3872,7 @@ class MeshExecutor:
         try:
             return self._stream_execute_inner(
                 m, specs, evaluator, key_plan, table, cols, n,
-                f32_cols, cell_cols, aux, cacheable,
+                f32_cols, cell_cols, aux, cacheable, base_row,
             )
         except Exception as e:
             import logging
@@ -3620,7 +3890,7 @@ class MeshExecutor:
 
     def _stream_execute_inner(
         self, m, specs, evaluator, key_plan, table, cols, n,
-        f32_cols, cell_cols, aux, cacheable,
+        f32_cols, cell_cols, aux, cacheable, base_row=0,
     ):
         import concurrent.futures
         import types as _types
@@ -3633,17 +3903,30 @@ class MeshExecutor:
             # Multi-pass gid windows re-scan the staged blocks once per
             # pass: they need HBM-resident blocks, not a stream.
             return None
+        # Resident ingest (r13): when the table has an HBM ring, stream
+        # at the RING's window size so plan window w covers exactly ring
+        # window (base_row + w·W)/W — a hit substitutes device-resident
+        # blocks for the whole pack+transfer of that window.
+        ring = self._resident_ring(table, m.source_op)
+        window_rows = flags.streaming_window_rows
+        if ring is not None:
+            window_rows = ring.window_rows
         plan = _staging.plan_stream(
             self.mesh,
             cols,
             n,
-            flags.streaming_window_rows,
+            window_rows,
             block_rows=self.block_rows,
             f32_cols=f32_cols,
             cell_cols=cell_cols,
             num_groups=max(key_plan.num_groups, 1),
             has_gids=key_plan.host_gids is not None,
         )
+        if ring is not None and (
+            plan.window_rows != ring.window_rows
+            or (plan.d, plan.nblk, plan.b) != (ring.d, ring.nblk, ring.b)
+        ):
+            ring = None  # clamped geometry (small table): no aligned hits
         aux = dict(aux)  # int-dict LUTs are stream-local; keep caller's aux clean
         for n2 in sorted(plan.int_dicts):
             aux[f"intdict:{n2}"] = np.asarray(plan.int_dicts[n2])
@@ -3755,7 +4038,9 @@ class MeshExecutor:
             # tree (pack/transfer/compile/fold per window) instead of
             # living only in the COLD_PROFILE dict. Counter-valued keys
             # (bytes, window counts) are not durations — skipped.
-            if trace.ACTIVE and key not in ("stage_bytes", "stream_windows"):
+            if trace.ACTIVE and key not in (
+                "stage_bytes", "wire_bytes", "stream_windows"
+            ):
                 trace.phase(f"device.{key}", dt)
 
         def resolve_fold(block: bool) -> bool:
@@ -3794,6 +4079,26 @@ class MeshExecutor:
         inflight: "collections.deque" = collections.deque()
         flat_state = None
 
+        # Resident-window hits: plan windows whose rows are already in
+        # HBM (full ring windows only). Their pack is gids-only and
+        # their blocks come from a device-side raw→plan convert.
+        hits: dict[int, Any] = {}
+        if ring is not None:
+            for w0 in range(plan.n_windows):
+                rows_w = min(
+                    plan.window_rows, plan.num_rows - w0 * plan.window_rows
+                )
+                rw = ring.lookup(
+                    base_row + w0 * plan.window_rows, rows_w, col_names
+                )
+                if rw is not None:
+                    hits[w0] = rw
+        # Decode programs compile on the AOT worker while the first
+        # windows pack/transfer; in-line jit remains the fallback.
+        if plan.codecs:
+            self._kick_decode_aot(plan)
+        dec_cache: dict = {}
+
         def dispatch_fold(dev_cols, mask, dev_g):
             nonlocal flat_state
             args = list(flat_state)
@@ -3827,7 +4132,8 @@ class MeshExecutor:
             with _segment.platform_hint(self.mesh.devices.flat[0].platform):
                 flat_state = list(init_p())
                 fut = pool.submit(
-                    _staging.pack_stream_window, plan, cols, gids, 0
+                    _staging.pack_stream_window, plan, cols, gids, 0,
+                    0 in hits,
                 )
                 for w in range(plan.n_windows):
                     t0 = time.perf_counter()
@@ -3839,12 +4145,20 @@ class MeshExecutor:
                         fut = pool.submit(
                             _staging.pack_stream_window,
                             plan, cols, gids, w + 1,
+                            (w + 1) in hits,
                         )
                     t0 = time.perf_counter()
-                    dev_cols = {
-                        n2: jax.device_put(packed[n2], sharding)
-                        for n2 in col_names
-                    }
+                    if w in hits:
+                        # Resident-ingest hit: the window's columns are
+                        # already in HBM — convert raw→plan dtypes on
+                        # device; only the (tiny) gids traveled.
+                        dev_cols = self._convert_resident_window(
+                            plan, hits[w], col_names
+                        )
+                    else:
+                        dev_cols = self._put_window_cols(
+                            plan, packed, col_names, dec_cache
+                        )
                     mask = _staging._build_mask(
                         self.mesh, plan.d, plan.nblk, plan.b, rows
                     )
@@ -3854,7 +4168,14 @@ class MeshExecutor:
                         else None
                     )
                     prof("stage_stream_put", time.perf_counter() - t0)
-                    prof("stage_bytes", float(nbytes))
+                    prof(
+                        "stage_bytes",
+                        float(
+                            plan.window_block_nbytes()
+                            + (pgids.nbytes if pgids is not None else 0)
+                        ),
+                    )
+                    prof("wire_bytes", float(nbytes))
                     if cacheable:
                         win_blocks.append(dev_cols)
                         win_masks.append(mask)

@@ -43,6 +43,18 @@ def reset_cold_profile() -> dict:
     return snap
 
 
+# Observed staged (decoded, HBM-resident) bytes per row, by table — the
+# metadata admission control uses to estimate a query's staging cost
+# BEFORE the cold stage starts (serving/admission.estimate_staging_bytes).
+# Updated after every staging; survives cache eviction.
+OBSERVED_BPR: dict[str, float] = {}
+
+
+def record_observed_bpr(table_name: str, nbytes: int, rows: int) -> None:
+    if table_name and rows > 0 and nbytes > 0:
+        OBSERVED_BPR[table_name] = nbytes / rows
+
+
 class timed:
     """with timed('stage'): ... — accumulates into COLD_PROFILE, and
     (r11) emits the same interval as a ``device.<key>`` trace span under
@@ -297,17 +309,23 @@ def stage_columns(
     transfer with zero end-to-end precision change. ``int_dicts`` maps
     column names already replaced by small-domain codes (see
     int_dict_encode) to their value LUTs."""
+    from pixie_tpu.ops import codec as _codec
+
     (axis_name,) = mesh.axis_names
     d = mesh.devices.size
     b, nblk = block_geometry(num_rows, d, block_rows)
     total = d * nblk * b
     sharding = NamedSharding(mesh, P(axis_name))
 
-    def shape3(arr, fill):
+    def flat_pad(arr, fill):
         out = np.full(total, fill, dtype=arr.dtype if arr.size else np.int32)
         out[:num_rows] = arr
-        return out.reshape(d, nblk, b)
+        return out
 
+    def shape3(arr, fill):
+        return flat_pad(arr, fill).reshape(d, nblk, b)
+
+    use_codec = flags.staging_codec
     narrow_offsets: dict[str, int] = {}
     blocks: dict[str, jax.Array] = {}
     for name, a in cols.items():
@@ -318,17 +336,46 @@ def stage_columns(
                 a, off = _narrow_int(a)
                 if off is not None:
                     narrow_offsets[name] = off
-            packed = shape3(a, 0)
-        with timed("stage_transfer"):
-            # device_put is async on local backends; do NOT block per
-            # column — that serializes transfers behind each other and
-            # behind the next column's host pack. One sync below, after
-            # every put is in flight (the PJRT runtime retains the host
-            # buffer until its transfer completes).
-            blocks[name] = jax.device_put(packed, sharding)
-            COLD_PROFILE["stage_bytes"] = COLD_PROFILE.get(
-                "stage_bytes", 0.0
-            ) + float(packed.nbytes)
+            flat = flat_pad(a, 0)
+        # Staging codec (r13): ship the packed representation encoded
+        # when a lightweight encoder pays; a jitted program expands it
+        # in HBM, bit-identical to the uncompressed transfer.
+        payload = None
+        if use_codec and num_rows > 0:
+            with timed("stage_encode"):
+                cplan = _codec.plan_codec_local(
+                    flat, d, nblk, b, num_rows,
+                    float(flags.staging_codec_min_ratio),
+                )
+                if cplan is not None:
+                    try:
+                        payload = _codec.encode_window(flat, cplan, num_rows)
+                    except _codec.CodecOverflow:
+                        payload = None
+        COLD_PROFILE["stage_bytes"] = COLD_PROFILE.get(
+            "stage_bytes", 0.0
+        ) + float(flat.nbytes)
+        if payload is not None:
+            with timed("stage_transfer"):
+                args = _codec.put_payload(mesh, payload)
+                COLD_PROFILE["wire_bytes"] = COLD_PROFILE.get(
+                    "wire_bytes", 0.0
+                ) + float(payload.nbytes)
+            with timed("stage_decode"):
+                blocks[name] = _codec.decoder(mesh, cplan, nblk, b)(*args)
+        else:
+            with timed("stage_transfer"):
+                # device_put is async on local backends; do NOT block per
+                # column — that serializes transfers behind each other and
+                # behind the next column's host pack. One sync below, after
+                # every put is in flight (the PJRT runtime retains the host
+                # buffer until its transfer completes).
+                blocks[name] = jax.device_put(
+                    flat.reshape(d, nblk, b), sharding
+                )
+                COLD_PROFILE["wire_bytes"] = COLD_PROFILE.get(
+                    "wire_bytes", 0.0
+                ) + float(flat.nbytes)
     with timed("stage_transfer"):
         if blocks:
             jax.block_until_ready(list(blocks.values()))
@@ -398,6 +445,19 @@ class StreamPlan:
     b: int
     gid_dtype: Optional[np.dtype]
     num_groups: int
+    # Staging codec (r13): name -> ops.codec.CodecPlan for columns whose
+    # wire bytes an encoder beats by >= staging_codec_min_ratio. Fixed
+    # from the FULL column like every other recipe entry, so all windows
+    # share one decode program. Columns absent here ship passthrough.
+    codecs: dict = dataclasses.field(default_factory=dict)
+
+    def window_block_nbytes(self) -> int:
+        """Decoded (HBM) bytes per full window: column blocks only —
+        what stage_bytes accounts per window (gids ride separately)."""
+        return sum(
+            self.d * self.nblk * self.b * np.dtype(dt).itemsize
+            for dt in self.block_dtypes.values()
+        )
 
 
 def int_dict_lut(arr: np.ndarray, max_card: int) -> Optional[np.ndarray]:
@@ -487,6 +547,27 @@ def plan_stream(
             if num_groups <= 0xFF + 1
             else (np.uint16 if num_groups <= 0xFFFF + 1 else np.int32)
         )
+    # Staging codec (r13): pick a per-column encoder from the FULL
+    # column's stats so every window encodes identically (one decode
+    # program serves all windows, and the decoded blocks are exactly
+    # what the passthrough pack would have transferred). Delta needs a
+    # diff-preserving (raw/narrow int) transform; RLE composes with
+    # anything because run boundaries are invariant under the pack
+    # transforms (bit-pattern changes map 1:1).
+    codecs: dict = {}
+    if flags.staging_codec:
+        from pixie_tpu.ops import codec as _codec
+
+        for name, a in cols.items():
+            kind = col_plans[name][0]
+            bdt = np.dtype(block_dtypes[name])
+            affine = kind in ("raw", "narrow") and bdt.kind in "iu"
+            cp = _codec.plan_codec(
+                a, bdt, d, nblk, b, window_rows, num_rows,
+                float(flags.staging_codec_min_ratio), affine,
+            )
+            if cp is not None:
+                codecs[name] = cp
     return StreamPlan(
         col_plans=col_plans,
         narrow_offsets=narrow_offsets,
@@ -500,6 +581,7 @@ def plan_stream(
         b=b,
         gid_dtype=gid_dtype,
         num_groups=num_groups,
+        codecs=codecs,
     )
 
 
@@ -508,11 +590,20 @@ def pack_stream_window(
     cols: dict[str, np.ndarray],
     gids: Optional[np.ndarray],
     w: int,
+    skip_cols: bool = False,
 ):
     """Host-pack window w per the plan: narrow/f32/int-dict encode + pad +
     reshape to [D, nblk, B]. Runs on the streaming pipeline's background
     thread — this is the 'pack' stage that overlaps transfer and compute.
-    Returns (rows, packed_cols, packed_gids, nbytes)."""
+    Returns (rows, packed_cols, packed_gids, wire_nbytes): with the
+    staging codec on, a packed_cols value may be a CodecPayload (the
+    compressed representation the wire actually carries — the device
+    decode expands it to the identical block), and wire_nbytes counts
+    what ships, not what lands. ``skip_cols`` packs only the gids — the
+    resident-ingest path, where the window's columns are already in
+    HBM and only the query-specific group ids must travel."""
+    from pixie_tpu.ops import codec as _codec
+
     # Fault site: a poisoned stream pack (chaos tests prove the query
     # falls back to monolithic staging, still on-device, and stays
     # correct — MeshExecutor.stream_fallback_errors records it).
@@ -524,7 +615,7 @@ def pack_stream_window(
         rows = hi - lo
         total = plan.d * plan.nblk * plan.b
 
-        def shape3(a, dtype):
+        def flat_pad(a, dtype):
             # np.empty + tail-zero, not np.zeros: the rows prefix is about
             # to be overwritten anyway, and this pack is on the pipeline's
             # critical path when pack is the slowest stage.
@@ -532,11 +623,14 @@ def pack_stream_window(
             out[:rows] = a
             if rows < total:
                 out[rows:] = 0
-            return out.reshape(plan.d, plan.nblk, plan.b)
+            return out
 
-        packed: dict[str, np.ndarray] = {}
+        def shape3(a, dtype):
+            return flat_pad(a, dtype).reshape(plan.d, plan.nblk, plan.b)
+
+        packed: dict = {}
         nbytes = 0
-        for name, arr in cols.items():
+        for name, arr in ({} if skip_cols else cols).items():
             a = arr[lo:hi]
             kind, info = plan.col_plans[name]
             if kind == "f32":
@@ -548,6 +642,22 @@ def pack_stream_window(
                 lut, dt = info
                 c = np.searchsorted(lut, a)
                 a = np.minimum(c, len(lut) - 1).astype(dt)
+            cp = plan.codecs.get(name)
+            if cp is not None:
+                flat = flat_pad(a, plan.block_dtypes[name])
+                try:
+                    with timed("stage_encode"):
+                        packed[name] = _codec.encode_window(flat, cp, rows)
+                    nbytes += packed[name].nbytes
+                    continue
+                except _codec.CodecOverflow:
+                    # A window that defeats the plan ships raw —
+                    # correctness never rides the plan's guess.
+                    packed[name] = flat.reshape(
+                        plan.d, plan.nblk, plan.b
+                    )
+                    nbytes += packed[name].nbytes
+                    continue
             packed[name] = shape3(a, plan.block_dtypes[name])
             nbytes += packed[name].nbytes
         packed_gids = None

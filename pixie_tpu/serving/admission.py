@@ -56,6 +56,12 @@ _WAIT_SECONDS = _M.histogram(
     "admission_wait_seconds",
     "Time a query spent in the admission queue before grant/rejection.",
 )
+_LOCK_WAIT = _M.histogram(
+    "admission_lock_wait_seconds",
+    "Time a caller waited to acquire the admission controller's lock "
+    "(only contended acquisitions are observed — the r12 follow-on "
+    "lock-profiling signal at ~1k-client depth).",
+)
 
 
 class AdmissionRejected(RuntimeError):
@@ -203,15 +209,29 @@ class AdmissionController:
         return float(weights.get(tenant, 1.0))
 
     # -- the front door ------------------------------------------------------
-    def acquire(self, tenant: str = "default") -> _Ticket:
+    def acquire(
+        self, tenant: str = "default", estimated_bytes: int = 0
+    ) -> _Ticket:
         """Block until admitted (WFQ order) or raise AdmissionRejected.
         Every exit path is bounded: queue-full and budget rejections are
-        immediate, a queued request rejects at ``admission_timeout_s``."""
+        immediate, a queued request rejects at ``admission_timeout_s``.
+
+        ``estimated_bytes`` (r13): the query's predicted staging
+        footprint from table metadata (row count × encoded column
+        widths — see ``estimate_staging_bytes``). When set, the HBM
+        budget check rejects a query whose staging could never fit
+        even after evicting every unpinned entry — BEFORE the doomed
+        cold stage starts, not once pinned bytes already exceed
+        budget."""
         t0 = time.monotonic()
-        with self._cv:
+        if not self._cv.acquire(blocking=False):
+            w0 = time.perf_counter()
+            self._cv.acquire()
+            _LOCK_WAIT.observe(time.perf_counter() - w0)
+        try:
             if faults.ACTIVE and faults.fires("serving.admission_reject"):
                 self._reject(tenant, "fault_injected", t0)
-            self._budget_check(tenant, t0)
+            self._budget_check(tenant, t0, estimated_bytes)
             # Prune timed-out waiters off the heap top so a queue of
             # abandoned entries cannot block the immediate-admit path.
             while self._heap and self._heap[0].abandoned:
@@ -252,11 +272,18 @@ class AdmissionController:
             _ADMITTED.inc(tenant=tenant)
             _WAIT_SECONDS.observe(waited)
             return _Ticket(self, tenant, waited)
+        finally:
+            self._cv.release()
 
-    def _budget_check(self, tenant: str, t0: float) -> None:
+    def _budget_check(
+        self, tenant: str, t0: float, estimated_bytes: int = 0
+    ) -> None:
         """Reject when the HBM residency pool has no reclaimable
         headroom: pinned bytes (in-flight folds) already at/over budget
-        means eviction cannot make room for this query's staging."""
+        means eviction cannot make room for this query's staging — and
+        (r13) when the query's ESTIMATED staging bytes cannot fit the
+        budget's unpinned headroom either, so a doomed cold stage is
+        refused before it moves a single byte."""
         if self._budget_fn is None:
             return
         try:
@@ -271,6 +298,18 @@ class AdmissionController:
                 "hbm_budget",
                 t0,
                 detail=f"pinned {pinned}B >= budget {budget}B",
+            )
+        if budget > 0 and estimated_bytes > 0 and (
+            pinned + estimated_bytes > budget
+        ):
+            self._reject(
+                tenant,
+                "hbm_budget",
+                t0,
+                detail=(
+                    f"estimated staging {estimated_bytes}B > budget "
+                    f"{budget}B - pinned {pinned}B"
+                ),
             )
 
     def _reject(self, tenant: str, reason: str, t0: float, detail=""):
@@ -305,7 +344,8 @@ class AdmissionController:
 
     def snapshot(self) -> dict:
         """Admission state for /statusz (the r10 health plane) and the
-        soak harness."""
+        soak harness — including queue-wait and lock-wait quantiles,
+        the r13 contention signals at ~1k-client depth."""
         with self._cv:
             return {
                 "active": self._active,
@@ -317,4 +357,69 @@ class AdmissionController:
                     t: round(v, 6)
                     for t, v in sorted(self._tenant_vtime.items())
                 },
+                "wait_p50_ms": round(
+                    _WAIT_SECONDS.quantile(0.5) * 1e3, 3
+                ),
+                "wait_p99_ms": round(
+                    _WAIT_SECONDS.quantile(0.99) * 1e3, 3
+                ),
+                "lock_wait_p99_ms": round(
+                    _LOCK_WAIT.quantile(0.99) * 1e3, 3
+                ),
             }
+
+
+# -- metadata staging-cost estimation (r13 satellite) ------------------------
+
+
+def estimate_staging_bytes(table, columns=None) -> int:
+    """A query's predicted HBM staging footprint from table METADATA:
+    row count × encoded column widths, no data read.
+
+    Width per column prefers the table's OBSERVED staged bytes-per-row
+    (parallel/staging.OBSERVED_BPR, recorded at every staging insert —
+    it reflects narrowing, f32 sketch staging, and int-dict codes);
+    before any staging exists it falls back to the relation's raw host
+    widths plus the 1-byte validity mask — deliberately conservative,
+    since the check exists to refuse DOOMED cold stages."""
+    from pixie_tpu.parallel.staging import OBSERVED_BPR
+    from pixie_tpu.types import DataType
+
+    stats = table.stats()
+    rows = max(int(stats.num_rows), 0)
+    if rows == 0:
+        return 0
+    bpr = OBSERVED_BPR.get(table.name)
+    if bpr is None:
+        widths = {
+            DataType.BOOLEAN: 1,
+            DataType.INT64: 8,
+            DataType.FLOAT64: 8,
+            DataType.STRING: 4,  # dictionary codes
+            DataType.TIME64NS: 8,
+            DataType.UINT128: 16,
+        }
+        names = set(columns) if columns else None
+        bpr = 1.0  # validity mask
+        for c in table.relation:
+            if names is not None and c.name not in names:
+                continue
+            bpr += widths.get(c.data_type, 8)
+    return int(rows * bpr)
+
+
+def make_store_estimator(table_store):
+    """table_name -> estimated staging bytes, over a TableStore — the
+    callable QueryBroker(staging_estimator=...) wants. Unknown tables
+    estimate 0 (never reject what we cannot see)."""
+
+    def estimate(table_name: str) -> int:
+        table = table_store.get_table(table_name)
+        if table is None:
+            return 0
+        try:
+            return estimate_staging_bytes(table)
+        except Exception:
+            return 0
+
+    return estimate

@@ -123,6 +123,11 @@ class ResidencyPool:
         self._budget_bytes = budget_bytes
         self._used = 0
         self._pinned = 0
+        # Device-resident ring windows (r13, serving/resident.py):
+        # byte-accounted like staged entries and treated as permanently
+        # pinned — never LRU-evicted, never OOM-cleared; only the ring
+        # itself releases them (its own depth bound / table expiry).
+        self._resident: dict = {}
 
     # -- configuration (read per call so flag flips apply live) --------------
     def _cap(self) -> int:
@@ -216,6 +221,33 @@ class ResidencyPool:
             for k in list(self._entries):
                 self._retire_locked(self._entries.pop(k), reason=reason)
             self._publish_locked()
+
+    # -- resident ring windows (r13) -----------------------------------------
+    def register_resident(self, key, nbytes: int) -> None:
+        """Account a device-resident ring window's bytes: they count as
+        used AND pinned (unevictable by any pool policy — the ring owns
+        their lifetime), so the byte watermark, /statusz, and admission's
+        headroom math all see HBM the rings occupy."""
+        with self._lock:
+            old = self._resident.pop(key, None)
+            if old is not None:
+                self._used -= old
+                self._pinned -= old
+            self._resident[key] = int(nbytes)
+            self._used += int(nbytes)
+            self._pinned += int(nbytes)
+            self._publish_locked()
+
+    def release_resident(self, key) -> None:
+        """Free a ring window's accounting (ring rolled past it, or the
+        table expired its rows)."""
+        with self._lock:
+            nbytes = self._resident.pop(key, None)
+            if nbytes is not None:
+                self._used -= nbytes
+                self._pinned -= nbytes
+                _EVICTIONS.inc(reason="resident_roll")
+                self._publish_locked()
 
     # -- pinning -------------------------------------------------------------
     class _Pin:
@@ -311,6 +343,8 @@ class ResidencyPool:
                 "used_bytes": self._used,
                 "pinned_bytes": self._pinned,
                 "zombie_entries": len(self._zombies),
+                "resident_windows": len(self._resident),
+                "resident_bytes": sum(self._resident.values()),
                 "budget_bytes": budget,
                 "headroom_bytes": (
                     max(budget - self._used, 0) if budget > 0 else None

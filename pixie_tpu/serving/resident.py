@@ -1,0 +1,300 @@
+"""Device-resident incremental ingest: HBM ring tables fed by appends.
+
+The r13 production posture: telemetry is continuous and queries are
+repeated, so a hot table should NEVER cold-stage its recent span — the
+ingest loop pays the wire incrementally (compressed, off any query's
+critical path) and a query finds the last N windows already in HBM,
+staging only the cold tail. Crescando/SharedDB's continuously-resident
+operational data, on a TPU.
+
+Mechanics (reusing the r6 windowed layout end to end):
+
+- A ``ResidentRing`` attaches to a Table's append listener. Appends
+  buffer host-side until a full **ring window** (``resident_window_rows``
+  rows, geometry from ``staging.block_geometry`` — exactly the stream
+  plan's) is available, which is then packed in RAW column dtypes,
+  codec-encoded (``staging_codec``), transferred, and device-decoded
+  into [D, nblk, B] blocks that stay resident.
+- Queries over the table stream at the ring's window size, so plan
+  window w covers the same absolute rows as ring window
+  ``(min_row + w·W) / W``. On a hit the pipeline skips pack+transfer
+  entirely and runs a jitted raw→plan CONVERT (ops/codec.py:
+  narrow/f32/int-dict computed on device) — bit-identical to the host
+  pack, zero wire bytes. Misses (partial tail, pre-ring history,
+  post-expiry misalignment) take the normal compressed staging path.
+- Ring windows are registered with the ResidencyPool as permanently
+  pinned bytes (``register_resident``), so /statusz, the byte
+  watermark, and admission headroom all see them; the ring's own depth
+  bound (``resident_max_windows``) rolls the oldest window out and
+  frees its accounting — the device-side analogue of the table store's
+  ring-buffer expiry.
+
+Correctness stance: the ring only ever serves FULL windows whose rows it
+observed gap-free in row-id order (a skipped row id — e.g. a listener
+attached mid-write race — permanently invalidates the ring, never the
+query). Everything else falls back to staging from the host columns.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from pixie_tpu.types import DataType
+from pixie_tpu.utils import flags, metrics_registry
+
+_M = metrics_registry()
+_WINDOWS = _M.counter(
+    "resident_ingest_windows_total",
+    "Ring windows staged to HBM by the resident-ingest path.",
+)
+_WIRE = _M.counter(
+    "resident_ingest_wire_bytes_total",
+    "Bytes the resident-ingest path actually transferred (encoded).",
+)
+_HITS = _M.counter(
+    "resident_window_hits_total",
+    "Query stream windows served from HBM-resident ring windows "
+    "(pack+transfer skipped).",
+)
+_INVALID = _M.counter(
+    "resident_ring_invalidated_total",
+    "Rings permanently invalidated (row-id gap or column mismatch).",
+)
+
+# Raw host dtypes the ring can hold, per column DataType (strings ride
+# as their table-dictionary int32 codes, matching read_columns).
+_RAW_DTYPES = {
+    DataType.BOOLEAN: np.dtype(np.bool_),
+    DataType.INT64: np.dtype(np.int64),
+    DataType.FLOAT64: np.dtype(np.float64),
+    DataType.STRING: np.dtype(np.int32),
+    DataType.TIME64NS: np.dtype(np.int64),
+}
+
+
+class ResidentWindow:
+    __slots__ = ("index", "start_row", "rows", "blocks", "nbytes")
+
+    def __init__(self, index, start_row, rows, blocks, nbytes):
+        self.index = index
+        self.start_row = start_row
+        self.rows = rows
+        self.blocks = blocks  # col -> [D, nblk, B] raw-dtype device array
+        self.nbytes = nbytes
+
+
+class ResidentRing:
+    """Per-table HBM ring of full append windows in raw column dtypes."""
+
+    def __init__(self, mesh, table, block_rows: int, pool=None):
+        from pixie_tpu.parallel.staging import block_geometry
+
+        self.mesh = mesh
+        self.table_name = table.name
+        self.window_rows = int(flags.resident_window_rows)
+        self.d = mesh.devices.size
+        self.b, self.nblk = block_geometry(
+            self.window_rows, self.d, block_rows
+        )
+        self._pool = pool
+        self._lock = threading.Lock()
+        self.columns: dict[str, np.dtype] = {}
+        for c in table.relation:
+            dt = _RAW_DTYPES.get(c.data_type)
+            if dt is not None:
+                self.columns[c.name] = dt
+        self.windows: dict[int, ResidentWindow] = {}
+        self._valid = bool(self.columns)
+        # Buffered host rows cover [_buf_start, _next_row).
+        self._next_row = table.end_row_id()
+        self._buf_start = self._next_row
+        self._buf: dict[str, list] = {n: [] for n in self.columns}
+
+    # -- write side (table append listener) ----------------------------------
+    def on_append(self, first_row_id: int, batch) -> None:
+        from pixie_tpu.table.column import DictColumn
+
+        with self._lock:
+            if not self._valid:
+                return
+            if first_row_id != self._next_row:
+                self._invalidate_locked()
+                return
+            if batch.num_rows == 0:
+                return
+            for name, dt in self.columns.items():
+                c = batch.col(name)
+                arr = c.codes if isinstance(c, DictColumn) else np.asarray(c)
+                if arr.dtype != dt:
+                    # A batch whose host dtype diverges from what
+                    # read_columns would return must never be served.
+                    self._invalidate_locked()
+                    return
+                self._buf[name].append(arr)
+            self._next_row += batch.num_rows
+            self._stage_complete_windows_locked()
+
+    def _invalidate_locked(self) -> None:
+        self._valid = False
+        _INVALID.inc()
+        for w in list(self.windows):
+            self._release_locked(w)
+        self._buf = {n: [] for n in self.columns}
+
+    def _stage_complete_windows_locked(self) -> None:
+        W = self.window_rows
+        while True:
+            k = -(-self._buf_start // W)  # first window at/after buffer
+            if (k + 1) * W > self._next_row:
+                return
+            # Compact the buffer to single chunks once per staging.
+            for name in self.columns:
+                if len(self._buf[name]) > 1:
+                    self._buf[name] = [np.concatenate(self._buf[name])]
+            lo = k * W - self._buf_start
+            win_cols = {
+                name: self._buf[name][0][lo : lo + W]
+                for name in self.columns
+            }
+            self._stage_window_locked(k, win_cols)
+            # Drop everything through the staged window.
+            keep_from = (k + 1) * W - self._buf_start
+            for name in self.columns:
+                self._buf[name] = [self._buf[name][0][keep_from:]]
+            self._buf_start = (k + 1) * W
+
+    def _stage_window_locked(self, k: int, win_cols: dict) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from pixie_tpu.ops import codec as _codec
+
+        (axis_name,) = self.mesh.axis_names
+        sharding = NamedSharding(self.mesh, P(axis_name))
+        total = self.d * self.nblk * self.b
+        W = self.window_rows
+        use_codec = flags.staging_codec
+        min_ratio = float(flags.staging_codec_min_ratio)
+        blocks = {}
+        nbytes = 0
+        wire = 0
+        for name, a in win_cols.items():
+            flat = np.zeros(total, dtype=a.dtype)
+            flat[:W] = a
+            payload = None
+            if use_codec:
+                cp = _codec.plan_codec_local(
+                    flat, self.d, self.nblk, self.b, W, min_ratio
+                )
+                if cp is not None:
+                    try:
+                        payload = _codec.encode_window(flat, cp, W)
+                    except _codec.CodecOverflow:
+                        payload = None
+            if payload is not None:
+                args = _codec.put_payload(self.mesh, payload)
+                blocks[name] = _codec.decoder(
+                    self.mesh, cp, self.nblk, self.b
+                )(*args)
+                wire += payload.nbytes
+            else:
+                blocks[name] = jax.device_put(
+                    flat.reshape(self.d, self.nblk, self.b), sharding
+                )
+                wire += flat.nbytes
+            nbytes += flat.nbytes
+        win = ResidentWindow(k, k * W, W, blocks, nbytes)
+        self.windows[k] = win
+        _WINDOWS.inc()
+        _WIRE.inc(wire)
+        if self._pool is not None:
+            self._pool.register_resident(
+                ("resident", self.table_name, k), nbytes
+            )
+        # Ring depth bound: roll the oldest window out.
+        cap = max(int(flags.resident_max_windows), 1)
+        while len(self.windows) > cap:
+            self._release_locked(min(self.windows))
+
+    def _release_locked(self, k: int) -> None:
+        self.windows.pop(k, None)
+        if self._pool is not None:
+            self._pool.release_resident(("resident", self.table_name, k))
+
+    # -- read side (query staging) -------------------------------------------
+    def lookup(
+        self, start_row: int, rows: int, needed_cols
+    ) -> Optional[ResidentWindow]:
+        """The resident window covering EXACTLY rows
+        [start_row, start_row + rows) with every needed column, or None.
+        Only full, aligned windows ever match — misalignment after
+        ring-buffer expiry silently degrades to the staging path."""
+        W = self.window_rows
+        if rows != W or start_row % W != 0:
+            return None
+        with self._lock:
+            if not self._valid:
+                return None
+            win = self.windows.get(start_row // W)
+        if win is None:
+            return None
+        for name in needed_cols:
+            if name not in win.blocks:
+                return None
+        _HITS.inc()
+        return win
+
+    def release_all(self) -> None:
+        with self._lock:
+            for k in list(self.windows):
+                self._release_locked(k)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "table": self.table_name,
+                "window_rows": self.window_rows,
+                "windows": len(self.windows),
+                "resident_rows": len(self.windows) * self.window_rows,
+                "bytes": sum(w.nbytes for w in self.windows.values()),
+                "valid": self._valid,
+                "buffered_rows": self._next_row - self._buf_start,
+            }
+
+
+class ResidentIngestManager:
+    """The MeshExecutor's registry of per-table rings."""
+
+    def __init__(self, mesh, block_rows: int, pool=None):
+        self.mesh = mesh
+        self.block_rows = block_rows
+        self.pool = pool
+        self._lock = threading.Lock()
+        self._rings: dict[str, ResidentRing] = {}
+
+    def enable(self, table) -> Optional[ResidentRing]:
+        """Attach a ring to ``table`` (idempotent per table name).
+        Returns the ring, or None when the table has no ring-able
+        columns."""
+        with self._lock:
+            ring = self._rings.get(table.name)
+            if ring is not None:
+                return ring
+            ring = ResidentRing(self.mesh, table, self.block_rows, self.pool)
+            if not ring.columns:
+                return None
+            self._rings[table.name] = ring
+        table.add_append_listener(ring.on_append)
+        return ring
+
+    def ring_for(self, table_name: str) -> Optional[ResidentRing]:
+        with self._lock:
+            return self._rings.get(table_name)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            rings = list(self._rings.values())
+        return {r.table_name: r.snapshot() for r in rings}

@@ -92,6 +92,12 @@ class Table:
         self._time_idx = (
             relation.col_idx(TIME_COLUMN) if relation.has_column(TIME_COLUMN) else -1
         )
+        # Append listeners (r13): fn(first_row_id, batch) fired inside
+        # the write lock AFTER dictionary adoption, so device-resident
+        # ingest rings see every row exactly once, in row-id order, with
+        # table-dictionary codes. Keep listeners cheap-ish: they run on
+        # the writer's thread.
+        self._append_listeners: list = []
 
     # -- write side --------------------------------------------------------
     def write(self, batch: RowBatch) -> None:
@@ -109,7 +115,17 @@ class Table:
                 mn = mx = self._segments[-1].max_time if self._segments else 0
             seg = _Segment(self._next_row_id, batch, mn, mx, hot=True)
             self._segments.append(seg)
+            first_row_id = self._next_row_id
             self._next_row_id += batch.num_rows
+            for fn in self._append_listeners:
+                try:
+                    fn(first_row_id, batch)
+                except Exception:
+                    import logging
+
+                    logging.getLogger("pixie_tpu.table").exception(
+                        "append listener failed (ignored)"
+                    )
             nbytes = batch.num_bytes()
             self._bytes += nbytes
             self._stats.batches_added += 1
@@ -140,6 +156,20 @@ class Table:
         """Mark the stream ended (streaming cursors will see eos)."""
         with self._lock:
             self._stopped = True
+
+    def add_append_listener(self, fn) -> None:
+        """Register fn(first_row_id, batch), fired under the write lock
+        after every append (post dictionary adoption). The r13
+        device-resident ingest hook."""
+        with self._lock:
+            self._append_listeners.append(fn)
+
+    def remove_append_listener(self, fn) -> None:
+        with self._lock:
+            try:
+                self._append_listeners.remove(fn)
+            except ValueError:
+                pass
 
     def _adopt_dictionaries(self, batch: RowBatch) -> RowBatch:
         """Re-encode any foreign-dictionary string columns into table dicts."""

@@ -348,6 +348,55 @@ define_flag(
     "tenant's backlog tail.",
 )
 
+# -- staging codec + device-resident ingest (r13) ----------------------------
+define_flag(
+    "staging_codec",
+    True,
+    help_="Compress host→HBM staging transfers with per-column "
+    "lightweight encoders (ops/codec.py): RLE for runs, delta+narrow "
+    "for timestamps/monotone ids, passthrough when neither pays. The "
+    "host packs ENCODED shards, the wire carries the compressed "
+    "representation, and a jitted device program decodes ahead of the "
+    "fold — decoded blocks are bit-identical to an uncompressed "
+    "transfer, so fold programs, staged-cache entries, and shared "
+    "scans are untouched. Cold breakdowns gain stage_encode/"
+    "stage_decode/wire_bytes/codec_ratio.",
+)
+define_flag(
+    "staging_codec_min_ratio",
+    1.4,
+    help_="Minimum compression ratio (decoded bytes / wire bytes) an "
+    "encoder must achieve at plan time before a column ships encoded; "
+    "below it the column ships passthrough (encode+decode cycles are "
+    "cheap but not free).",
+)
+define_flag(
+    "resident_ingest",
+    False,
+    help_="Device-resident incremental ingest (serving/resident.py): "
+    "table appends accumulate into HBM-resident ring windows (the r6 "
+    "windowed layout, raw dtypes, codec-compressed on the wire), so a "
+    "query over a hot table finds full windows already in HBM and "
+    "stages only the cold tail — stage_transfer ≈ 0 for the "
+    "in-window span. Ring entries are pinned and byte-accounted in "
+    "the residency pool like staged entries.",
+)
+define_flag(
+    "resident_window_rows",
+    1 << 21,
+    help_="Rows per device-resident ring window. Queries over a ring "
+    "table stream at this window size so plan windows align with ring "
+    "windows exactly (a resident window substitutes for a "
+    "pack+transfer, bit for bit).",
+)
+define_flag(
+    "resident_max_windows",
+    64,
+    help_="Ring depth per table: oldest resident windows are released "
+    "(and their pool bytes freed) past this bound — the device-side "
+    "ring-buffer analogue of the table store's size_limit expiry.",
+)
+
 # -- robustness (r10): acked delivery + cluster health plane -----------------
 # (transport_ack_* / transport_window_block_s are declared next to their
 # use in vizier/transport.py.)

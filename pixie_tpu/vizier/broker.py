@@ -266,6 +266,7 @@ class QueryBroker:
         registry=None,
         table_relations: Optional[dict[str, Relation]] = None,
         residency=None,
+        staging_estimator=None,
     ):
         if registry is None:
             from pixie_tpu.udf.registry import default_registry
@@ -298,6 +299,12 @@ class QueryBroker:
                 residency.snapshot if residency is not None else None
             )
         )
+        # r13 satellite: table_name -> estimated staging bytes (e.g.
+        # serving.admission.make_store_estimator over the agents' table
+        # store). With it, admission rejects a query whose staging
+        # could NEVER fit the HBM budget before the doomed cold stage
+        # starts, not only once pinned bytes already exceed budget.
+        self.staging_estimator = staging_estimator
         # Unacknowledged fragment launches per agent (r12 reconnect-gap
         # fix): a launch published into an agent's reconnect window is
         # silently lost by an at-most-once bus; when the agent
@@ -401,6 +408,25 @@ class QueryBroker:
         with self._launch_lock:
             self._inflight_launches.get(agent_id, {}).pop(query_id, None)
 
+    def _estimate_staging(self, query: str) -> int:
+        """Sum the staging-bytes estimates of every table the script
+        names (syntactic: px.DataFrame(table='...') references — the
+        estimate gates admission, it does not need plan precision).
+        Returns 0 without an estimator: the check disables cleanly."""
+        if self.staging_estimator is None:
+            return 0
+        import re
+
+        total = 0
+        for name in set(
+            re.findall(r"table\s*=\s*['\"]([^'\"]+)['\"]", query)
+        ):
+            try:
+                total += int(self.staging_estimator(name) or 0)
+            except Exception:
+                pass  # advisory: estimation must never fail a query
+        return total
+
     def execute_script(
         self,
         query: str,
@@ -424,7 +450,10 @@ class QueryBroker:
                 query, timeout_s, now_ns, script_args, analyze,
                 exec_funcs, on_batch, on_event,
             )
-        ticket = self.admission.acquire(tenant)  # may raise AdmissionRejected
+        # may raise AdmissionRejected
+        ticket = self.admission.acquire(
+            tenant, estimated_bytes=self._estimate_staging(query)
+        )
         try:
             return self._execute_script_inner(
                 query, timeout_s, now_ns, script_args, analyze,

@@ -1,0 +1,169 @@
+"""Staging-codec microbenchmark: encode MB/s, device decode ns/row, ratio.
+
+Per encoder per column family, this measures the three numbers the r13
+codec trades against the wire:
+
+- **encode MB/s** (host): the background pack thread pays this; it must
+  comfortably beat the tunnel's ~100MB/s for compression to win.
+- **decode ns/row** (device): the pre-fold expansion program
+  (searchsorted-gather for RLE, masked cumsum for delta) — cheap TPU
+  cycles traded for wire bytes.
+- **achieved ratio**: decoded block bytes / wire payload bytes.
+
+Column families mirror what telemetry staging actually sees:
+timestamps (monotone int64, ~constant delta), monotone ids (jittered
+increments), enum ints (low-cardinality, shuffled), sorted keys (long
+runs), bool flags, float metrics with NaN runs, and adversarial random
+ints/floats (must fall back to passthrough, cost ≈ one plan pass).
+
+With ``MB_WRITE_BENCH_DETAIL=1`` the summary lands in BENCH_DETAIL.json
+under the ``codec`` key, like ``fault_overhead``.
+
+Run: JAX_PLATFORMS=cpu python tools/microbench_codec.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def families(rows: int, rng) -> dict[str, np.ndarray]:
+    n = rows
+    return {
+        "timestamps": np.arange(n, dtype=np.int64) * 1_000 + 5 << 40,
+        "monotone_ids": np.cumsum(
+            rng.integers(0, 3, n), dtype=np.int64
+        ),
+        "enum_ints": rng.choice(
+            np.array([200, 301, 404, 500], np.int64), n
+        ),
+        "sorted_keys": np.sort(rng.integers(0, 64, n)).astype(np.int64),
+        "bool_flags": (rng.random(n) < 0.01),
+        "float_nan_runs": np.where(
+            rng.random(n) < 0.3,
+            np.nan,
+            np.repeat(
+                rng.standard_normal(n // 128 + 1), 128
+            )[:n],
+        ),
+        "random_ints": rng.integers(0, 1 << 40, n),
+        "random_floats": rng.standard_normal(n),
+    }
+
+
+def bench_family(mesh, name, arr, d, nblk, b, reps=3) -> dict:
+    import jax
+
+    from pixie_tpu.ops import codec
+
+    total = d * nblk * b
+    rows = min(arr.size, total)
+    flat = np.zeros(total, dtype=arr.dtype)
+    flat[:rows] = arr[:rows]
+    t0 = time.perf_counter()
+    plan = codec.plan_codec_local(flat, d, nblk, b, rows, 1.1)
+    plan_s = time.perf_counter() - t0
+    out = {
+        "family": name,
+        "dtype": str(arr.dtype),
+        "encoder": plan.kind if plan else "passthrough",
+        "plan_ms": round(plan_s * 1e3, 3),
+    }
+    if plan is None:
+        return out
+    # Host encode throughput (best of reps over the same window).
+    enc_s = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        payload = codec.encode_window(flat, plan, rows)
+        enc_s = min(enc_s, time.perf_counter() - t0)
+    dec = codec.decoder(mesh, plan, nblk, b)
+    args = codec.put_payload(mesh, payload)
+    ref = jax.block_until_ready(dec(*args))  # compile + warm
+    dec_s = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(dec(*args))
+        dec_s = min(dec_s, time.perf_counter() - t0)
+    exact = np.array_equal(
+        np.asarray(ref).view(np.uint8),
+        flat.reshape(d, nblk, b).view(np.uint8),
+    )
+    out.update(
+        {
+            "ratio_x": round(flat.nbytes / payload.nbytes, 2),
+            "encode_mb_s": round(flat.nbytes / enc_s / 1e6, 1),
+            "decode_ns_row": round(dec_s / total * 1e9, 2),
+            "wire_bytes": int(payload.nbytes),
+            "block_bytes": int(flat.nbytes),
+            "bit_exact": bool(exact),
+        }
+    )
+    return out
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    from jax.sharding import Mesh
+
+    import pixie_tpu  # noqa: F401  (enables x64)
+
+    rows = int(os.environ.get("MB_CODEC_ROWS", 2_000_000))
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("d",))
+    d = devs.size
+    from pixie_tpu.parallel.staging import block_geometry
+
+    b, nblk = block_geometry(rows, d, 1 << 17)
+    rng = np.random.default_rng(13)
+    results = []
+    for name, arr in families(rows, rng).items():
+        r = bench_family(mesh, name, arr, d, nblk, b)
+        results.append(r)
+        log(json.dumps(r))
+    assert all(r.get("bit_exact", True) for r in results), results
+    summary = {
+        "rows": rows,
+        "devices": d,
+        "platform": devs[0].platform,
+        "families": results,
+        # Headline: the wire reduction over the family mix, weighting
+        # every family equally (the bench configs' own wire_bytes /
+        # stage_bytes is the dataset-true number).
+        "mean_ratio_x": round(
+            float(
+                np.mean([r.get("ratio_x", 1.0) for r in results])
+            ),
+            2,
+        ),
+    }
+    print(json.dumps(summary, indent=1))
+
+    if os.environ.get("MB_WRITE_BENCH_DETAIL") == "1":
+        path = os.path.join(REPO, "BENCH_DETAIL.json")
+        with open(path) as f:
+            detail = json.load(f)
+        detail["codec"] = summary
+        with open(path, "w") as f:
+            json.dump(detail, f, indent=1)
+            f.write("\n")
+        log("BENCH_DETAIL.json updated (codec)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

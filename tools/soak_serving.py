@@ -162,6 +162,8 @@ def _run_soak_inner(
     t.compact()
     t.stop()
 
+    from pixie_tpu.serving.admission import make_store_estimator
+
     bus = MessageBus()
     router = BridgeRouter()
     broker = QueryBroker(
@@ -169,6 +171,9 @@ def _run_soak_inner(
         router,
         table_relations={"http_events": rel},
         residency=ex._staged_cache,
+        # r13: metadata staging-bytes estimates gate admission BEFORE a
+        # doomed cold stage (row count × encoded column widths).
+        staging_estimator=make_store_estimator(store),
     )
     agents = [
         Agent(
@@ -304,19 +309,77 @@ def _run_soak_inner(
             "evictions": int(evictions.value()),
         },
         "admission": broker.admission.snapshot(),
+        # Lock contention at depth (r13, the r12 follow-on profiling
+        # item): admission queue/lock waits + bus publish lock waits —
+        # the two serialization points every concurrent query crosses.
+        "contention": {
+            "admission_wait_p50_ms": round(
+                reg.histogram("admission_wait_seconds").quantile(0.5)
+                * 1e3, 3,
+            ),
+            "admission_wait_p99_ms": round(
+                reg.histogram("admission_wait_seconds").quantile(0.99)
+                * 1e3, 3,
+            ),
+            "admission_lock_wait_p99_ms": round(
+                reg.histogram("admission_lock_wait_seconds").quantile(0.99)
+                * 1e3, 3,
+            ),
+            "bus_lock_wait_p99_ms": round(
+                reg.histogram("bus_lock_wait_seconds").quantile(0.99)
+                * 1e3, 3,
+            ),
+        },
     }
     return report
 
 
 def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Serving soak: N concurrent scripted clients "
+        "through admission + shared scans + HBM residency. "
+        "--clients 1000 is the r13 scale target; the report's "
+        "'contention' block carries admission/bus lock waits at depth."
+    )
+    ap.add_argument(
+        "--clients", type=int,
+        default=int(os.environ.get("SOAK_CLIENTS", 64)),
+    )
+    ap.add_argument(
+        "--requests", type=int,
+        default=int(os.environ.get("SOAK_REQUESTS", 4)),
+    )
+    ap.add_argument(
+        "--qps", type=float,
+        default=float(os.environ.get("SOAK_QPS", 8.0)),
+    )
+    ap.add_argument(
+        "--rows", type=int,
+        default=int(os.environ.get("SOAK_ROWS", 100_000)),
+    )
+    ap.add_argument(
+        "--hbm-budget-mb", type=int,
+        default=int(os.environ.get("SOAK_HBM_BUDGET_MB", 64)),
+    )
+    ap.add_argument(
+        "--window-ms", type=float,
+        default=float(os.environ.get("SOAK_WINDOW_MS", 25.0)),
+    )
+    ap.add_argument(
+        "--max-concurrent", type=int,
+        default=int(os.environ.get("SOAK_MAX_CONCURRENT", 8)),
+    )
+    args = ap.parse_args()
     report = run_soak(
-        clients=int(os.environ.get("SOAK_CLIENTS", 64)),
-        requests_per_client=int(os.environ.get("SOAK_REQUESTS", 4)),
-        qps_per_client=float(os.environ.get("SOAK_QPS", 8.0)),
-        rows=int(os.environ.get("SOAK_ROWS", 100_000)),
-        hbm_budget_mb=int(os.environ.get("SOAK_HBM_BUDGET_MB", 64)),
-        window_ms=float(os.environ.get("SOAK_WINDOW_MS", 25.0)),
-        max_concurrent=int(os.environ.get("SOAK_MAX_CONCURRENT", 8)),
+        clients=args.clients,
+        requests_per_client=args.requests,
+        qps_per_client=args.qps,
+        rows=args.rows,
+        hbm_budget_mb=args.hbm_budget_mb,
+        window_ms=args.window_ms,
+        max_concurrent=args.max_concurrent,
     )
     print(json.dumps(report, indent=1))
     path = os.environ.get("SOAK_JSON")
