@@ -241,8 +241,16 @@ class HttpParser(base.ProtocolParser):
         # transfer stays unemitted; bodiless no-CL responses at close
         # fall to Case 4, which emits them with an empty body anyway.)
         if msg.type == MessageType.RESPONSE:
-            bodiless = req_method == "HEAD" or (
-                req_method == "CONNECT" and 200 <= msg.resp_status < 300
+            # Status-bodiless first (RFC 7230 §3.3.3): 1xx/204/304 have
+            # no body even when they carry Content-Length (servers
+            # legally send it on 304 to describe the would-be entity) or
+            # Transfer-Encoding — letting the Content-Length branch run
+            # would consume the NEXT response's bytes as this body.
+            bodiless = (
+                100 <= msg.resp_status < 200
+                or msg.resp_status in (204, 304)
+                or req_method == "HEAD"
+                or (req_method == "CONNECT" and 200 <= msg.resp_status < 300)
             )
             if bodiless or (
                 req_method is None and self._adjacent_response(buf, start)

@@ -45,6 +45,12 @@ import numpy as np
 from pixie_tpu.types import DataType
 from pixie_tpu.utils import flags, metrics_registry
 
+
+def _log_serving():
+    import logging
+
+    return logging.getLogger("pixie_tpu.serving")
+
 _M = metrics_registry()
 _WINDOWS = _M.counter(
     "resident_ingest_windows_total",
@@ -62,6 +68,16 @@ _HITS = _M.counter(
 _INVALID = _M.counter(
     "resident_ring_invalidated_total",
     "Rings permanently invalidated (row-id gap or column mismatch).",
+)
+_RESTAGED = _M.counter(
+    "ring_restaged_windows_total",
+    "Ring windows re-staged into HBM from the durable spill after a "
+    "restart (r14, flag durable_resident) — recovered without replaying "
+    "table appends.",
+)
+_SPILL_BYTES = _M.gauge(
+    "resident_spill_bytes",
+    "On-disk bytes of resident-ring spill logs, by table.",
 )
 
 # Raw host dtypes the ring can hold, per column DataType (strings ride
@@ -112,6 +128,30 @@ class ResidentRing:
         self._next_row = table.end_row_id()
         self._buf_start = self._next_row
         self._buf: dict[str, list] = {n: [] for n in self.columns}
+        # Durable spill (r14, flags durable_resident + wal_dir): full
+        # windows + the partial buffer mirror to a per-table segment
+        # log, and a fresh ring over a recovered table re-stages its
+        # windows into HBM from disk (no append replay).
+        self._spill = None
+        self.recovered_windows = 0
+        self.spill_corrupt_records = 0
+        if self._valid and flags.durable_resident and flags.wal_dir:
+            from pixie_tpu.vizier.durability import RingSpill, ring_spill_path
+
+            try:
+                self._spill = RingSpill(
+                    ring_spill_path(flags.wal_dir, self.table_name)
+                )
+                with self._lock:
+                    self._recover_from_spill_locked(table)
+            except Exception:
+                import logging
+
+                logging.getLogger("pixie_tpu.serving").exception(
+                    "ring spill unavailable for %r (running without "
+                    "durability)", self.table_name,
+                )
+                self._spill = None
 
     # -- write side (table append listener) ----------------------------------
     def on_append(self, first_row_id: int, batch) -> None:
@@ -125,6 +165,7 @@ class ResidentRing:
                 return
             if batch.num_rows == 0:
                 return
+            chunk = {}
             for name, dt in self.columns.items():
                 c = batch.col(name)
                 arr = c.codes if isinstance(c, DictColumn) else np.asarray(c)
@@ -133,8 +174,15 @@ class ResidentRing:
                     # read_columns would return must never be served.
                     self._invalidate_locked()
                     return
+                chunk[name] = arr
+            for name, arr in chunk.items():
                 self._buf[name].append(arr)
             self._next_row += batch.num_rows
+            if self._spill is not None:
+                # Mirror the partial buffer incrementally: a restart
+                # recovers buffered-but-unstaged rows too, not only
+                # full windows.
+                self._spill.record_append(first_row_id, chunk)
             self._stage_complete_windows_locked()
 
     def _invalidate_locked(self) -> None:
@@ -143,6 +191,8 @@ class ResidentRing:
         for w in list(self.windows):
             self._release_locked(w)
         self._buf = {n: [] for n in self.columns}
+        if self._spill is not None:
+            self._spill.record_reset()
 
     def _stage_complete_windows_locked(self) -> None:
         W = self.window_rows
@@ -165,12 +215,29 @@ class ResidentRing:
             for name in self.columns:
                 self._buf[name] = [self._buf[name][0][keep_from:]]
             self._buf_start = (k + 1) * W
+            if self._spill is not None:
+                self._spill.record_trim(self._buf_start)
+                self._spill.maybe_compact(
+                    set(self.windows), self._buf_start
+                )
+                _SPILL_BYTES.labels(table=self.table_name).set(
+                    self._spill.nbytes()
+                )
 
-    def _stage_window_locked(self, k: int, win_cols: dict) -> None:
+    def _stage_window_locked(
+        self, k: int, win_cols: dict, record: bool = True
+    ) -> None:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from pixie_tpu.ops import codec as _codec
+
+        if record and self._spill is not None:
+            # WAL posture: the window's raw host columns hit disk before
+            # the HBM transfer, so a crash at any later point recovers it.
+            self._spill.record_window(
+                k, k * self.window_rows, self.window_rows, win_cols
+            )
 
         (axis_name,) = self.mesh.axis_names
         sharding = NamedSharding(self.mesh, P(axis_name))
@@ -223,6 +290,93 @@ class ResidentRing:
         self.windows.pop(k, None)
         if self._pool is not None:
             self._pool.release_resident(("resident", self.table_name, k))
+        if self._spill is not None:
+            self._spill.record_release(k)
+
+    def _recover_from_spill_locked(self, table) -> None:
+        """Restart recovery: re-stage full windows into HBM from the
+        spill and restore the partial buffer — without replaying table
+        appends. Everything is validated against the recovered table
+        (row ranges, column set, dtypes); anything questionable is
+        dropped, never served (queries fall back to the staging path,
+        bit-identical either way)."""
+        state = self._spill.recover()
+        self.spill_corrupt_records = state["corrupt"]
+        table_end = table.end_row_id()
+        W = self.window_rows
+        restaged = 0
+        for k in sorted(state["windows"]):
+            start_row, rows, cols = state["windows"][k]
+            if rows != W or start_row != k * W or start_row + rows > table_end:
+                continue  # geometry drift, or rows the table lost
+            if set(cols) != set(self.columns) or any(
+                np.asarray(cols[n]).dtype != dt or len(cols[n]) != W
+                for n, dt in self.columns.items()
+            ):
+                continue
+            self._stage_window_locked(
+                k,
+                {n: np.asarray(cols[n]) for n in self.columns},
+                record=False,  # already on disk
+            )
+            restaged += 1
+        self.recovered_windows = restaged
+        if restaged:
+            _RESTAGED.inc(restaged)
+        # Partial buffer: usable only when the recorded chunks are
+        # gap-free and reach EXACTLY the table's end (the ring's
+        # observed-every-row contract, re-established across restart).
+        chunks = state["buf"]
+        bs = state["buf_start"]
+        cov_start = chunks[0][0] if chunks else None
+        cov_end = cov_start
+        ok = bool(chunks)
+        for first_row, cols in chunks:
+            rows = len(next(iter(cols.values()))) if cols else 0
+            if first_row != cov_end or set(cols) != set(self.columns) or any(
+                np.asarray(cols[n]).dtype != dt
+                for n, dt in self.columns.items()
+            ):
+                ok = False
+                break
+            cov_end = first_row + rows
+        if ok and cov_end == table_end:
+            if bs is None:
+                bs = cov_start
+            # A crash between a window record and its trim record leaves
+            # a stale buf_start: never re-buffer rows a restaged window
+            # already covers.
+            if restaged:
+                bs = max(bs, (max(self.windows) + 1) * W)
+            bs = max(bs, cov_start)
+            self._buf = {
+                name: [
+                    np.concatenate(
+                        [np.asarray(c[name]) for _, c in chunks]
+                    )[bs - cov_start :]
+                ]
+                for name in self.columns
+            }
+            self._buf_start = bs
+            self._next_row = table_end
+        elif chunks:
+            _log_serving().warning(
+                "ring %r: discarding unrecoverable spill buffer "
+                "(coverage [%s, %s) vs table end %d)",
+                self.table_name, cov_start, cov_end, table_end,
+            )
+        if self._spill is not None:
+            # Persist exactly the adopted state: anything recovery
+            # rejected (stale geometry, rows this table doesn't have,
+            # corrupt payloads) is compacted off disk NOW, so it can
+            # never resurrect on a later restart against a table whose
+            # rows it no longer matches.
+            self._spill.maybe_compact(
+                set(self.windows), self._buf_start, force=True
+            )
+            _SPILL_BYTES.labels(table=self.table_name).set(
+                self._spill.nbytes()
+            )
 
     # -- read side (query staging) -------------------------------------------
     def lookup(
@@ -262,6 +416,10 @@ class ResidentRing:
                 "bytes": sum(w.nbytes for w in self.windows.values()),
                 "valid": self._valid,
                 "buffered_rows": self._next_row - self._buf_start,
+                "recovered_windows": self.recovered_windows,
+                "spill_bytes": (
+                    self._spill.nbytes() if self._spill is not None else 0
+                ),
             }
 
 

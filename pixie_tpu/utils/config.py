@@ -397,6 +397,55 @@ define_flag(
     "ring-buffer analogue of the table store's size_limit expiry.",
 )
 
+# -- durability (r14): crash-restart recovery --------------------------------
+define_flag(
+    "durable_transport",
+    False,
+    help_="Persist the RemoteBus delivery identity (agent_id + epoch) "
+    "and spill the in-flight ack window to a checksummed WAL under "
+    "wal_dir (vizier/durability.py TransportWAL), so a full agent "
+    "process restart replays unacked frames above the server's applied "
+    "watermark — exactly-once across crash, not just reconnect. "
+    "Requires wal_dir; no-op without it.",
+)
+define_flag(
+    "durable_resident",
+    False,
+    help_="Mirror each ResidentRing's full HBM windows and its partial "
+    "host buffer to a per-table spill log under wal_dir "
+    "(vizier/durability.py RingSpill): a restarted agent re-stages its "
+    "rings into HBM from disk before accepting queries instead of "
+    "losing every hot window (stage_resident_hits recover without "
+    "replaying appends). Requires wal_dir and resident_ingest.",
+)
+define_flag(
+    "wal_dir",
+    "",
+    help_="Directory for durable-restart state: the transport WAL "
+    "(transport.wal), the agent's durable registration/query markers "
+    "(agent-<id>.db, id-keyed so co-located agents never share "
+    "state), and per-table resident-ring spill files "
+    "(resident/<table>.wal). Empty disables all durability even when "
+    "the durable_* flags are set.",
+)
+define_flag(
+    "wal_fsync",
+    "always",
+    help_="WAL fsync policy: 'always' fsyncs every appended record "
+    "(survives node power loss), 'never' flushes to the OS page cache "
+    "only (survives process crash — OOM-kill, deploy, SIGKILL — but "
+    "not a kernel panic). tools/microbench_fault_overhead.py reports "
+    "the cost of each under durability_overhead.",
+)
+define_flag(
+    "transport_wal_mem_frames",
+    64,
+    help_="In-flight window frames kept decoded in memory when the "
+    "transport WAL is on; older unacked frames keep only their seq and "
+    "byte count in RAM and are re-read from the WAL at replay time "
+    "(the ARIES-style spill bound).",
+)
+
 # -- robustness (r10): acked delivery + cluster health plane -----------------
 # (transport_ack_* / transport_window_block_s are declared next to their
 # use in vizier/transport.py.)

@@ -19,8 +19,13 @@ admission controller force-rejects the query (chaos tests prove a
 rejected query returns a structured AdmissionRejected, never a hang),
 ``serving.evict_pinned_attempt`` — checked whenever an eviction pass in
 the HBM residency pool SKIPS an entry because an in-flight fold has it
-pinned (chaos tests prove the pin held)), and tests/operators arm them
-deterministically.
+pinned (chaos tests prove the pin held); r14 durability sites:
+``transport.crash_restart`` — the process dies (SIGKILL posture: sockets
+cut, no drain) immediately AFTER a frame reaches the wire and the WAL,
+``wal.torn_write`` — a WAL append crashes mid-write() leaving a torn
+record for recovery to truncate, ``resident.spill_corrupt`` — a ring
+spill window record reads back corrupt and recovery must skip it, never
+serve it), and tests/operators arm them deterministically.
 
 Design contract:
 

@@ -10,6 +10,7 @@ merge fragments (pem_main.cc / kelvin_main.cc).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import traceback
@@ -20,7 +21,15 @@ from pixie_tpu.exec import BridgeRouter, QueryDeadlineExceeded
 from pixie_tpu.plan.plan import Plan
 from pixie_tpu.vizier.bus import MessageBus, agent_topic
 
-from pixie_tpu.utils import faults, flags, trace
+from pixie_tpu.utils import faults, flags, metrics_registry, trace
+
+_log = logging.getLogger("pixie_tpu.agent")
+
+_RECOVERY_SECONDS = metrics_registry().gauge(
+    "agent_recovery_seconds",
+    "Wall seconds the last agent restart recovery took (identity "
+    "restore -> WAL replay -> ring re-stage -> re-register), by agent.",
+)
 
 # scaled-down from the reference's ~5s; PIXIE_TPU_AGENT_HEARTBEAT_INTERVAL_S.
 HEARTBEAT_INTERVAL_S = flags.agent_heartbeat_interval_s
@@ -43,10 +52,30 @@ class Agent:
         is_kelvin: bool = False,
         device_executor=None,
         vizier_ctx=None,
+        wal_dir: Optional[str] = None,
     ):
         self.agent_id = agent_id
         self.bus = bus
         self.is_kelvin = is_kelvin
+        # Durable restart recovery (r14): with a per-agent wal_dir, the
+        # agent persists its registration epoch and per-query
+        # started/done markers (durability.AgentDurableState) so a
+        # restarted process supersedes its zombie with a higher epoch
+        # and handles re-offered launches exactly-once.
+        self.durable = None
+        self.recovery_info: "dict | None" = None
+        self._restarted_pending = False
+        if wal_dir is None and flags.wal_dir and (
+            flags.durable_transport or flags.durable_resident
+        ):
+            # Flag-driven deployments get agent durability from the same
+            # wal_dir the transport/ring spills use (RemoteBus applies
+            # the identical fallback).
+            wal_dir = flags.wal_dir
+        if wal_dir:
+            from pixie_tpu.vizier.durability import AgentDurableState
+
+            self.durable = AgentDurableState(wal_dir, agent_id)
         self.carnot = Carnot(
             table_store=table_store,
             registry=registry,
@@ -76,7 +105,70 @@ class Agent:
         )
 
     # -- lifecycle ----------------------------------------------------------
+    def _recover(self) -> None:
+        """Restart recovery phase (r14), BEFORE the agent subscribes or
+        registers: restore the persisted registration epoch, re-stage
+        resident rings from their spill files, and collect the transport
+        WAL's replay stats — so by the time the broker learns we exist,
+        the rings are hot and the unacked window is already replayed
+        (the RemoteBus replays at connect, i.e. before Agent.start)."""
+        t0 = time.perf_counter()
+        prior_epoch = self.durable.epoch()
+        restarted = prior_epoch > 0
+        span = trace.begin(
+            "agent.recover",
+            trace_id=f"recover:{self.agent_id}:{prior_epoch}",
+            parent_id="",
+            instance=self.agent_id,
+            attrs={"agent_id": self.agent_id, "prior_epoch": prior_epoch},
+        )
+        self._epoch = prior_epoch
+        restaged = 0
+        dev = getattr(self.carnot, "device_executor", None)
+        if dev is not None and hasattr(dev, "enable_resident_ingest"):
+            # Sweep tables that existed BEFORE this process (the create
+            # listeners only cover tables made after Carnot init): each
+            # enable() recovers that table's ring from its spill.
+            for t in self.carnot.table_store.tables():
+                try:
+                    ring = dev.enable_resident_ingest(t)
+                except Exception:
+                    _log.exception(
+                        "ring recovery failed for table %r", t.name
+                    )
+                    ring = None
+                if ring is not None:
+                    restaged += getattr(ring, "recovered_windows", 0)
+        if restarted:
+            self.durable.bump_restarts()
+            self._restarted_pending = True
+        self.recovery_info = {
+            "restarted": restarted,
+            "restart_count": self.durable.restarts(),
+            "wal_replayed_frames": int(
+                getattr(self.bus, "wal_restored_frames", 0)
+            ),
+            "ring_restaged_windows": int(restaged),
+            "recovery_seconds": round(time.perf_counter() - t0, 6),
+        }
+        _RECOVERY_SECONDS.labels(agent=self.agent_id).set(
+            self.recovery_info["recovery_seconds"]
+        )
+        trace.finish(span, attrs=self.recovery_info)
+        if restarted:
+            _log.info(
+                "agent %s recovered from restart #%d: %d WAL frames, "
+                "%d ring windows re-staged, %.3fs",
+                self.agent_id,
+                self.recovery_info["restart_count"],
+                self.recovery_info["wal_replayed_frames"],
+                restaged,
+                self.recovery_info["recovery_seconds"],
+            )
+
     def start(self) -> None:
+        if self.durable is not None:
+            self._recover()
         self._sub = self.bus.subscribe(agent_topic(self.agent_id))
         # On a transport reconnect (RemoteBus backoff, r9), re-register so
         # the broker's tracker re-learns our tables without waiting a full
@@ -106,26 +198,41 @@ class Agent:
         agents have nothing to trip)."""
         dev = getattr(self.carnot, "device_executor", None)
         snap = getattr(dev, "health_snapshot", None)
-        if snap is None:
-            return None
-        try:
-            return snap()
-        except Exception:
-            return None  # health is advisory; never fail the heartbeat
+        health = None
+        if snap is not None:
+            try:
+                health = snap()
+            except Exception:
+                health = None  # advisory; never fail the heartbeat
+        if self.recovery_info is not None:
+            # Recovery stats ride every heartbeat into the broker's
+            # health plane and /statusz (wal_replayed_frames,
+            # ring_restaged_windows, recovery_seconds).
+            health = dict(health or {})
+            health["recovery"] = self.recovery_info
+        return health
 
     def _register(self) -> None:
         self._epoch += 1
-        self.bus.publish(
-            AGENT_STATUS_TOPIC,
-            {
-                "type": "register",
-                "agent_id": self.agent_id,
-                "epoch": self._epoch,
-                "is_kelvin": self.is_kelvin,
-                "tables": sorted(self.carnot.table_store.table_names()),
-                "health": self._health(),
-            },
-        )
+        if self.durable is not None:
+            # Persist BEFORE publishing: a crash right after this
+            # register restarts with a strictly higher epoch, so the
+            # tracker always supersedes the zombie entry.
+            self.durable.save_epoch(self._epoch)
+        msg = {
+            "type": "register",
+            "agent_id": self.agent_id,
+            "epoch": self._epoch,
+            "is_kelvin": self.is_kelvin,
+            "tables": sorted(self.carnot.table_store.table_names()),
+            "health": self._health(),
+        }
+        if self._restarted_pending:
+            # First registration of a restarted incarnation: the tracker
+            # distinguishes it from a plain reconnect re-register.
+            msg["restarted"] = True
+            self._restarted_pending = False
+        self.bus.publish(AGENT_STATUS_TOPIC, msg)
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(HEARTBEAT_INTERVAL_S):
@@ -135,18 +242,27 @@ class Agent:
                 "agent.heartbeat", self.agent_id
             ):
                 continue
-            self.bus.publish(
-                AGENT_STATUS_TOPIC,
-                {
-                    "type": "heartbeat",
-                    "agent_id": self.agent_id,
-                    "epoch": self._epoch,
-                    "is_kelvin": self.is_kelvin,
-                    "tables": sorted(self.carnot.table_store.table_names()),
-                    "ts": time.monotonic(),
-                    "health": self._health(),
-                },
-            )
+            try:
+                self.bus.publish(
+                    AGENT_STATUS_TOPIC,
+                    {
+                        "type": "heartbeat",
+                        "agent_id": self.agent_id,
+                        "epoch": self._epoch,
+                        "is_kelvin": self.is_kelvin,
+                        "tables": sorted(
+                            self.carnot.table_store.table_names()
+                        ),
+                        "ts": time.monotonic(),
+                        "health": self._health(),
+                    },
+                )
+            except (OSError, ConnectionError):
+                # A dead transport must not kill the loop: the bus
+                # reconnects (or the process is crashing and stop() is
+                # imminent); the broker reaps us via the heartbeat
+                # window either way.
+                continue
 
     # -- query execution (exec.{h,cc}) --------------------------------------
     def _run_loop(self) -> None:
@@ -158,12 +274,54 @@ class Agent:
                 qid = msg.get("query_id")
                 if qid in self._seen_queries:
                     continue  # re-offered launch we already ran
+                if self.durable is not None:
+                    # Exactly-once across restart (r14): a durable
+                    # ``done`` marker means the dead incarnation windowed
+                    # the query's ENTIRE result stream into the transport
+                    # WAL — the replay completes it; re-executing would
+                    # double-apply. A ``started``-but-not-done marker
+                    # means execution died mid-flight with partial output
+                    # possibly applied — refuse the re-offer with a
+                    # structured error (the broker degrades the query and
+                    # releases our bridges) rather than re-execute into
+                    # duplicate application.
+                    state = self.durable.query_state(qid)
+                    if state == "done":
+                        continue
+                    if state == "started":
+                        self._refuse_restarted_query(msg)
+                        continue
                 self._seen_queries[qid] = True
                 while len(self._seen_queries) > 512:
                     self._seen_queries.popitem(last=False)
                 threading.Thread(
                     target=self._execute_fragment, args=(msg,), daemon=True
                 ).start()
+
+    def _refuse_restarted_query(self, msg: dict) -> None:
+        """A launch re-offered for a query our previous incarnation died
+        executing: its partial output may already be applied, so the only
+        exactly-once answer is a structured failure — the broker returns
+        the surviving agents' rows with a ``degraded`` annotation, exactly
+        as if the agent had stayed lost (r9 contract)."""
+        qid = msg["query_id"]
+        _log.warning(
+            "agent %s: refusing re-offered query %s (execution died "
+            "mid-flight in a previous incarnation)", self.agent_id, qid,
+        )
+        try:
+            self.bus.publish(
+                RESULTS_TOPIC_PREFIX + qid,
+                {
+                    "type": "fragment_error",
+                    "agent_id": self.agent_id,
+                    "error": "agent restarted mid-execution; partial "
+                    "output withheld for exactly-once delivery",
+                    "error_kind": "restart_lost",
+                },
+            )
+        except (OSError, ConnectionError):
+            pass  # broker will reap us via the heartbeat window instead
 
     def _trace_spans_for(self, trace_id: str) -> "list | None":
         """Wire-ready copies of this process's buffered spans for one
@@ -189,6 +347,12 @@ class Agent:
             instance=self.agent_id,
             attrs={"agent_id": self.agent_id},
         )
+        if self.durable is not None:
+            # Durably mark BEFORE any result frame can exist: a crash
+            # from here until mark_done leaves a ``started`` marker, and
+            # the restarted incarnation refuses the re-offer instead of
+            # re-executing into duplicate application.
+            self.durable.mark_started(query_id)
         try:
             if faults.ACTIVE:
                 if faults.fires_scoped("agent.execute_hang", self.agent_id):
@@ -230,6 +394,11 @@ class Agent:
                     "spans": self._trace_spans_for(trace_id),
                 },
             )
+            if self.durable is not None:
+                # Every result frame (batches + fragment_done) is now in
+                # the transport window/WAL: replay alone completes the
+                # query, so a re-offered launch is dropped, not re-run.
+                self.durable.mark_done(query_id)
         except Exception as e:  # surfaced to the forwarder (ref: error chunks)
             trace.finish(span, status="error", attrs={"error": str(e)[:200]})
             self.bus.publish(
@@ -248,3 +417,7 @@ class Agent:
                     "spans": self._trace_spans_for(trace_id),
                 },
             )
+            if self.durable is not None:
+                # The structured error is windowed: replay delivers it,
+                # so this query is complete for exactly-once purposes.
+                self.durable.mark_done(query_id)

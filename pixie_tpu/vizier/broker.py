@@ -60,7 +60,16 @@ _QUERY_SECONDS = _M.histogram(
 _REOFFERS = _M.counter(
     "broker_launch_reoffers_total",
     "execute_fragment launches re-offered to an agent that re-registered "
-    "while a launch was still unacknowledged (reconnect-gap hole, r12).",
+    "while a launch was still unacknowledged (reconnect-gap hole, r12), "
+    "by reason: 'reconnect' (same process, new connection) vs 'restart' "
+    "(new process with durable identity, r14).",
+)
+_RESTARTS = _M.counter(
+    "broker_agent_restarts_total",
+    "Register messages from a RESTARTED agent incarnation (r14: durable "
+    "identity restored from its WAL, epoch bumped past the dead "
+    "process's persisted counter) — distinct from plain reconnect "
+    "re-registers.",
 )
 
 
@@ -79,9 +88,10 @@ class AgentTracker:
         self._lock = threading.Lock()
         self._agents: dict[str, dict] = {}
         self._stop = threading.Event()
-        # fn(agent_id, epoch) fired on every "register" message (r12):
-        # the broker re-offers unacknowledged fragment launches to an
-        # agent that re-registered after a reconnect gap.
+        # fn(agent_id, epoch, restarted) fired on every "register"
+        # message (r12): the broker re-offers unacknowledged fragment
+        # launches to an agent that re-registered after a reconnect gap
+        # (or, r14, after a full process restart — restarted=True).
         self._register_listeners: list = []
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -97,6 +107,14 @@ class AgentTracker:
                 continue
             if msg.get("type") in ("register", "heartbeat"):
                 epoch = msg.get("epoch", 0)
+                # r14: a register from a RESTARTED incarnation (durable
+                # identity, epoch continued past the dead process's
+                # counter) supersedes the zombie entry like any higher
+                # epoch, but is counted and surfaced separately so
+                # operators can tell crash recovery from network flaps.
+                restarted = bool(
+                    msg["type"] == "register" and msg.get("restarted")
+                )
                 with self._lock:
                     cur = self._agents.get(msg["agent_id"])
                     if cur is not None and epoch < cur["epoch"]:
@@ -107,15 +125,21 @@ class AgentTracker:
                         "last_seen": time.monotonic(),
                         "epoch": epoch,
                         "health": msg.get("health"),
+                        "restarts": (
+                            (cur.get("restarts", 0) if cur else 0)
+                            + (1 if restarted else 0)
+                        ),
                     }
                     listeners = (
                         list(self._register_listeners)
                         if msg["type"] == "register"
                         else ()
                     )
+                if restarted:
+                    _RESTARTS.inc(agent=msg["agent_id"])
                 for fn in listeners:
                     try:
-                        fn(msg["agent_id"], epoch)
+                        fn(msg["agent_id"], epoch, restarted)
                     except Exception:
                         _log.exception(
                             "register listener failed (ignored)"
@@ -183,6 +207,11 @@ class AgentTracker:
                     "epoch": a["epoch"],
                     "is_kelvin": a["is_kelvin"],
                     "health": a.get("health"),
+                    # r14: observed crash-restart registers; the agent's
+                    # own recovery stats (wal_replayed_frames,
+                    # ring_restaged_windows, recovery_seconds) ride in
+                    # health["recovery"].
+                    "restarts": a.get("restarts", 0),
                 }
                 for aid, a in sorted(self._agents.items())
             }
@@ -235,6 +264,7 @@ class AgentTracker:
                     "last_heartbeat_ns": int((now - a["last_seen"]) * 1e9),
                     "kelvin": a["is_kelvin"],
                     "epoch": a["epoch"],
+                    "restarts": a.get("restarts", 0),
                     "breaker_open": len(
                         (a.get("health") or {}).get("breaker_open") or ()
                     ),
@@ -387,20 +417,27 @@ class QueryBroker:
             return plan, []
         return replanned, sorted(sick)
 
-    def _reoffer_launches(self, agent_id: str, epoch: int) -> None:
+    def _reoffer_launches(
+        self, agent_id: str, epoch: int, restarted: bool = False
+    ) -> None:
         """Register-listener (r12): an agent re-registering while the
         broker still holds unacknowledged launches for it lost those
         publishes in its reconnect gap (the bus is at-most-once to
         CURRENT subscribers) — re-offer them. Agents dedup by query_id,
-        so the common both-delivered case is harmless."""
+        so the common both-delivered case is harmless. A RESTARTED
+        incarnation (r14) gets the same re-offer, but its durable query
+        markers decide the outcome: ``done`` → drop (the WAL replay
+        already completed the query), ``started`` → structured refusal
+        (partial output may be applied), unseen → execute normally."""
         with self._launch_lock:
             msgs = list(self._inflight_launches.get(agent_id, {}).values())
+        reason = "restart" if restarted else "reconnect"
         for msg in msgs:
-            _REOFFERS.inc()
+            _REOFFERS.inc(reason=reason)
             _log.info(
                 "re-offering query %s launch to re-registered agent %s "
-                "(epoch %d)",
-                msg.get("query_id"), agent_id, epoch,
+                "(epoch %d, %s)",
+                msg.get("query_id"), agent_id, epoch, reason,
             )
             self.bus.publish(agent_topic(agent_id), msg)
 

@@ -15,11 +15,29 @@ import base64
 import json
 import os
 import sqlite3
+import struct
 import threading
 import zlib
 from typing import Optional
 
 from pixie_tpu.utils import faults
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory containing ``path`` so a just-renamed file's
+    directory entry is durable (the classic missing half of the
+    write-temp + rename pattern)."""
+    d = os.path.dirname(path) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 class Datastore:
@@ -84,12 +102,25 @@ class FileDatastore(Datastore):
     before it, and truncates the log there — the pebble/WAL recovery
     contract (complete records survive, the torn suffix is discarded)."""
 
-    def __init__(self, path: str, compact_every: int = 4096):
+    def __init__(
+        self, path: str, compact_every: int = 4096, fsync: bool = True
+    ):
         super().__init__()
         self.path = path
         self.compact_every = compact_every
+        self._fsync = fsync
         self._writes_since_compact = 0
         self._f = None
+        # A stale .compact temp means a previous process died mid-
+        # compaction BEFORE the atomic rename: the main log is still the
+        # authority (it holds every record the temp would), so the temp
+        # is garbage — remove it rather than ever risk reading it.
+        tmp = path + ".compact"
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
         good_end = 0
         if os.path.exists(path):
             with open(path, "rb") as f:
@@ -148,14 +179,28 @@ class FileDatastore(Datastore):
     def _on_write(self, key: str, value: Optional[bytes]) -> None:
         if self._f is None:
             return
-        self._f.write(self._format_record(key, value))
+        rec = self._format_record(key, value)
+        if faults.ACTIVE and faults.fires("wal.torn_write"):
+            # Simulated crash mid-write(): only a prefix of the record
+            # reaches the file. Recovery must truncate it (the CRC/
+            # terminator check) and the writer sees the crash.
+            self._f.write(rec[: max(1, len(rec) // 2)])
+            self._f.flush()
+            raise faults.FaultInjectedError("wal.torn_write")
+        self._f.write(rec)
         self._f.flush()
-        os.fsync(self._f.fileno())
+        if self._fsync:
+            os.fsync(self._f.fileno())
         self._writes_since_compact += 1
         if self._writes_since_compact >= self.compact_every:
             self._compact_locked()
 
     def _compact_locked(self) -> None:
+        """Crash-safe compaction: the full state is written to a temp
+        file and fsync'd BEFORE the atomic rename, and the directory is
+        fsync'd after, so a crash at any point leaves either the old
+        complete log or the new complete log — never a partial one (a
+        stale temp from a crash before the rename is removed at open)."""
         tmp = self.path + ".compact"
         with open(tmp, "wb") as f:
             for k, v in sorted(self._data.items()):
@@ -164,8 +209,125 @@ class FileDatastore(Datastore):
             os.fsync(f.fileno())
         self._f.close()
         os.replace(tmp, self.path)
+        _fsync_dir(self.path)
         self._f = open(self.path, "ab")
         self._writes_since_compact = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+class SegmentLog:
+    """Binary append-only record log with per-record CRC32 and torn-tail
+    recovery — the spill substrate for the r14 durability plane (the
+    transport ack-window WAL and the resident-ring spill), sharing the
+    FileDatastore crash posture for opaque binary payloads (no
+    base64/JSON inflation on multi-MB frames).
+
+    Record layout: ``u32 length | u32 crc32(payload) | payload``. A torn
+    tail (short record, bad CRC) stops the scan; everything before it
+    survives and the file is truncated there at open. ``rewrite``
+    replaces the log with a fresh record sequence via the hardened
+    write-temp + fsync + atomic-rename + dir-fsync pattern."""
+
+    _HDR = struct.Struct(">II")
+
+    def __init__(self, path: str, fsync: bool = True):
+        self.path = path
+        self._fsync = fsync
+        # Reentrant: compaction callers stream ``rewrite(records())``
+        # where the generator re-reads live payloads via ``scan()`` —
+        # both under this lock.
+        self._lock = threading.RLock()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".compact"
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)  # died mid-rewrite: the main log rules
+            except OSError:
+                pass
+        good_end = 0
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                for _, payload, end in self._scan_file(f):
+                    good_end = end
+            if good_end < os.path.getsize(path):
+                with open(path, "r+b") as f:
+                    f.truncate(good_end)
+        self._f = open(path, "ab")
+        self.nbytes = good_end
+
+    @classmethod
+    def _scan_file(cls, f):
+        """Yield (offset, payload, end_offset) for every intact record;
+        stop at the first torn/corrupt one."""
+        off = 0
+        while True:
+            hdr = f.read(cls._HDR.size)
+            if len(hdr) < cls._HDR.size:
+                return
+            n, crc = cls._HDR.unpack(hdr)
+            payload = f.read(n)
+            if len(payload) < n or zlib.crc32(payload) != crc:
+                return
+            end = off + cls._HDR.size + n
+            yield off, payload, end
+            off = end
+
+    def append(self, payload: bytes) -> None:
+        rec = self._HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            if self._f is None:
+                raise ValueError("SegmentLog is closed")
+            if faults.ACTIVE and faults.fires("wal.torn_write"):
+                # Simulated crash inside write(): a prefix lands, the
+                # writer dies. Recovery truncates at the torn record.
+                self._f.write(rec[: max(1, len(rec) // 2)])
+                self._f.flush()
+                raise faults.FaultInjectedError("wal.torn_write")
+            self._f.write(rec)
+            self._f.flush()
+            if self._fsync:
+                os.fsync(self._f.fileno())
+            self.nbytes += len(rec)
+
+    def scan(self):
+        """List of intact payloads, re-read from disk (recovery and the
+        rare replay-of-spilled-frames path — never the hot path)."""
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+        out = []
+        try:
+            with open(self.path, "rb") as f:
+                for _, payload, _ in self._scan_file(f):
+                    out.append(payload)
+        except OSError:
+            pass
+        return out
+
+    def rewrite(self, payloads) -> None:
+        """Atomically replace the log's contents with ``payloads``
+        (compaction). Crash-safe: temp + fsync + rename + dir fsync."""
+        with self._lock:
+            tmp = self.path + ".compact"
+            nbytes = 0
+            with open(tmp, "wb") as f:
+                for p in payloads:
+                    rec = self._HDR.pack(len(p), zlib.crc32(p)) + p
+                    f.write(rec)
+                    nbytes += len(rec)
+                f.flush()
+                os.fsync(f.fileno())
+            if self._f is not None:
+                self._f.close()
+            os.replace(tmp, self.path)
+            _fsync_dir(self.path)
+            self._f = open(self.path, "ab")
+            self.nbytes = nbytes
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,478 @@
+"""Durable restart recovery (r14): WAL spill for in-flight state.
+
+The r10 acked-delivery plane made delivery exactly-once across
+*reconnects*; this module extends it across *process restarts* — the
+OOM-kill/deploy/node-reboot cases a production serving tier must treat
+as routine. Three durable stores, all under ``flags.wal_dir``:
+
+- ``TransportWAL`` (ARIES-style write-ahead spill + Kafka's idempotent-
+  producer identity): persists the RemoteBus delivery identity
+  (agent_id + epoch counter) and every stamped-but-unacked in-flight
+  frame. A restarted process restores its identity, bumps the epoch,
+  and replays the window above the server's applied watermark — the
+  per-identity seq watermark then dedups any half the dead process
+  already delivered, so crash delivery is exactly-once, not just
+  reconnect delivery.
+- ``AgentDurableState``: the agent's registration epoch plus per-query
+  started/done markers. A ``done`` marker means every result frame of
+  the query (batches + fragment_done) was windowed into the transport
+  WAL before the crash — the replay completes the query, so a
+  re-offered launch is dropped. A ``started``-but-not-done marker means
+  execution died mid-flight with partial output possibly applied — the
+  restarted agent REFUSES the re-offer with a structured
+  fragment_error instead of re-executing into duplicate application.
+- ``RingSpill``: mirrors a ResidentRing's full HBM windows (raw host
+  columns) and its partial append buffer to a per-table segment log, so
+  a restarted agent re-stages its rings into HBM from disk instead of
+  cold-staging every hot window again (``stage_resident_hits`` recover
+  without replaying appends).
+
+All three ride ``vizier.datastore`` machinery: ``FileDatastore`` for
+small keyed state and ``SegmentLog`` (CRC-checked, torn-write-tolerant,
+crash-safe compaction) for binary frame/column payloads. The fsync
+policy is ``flags.wal_fsync`` ('always' | 'never').
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from pixie_tpu.utils import faults, flags
+from pixie_tpu.vizier import wire
+from pixie_tpu.vizier.datastore import FileDatastore, SegmentLog
+
+_log = logging.getLogger("pixie_tpu.durability")
+
+
+def wal_enabled() -> bool:
+    """The transport-durability gate: flag on AND a wal_dir configured."""
+    return bool(flags.durable_transport and flags.wal_dir)
+
+
+def resident_spill_enabled() -> bool:
+    return bool(flags.durable_resident and flags.wal_dir)
+
+
+def _fsync_policy() -> bool:
+    return flags.wal_fsync != "never"
+
+
+class TransportWAL:
+    """Write-ahead spill for one RemoteBus: identity + unacked frames.
+
+    Record vocabulary (wire-encoded dicts; payload bytes ride as wire
+    blobs, never base64):
+
+    - ``{"op": "ident", "agent_id", "epoch"}`` — latest wins.
+    - ``{"op": "frame", "plane", "seq", "payload"}`` — one stamped
+      frame's encoded bytes, appended BEFORE the frame hits the wire.
+    - ``{"op": "rel", "plane", "seq"}`` — cumulative release: every
+      frame with seq' <= seq on that plane is acked/applied.
+
+    Memory posture: only (plane, seq, nbytes) indexes live in RAM;
+    payloads are re-read from the log on the rare replay path, so the
+    WAL can hold a full 8MB window without doubling it in memory.
+    Compaction rewrites the live set once dead records dominate.
+    """
+
+    def __init__(self, path: str):
+        self._log = SegmentLog(path, fsync=_fsync_policy())
+        self._lock = threading.Lock()
+        self._ident: Optional[tuple[str, int]] = None
+        # plane -> {seq: nbytes} pending (appended, not yet released).
+        self._pending: dict[str, dict[int, int]] = {}
+        self._released: dict[str, int] = {}
+        self._live_bytes = 0
+        for payload in self._log.scan():
+            try:
+                rec = wire.decode(payload)
+                self._apply(rec)
+            except (wire.WireError, KeyError, TypeError, ValueError):
+                # A record that decodes but fails the schema is treated
+                # like a torn tail would be: ignored.
+                continue
+
+    def _apply(self, rec: dict) -> None:
+        op = rec.get("op")
+        if op == "ident":
+            self._ident = (str(rec["agent_id"]), int(rec["epoch"]))
+        elif op == "frame":
+            plane, seq = str(rec["plane"]), int(rec["seq"])
+            if seq > self._released.get(plane, -1):
+                n = len(rec["payload"])
+                self._pending.setdefault(plane, {})[seq] = n
+                self._live_bytes += n
+        elif op == "rel":
+            plane, seq = str(rec["plane"]), int(rec["seq"])
+            self._released[plane] = max(self._released.get(plane, -1), seq)
+            pend = self._pending.get(plane, {})
+            for s in [s for s in pend if s <= seq]:
+                self._live_bytes -= pend.pop(s)
+
+    # -- identity -------------------------------------------------------------
+    def identity(self) -> Optional[tuple[str, int]]:
+        """(agent_id, last persisted epoch) or None on a fresh WAL."""
+        with self._lock:
+            return self._ident
+
+    def save_identity(self, agent_id: str, epoch: int) -> None:
+        with self._lock:
+            self._ident = (agent_id, int(epoch))
+            self._log.append(
+                wire.encode(
+                    {"op": "ident", "agent_id": agent_id, "epoch": int(epoch)}
+                )
+            )
+
+    # -- frames ---------------------------------------------------------------
+    def append_frame(self, plane: str, seq: int, payload: bytes) -> None:
+        with self._lock:
+            self._pending.setdefault(plane, {})[seq] = len(payload)
+            self._live_bytes += len(payload)
+            self._log.append(
+                wire.encode(
+                    {"op": "frame", "plane": plane, "seq": int(seq),
+                     "payload": payload}
+                )
+            )
+
+    def release(self, plane: str, seq: int) -> None:
+        """Cumulative: frames <= seq left the window (acked or trimmed
+        by the server's applied watermark)."""
+        with self._lock:
+            if seq <= self._released.get(plane, -1):
+                return
+            self._released[plane] = int(seq)
+            pend = self._pending.get(plane, {})
+            had = False
+            for s in [s for s in pend if s <= seq]:
+                self._live_bytes -= pend.pop(s)
+                had = True
+            if not had:
+                return
+            self._log.append(
+                wire.encode({"op": "rel", "plane": plane, "seq": int(seq)})
+            )
+            self._maybe_compact_locked()
+
+    def pending(self, plane: str) -> list[tuple[int, int]]:
+        """Sorted (seq, nbytes) of unreleased frames for ``plane``."""
+        with self._lock:
+            return sorted(self._pending.get(plane, {}).items())
+
+    def next_seq(self, plane: str) -> int:
+        """First unused sequence number for ``plane`` — continues above
+        everything ever stamped, so the server's per-identity watermark
+        (which survived the restart server-side) stays meaningful."""
+        with self._lock:
+            top = self._released.get(plane, -1)
+            pend = self._pending.get(plane)
+            if pend:
+                top = max(top, max(pend))
+            return top + 1
+
+    def released(self, plane: str) -> int:
+        with self._lock:
+            return self._released.get(plane, -1)
+
+    def payloads(self, plane: str, seqs) -> dict[int, bytes]:
+        """Encoded frame bytes for the requested seqs, re-read from the
+        log (one sequential scan — replay-time only). Later records win
+        (there are no frame overwrites, but scans are cheap to keep
+        correct)."""
+        want = set(seqs)
+        out: dict[int, bytes] = {}
+        if not want:
+            return out
+        for payload in self._log.scan():
+            try:
+                rec = wire.decode(payload)
+            except wire.WireError:
+                continue
+            if (
+                rec.get("op") == "frame"
+                and rec.get("plane") == plane
+                and int(rec.get("seq", -1)) in want
+            ):
+                out[int(rec["seq"])] = rec["payload"]
+        return out
+
+    def _maybe_compact_locked(self) -> None:
+        # Compact when dead records dominate: rewrite ident + release
+        # watermarks + still-pending frames (re-read via scan so payload
+        # bytes never need a resident copy).
+        if self._log.nbytes < max(1 << 16, 4 * (self._live_bytes + 1024)):
+            return
+        live_seqs = {
+            plane: set(pend) for plane, pend in self._pending.items()
+        }
+
+        def records():
+            if self._ident is not None:
+                yield wire.encode(
+                    {"op": "ident", "agent_id": self._ident[0],
+                     "epoch": self._ident[1]}
+                )
+            for plane, seq in sorted(self._released.items()):
+                yield wire.encode(
+                    {"op": "rel", "plane": plane, "seq": int(seq)}
+                )
+            seen: dict[str, set] = {}
+            for payload in self._log.scan():
+                try:
+                    rec = wire.decode(payload)
+                except wire.WireError:
+                    continue
+                if rec.get("op") != "frame":
+                    continue
+                plane, seq = str(rec["plane"]), int(rec["seq"])
+                if seq in live_seqs.get(plane, ()) and seq not in seen.setdefault(
+                    plane, set()
+                ):
+                    seen[plane].add(seq)
+                    yield payload
+
+        self._log.rewrite(records())
+
+    def nbytes(self) -> int:
+        return self._log.nbytes
+
+    def close(self) -> None:
+        self._log.close()
+
+
+def transport_wal_path(wal_dir: str) -> str:
+    return os.path.join(wal_dir, "transport.wal")
+
+
+class AgentDurableState:
+    """The agent's durable registration epoch + per-query exactly-once
+    markers, on a FileDatastore (CRC'd, fsync'd, compacting). Keyed by
+    ``agent_id`` on disk: several agents (a PEM and the in-process
+    kelvin, tests) may share one ``wal_dir`` without one agent's epoch
+    making another believe IT restarted."""
+
+    MAX_QUERIES = 512
+
+    def __init__(self, wal_dir: str, agent_id: str):
+        safe = agent_id.replace(os.sep, "_")
+        self._ds = FileDatastore(
+            os.path.join(wal_dir, f"agent-{safe}.db"),
+            fsync=_fsync_policy(),
+        )
+        self._lock = threading.Lock()
+
+    def epoch(self) -> int:
+        v = self._ds.get("epoch")
+        return int(v) if v else 0
+
+    def save_epoch(self, epoch: int) -> None:
+        self._ds.set("epoch", str(int(epoch)).encode())
+
+    def restarts(self) -> int:
+        v = self._ds.get("restarts")
+        return int(v) if v else 0
+
+    def bump_restarts(self) -> int:
+        with self._lock:
+            n = self.restarts() + 1
+            self._ds.set("restarts", str(n).encode())
+            return n
+
+    # -- query markers --------------------------------------------------------
+    def query_state(self, query_id: str) -> Optional[str]:
+        v = self._ds.get(f"q/{query_id}")
+        return v.decode() if v else None
+
+    def mark_started(self, query_id: str) -> None:
+        """Durably record that execution began — written BEFORE the
+        first result frame can be produced, so a crash mid-execution is
+        distinguishable from a crash after completion."""
+        with self._lock:
+            self._ds.set(f"q/{query_id}", b"started")
+            self._trim_locked()
+
+    def mark_done(self, query_id: str) -> None:
+        """Every result frame (batches + fragment_done/error) is in the
+        transport window/WAL: replay alone completes the query."""
+        self._ds.set(f"q/{query_id}", b"done")
+
+    def _trim_locked(self) -> None:
+        keys = self._ds.keys("q/")
+        # FIFO-ish bound: FileDatastore keys sort lexically, which is
+        # arbitrary across uuids — a simple count cap is enough here
+        # (markers only matter for the restart window).
+        while len(keys) > self.MAX_QUERIES:
+            self._ds.delete(keys.pop(0))
+
+    def close(self) -> None:
+        self._ds.close()
+
+
+# -- resident-ring spill ------------------------------------------------------
+
+_RESIDENT_DIR = "resident"
+
+
+def ring_spill_path(wal_dir: str, table_name: str) -> str:
+    safe = table_name.replace(os.sep, "_")
+    return os.path.join(wal_dir, _RESIDENT_DIR, f"{safe}.wal")
+
+
+class RingSpill:
+    """Per-table mirror of a ResidentRing's recoverable state.
+
+    Record vocabulary (wire-encoded; numpy columns ride as validated npy
+    blobs):
+
+    - ``{"op": "window", "k", "start_row", "rows", "cols"}`` — one full
+      staged ring window's RAW host columns.
+    - ``{"op": "release", "k"}`` — the ring rolled the window out.
+    - ``{"op": "buf", "first_row", "cols"}`` — one append's ring-able
+      columns (the partial host buffer, incrementally).
+    - ``{"op": "trim", "buf_start"}`` — buffer rows below buf_start were
+      consumed into a staged window.
+    - ``{"op": "reset"}`` — the ring invalidated itself; nothing before
+      this record is recoverable.
+
+    Recovery replays in order; the ``resident.spill_corrupt`` fault site
+    lets chaos tests force a window record to read as corrupt, proving
+    recovery degrades (window skipped, queries fall back to staging)
+    instead of serving bad data.
+    """
+
+    def __init__(self, path: str):
+        self._log = SegmentLog(path, fsync=_fsync_policy())
+        self._lock = threading.Lock()
+        self._writes = 0
+
+    def record_window(self, k: int, start_row: int, rows: int, cols) -> None:
+        self._append(
+            {"op": "window", "k": int(k), "start_row": int(start_row),
+             "rows": int(rows), "cols": {n: np.asarray(a) for n, a in cols.items()}}
+        )
+
+    def record_release(self, k: int) -> None:
+        self._append({"op": "release", "k": int(k)})
+
+    def record_append(self, first_row: int, cols) -> None:
+        self._append(
+            {"op": "buf", "first_row": int(first_row),
+             "cols": {n: np.asarray(a) for n, a in cols.items()}}
+        )
+
+    def record_trim(self, buf_start: int) -> None:
+        self._append({"op": "trim", "buf_start": int(buf_start)})
+
+    def record_reset(self) -> None:
+        self._append({"op": "reset"})
+
+    def _append(self, rec: dict) -> None:
+        with self._lock:
+            self._log.append(wire.encode(rec))
+            self._writes += 1
+
+    def recover(self) -> dict:
+        """Replay the log into ``{"windows": {k: (start_row, rows,
+        cols)}, "buf": [(first_row, cols)...], "buf_start": int|None,
+        "corrupt": int}``. Window records that fail to decode (or that
+        the ``resident.spill_corrupt`` fault marks corrupt) are skipped
+        and counted — recovery never serves questionable data."""
+        windows: dict[int, tuple] = {}
+        buf: list[tuple] = []
+        buf_start = None
+        corrupt = 0
+        for payload in self._log.scan():
+            try:
+                rec = wire.decode(payload)
+                op = rec.get("op")
+                if op == "window":
+                    if faults.ACTIVE and faults.fires("resident.spill_corrupt"):
+                        raise wire.WireError(
+                            "fault injected: resident.spill_corrupt"
+                        )
+                    windows[int(rec["k"])] = (
+                        int(rec["start_row"]), int(rec["rows"]), rec["cols"]
+                    )
+                elif op == "release":
+                    windows.pop(int(rec["k"]), None)
+                elif op == "buf":
+                    buf.append((int(rec["first_row"]), rec["cols"]))
+                elif op == "trim":
+                    buf_start = int(rec["buf_start"])
+                    buf = [
+                        (r, cols) for r, cols in buf
+                        if r + _chunk_rows(cols) > buf_start
+                    ]
+                elif op == "reset":
+                    windows.clear()
+                    buf = []
+                    buf_start = None
+            except (wire.WireError, KeyError, TypeError, ValueError) as e:
+                corrupt += 1
+                _log.warning("ring spill: skipping bad record: %s", e)
+        return {
+            "windows": windows, "buf": buf, "buf_start": buf_start,
+            "corrupt": corrupt,
+        }
+
+    def maybe_compact(self, live_ks, buf_start: int, force: bool = False) -> None:
+        """Rewrite the log down to the live state (windows still in the
+        ring + buffer chunks at/after ``buf_start``) once dead records
+        have accumulated. Scan-filter: live window payloads are re-read
+        from the log itself, so compaction never needs a host-resident
+        copy of HBM window columns. ``force`` skips the dead-record
+        threshold — recovery uses it to persist EXACTLY its adopted
+        state, so records it rejected (stale geometry, rows the table
+        lost, corrupt payloads) can never resurrect on a later
+        restart against a table whose rows they no longer match."""
+        with self._lock:
+            if (
+                not force
+                and self._writes < 64
+                and self._log.nbytes < (8 << 20)
+            ):
+                return
+            self._writes = 0
+        live_ks = set(int(k) for k in live_ks)
+
+        def records():
+            seen: set = set()
+            for payload in self._log.scan():
+                try:
+                    rec = wire.decode(payload)
+                except wire.WireError:
+                    continue
+                op = rec.get("op")
+                if op == "window":
+                    k = int(rec.get("k", -1))
+                    if k in live_ks and k not in seen:
+                        seen.add(k)
+                        yield payload
+                elif op == "buf":
+                    cols = rec.get("cols") or {}
+                    if int(rec.get("first_row", 0)) + _chunk_rows(cols) > (
+                        buf_start
+                    ):
+                        yield payload
+            yield wire.encode({"op": "trim", "buf_start": int(buf_start)})
+
+        with self._lock:
+            self._log.rewrite(records())
+
+    def nbytes(self) -> int:
+        return self._log.nbytes
+
+    def close(self) -> None:
+        self._log.close()
+
+
+def _chunk_rows(cols: dict) -> int:
+    for a in cols.values():
+        return len(a)
+    return 0

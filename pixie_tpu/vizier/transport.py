@@ -121,6 +121,16 @@ _ACK_LATENCY = metrics_registry().histogram(
     "by plane (reconnect replays keep the ORIGINAL send time: the span "
     "covers first transmission to final acknowledgement).",
 )
+_WAL_REPLAYS = metrics_registry().counter(
+    "transport_wal_replayed_total",
+    "Window frames replayed from the durable WAL spill (restart "
+    "recovery and spilled-entry reconnect replays), by plane.",
+)
+_RESTART_SESSIONS = metrics_registry().counter(
+    "transport_restart_sessions_total",
+    "Sessions presented by a RESTARTED process (persisted identity with "
+    "a bumped epoch) — distinct from plain reconnects, by plane.",
+)
 
 
 class TransportBackpressureError(ConnectionError):
@@ -144,23 +154,44 @@ class _AckWindow:
     plane. The seq counter is per-IDENTITY, not per-connection: it never
     resets for the life of the RemoteBus, so the server's (agent_id,
     plane) watermark stays meaningful across reconnects. After a
-    reconnect, ``replay_frames`` returns everything above the server's
+    reconnect, ``replay_payloads`` returns everything above the server's
     applied watermark — the replay source that closes the r9 retry
     ambiguity (frames the OLD connection may have delivered are either
     trimmed here via the server's watermark, or dropped server-side by
-    per-identity dedup)."""
+    per-identity dedup).
 
-    def __init__(self, plane: str):
+    Durable spill (r14, ``wal`` = a durability.TransportWAL): every
+    windowed frame's encoded bytes are appended to the WAL before the
+    wire sees them, and only ``transport_wal_mem_frames`` frames stay
+    decoded in memory — older entries keep (seq, nbytes) only and are
+    re-read from the WAL at replay time. On restart the window restores
+    its pending set, seq counter, and ack watermark from the WAL, so the
+    replay that closes the crash hole is exactly the reconnect replay."""
+
+    def __init__(self, plane: str, wal=None):
         self.plane = plane
         self._cv = threading.Condition()
-        # (seq, encoded bytes, stamped frame, first-send perf_counter_ns)
-        # in ascending-seq order. The send time is stamped ONCE — replays
-        # keep it, so the ack-latency span covers first transmission to
-        # final acknowledgement across reconnects.
+        # [seq, encoded bytes, stamped frame | None (spilled), first-send
+        # perf_counter_ns] in ascending-seq order. The send time is
+        # stamped ONCE — replays keep it, so the ack-latency span covers
+        # first transmission to final acknowledgement across reconnects.
+        # send_ns == 0 marks a frame restored from the WAL (no latency
+        # span: its original send time died with the old process).
         self._entries: "collections.deque" = collections.deque()
         self._bytes = 0
         self.next_seq = 0
         self.acked = -1
+        self._wal = wal
+        self._mem_frames = 0  # entries currently holding a decoded frame
+        self.restored_frames = 0
+        if wal is not None:
+            pending = wal.pending(plane)
+            for seq, nbytes in pending:
+                self._entries.append([seq, nbytes, None, 0])
+                self._bytes += nbytes
+            self.next_seq = wal.next_seq(plane)
+            self.acked = wal.released(plane)
+            self.restored_frames = len(pending)
 
     @property
     def enabled(self) -> bool:
@@ -187,10 +218,12 @@ class _AckWindow:
         window owns) a trace context."""
         seq, _, frame, send_ns = entry
         if send_ns == 0:
-            return
+            return  # WAL-restored: the original send time died with us
         now = now_pc_ns if now_pc_ns is not None else time.perf_counter_ns()
         lat_ns = max(0, now - send_ns)
         _ACK_LATENCY.observe(lat_ns / 1e9, plane=self.plane)
+        if frame is None:
+            return  # spilled to the WAL: no trace context in memory
         if trace.ACTIVE:
             trace.record(
                 "transport.ack",
@@ -209,11 +242,16 @@ class _AckWindow:
         with self._cv:
             return len(self._entries), self._bytes
 
-    def add(self, frame: dict, nbytes: int, force: bool = False) -> None:
+    def add(self, frame: dict, payload: bytes, force: bool = False) -> None:
         """Track a stamped frame until acked. Blocks (backpressure) while
         the window is full, up to transport_window_block_s, then raises
         TransportBackpressureError. ``force`` skips the bound (internal
-        reconnect frames must not deadlock inside the replay path)."""
+        reconnect frames must not deadlock inside the replay path). With
+        a WAL attached, the encoded payload is appended durably BEFORE
+        the entry joins the window, and frames beyond the
+        transport_wal_mem_frames bound spill: the window keeps only
+        (seq, nbytes) and replay re-reads the bytes from disk."""
+        nbytes = len(payload)
         max_frames = flags.transport_ack_window
         max_bytes = int(flags.transport_ack_window_mb * (1 << 20))
         with self._cv:
@@ -229,8 +267,17 @@ class _AckWindow:
                             self.plane, len(self._entries), self._bytes
                         )
                     self._cv.wait(remaining)
+            keep: "dict | None" = frame
+            if self._wal is not None:
+                self._wal.append_frame(self.plane, frame["seq"], payload)
+                if self._mem_frames >= max(
+                    int(flags.transport_wal_mem_frames), 1
+                ):
+                    keep = None  # spilled: the WAL holds the bytes
+                else:
+                    self._mem_frames += 1
             self._entries.append(
-                (frame["seq"], nbytes, frame, time.perf_counter_ns())
+                [frame["seq"], nbytes, keep, time.perf_counter_ns()]
             )
             self._bytes += nbytes
 
@@ -244,8 +291,12 @@ class _AckWindow:
             while self._entries and self._entries[0][0] <= seq:
                 entry = self._entries.popleft()
                 self._bytes -= entry[1]
+                if entry[2] is not None and self._wal is not None:
+                    self._mem_frames -= 1
                 released.append(entry)
             self._cv.notify_all()
+        if released and self._wal is not None:
+            self._wal.release(self.plane, released[-1][0])
         now = time.perf_counter_ns()
         for entry in released:
             self._release(entry, now)
@@ -261,12 +312,14 @@ class _AckWindow:
                 self._cv.wait(remaining)
             return True
 
-    def replay_frames(self, server_applied_seq: int) -> list[dict]:
-        """Frames to resend on a fresh connection: everything above the
-        server's per-identity applied watermark. Entries at or below it
-        WERE delivered by the old connection — trimmed here (and were a
-        replay to happen anyway, the server's watermark drops it; the
-        transport.replay_dup fault site forces exactly that path)."""
+    def replay_payloads(self, server_applied_seq: int) -> list[bytes]:
+        """Encoded frames to resend on a fresh connection: everything
+        above the server's per-identity applied watermark. Entries at or
+        below it WERE delivered by the old connection — trimmed here
+        (and were a replay to happen anyway, the server's watermark
+        drops it; the transport.replay_dup fault site forces exactly
+        that path). Spilled/restored entries (frame is None) re-read
+        their bytes from the WAL — the restart-recovery replay source."""
         released = []
         with self._cv:
             if not (faults.ACTIVE and faults.fires("transport.replay_dup")):
@@ -276,16 +329,47 @@ class _AckWindow:
                 ):
                     entry = self._entries.popleft()
                     self._bytes -= entry[1]
+                    if entry[2] is not None and self._wal is not None:
+                        self._mem_frames -= 1
                     released.append(entry)
                 if server_applied_seq > self.acked:
                     self.acked = server_applied_seq
                 self._cv.notify_all()
-            frames = [e[2] for e in self._entries]
+            entries = list(self._entries)
+        if released and self._wal is not None:
+            self._wal.release(self.plane, released[-1][0])
         # Watermark-trimmed entries WERE applied by the old connection:
         # their ack span closes here, once, with the original send time.
         for entry in released:
             self._release(entry)
-        return frames
+        spilled = [e[0] for e in entries if e[2] is None]
+        from_wal = (
+            self._wal.payloads(self.plane, spilled)
+            if spilled and self._wal is not None
+            else {}
+        )
+        out: list[bytes] = []
+        wal_count = 0
+        for seq, _nbytes, frame, _send_ns in entries:
+            if frame is not None:
+                out.append(wire.encode(frame))
+                continue
+            payload = from_wal.get(seq)
+            if payload is None:
+                # Unrecoverable spill (should not happen: the WAL append
+                # precedes windowing). Skipping is safe for delivery
+                # semantics — the server either already applied this seq
+                # (watermark) or the sender will surface the loss.
+                _log.error(
+                    "transport %s: WAL lost spilled frame seq=%d",
+                    self.plane, seq,
+                )
+                continue
+            out.append(payload)
+            wal_count += 1
+        if wal_count:
+            _WAL_REPLAYS.inc(wal_count, plane=self.plane)
+        return out
 
 define_flag(
     "tls_cert",
@@ -582,6 +666,11 @@ class BusTransportServer:
             return None
         if old_conn is not None and old_conn is not conn:
             _close(old_conn)  # the superseded zombie cannot interleave
+        if frame.get("restarted"):
+            # Restart (persisted identity + bumped epoch after process
+            # death) vs plain reconnect: counted separately so operators
+            # can tell crash-recovery traffic from network flaps.
+            _RESTART_SESSIONS.inc(plane=frame["plane"])
         with send_lock:
             _send_frame(
                 conn,
@@ -896,7 +985,12 @@ class RemoteBus:
 
     DATA_TOPIC_PREFIXES = ("results/",)
 
-    def __init__(self, address, agent_id: Optional[str] = None):
+    def __init__(
+        self,
+        address,
+        agent_id: Optional[str] = None,
+        wal_dir: Optional[str] = None,
+    ):
         self._address = tuple(address)
         self._secret = flags.cluster_secret
         self._tls = _tls_client_context()
@@ -909,14 +1003,41 @@ class RemoteBus:
                 "without a cluster_secret (set PIXIE_TPU_CLUSTER_SECRET) "
                 "or a verified TLS server (tls_ca)"
             )
+        # Durable identity + window spill (r14, flag durable_transport +
+        # wal_dir, or an explicit wal_dir): a restart restores the same
+        # agent_id, continues the epoch counter, and replays the unacked
+        # window from disk — exactly-once across crash.
+        self._wal = None
+        restored_ident = None
+        if wal_dir is None and flags.durable_transport and flags.wal_dir:
+            wal_dir = flags.wal_dir
+        if wal_dir:
+            from pixie_tpu.vizier import durability
+
+            self._wal = durability.TransportWAL(
+                durability.transport_wal_path(wal_dir)
+            )
+            restored_ident = self._wal.identity()
         # Stable delivery identity + per-process epoch counter: every
         # (re)connect on either plane presents a strictly higher epoch,
         # so the server can reject zombies deterministically.
+        if agent_id is None and restored_ident is not None:
+            agent_id = restored_ident[0]
         self._ident = agent_id or f"rbus-{uuid.uuid4().hex}"
         self._epoch = 0
+        self._restarted = False
+        if restored_ident is not None and restored_ident[0] == self._ident:
+            self._epoch = restored_ident[1]
+            self._restarted = self._epoch > 0
         self._epoch_lock = threading.Lock()
-        self._ctrl_window = _AckWindow("control")
-        self._data_window = _AckWindow("data")
+        self._ctrl_window = _AckWindow("control", wal=self._wal)
+        self._data_window = _AckWindow("data", wal=self._wal)
+        # Recovery observability: frames restored from the WAL at open
+        # (the agent's recovery stats pick this up).
+        self.wal_restored_frames = (
+            self._ctrl_window.restored_frames
+            + self._data_window.restored_frames
+        )
         self._send_lock = threading.Lock()
         self._data_sock = None  # opened on first data-plane send
         self._data_lock = threading.Lock()
@@ -927,14 +1048,63 @@ class RemoteBus:
         # would re-enter _reconnect on the same thread.
         self._reconnect_lock = threading.RLock()
         self._reconnect_listeners: list = []
-        self._sock, _ = self._connect("control")
+        self._sock, server_applied = self._connect("control")
+        if self._ctrl_window.enabled and self._ctrl_window.depth()[0]:
+            # Restart recovery: replay restored control frames above the
+            # server's applied watermark before anything else is sent.
+            with self._send_lock:
+                try:
+                    self._replay_onto(
+                        self._sock, self._ctrl_window, server_applied
+                    )
+                except OSError:
+                    pass  # the read loop will redial + replay
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
+        if self._data_window.enabled and self._data_window.depth()[0]:
+            # Stranded data frames (a crashed process's windowed result
+            # stream) must not wait for the next data send: dial the
+            # plane now so the replay delivers them.
+            try:
+                with self._data_lock:
+                    if self._data_sock is None:
+                        self._data_redial_locked(redialing=False)
+            except (OSError, ConnectionError) as e:
+                _log.warning(
+                    "transport: data-plane WAL replay deferred "
+                    "(redial failed: %s)", e
+                )
 
     def add_reconnect_listener(self, fn) -> None:
         """``fn()`` runs after each successful control-plane reconnect
         (the Agent re-registers itself + its tables)."""
         self._reconnect_listeners.append(fn)
+
+    @staticmethod
+    def _replay_onto(sock, window: _AckWindow, server_applied: int) -> None:
+        """Resend a window's unacked frames (in-memory or WAL-spilled)
+        above the server's applied watermark onto a fresh socket."""
+        for payload in window.replay_payloads(server_applied):
+            sock.sendall(_LEN.pack(len(payload)) + payload)
+            _REPLAYS.inc(plane=window.plane)
+
+    def _hard_crash(self) -> None:
+        """Simulate abrupt process death from inside a send path (the
+        transport.crash_restart fault site): both sockets die with no
+        drain and no graceful close; the WAL keeps exactly what a real
+        SIGKILL would have left on disk. Callers must not hold
+        ``_data_lock`` unless they close the data socket themselves."""
+        self._stop.set()
+        _close(self._sock)
+
+    def crash(self) -> None:
+        """Test/chaos helper: kill this bus as a SIGKILL would — no
+        window drain, no unsubscribes; durable state stays as-is."""
+        self._hard_crash()
+        with self._data_lock:
+            if self._data_sock is not None:
+                _close(self._data_sock)
+                self._data_sock = None
 
     def _connect(self, plane: str) -> tuple[socket.socket, int]:
         """Dial + authenticate + establish the delivery session for one
@@ -956,16 +1126,23 @@ class RemoteBus:
             with self._epoch_lock:
                 self._epoch += 1
                 epoch = self._epoch
-            _send_frame(
-                sock,
-                {
-                    "kind": "session",
-                    "agent_id": self._ident,
-                    "plane": plane,
-                    "epoch": epoch,
-                    "want_ack": flags.transport_ack_window > 0,
-                },
-            )
+                if self._wal is not None:
+                    # Persist identity + epoch BEFORE presenting them: a
+                    # crash right after this connect must restart with a
+                    # strictly higher epoch than any the server saw.
+                    self._wal.save_identity(self._ident, epoch)
+            session = {
+                "kind": "session",
+                "agent_id": self._ident,
+                "plane": plane,
+                "epoch": epoch,
+                "want_ack": flags.transport_ack_window > 0,
+            }
+            if self._restarted:
+                # Restart (persisted identity, bumped epoch), distinct
+                # from a plain reconnect — servers count it.
+                session["restarted"] = True
+            _send_frame(sock, session)
             resp = _recv_frame(
                 sock, max_len=_HANDSHAKE_MAX_FRAME, pre_auth=True
             )
@@ -1028,11 +1205,9 @@ class RemoteBus:
                     self._sock = sock
                     if self._ctrl_window.enabled:
                         try:
-                            for fr in self._ctrl_window.replay_frames(
-                                server_applied
-                            ):
-                                _send_frame(sock, fr)
-                                _REPLAYS.inc(plane="control")
+                            self._replay_onto(
+                                sock, self._ctrl_window, server_applied
+                            )
                         except OSError:
                             replay_failed = True
                 if replay_failed:
@@ -1149,9 +1324,7 @@ class RemoteBus:
             # Replay unacked frames above the server's applied watermark;
             # delivered-but-unacked halves are trimmed (or, under the
             # transport.replay_dup fault, deduped server-side).
-            for fr in self._data_window.replay_frames(server_applied):
-                _send_frame(sock, fr)
-                _REPLAYS.inc(plane="data")
+            self._replay_onto(sock, self._data_window, server_applied)
             threading.Thread(
                 target=self._data_read_loop, args=(sock,), daemon=True
             ).start()
@@ -1211,7 +1384,7 @@ class RemoteBus:
             frame = self._ctrl_window.stamp(obj)
             payload = wire.encode(frame)
             if self._ctrl_window.enabled:
-                self._ctrl_window.add(frame, len(payload), force=force)
+                self._ctrl_window.add(frame, payload, force=force)
             sock.sendall(_LEN.pack(len(payload)) + payload)
 
     def _send(self, obj: dict) -> None:
@@ -1233,8 +1406,19 @@ class RemoteBus:
                     payload = wire.encode(frame)
                     windowed = self._ctrl_window.enabled
                     if windowed:
-                        self._ctrl_window.add(frame, len(payload))
+                        self._ctrl_window.add(frame, payload)
                     sock.sendall(_LEN.pack(len(payload)) + payload)
+                    if faults.ACTIVE and faults.fires_scoped(
+                        "transport.crash_restart", "control"
+                    ):
+                        # The frame IS on the wire (and in the WAL); the
+                        # process dies before it can learn the outcome —
+                        # a restart must replay it and the server's
+                        # watermark must apply it exactly once.
+                        self._hard_crash()
+                        raise ConnectionError(
+                            "fault injected: transport.crash_restart"
+                        )
                 return
             except TransportBackpressureError:
                 raise  # structured: peer alive but not draining acks
@@ -1271,11 +1455,22 @@ class RemoteBus:
                     frame = self._data_window.stamp(obj)
                     payload = wire.encode(frame)
                     if self._data_window.enabled:
-                        self._data_window.add(frame, len(payload))
+                        self._data_window.add(frame, payload)
                         windowed_frame = frame
                     self._data_sock.sendall(
                         _LEN.pack(len(payload)) + payload
                     )
+                    if faults.ACTIVE and faults.fires_scoped(
+                        "transport.crash_restart", "data"
+                    ):
+                        # Applied-but-unobserved: the frame reached the
+                        # wire (and the WAL), then the process dies.
+                        _close(self._data_sock)
+                        self._data_sock = None
+                        self._hard_crash()
+                        raise ConnectionError(
+                            "fault injected: transport.crash_restart"
+                        )
                 return
             except TransportBackpressureError:
                 raise  # structured: the peer is alive but not draining
@@ -1351,6 +1546,8 @@ class RemoteBus:
         with self._data_lock:
             if self._data_sock is not None:
                 _close(self._data_sock)
+        if self._wal is not None:
+            self._wal.close()
 
 
 class RemoteRouter(BridgeRouter):

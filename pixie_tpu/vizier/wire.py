@@ -139,6 +139,10 @@ OPTIONAL_FRAME_FIELDS: dict[str, type] = {
     "want_ack": bool,
     "trace_id": str,
     "span_id": str,
+    # r14: a session presented by a RESTARTED process (identity restored
+    # from its WAL, epoch bumped past the persisted counter) — lets the
+    # server distinguish crash recovery from a plain reconnect.
+    "restarted": bool,
 }
 
 

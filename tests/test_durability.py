@@ -1,0 +1,821 @@
+"""Durable restart recovery (r14): crash chaos + WAL/spill units.
+
+The r10 acked-delivery plane closed the reconnect ambiguity; this suite
+proves the same contracts across full PROCESS death: the transport WAL
+restores identity + unacked window and replays above the server's applied
+watermark (exactly-once across crash), the agent's durable query markers
+make re-offered launches exactly-once (done → drop, started → structured
+refusal), and the resident-ring spill re-stages HBM windows on restart
+without replaying appends. Crash posture throughout is SIGKILL: sockets
+cut mid-send (``transport.crash_restart``), WAL records torn mid-write()
+(``wal.torn_write``), spill payloads corrupt (``resident.spill_corrupt``)
+— recovery must degrade (skip, refuse, re-stage less) but never serve
+wrong data or apply a frame twice.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec.router import BridgeRouter
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.table.table_store import TableStore
+from pixie_tpu.types import DataType, Relation, SemanticType
+from pixie_tpu.utils import faults, flags, metrics_registry
+from pixie_tpu.vizier import Agent, MessageBus, QueryBroker
+from pixie_tpu.vizier import agent as agent_mod
+from pixie_tpu.vizier import broker as broker_mod
+from pixie_tpu.vizier import wire
+from pixie_tpu.vizier.agent import AGENT_STATUS_TOPIC
+from pixie_tpu.vizier.bus import agent_topic
+from pixie_tpu.vizier.datastore import SegmentLog
+from pixie_tpu.vizier.durability import (
+    AgentDurableState,
+    RingSpill,
+    TransportWAL,
+    ring_spill_path,
+    transport_wal_path,
+)
+from pixie_tpu.vizier.transport import (
+    BusTransportServer,
+    RemoteBus,
+    RemoteRouter,
+)
+
+F, I, S, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+REL = Relation.of(("time_", T), ("service", S), ("latency", F))
+TABLES = {"http_events": REL}
+
+AGG_QUERY = (
+    "df = px.DataFrame(table='http_events')\n"
+    "stats = df.groupby(['service']).agg(\n"
+    "    total=('latency', px.sum), n=('latency', px.count))\n"
+    "px.display(stats, 'out')\n"
+)
+
+N_ROWS = 2000
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture
+def flagset():
+    saved = {}
+
+    def set_(name, value):
+        if name not in saved:
+            saved[name] = flags.get(name)
+        flags.set(name, value)
+
+    yield set_
+    for name, value in saved.items():
+        flags.set(name, value)
+
+
+def _make_store(seed_offset, n=N_ROWS):
+    rng = np.random.default_rng(5 + seed_offset)
+    ts = TableStore()
+    t = ts.create_table("http_events", REL)
+    t.write_pydict(
+        {
+            "time_": np.arange(n) + seed_offset,
+            "service": rng.choice(["a", "b", "c"], n).astype(object),
+            # Integer-valued: float sums are exact in any order, so
+            # pre/post-restart rows compare bit-equal.
+            "latency": rng.integers(1, 100, n).astype(np.float64),
+        }
+    )
+    t.stop()
+    return ts
+
+
+def _sorted_rows(res, name="out"):
+    batches = [b for b in res.tables.get(name, []) if b.num_rows]
+    if not batches:
+        return []
+    d = RowBatch.concat(batches).to_pydict()
+    cols = sorted(d)
+    return sorted(zip(*[d[c] for c in cols]))
+
+
+def _wait_agents(broker, count, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(broker.tracker.distributed_state().agents) >= count:
+            return
+        time.sleep(0.02)
+    pytest.fail(f"{count} agents never registered")
+
+
+# -- SegmentLog: the spill substrate ------------------------------------------
+
+
+def test_segment_log_roundtrip_and_torn_tail(tmp_path):
+    p = str(tmp_path / "seg.log")
+    log = SegmentLog(p)
+    log.append(b"alpha")
+    log.append(b"beta" * 100)
+    log.close()
+    # Torn tail: a crash mid-write leaves a partial record.
+    with open(p, "ab") as f:
+        f.write(b"\x00\x00\x01\x00GARBAGE")
+    log2 = SegmentLog(p)
+    assert log2.scan() == [b"alpha", b"beta" * 100]
+    # Recovery truncated the torn suffix; appends continue cleanly.
+    log2.append(b"gamma")
+    assert log2.scan() == [b"alpha", b"beta" * 100, b"gamma"]
+    log2.close()
+
+
+def test_segment_log_corrupt_middle_stops_scan(tmp_path):
+    """CRC failure mid-log: everything before survives, the rest is
+    discarded (never served) — the WAL recovery contract."""
+    p = str(tmp_path / "seg.log")
+    log = SegmentLog(p)
+    log.append(b"keep-me")
+    log.append(b"corrupt-me")
+    log.append(b"after")
+    log.close()
+    data = bytearray(open(p, "rb").read())
+    off = 8 + len(b"keep-me") + 8  # into the 2nd record's payload
+    data[off] ^= 0xFF
+    open(p, "wb").write(bytes(data))
+    log2 = SegmentLog(p)
+    assert log2.scan() == [b"keep-me"]
+    log2.close()
+
+
+def test_segment_log_rewrite_is_atomic_and_stale_temp_ignored(tmp_path):
+    p = str(tmp_path / "seg.log")
+    log = SegmentLog(p)
+    for i in range(10):
+        log.append(f"rec{i}".encode())
+    log.rewrite([b"only", b"live"])
+    assert log.scan() == [b"only", b"live"]
+    log.close()
+    # A crash mid-rewrite leaves a .compact temp; the main log rules.
+    open(p + ".compact", "wb").write(b"partial junk")
+    log2 = SegmentLog(p)
+    assert log2.scan() == [b"only", b"live"]
+    assert not os.path.exists(p + ".compact")
+    log2.close()
+
+
+def test_wal_torn_write_fault_truncates_on_reopen(tmp_path):
+    """``wal.torn_write``: the append crashes mid-write() with only a
+    prefix on disk; reopen truncates the torn record, prior records
+    survive."""
+    p = str(tmp_path / "seg.log")
+    log = SegmentLog(p)
+    log.append(b"durable")
+    faults.arm("wal.torn_write", count=1)
+    with pytest.raises(faults.FaultInjectedError):
+        log.append(b"torn-away-payload")
+    log.close()
+    log2 = SegmentLog(p)
+    assert log2.scan() == [b"durable"]
+    log2.append(b"post-recovery")
+    assert log2.scan() == [b"durable", b"post-recovery"]
+    log2.close()
+
+
+# -- TransportWAL -------------------------------------------------------------
+
+
+def test_transport_wal_restart_restores_identity_window_watermark(tmp_path):
+    w = TransportWAL(transport_wal_path(str(tmp_path)))
+    assert w.identity() is None
+    w.save_identity("agent-x", 3)
+    w.append_frame("data", 0, b"f0")
+    w.append_frame("data", 1, b"f1-longer")
+    w.append_frame("control", 0, b"c0")
+    w.release("data", 0)
+    w.close()
+
+    w2 = TransportWAL(transport_wal_path(str(tmp_path)))
+    assert w2.identity() == ("agent-x", 3)
+    assert w2.pending("data") == [(1, len(b"f1-longer"))]
+    assert w2.pending("control") == [(0, 2)]
+    assert w2.next_seq("data") == 2  # continues ABOVE everything stamped
+    assert w2.released("data") == 0
+    assert w2.payloads("data", [1]) == {1: b"f1-longer"}
+    w2.close()
+
+
+def test_transport_wal_compaction_keeps_live_frames(tmp_path):
+    w = TransportWAL(transport_wal_path(str(tmp_path)))
+    w.save_identity("agent-c", 1)
+    payload = b"x" * 2048
+    for seq in range(64):
+        w.append_frame("data", seq, payload)
+        if seq >= 2:
+            w.release("data", seq - 2)  # keep a rolling window of 2-3
+    # Dead records dominate → compaction rewrote; live set intact.
+    assert w.nbytes() < 64 * 2048
+    assert [s for s, _ in w.pending("data")] == [62, 63]
+    w.close()
+    w2 = TransportWAL(transport_wal_path(str(tmp_path)))
+    assert w2.identity() == ("agent-c", 1)
+    assert [s for s, _ in w2.pending("data")] == [62, 63]
+    assert w2.payloads("data", [62, 63]) == {62: payload, 63: payload}
+    w2.close()
+
+
+# -- AgentDurableState --------------------------------------------------------
+
+
+def test_agent_state_epoch_and_markers_survive_restart(tmp_path):
+    s = AgentDurableState(str(tmp_path), "agent-x")
+    assert s.epoch() == 0 and s.restarts() == 0
+    s.save_epoch(7)
+    s.mark_started("q-started")
+    s.mark_started("q-finished")
+    s.mark_done("q-finished")
+    assert s.bump_restarts() == 1
+    s.close()
+    s2 = AgentDurableState(str(tmp_path), "agent-x")
+    assert s2.epoch() == 7 and s2.restarts() == 1
+    assert s2.query_state("q-started") == "started"
+    assert s2.query_state("q-finished") == "done"
+    assert s2.query_state("q-unknown") is None
+    s2.close()
+
+
+def test_agent_state_marker_count_is_bounded(tmp_path):
+    s = AgentDurableState(str(tmp_path), "agent-x")
+    s.MAX_QUERIES = 8
+    for i in range(40):
+        s.mark_started(f"q{i:03d}")
+    assert len(s._ds.keys("q/")) <= 8
+    s.close()
+
+
+# -- RingSpill ----------------------------------------------------------------
+
+
+def _cols(n, base=0):
+    return {
+        "a": np.arange(base, base + n, dtype=np.int64),
+        "b": np.full(n, 1.5 + base),
+    }
+
+
+def test_ring_spill_windows_buffer_trim_release(tmp_path):
+    sp = RingSpill(ring_spill_path(str(tmp_path), "tbl"))
+    sp.record_append(0, _cols(8))
+    sp.record_window(0, 0, 8, _cols(8))
+    sp.record_trim(8)
+    sp.record_append(8, _cols(8, base=8))
+    sp.record_window(1, 8, 8, _cols(8, base=8))
+    sp.record_release(0)  # ring rolled window 0 out
+    sp.record_trim(16)
+    sp.record_append(16, _cols(3, base=16))
+    sp.close()
+
+    st = RingSpill(ring_spill_path(str(tmp_path), "tbl")).recover()
+    assert sorted(st["windows"]) == [1]
+    start_row, rows, cols = st["windows"][1]
+    assert (start_row, rows) == (8, 8)
+    np.testing.assert_array_equal(cols["a"], np.arange(8, 16))
+    assert [r for r, _ in st["buf"]] == [16]
+    assert st["buf_start"] == 16
+    assert st["corrupt"] == 0
+
+
+def test_ring_spill_reset_clears_prior_state(tmp_path):
+    sp = RingSpill(ring_spill_path(str(tmp_path), "tbl"))
+    sp.record_window(0, 0, 8, _cols(8))
+    sp.record_append(8, _cols(4, base=8))
+    sp.record_reset()
+    sp.record_append(0, _cols(2))
+    sp.close()
+    st = RingSpill(ring_spill_path(str(tmp_path), "tbl")).recover()
+    assert st["windows"] == {}
+    assert [r for r, _ in st["buf"]] == [0]
+
+
+def test_ring_spill_corrupt_fault_skips_window_counts(tmp_path):
+    sp = RingSpill(ring_spill_path(str(tmp_path), "tbl"))
+    sp.record_window(0, 0, 8, _cols(8))
+    sp.record_window(1, 8, 8, _cols(8, base=8))
+    sp.close()
+    faults.arm("resident.spill_corrupt", count=1)
+    st = RingSpill(ring_spill_path(str(tmp_path), "tbl")).recover()
+    # First window record read back corrupt: skipped + counted, never
+    # served; the second survives.
+    assert st["corrupt"] == 1
+    assert sorted(st["windows"]) == [1]
+
+
+# -- transport crash-restart (real TCP) ---------------------------------------
+
+
+def test_control_crash_restart_is_exactly_once(tmp_path):
+    """The applied-but-unobserved crash: the frame reaches the wire (and
+    the WAL), the process dies before the ack. The restarted process
+    presents the persisted identity with a bumped epoch; the server's
+    per-identity watermark trims the already-applied frame from the
+    replay — delivered exactly once."""
+    wal_dir = str(tmp_path)
+    bus = MessageBus()
+    router = BridgeRouter()
+    server = BusTransportServer(bus, router)
+    sub = bus.subscribe("t")
+    restart_sessions = metrics_registry().counter(
+        "transport_restart_sessions_total"
+    )
+    before_restarts = restart_sessions.value(plane="control")
+    try:
+        rb = RemoteBus(server.address, agent_id="aid-1", wal_dir=wal_dir)
+        rb.publish("t", {"n": 1})
+        assert sub.get(timeout=10) == {"n": 1}
+
+        faults.arm("transport.crash_restart@control", count=1)
+        with pytest.raises(ConnectionError):
+            rb.publish("t", {"n": 2})
+        faults.reset()
+        # The frame WAS applied before the process died.
+        assert sub.get(timeout=10) == {"n": 2}
+
+        rb2 = RemoteBus(server.address, wal_dir=wal_dir)
+        assert rb2._ident == "aid-1"  # identity restored, not regenerated
+        assert rb2._restarted
+        # No duplicate delivery of the crashed frame.
+        assert sub.get(timeout=0.5) is None
+        rb2.publish("t", {"n": 3})
+        assert sub.get(timeout=10) == {"n": 3}
+        assert (
+            restart_sessions.value(plane="control") > before_restarts
+        )
+        rb2.close()
+    finally:
+        server.stop()
+
+
+def test_restart_replays_frame_the_server_never_saw(tmp_path):
+    """The lost-before-apply crash: a frame landed in the WAL but died
+    with the socket before the server applied it. The restart replay
+    delivers it — and the ack then releases it from the durable WAL."""
+    wal_dir = str(tmp_path)
+    wal = TransportWAL(transport_wal_path(wal_dir))
+    wal.save_identity("wal-agent", 1)
+    frame = {"kind": "publish", "topic": "t-replay", "msg": {"n": 9},
+             "seq": 0}
+    wal.append_frame("control", 0, wire.encode(frame))
+    wal.close()
+
+    bus = MessageBus()
+    router = BridgeRouter()
+    server = BusTransportServer(bus, router)
+    sub = bus.subscribe("t-replay")
+    wal_replays = metrics_registry().counter("transport_wal_replayed_total")
+    before = wal_replays.value(plane="control")
+    try:
+        rb = RemoteBus(server.address, wal_dir=wal_dir)
+        assert rb._ident == "wal-agent" and rb._restarted
+        assert rb.wal_restored_frames == 1
+        assert sub.get(timeout=10) == {"n": 9}
+        assert wal_replays.value(plane="control") == before + 1
+        # The cumulative ack drains the restored entry from the window
+        # AND the WAL: a second restart replays nothing.
+        deadline = time.monotonic() + 10
+        while rb._ctrl_window.depth()[0]:
+            assert time.monotonic() < deadline, "restored frame never acked"
+            time.sleep(0.02)
+        rb.close()
+        w2 = TransportWAL(transport_wal_path(wal_dir))
+        assert w2.pending("control") == []
+        w2.close()
+        assert sub.get(timeout=0.3) is None  # exactly once
+    finally:
+        server.stop()
+
+
+@pytest.fixture
+def crash_cluster(flagset, monkeypatch, tmp_path):
+    """Broker + kelvin in-process; one durable PEM over real TCP."""
+    flagset("agent_backoff_initial_s", 0.01)
+    flagset("agent_backoff_max_s", 0.1)
+    monkeypatch.setattr(agent_mod, "HEARTBEAT_INTERVAL_S", 0.05)
+    monkeypatch.setattr(broker_mod, "AGENT_EXPIRY_S", 0.4)
+    bus = MessageBus()
+    router = BridgeRouter()
+    server = BusTransportServer(bus, router)
+    broker = QueryBroker(bus, router, table_relations=TABLES)
+    kelvin = Agent("kelvin", bus, router, is_kelvin=True)
+    kelvin.start()
+    wal_dir = str(tmp_path / "pem1-wal")
+    os.makedirs(wal_dir, exist_ok=True)
+    rbus = RemoteBus(server.address, agent_id="pem1", wal_dir=wal_dir)
+    pem = Agent(
+        "pem1", rbus, RemoteRouter(rbus), table_store=_make_store(0),
+        wal_dir=wal_dir,
+    )
+    pem.start()
+    _wait_agents(broker, 2)
+    ctx = {
+        "broker": broker, "server": server, "wal_dir": wal_dir,
+        "agents": [pem], "buses": [rbus],
+    }
+    yield ctx
+    broker.stop()
+    for a in ctx["agents"]:
+        a.stop()
+    kelvin.stop()
+    for b in ctx["buses"]:
+        try:
+            b.close()
+        except Exception:
+            pass
+    server.stop()
+
+
+def test_mid_query_crash_then_restart_rerun_bit_identical(crash_cluster):
+    """THE acceptance chaos: kill the agent process mid-query (data-plane
+    crash_restart), restart it from its WAL, rerun — rows bit-identical
+    to the unfaulted run, zero duplicate applies server-side."""
+    broker = crash_cluster["broker"]
+    res0 = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res0.degraded is None
+    rows0 = _sorted_rows(res0)
+    assert rows0, "unfaulted run returned no rows"
+
+    dedup = metrics_registry().counter("transport_dedup_dropped_total")
+    dedup_before = dedup.value()
+
+    # Crash: the process dies the instant its first result frame of the
+    # next query reaches the wire (and the WAL).
+    faults.arm("transport.crash_restart@data", count=1)
+    res1 = broker.execute_script(AGG_QUERY, timeout_s=30)
+    faults.reset()
+    # Mid-crash behavior is the r9 contract: the broker degrades around
+    # the dead agent rather than hanging (rows may be partial).
+    assert res1 is not None
+
+    # Restart: same identity, same WAL dir, table store restored by the
+    # embedder (host tables are the ingest tier's durability, not ours).
+    wal_dir = crash_cluster["wal_dir"]
+    rbus2 = RemoteBus(
+        crash_cluster["server"].address, agent_id="pem1", wal_dir=wal_dir
+    )
+    pem2 = Agent(
+        "pem1", rbus2, RemoteRouter(rbus2), table_store=_make_store(0),
+        wal_dir=wal_dir,
+    )
+    pem2.start()
+    crash_cluster["agents"].append(pem2)
+    crash_cluster["buses"].append(rbus2)
+    assert pem2.recovery_info is not None
+    assert pem2.recovery_info["restarted"] is True
+    assert pem2.recovery_info["restart_count"] >= 1
+    _wait_agents(broker, 2)
+
+    res2 = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res2.degraded is None, res2.degraded
+    assert _sorted_rows(res2) == rows0  # bit-identical to unfaulted
+    # The WAL replay + watermark closed the crash without a single
+    # duplicate apply.
+    assert dedup.value() == dedup_before
+    # The broker saw the restart as a restart, not a plain reconnect.
+    hv = broker.tracker.health_view()["pem1"]
+    assert hv["restarts"] >= 1
+    assert hv["health"]["recovery"]["restarted"] is True
+
+
+def test_restarted_agent_handles_reoffers_exactly_once(tmp_path):
+    """Durable query markers across restart: ``done`` → the re-offer is
+    dropped (the WAL replay already completed the query), ``started`` →
+    structured refusal (partial output may be applied), never
+    re-execution."""
+    wal_dir = str(tmp_path)
+    s = AgentDurableState(wal_dir, "pem9")
+    s.save_epoch(3)
+    s.mark_started("q-done")
+    s.mark_done("q-done")
+    s.mark_started("q-partial")
+    s.close()
+
+    bus = MessageBus()
+    agent = Agent(
+        "pem9", bus, BridgeRouter(), table_store=_make_store(0),
+        wal_dir=wal_dir,
+    )
+    agent.start()
+    try:
+        assert agent.recovery_info["restarted"] is True
+        assert agent._epoch == 4  # continued past the persisted counter
+
+        # done: dropped silently — plan=None would explode if executed.
+        sub_done = bus.subscribe("results/q-done")
+        bus.publish(
+            agent_topic("pem9"),
+            {"type": "execute_fragment", "query_id": "q-done", "plan": None},
+        )
+        assert sub_done.get(timeout=0.5) is None
+        assert "q-done" not in agent._seen_queries
+
+        # started: structured fragment_error, kind restart_lost.
+        sub_part = bus.subscribe("results/q-partial")
+        bus.publish(
+            agent_topic("pem9"),
+            {
+                "type": "execute_fragment", "query_id": "q-partial",
+                "plan": None,
+            },
+        )
+        msg = sub_part.get(timeout=10)
+        assert msg is not None and msg["type"] == "fragment_error"
+        assert msg["error_kind"] == "restart_lost"
+        assert "q-partial" not in agent._seen_queries
+    finally:
+        agent.stop()
+
+
+def test_tracker_restart_supersedes_zombie_and_reoffers_once(monkeypatch):
+    """Satellite: same agent_id with a bumped epoch after a dead
+    heartbeat window supersedes the zombie entry and triggers the launch
+    re-offer exactly once (reason=restart); a straggler heartbeat from
+    the dead incarnation cannot resurrect it."""
+    monkeypatch.setattr(broker_mod, "AGENT_EXPIRY_S", 0.3)
+    bus = MessageBus()
+    broker = QueryBroker(bus, BridgeRouter(), table_relations=TABLES)
+    try:
+        calls = []
+        broker.tracker.add_register_listener(
+            lambda aid, epoch, restarted: calls.append(
+                (aid, epoch, restarted)
+            )
+        )
+        bus.publish(
+            AGENT_STATUS_TOPIC,
+            {"type": "register", "agent_id": "pemZ", "epoch": 5,
+             "is_kelvin": False, "tables": ["http_events"]},
+        )
+        deadline = time.monotonic() + 10
+        while ("pemZ", 5, False) not in calls:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        # Dead heartbeat window: the zombie has expired from planning.
+        with broker.tracker._lock:
+            broker.tracker._agents["pemZ"]["last_seen"] -= 5.0
+        assert broker.tracker.expired_among(["pemZ"]) == ["pemZ"]
+
+        # An unacked launch from before the crash.
+        launch = {
+            "type": "execute_fragment", "query_id": "qX", "plan": None,
+        }
+        with broker._launch_lock:
+            broker._inflight_launches["pemZ"] = {"qX": launch}
+        sub = bus.subscribe(agent_topic("pemZ"))
+        reoffers = metrics_registry().counter(
+            "broker_launch_reoffers_total"
+        )
+        before = reoffers.value(reason="restart")
+
+        bus.publish(
+            AGENT_STATUS_TOPIC,
+            {"type": "register", "agent_id": "pemZ", "epoch": 6,
+             "is_kelvin": False, "tables": ["http_events"],
+             "restarted": True},
+        )
+        got = sub.get(timeout=10)
+        assert got == launch
+        assert sub.get(timeout=0.3) is None  # exactly once
+        assert reoffers.value(reason="restart") == before + 1
+        deadline = time.monotonic() + 10
+        while ("pemZ", 6, True) not in calls:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+
+        hv = broker.tracker.health_view()["pemZ"]
+        assert hv["epoch"] == 6 and hv["restarts"] == 1
+
+        # Zombie straggler: a buffered heartbeat with the dead epoch.
+        bus.publish(
+            AGENT_STATUS_TOPIC,
+            {"type": "heartbeat", "agent_id": "pemZ", "epoch": 5,
+             "is_kelvin": False, "tables": [], "ts": 0.0},
+        )
+        time.sleep(0.2)
+        hv = broker.tracker.health_view()["pemZ"]
+        assert hv["epoch"] == 6 and hv["restarts"] == 1
+    finally:
+        broker.stop()
+
+
+# -- resident-ring restart recovery (device mesh) -----------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices("cpu"))
+    assert devs.size == 8, "conftest must provide 8 virtual devices"
+    return Mesh(devs, ("d",))
+
+
+RING_REL_COLS = (
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("service", S),
+    ("resp_status", I),
+    ("latency", F),
+)
+RING_N = 20_000
+RING_WINDOW = 4096
+
+RING_AGG = (
+    "df = px.DataFrame(table='http_events')\n"
+    "s = df.groupby(['service']).agg(\n"
+    "    n=('latency', px.count), total=('latency', px.sum))\n"
+    "px.display(s, 'out')\n"
+)
+
+
+def _ring_data(n=RING_N, seed=7):
+    rng = np.random.default_rng(seed)
+    return {
+        "time_": np.arange(n) * 10**6,
+        "service": rng.choice(["a", "b", "c"], n).astype(object),
+        "resp_status": rng.choice([200, 400, 500], n, p=[0.8, 0.1, 0.1]),
+        # Integer-valued: sums are exact, rows compare bit-equal.
+        "latency": rng.integers(1, 100, n).astype(np.float64),
+    }
+
+
+def _write_all(t, data, n=RING_N):
+    for off in range(0, n, 2048):
+        t.write_pydict({k: v[off : off + 2048] for k, v in data.items()})
+    t.compact()
+    t.stop()
+
+
+def _ring_carnot(mesh, data, restore=False):
+    """restore=False: the pre-crash process (table created through the
+    Carnot listener, ring fed by live appends). restore=True: the
+    restarted process — the embedder rebuilds the table store FIRST,
+    then the agent's recovery sweep attaches rings that recover from
+    the spill (no append replay)."""
+    from pixie_tpu.engine import Carnot
+    from pixie_tpu.parallel import MeshExecutor
+
+    rel = Relation.of(*RING_REL_COLS)
+    if restore:
+        store = TableStore()
+        _write_all(store.create_table("http_events", rel), data)
+        c = Carnot(
+            table_store=store,
+            device_executor=MeshExecutor(mesh=mesh, block_rows=512),
+        )
+        recovered = 0
+        for t in c.table_store.tables():  # agent._recover's sweep
+            ring = c.device_executor.enable_resident_ingest(t)
+            if ring is not None:
+                recovered += ring.recovered_windows
+        return c, recovered
+    c = Carnot(device_executor=MeshExecutor(mesh=mesh, block_rows=512))
+    _write_all(c.table_store.create_table("http_events", rel), data)
+    return c, 0
+
+
+def _agg_rows(c):
+    r = c.execute_query(RING_AGG)
+    out = r.table("out")
+    d = {k: np.asarray(out[k]) for k in ("service", "n", "total")}
+    order = np.argsort(d["service"])
+    return [tuple(d[k][order].tolist()) for k in ("service", "n", "total")]
+
+
+@pytest.fixture
+def ring_flags(flagset, tmp_path):
+    wal_dir = str(tmp_path / "wal")
+    flagset("resident_ingest", True)
+    flagset("resident_window_rows", RING_WINDOW)
+    flagset("durable_resident", True)
+    flagset("wal_dir", wal_dir)
+    return wal_dir
+
+
+def test_ring_restart_restages_windows_first_query_hits(mesh, ring_flags):
+    """THE mid-ingest acceptance: the ring's staged windows die with the
+    process; the restarted agent re-stages them from the spill — the
+    FIRST post-restart query's stage_resident_hits matches the pre-crash
+    ring depth, with zero append replay, rows bit-identical."""
+    from pixie_tpu.parallel.staging import reset_cold_profile
+
+    data = _ring_data()
+    c1, _ = _ring_carnot(mesh, data)
+    snap1 = c1.device_executor._resident.snapshot()["http_events"]
+    assert snap1["windows"] == 4  # 20000 rows / 4096
+    assert snap1["spill_bytes"] > 0
+    rows1 = _agg_rows(c1)
+
+    # Crash c1 (no cleanup); restart with the table store restored.
+    c2, recovered = _ring_carnot(mesh, data, restore=True)
+    assert recovered == 4
+    snap2 = c2.device_executor._resident.snapshot()["http_events"]
+    assert snap2["windows"] == 4
+    assert snap2["recovered_windows"] == 4
+    assert snap2["buffered_rows"] == snap1["buffered_rows"]
+
+    reset_cold_profile()
+    assert _agg_rows(c2) == rows1  # bit-identical across restart
+    prof = reset_cold_profile()
+    assert prof.get("stage_resident_hits") == 4.0, prof
+
+    # The recovered ring is LIVE, not a read-only relic: appends keep
+    # flowing into windows exactly as before the crash.
+    t = c2.table_store.get_table("http_events")
+    extra = {k: v[:2048] for k, v in _ring_data(seed=11).items()}
+    extra["time_"] = (np.arange(2048) + RING_N) * 10**6
+    t.write_pydict(extra)
+    snap3 = c2.device_executor._resident.snapshot()["http_events"]
+    assert snap3["windows"] == 5  # buffer + append crossed a boundary
+
+
+def test_ring_restart_corrupt_spill_window_degrades(mesh, ring_flags):
+    """``resident.spill_corrupt``: one window record reads back corrupt
+    at recovery — it is skipped (staging covers those rows again), never
+    served; results stay bit-identical."""
+    data = _ring_data()
+    c1, _ = _ring_carnot(mesh, data)
+    rows1 = _agg_rows(c1)
+
+    faults.arm("resident.spill_corrupt", count=1)
+    c2, recovered = _ring_carnot(mesh, data, restore=True)
+    faults.reset()
+    assert recovered == 3  # one skipped, three adopted
+    ring = c2.device_executor._resident.ring_for("http_events")
+    assert ring.spill_corrupt_records == 1
+    assert _agg_rows(c2) == rows1
+
+    # The adopted-state compaction dropped the corrupt record from disk:
+    # a SECOND restart recovers the 3 good windows cleanly.
+    c3, recovered3 = _ring_carnot(mesh, data, restore=True)
+    assert recovered3 == 3
+    assert (
+        c3.device_executor._resident.ring_for(
+            "http_events"
+        ).spill_corrupt_records
+        == 0
+    )
+
+
+def test_ring_torn_spill_write_recovers_prefix(mesh, ring_flags):
+    """``wal.torn_write`` mid-ingest: the spill append dies half-written
+    (the table's listener contract swallows it — the ring stays live);
+    restart recovery truncates at the torn record and adopts only the
+    intact prefix. Degraded recovery, correct answers."""
+    data = _ring_data()
+    faults.arm("wal.torn_write", count=1, after=6)  # tear mid-stream
+    c1, _ = _ring_carnot(mesh, data)
+    faults.reset()
+    rows1 = _agg_rows(c1)
+
+    c2, recovered = _ring_carnot(mesh, data, restore=True)
+    # Everything after the torn record is unreachable: fewer (possibly
+    # zero) windows recover — but nothing wrong is ever served.
+    assert recovered < 4
+    assert _agg_rows(c2) == rows1
+
+
+def test_fresh_table_after_restart_discards_stale_spill(mesh, ring_flags):
+    """A restarted process that recreates the table EMPTY (create-
+    listener path, rows not restored) must not adopt spilled windows for
+    rows the table no longer has — and must scrub them from disk so they
+    can never resurrect against a future table's unrelated rows."""
+    from pixie_tpu.engine import Carnot
+    from pixie_tpu.parallel import MeshExecutor
+    from pixie_tpu.vizier.durability import RingSpill, ring_spill_path
+
+    data = _ring_data()
+    c1, _ = _ring_carnot(mesh, data)
+    assert c1.device_executor._resident.snapshot()["http_events"][
+        "windows"
+    ] == 4
+
+    c2 = Carnot(device_executor=MeshExecutor(mesh=mesh, block_rows=512))
+    rel = Relation.of(*RING_REL_COLS)
+    c2.table_store.create_table("http_events", rel)  # empty: rows lost
+    ring = c2.device_executor._resident.ring_for("http_events")
+    assert ring is not None and ring.recovered_windows == 0
+    st = RingSpill(
+        ring_spill_path(flags.wal_dir, "http_events")
+    ).recover()
+    assert st["windows"] == {}  # stale state scrubbed, not lingering

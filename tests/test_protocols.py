@@ -328,6 +328,41 @@ def test_http_close_delimited_not_applied_to_204():
     assert recs[0].resp.body_size == 0
 
 
+def test_http_304_with_content_length_not_swallowing_next():
+    """304 is bodiless even WITH Content-Length (RFC 7230 §3.3.3 —
+    servers send it to describe the would-be entity): the next pipelined
+    response must not be consumed as its body."""
+    t = ConnTracker(http.HttpParser(), role=TraceRole.CLIENT)
+    t.add_send(0, _req("/cached") + _req("/fresh"), 10)
+    not_modified = (
+        b"HTTP/1.1 304 Not Modified\r\nContent-Length: 4096\r\n"
+        b"Etag: \"v1\"\r\n\r\n"
+    )
+    t.add_recv(0, not_modified + _resp(200, b"fresh-body"), 20)
+    recs = t.process_to_records()
+    assert len(recs) == 2
+    assert recs[0].resp.resp_status == 304
+    assert recs[0].resp.body_size == 0
+    assert recs[1].resp.body == "fresh-body"
+
+
+def test_http_head_with_chunked_encoding_not_swallowing_next():
+    """A HEAD response advertising Transfer-Encoding: chunked still has
+    no body — the method FIFO must skip the chunked parser entirely, or
+    the next response's bytes would be read as chunk framing."""
+    t = ConnTracker(http.HttpParser(), role=TraceRole.CLIENT)
+    t.add_send(0, b"HEAD /x HTTP/1.1\r\nHost: h\r\n\r\n" + _req("/y"), 10)
+    head_resp = (
+        b"HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\n"
+    )
+    t.add_recv(0, head_resp + _resp(200, b"yy"), 20)
+    recs = t.process_to_records()
+    assert len(recs) == 2
+    assert recs[0].req.req_method == "HEAD"
+    assert recs[0].resp.body_size == 0
+    assert recs[1].resp.body == "yy"
+
+
 def test_conn_tracker_interleaved_rounds():
     """Records appear incrementally as bytes arrive; leftovers carry over."""
     t = ConnTracker(http.HttpParser(), role=TraceRole.CLIENT)

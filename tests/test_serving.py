@@ -617,7 +617,7 @@ def test_reconnect_gap_launch_reoffered_on_reregister(cluster):
     when the agent re-registers — the query completes clean."""
     broker, agents, bus = cluster
     reoffers = metrics_registry().counter("broker_launch_reoffers_total")
-    before = reoffers.value()
+    before = reoffers.value(reason="reconnect")
     pem1 = agents[0]
     pem1._sub.unsubscribe()  # the reconnect gap: deaf to launches
     holder = {}
@@ -642,7 +642,7 @@ def test_reconnect_gap_launch_reoffered_on_reregister(cluster):
         [b for b in res.tables["out"] if b.num_rows]
     ).to_pydict()
     assert sum(rows["n"]) == 8000  # both shards, including the gapped one
-    assert reoffers.value() > before
+    assert reoffers.value(reason="reconnect") > before
 
 
 def test_agent_dedups_reoffered_launch(cluster):

@@ -20,9 +20,15 @@ bookkeeping costs <1% when DISABLED (``transport_ack_window=0``). Method:
    the window-disabled plane (that configuration IS the r9-equivalent
    hot path plus the ack bookkeeping branches).
 
+Also gates (r14) the durability spill hooks: <1% modeled on the acked
+RTT with durability DISABLED (bare ``wal is None`` branches; the warm
+query path has zero durability hooks), and reports the enabled cost per
+``wal_fsync`` policy ('always' fsyncs every windowed frame; 'never'
+rides the page cache — crash-safe, not powerloss-safe).
+
 Prints ONE JSON line on stdout. With MB_WRITE_BENCH_DETAIL=1, merges the
-headline numbers into BENCH_DETAIL.json under the ``fault_overhead`` and
-``ack_overhead`` keys.
+headline numbers into BENCH_DETAIL.json under the ``fault_overhead``,
+``ack_overhead``, ``trace_overhead`` and ``durability_overhead`` keys.
 
 Env knobs: MB_ROWS (default 200k), MB_WARM_RUNS (default 20),
 MB_RTT_MSGS (default 400), MB_THRPT_MSGS (default 2000), JAX_PLATFORMS.
@@ -46,6 +52,7 @@ SITES = (
     "transport.ack_drop",
     "transport.replay_dup",
     "transport.conn_kill_midflight",
+    "transport.crash_restart",
     "agent.heartbeat",
     "agent.execute",
     "agent.execute_hang",
@@ -53,6 +60,10 @@ SITES = (
     "datastore.append",
     "staging.pack",
     "pipeline.fold",
+    "wal.torn_write",
+    "resident.spill_corrupt",
+    "serving.admission_reject",
+    "serving.evict_pinned_attempt",
 )
 
 
@@ -315,6 +326,83 @@ def main() -> None:
         f"{trace_overhead['rtt_enabled_delta_pct']:+.2f}% rtt"
     )
 
+    # -- durability spill overhead (r14) -------------------------------------
+    # Disabled gate: with no WAL attached, every durability hook on the
+    # send/ack path is a bare ``wal is None`` attribute branch —
+    # _AckWindow.add (wal check + mem-frame spill decision) and the ack
+    # release (wal check). The warm QUERY path has zero durability
+    # hooks (ring spill checks sit on the ingest path, not the staged
+    # read path). Modeled like the fault gate: branches/op * branch_ns
+    # / op_ns. Enabled cost: the same RTT with a live WAL under each
+    # fsync policy — 'always' pays the fsync on every windowed frame,
+    # 'never' pays only the write+flush (crash-safe, not powerloss-safe).
+    import tempfile
+
+    def _branch_ns(iters: int = 1_000_000) -> float:
+        holder = type("H", (), {"w": None})()
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            if holder.w is not None:
+                raise AssertionError
+        return (time.perf_counter_ns() - t0) / iters
+
+    branch_ns = _branch_ns()
+    dur_branches_per_rtt = 3.0  # add: wal + spill-bound; release: wal
+    dur_disabled_pct = 100.0 * dur_branches_per_rtt * branch_ns / rtt_idle_ns
+
+    wal_tmp = tempfile.mkdtemp(prefix="mb-wal-")
+
+    def rtt_wal(policy: str, n: int) -> float:
+        saved_fs = flags.get("wal_fsync")
+        flags.set("wal_fsync", policy)
+        try:
+            rb = RemoteBus(
+                server.address, wal_dir=os.path.join(wal_tmp, policy)
+            )
+            subw = bus.subscribe(f"mb/dur-{policy}")
+
+            def go(k):
+                t0 = time.perf_counter_ns()
+                for i in range(k):
+                    rb.publish(f"mb/dur-{policy}", {"i": i})
+                    got = subw.get(timeout=5.0)
+                    assert got is not None
+                return (time.perf_counter_ns() - t0) / k
+
+            go(50)
+            out = go(n)
+            rb.close()
+            return out
+        finally:
+            flags.set("wal_fsync", saved_fs)
+
+    rtt_dur_always_ns = rtt_wal("always", rtt_msgs)
+    rtt_dur_never_ns = rtt_wal("never", rtt_msgs)
+    durability_overhead = {
+        "dur_branch_ns": round(branch_ns, 2),
+        "disabled_branches_per_rtt": dur_branches_per_rtt,
+        "warm_disabled_checks_per_query": 0,  # no hook on the read path
+        "disabled_modeled_pct": round(dur_disabled_pct, 5),
+        "rtt_disabled_us": round(rtt_idle_ns / 1e3, 2),
+        "rtt_wal_fsync_always_us": round(rtt_dur_always_ns / 1e3, 2),
+        "rtt_wal_fsync_never_us": round(rtt_dur_never_ns / 1e3, 2),
+        "fsync_always_delta_pct": round(
+            100.0 * (rtt_dur_always_ns - rtt_idle_ns) / rtt_idle_ns, 2
+        ),
+        "fsync_never_delta_pct": round(
+            100.0 * (rtt_dur_never_ns - rtt_idle_ns) / rtt_idle_ns, 2
+        ),
+        "pass_under_1pct": bool(dur_disabled_pct < 1.0),
+    }
+    log(
+        f"durability: disabled modeled {dur_disabled_pct:.5f}%, rtt "
+        f"{durability_overhead['rtt_disabled_us']}us off vs "
+        f"{durability_overhead['rtt_wal_fsync_never_us']}us fsync=never "
+        f"({durability_overhead['fsync_never_delta_pct']:+.1f}%) vs "
+        f"{durability_overhead['rtt_wal_fsync_always_us']}us fsync=always "
+        f"({durability_overhead['fsync_always_delta_pct']:+.1f}%)"
+    )
+
     server.stop()
     ack_overhead = {
         "rtt_ack_us": round(rtt_idle_ns / 1e3, 2),
@@ -354,11 +442,13 @@ def main() -> None:
             and rtt_overhead_pct < 1.0
             and ack_overhead["pass_under_1pct"]
             and trace_overhead["pass_under_1pct"]
+            and durability_overhead["pass_under_1pct"]
         ),
         "platform": jax.devices()[0].platform,
     }
     out["ack_overhead"] = ack_overhead
     out["trace_overhead"] = trace_overhead
+    out["durability_overhead"] = durability_overhead
     print(json.dumps(out))
 
     if os.environ.get("MB_WRITE_BENCH_DETAIL") == "1":
@@ -368,16 +458,19 @@ def main() -> None:
         detail["fault_overhead"] = {
             k: v
             for k, v in out.items()
-            if k not in ("ack_overhead", "trace_overhead")
+            if k not in (
+                "ack_overhead", "trace_overhead", "durability_overhead"
+            )
         }
         detail["ack_overhead"] = ack_overhead
         detail["trace_overhead"] = trace_overhead
+        detail["durability_overhead"] = durability_overhead
         with open(path, "w") as f:
             json.dump(detail, f, indent=1)
             f.write("\n")
         log(
             "BENCH_DETAIL.json updated (fault_overhead, ack_overhead, "
-            "trace_overhead)"
+            "trace_overhead, durability_overhead)"
         )
 
     if not out["pass_under_1pct"]:
