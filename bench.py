@@ -367,7 +367,11 @@ def main() -> None:
         f"@{flags.staging_codec_min_ratio} "
         f"resident_ingest={flags.resident_ingest} "
         f"resident_window_rows={flags.resident_window_rows} "
-        f"resident_max_windows={flags.resident_max_windows}"
+        f"resident_max_windows={flags.resident_max_windows} "
+        # r15 knobs: query-attributed profiling (thread attribution +
+        # device dispatch/program records + HBM usage snapshots).
+        f"resource_attribution={flags.resource_attribution} "
+        f"hbm_snapshot_interval_s={flags.hbm_snapshot_interval_s}"
     )
     carnot = Carnot(
         device_executor=MeshExecutor(mesh=mesh, block_rows=block_rows)

@@ -168,7 +168,7 @@ class Carnot:
         # about itself (ref: stirling_error/probe_status dogfooding).
         # Created eagerly so the compiler sees their relations; rows land
         # on demand (execute_plan flush) or via the ingest connector.
-        if flags.query_tracing:
+        if flags.query_tracing or flags.resource_attribution:
             from pixie_tpu.ingest.self_telemetry import ensure_tables
 
             ensure_tables(self.table_store)
@@ -217,18 +217,21 @@ class Carnot:
             "query", trace_id=qid, parent_id="", instance=self.instance
         )
         t0 = time.perf_counter_ns()
-        with trace.context_of(root):
-            with trace.span("compile", instance=self.instance):
-                plan = self.compiler.compile(
-                    query,
-                    self.table_store.relation_map(),
-                    now_ns=now_ns,
-                    script_args=script_args,
-                    query_id=qid,
-                    exec_funcs=exec_funcs,
-                )
-            compile_ns = time.perf_counter_ns() - t0
-            result = self.execute_plan(plan, analyze=analyze)
+        # r15: a standalone engine attributes its own CPU/device work to
+        # the query (the broker/agent paths set their own attribution).
+        with trace.attribution(qid, "default", "query"):
+            with trace.context_of(root):
+                with trace.span("compile", instance=self.instance):
+                    plan = self.compiler.compile(
+                        query,
+                        self.table_store.relation_map(),
+                        now_ns=now_ns,
+                        script_args=script_args,
+                        query_id=qid,
+                        exec_funcs=exec_funcs,
+                    )
+                compile_ns = time.perf_counter_ns() - t0
+                result = self.execute_plan(plan, analyze=analyze)
         result.compile_time_ns = compile_ns
         if root is not None:
             trace.finish(root)
@@ -278,7 +281,7 @@ class Carnot:
         # spans/metric samples flushed in before sources open — PxL can
         # profile a query that finished microseconds ago without waiting
         # for the periodic ingest connector.
-        if flags.query_tracing:
+        if flags.query_tracing or flags.resource_attribution:
             from pixie_tpu.ingest import self_telemetry
 
             if self_telemetry.plan_reads_telemetry(plan):

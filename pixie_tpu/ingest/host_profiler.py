@@ -30,6 +30,9 @@ import numpy as np
 from pixie_tpu.ingest.perf_profiler import STACK_TRACES_REL
 from pixie_tpu.ingest.source_connector import DataTable, SourceConnector
 from pixie_tpu.table.column import _fnv1a64
+from pixie_tpu.utils import trace
+
+_NO_ATTR = ("", "", "")
 
 
 def _fold_python_frame(frame) -> str:
@@ -45,13 +48,28 @@ def _fold_python_frame(frame) -> str:
     return ";".join(reversed(parts))
 
 
-def sample_own_python_stacks() -> dict[str, int]:
-    """One sample of every live Python thread's stack -> {folded: 1}."""
-    out: dict[str, int] = {}
-    for frames in sys._current_frames().values():
+def sample_own_python_stacks(
+    skip_ident: "int | None" = None,
+) -> "dict[tuple, int]":
+    """One sample of every live Python thread's stack ->
+    {(folded, query_id, tenant, phase): 1}.
+
+    Attribution (r15): ``sys._current_frames()`` is keyed by thread
+    ident, and so is the thread-attribution registry in utils/trace.py —
+    a thread sampled while inside a ``trace.attribution(...)`` scope
+    (broker/agent execute paths, pack/encode/compile workers via
+    ``trace.attributed``) labels its stack with the query it was
+    serving; everything else samples with empty attribution, exactly as
+    before."""
+    attrs = trace.thread_attributions()
+    out: "dict[tuple, int]" = {}
+    for tid, frames in sys._current_frames().items():
+        if tid == skip_ident:
+            continue
         folded = _fold_python_frame(frames)
         if folded:
-            out[folded] = out.get(folded, 0) + 1
+            key = (folded,) + attrs.get(tid, _NO_ATTR)
+            out[key] = out.get(key, 0) + 1
     return out
 
 
@@ -87,22 +105,35 @@ class HostProfilerConnector(SourceConnector):
     sample_period_s = 0.01  # ~100Hz, the reference's default headroom
     push_period_s = 0.5
 
-    def __init__(self, sample_others: bool = True, max_procs: int = 64):
+    def __init__(
+        self,
+        sample_others: bool = True,
+        max_procs: int = 64,
+        skip_self: bool = False,
+    ):
+        """``skip_self`` excludes the thread CALLING sample() from its
+        own samples (a dedicated sampling thread observing the process
+        should not profile the observer; default off — the r5 contract
+        where an in-thread sample sees its own stack is unchanged)."""
         super().__init__()
         self.tables = [DataTable("stack_traces.beta", STACK_TRACES_REL)]
-        self._counts: dict[tuple[str, str], int] = {}
+        # (upid, folded, query_id, tenant, phase) -> sample count
+        self._counts: dict[tuple, int] = {}
         self._lock = threading.Lock()
         self._own_upid = f"1:{os.getpid()}:1"
         self._sample_others = sample_others
         self._max_procs = max_procs
+        self._skip_self = skip_self
         self._last_ticks: dict[int, int] = {}
 
     # -- the sample step (called by the ingest core at sample_period) -------
     def sample(self) -> None:
-        own = sample_own_python_stacks()
+        own = sample_own_python_stacks(
+            threading.get_ident() if self._skip_self else None
+        )
         with self._lock:
-            for folded, c in own.items():
-                key = (self._own_upid, folded)
+            for (folded, qid, tenant, phase), c in own.items():
+                key = (self._own_upid, folded, qid, tenant, phase)
                 self._counts[key] = self._counts.get(key, 0) + c
         if self._sample_others:
             self._sample_other_processes()
@@ -124,7 +155,8 @@ class HostProfilerConnector(SourceConnector):
             folded = _read_proc_stack(pid)
             if not folded:
                 continue
-            key = (f"1:{pid}:1", folded)
+            # Other processes are outside the engine: no attribution.
+            key = (f"1:{pid}:1", folded, "", "", "")
             with self._lock:
                 self._counts[key] = self._counts.get(key, 0) + (
                     ticks - prev
@@ -141,11 +173,15 @@ class HostProfilerConnector(SourceConnector):
             return
         now = time.time_ns()
         upids, stacks, ids, cnts = [], [], [], []
-        for (upid, folded), c in counts.items():
+        qids, tenants, phases = [], [], []
+        for (upid, folded, qid, tenant, phase), c in counts.items():
             upids.append(upid)
             stacks.append(folded)
             ids.append(np.int64(_fnv1a64(folded) >> np.uint64(1)))
             cnts.append(c)
+            qids.append(qid)
+            tenants.append(tenant)
+            phases.append(phase)
         n = len(upids)
         self.tables[0].append_columns(
             {
@@ -154,5 +190,8 @@ class HostProfilerConnector(SourceConnector):
                 "stack_trace_id": np.array(ids, np.int64),
                 "stack_trace": np.array(stacks, dtype=object),
                 "count": np.array(cnts, np.int64),
+                "query_id": np.array(qids, dtype=object),
+                "tenant": np.array(tenants, dtype=object),
+                "phase": np.array(phases, dtype=object),
             }
         )

@@ -22,12 +22,19 @@ from pixie_tpu.types import DataType, Relation, SemanticType
 
 I, S, T = DataType.INT64, DataType.STRING, DataType.TIME64NS
 
+# r15: the reference schema plus query-attribution columns — a sampled
+# stack taken while its thread worked on behalf of a query (the
+# thread-ambient attribution registry in utils/trace.py) carries that
+# query's id/tenant/phase; unattributed stacks carry "".
 STACK_TRACES_REL = Relation.of(
     ("time_", T, SemanticType.ST_TIME_NS),
     ("upid", S, SemanticType.ST_UPID),
     ("stack_trace_id", I),
     ("stack_trace", S),
     ("count", I),
+    ("query_id", S),
+    ("tenant", S),
+    ("phase", S),
 )
 
 # A small synthetic call forest in folded format (semicolon-separated,
@@ -95,6 +102,8 @@ class PerfProfilerConnector(SourceConnector):
             rows_id.append(self.stack_ids[nz])
             rows_s.append(self.stacks[nz])
             rows_c.append(counts[nz].astype(np.int64))
+        n = sum(len(r) for r in rows_t)
+        empty = np.full(n, "", dtype=object)
         self.tables[0].append_columns(
             {
                 "time_": np.concatenate(rows_t),
@@ -102,5 +111,9 @@ class PerfProfilerConnector(SourceConnector):
                 "stack_trace_id": np.concatenate(rows_id),
                 "stack_trace": np.concatenate(rows_s),
                 "count": np.concatenate(rows_c),
+                # Synthetic stacks have no owning query.
+                "query_id": empty,
+                "tenant": empty,
+                "phase": empty,
             }
         )

@@ -14,11 +14,24 @@ tables land on every node:
                   `transport_dedup_dropped_total` and friends are one
                   PxL filter away.
 
+plus the resource-attribution plane (r15, parallel/profiler.py +
+serving/residency.py + vizier/slo.py):
+
+  device_programs   compiled device programs: signature hash, unit kind,
+                    XLA cost analysis (flops/bytes accessed), compile s.
+  device_dispatches per-dispatch device wall time and staged/wire bytes,
+                    attributed to (query_id, tenant, phase).
+  hbm_usage         residency-pool snapshots: pool totals + per-table
+                    staged/pinned/ring bytes vs budget.
+  alerts            SLO rule transitions (firing/ok) with the observed
+                    value, threshold, and window.
+
 Two consumption paths share ``flush_into``: the periodic
 SelfTelemetrySourceConnector (registered in an IngestCore, cadence
 ``self_telemetry_interval_s``) for PEM deployments, and an on-demand
-flush in Carnot.execute_plan when a plan reads either table — a query
-that finished microseconds ago is immediately profilable.
+flush in Carnot.execute_plan when a plan reads any of these tables — a
+query that finished microseconds ago is immediately profilable, and a
+distributed query over them sees every node's freshest rows.
 """
 
 from __future__ import annotations
@@ -72,13 +85,71 @@ ENGINE_METRICS_REL = Relation.of(
     ("value", F),
 )
 
+DEVICE_PROGRAMS_TABLE = "device_programs"
+DEVICE_DISPATCHES_TABLE = "device_dispatches"
+HBM_USAGE_TABLE = "hbm_usage"
+ALERTS_TABLE = "alerts"
+
+DEVICE_PROGRAMS_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("program", S),  # kind:contenthash (parallel/profiler.program_name)
+    ("kind", S),     # init | fold | merge | fin | decode | ...
+    ("flops", F),
+    ("bytes_accessed", F),
+    ("compile_seconds", F),
+)
+
+DEVICE_DISPATCHES_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("query_id", S),
+    ("tenant", S),
+    ("phase", S),
+    ("kind", S),  # fold | stream_fold | stream_window
+    ("program", S),
+    ("duration_ns", I),
+    ("rows", I),
+    ("staged_bytes", I),
+    ("wire_bytes", I),
+)
+
+HBM_USAGE_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("scope", S),  # pool | table
+    ("name", S),   # "" for the pool row, else the table name
+    ("used_bytes", I),
+    ("pinned_bytes", I),
+    ("resident_bytes", I),
+    ("budget_bytes", I),
+    ("entries", I),
+)
+
+ALERTS_REL = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("rule", S),
+    ("state", S),  # firing | ok
+    ("severity", S),
+    ("value", F),
+    ("threshold", F),
+    ("tenant", S),
+    ("window_s", F),
+    ("detail", S),
+)
+
+_ALL_TABLES = (
+    (QUERY_SPANS_TABLE, QUERY_SPANS_REL),
+    (ENGINE_METRICS_TABLE, ENGINE_METRICS_REL),
+    (DEVICE_PROGRAMS_TABLE, DEVICE_PROGRAMS_REL),
+    (DEVICE_DISPATCHES_TABLE, DEVICE_DISPATCHES_REL),
+    (HBM_USAGE_TABLE, HBM_USAGE_REL),
+    (ALERTS_TABLE, ALERTS_REL),
+)
+
 
 def ensure_tables(store) -> None:
     """Create the self-telemetry tables in a TableStore when missing."""
-    if store.get_table(QUERY_SPANS_TABLE) is None:
-        store.create_table(QUERY_SPANS_TABLE, QUERY_SPANS_REL)
-    if store.get_table(ENGINE_METRICS_TABLE) is None:
-        store.create_table(ENGINE_METRICS_TABLE, ENGINE_METRICS_REL)
+    for name, rel in _ALL_TABLES:
+        if store.get_table(name) is None:
+            store.create_table(name, rel)
 
 
 def plan_reads_telemetry(plan) -> bool:
@@ -86,13 +157,11 @@ def plan_reads_telemetry(plan) -> bool:
     table (the on-demand flush trigger in Carnot.execute_plan)."""
     from pixie_tpu.plan.operators import MemorySourceOp
 
+    names = {name for name, _ in _ALL_TABLES}
     for frag in plan.fragments:
         for nid in frag.nodes():
             op = frag.node(nid)
-            if isinstance(op, MemorySourceOp) and op.table_name in (
-                QUERY_SPANS_TABLE,
-                ENGINE_METRICS_TABLE,
-            ):
+            if isinstance(op, MemorySourceOp) and op.table_name in names:
                 return True
     return False
 
@@ -148,11 +217,76 @@ def metrics_to_columns(now_ns: int) -> dict:
     }
 
 
+def _rows_to_columns(rows: list, relation) -> dict:
+    """Profiler/alert row dicts -> column dict for ``relation``. Rows
+    carry ``time_ns``; every other relation column maps by name, with a
+    type-appropriate default for missing keys."""
+    out = {}
+    for c in relation:
+        if c.name == "time_":
+            out["time_"] = np.array([r["time_ns"] for r in rows], np.int64)
+        elif c.data_type == DataType.STRING:
+            out[c.name] = np.array(
+                [str(r.get(c.name, "")) for r in rows], dtype=object
+            )
+        elif c.data_type == DataType.FLOAT64:
+            out[c.name] = np.array(
+                [float(r.get(c.name, 0.0)) for r in rows], np.float64
+            )
+        else:
+            out[c.name] = np.array(
+                [int(r.get(c.name, 0)) for r in rows], np.int64
+            )
+    return out
+
+
+def _flush_attribution(store) -> int:
+    """Drain the resource-attribution buffers (parallel/profiler.py)
+    into device_programs/device_dispatches/hbm_usage — forcing one HBM
+    snapshot per registered pool first so the usage series is fresh even
+    when no pool mutation happened since the last flush."""
+    from pixie_tpu.parallel import profiler
+
+    if not profiler.ACTIVE:
+        return 0
+    profiler.sample_pools()
+    written = 0
+    for table, rel, rows in (
+        (DEVICE_PROGRAMS_TABLE, DEVICE_PROGRAMS_REL,
+         profiler.drain_programs()),
+        (DEVICE_DISPATCHES_TABLE, DEVICE_DISPATCHES_REL,
+         profiler.drain_dispatches()),
+        (HBM_USAGE_TABLE, HBM_USAGE_REL, profiler.drain_hbm()),
+    ):
+        if rows:
+            store.get_table(table).write_pydict(
+                _rows_to_columns(rows, rel)
+            )
+            written += len(rows)
+    return written
+
+
+def _flush_alerts(store) -> int:
+    """Drain buffered SLO alert transitions (vizier/slo.py) into the
+    alerts table."""
+    try:
+        from pixie_tpu.vizier import slo
+    except Exception:  # pragma: no cover - slo layer absent
+        return 0
+    rows = slo.drain_alert_rows()
+    if rows:
+        store.get_table(ALERTS_TABLE).write_pydict(
+            _rows_to_columns(rows, ALERTS_REL)
+        )
+    return len(rows)
+
+
 def flush_into(store, include_metrics: bool = True) -> int:
-    """Drain the finished-span buffer (and sample the metrics registry)
-    directly into a TableStore's self-telemetry tables. Returns the
-    number of span rows written. Shared by the on-demand read path and
-    available to embedders that run no IngestCore."""
+    """Drain the finished-span buffer, the resource-attribution buffers,
+    and pending SLO alerts (and sample the metrics registry) directly
+    into a TableStore's self-telemetry tables. Returns the number of
+    span rows written. Shared by the on-demand read path and available
+    to embedders that run no IngestCore."""
     ensure_tables(store)
     written = 0
     spans = trace.drain()
@@ -161,6 +295,9 @@ def flush_into(store, include_metrics: bool = True) -> int:
             spans_to_columns(spans)
         )
         written = len(spans)
+    if flags.resource_attribution:
+        _flush_attribution(store)
+    _flush_alerts(store)
     if include_metrics:
         cols = metrics_to_columns(time.time_ns())
         if len(cols["time_"]):
@@ -185,14 +322,42 @@ class SelfTelemetrySourceConnector(SourceConnector):
         self.push_period_s = period
         super().__init__()
         self.tables = [
-            DataTable(QUERY_SPANS_TABLE, QUERY_SPANS_REL),
-            DataTable(ENGINE_METRICS_TABLE, ENGINE_METRICS_REL),
+            DataTable(name, rel) for name, rel in _ALL_TABLES
         ]
+        self._by_name = {dt.name: dt for dt in self.tables}
 
     def transfer_data_impl(self, ctx) -> None:
         spans = trace.drain()
         if spans:
-            self.tables[0].append_columns(spans_to_columns(spans))
+            self._by_name[QUERY_SPANS_TABLE].append_columns(
+                spans_to_columns(spans)
+            )
+        if flags.resource_attribution:
+            from pixie_tpu.parallel import profiler
+
+            if profiler.ACTIVE:
+                profiler.sample_pools()
+                for table, rel, rows in (
+                    (DEVICE_PROGRAMS_TABLE, DEVICE_PROGRAMS_REL,
+                     profiler.drain_programs()),
+                    (DEVICE_DISPATCHES_TABLE, DEVICE_DISPATCHES_REL,
+                     profiler.drain_dispatches()),
+                    (HBM_USAGE_TABLE, HBM_USAGE_REL, profiler.drain_hbm()),
+                ):
+                    if rows:
+                        self._by_name[table].append_columns(
+                            _rows_to_columns(rows, rel)
+                        )
+        try:
+            from pixie_tpu.vizier import slo
+
+            rows = slo.drain_alert_rows()
+        except Exception:  # pragma: no cover - slo layer absent
+            rows = []
+        if rows:
+            self._by_name[ALERTS_TABLE].append_columns(
+                _rows_to_columns(rows, ALERTS_REL)
+            )
         cols = metrics_to_columns(time.time_ns())
         if len(cols["time_"]):
-            self.tables[1].append_columns(cols)
+            self._by_name[ENGINE_METRICS_TABLE].append_columns(cols)

@@ -82,6 +82,7 @@ from pixie_tpu.table.column import DictColumn, StringDictionary
 from pixie_tpu.table.row_batch import RowBatch
 from pixie_tpu.types import DataType
 from pixie_tpu.udf.udf import Executor, MergeKind
+from pixie_tpu.parallel import profiler as resattr
 from pixie_tpu.utils import faults, flags, metrics_registry, trace
 
 _M = metrics_registry()
@@ -884,6 +885,13 @@ class MeshExecutor:
                         "device.execute",
                         elapsed_ns,
                         attrs={"program_key": bkey[:120]},
+                    )
+                if resattr.ACTIVE:
+                    # r15: the offload as one attributed dispatch row —
+                    # joins device wall time to the ambient
+                    # (query_id, tenant) in device_dispatches.
+                    resattr.record_dispatch(
+                        "fold", elapsed_ns / 1e9, program=bkey[:120]
                     )
             return out
         except Exception as e:
@@ -2917,6 +2925,12 @@ class MeshExecutor:
         if entry is None or entry[1] != n_aux:
             self._program_cache[sig] = (build(), n_aux, None)
             _PROGRAMS.set(len(self._program_cache))
+            if resattr.ACTIVE:
+                # r15: every distinct program unit enters the
+                # device_programs registry at build time; the AOT worker
+                # enriches it with XLA cost analysis once a Compiled
+                # exists.
+                resattr.record_program(sig)
         return self._program_cache[sig][0]
 
     def _unit_programs(
@@ -3018,17 +3032,28 @@ class MeshExecutor:
                 self.mesh.devices.flat[0].platform
             ):
                 compiled = self._aot_lower_compile(program, avals)
+            compile_s = time.perf_counter() - t0
             COLD_PROFILE[profile_key] = COLD_PROFILE.get(
                 profile_key, 0.0
-            ) + (time.perf_counter() - t0)
+            ) + compile_s
             if _PERSISTENT_CACHE_HITS[0] > hits0:
                 COLD_PROFILE["compile_cache_hit"] = COLD_PROFILE.get(
                     "compile_cache_hit", 0.0
                 ) + 1.0
+            if resattr.ACTIVE:
+                # r15: the Compiled carries XLA cost analysis — flops +
+                # bytes accessed land in the device_programs registry
+                # alongside the measured compile seconds.
+                resattr.record_program(
+                    sig, compile_s=compile_s, compiled=compiled
+                )
             self._aot_compiled[sig] = compiled
             return compiled
 
-        fut = self._aot_pool.submit(work)
+        # Workers adopt the submitting query's trace context and
+        # resource attribution (r15): compile CPU burned for a query
+        # samples under that query's label.
+        fut = self._aot_pool.submit(trace.attributed(work, phase="compile"))
         self._aot_futures[sig] = fut
         return fut
 
@@ -4110,7 +4135,13 @@ class MeshExecutor:
             args.append(gid_base)
             t0 = time.perf_counter()
             flat_state = list(fold_fn(*args))
-            prof("stage_stream_dispatch", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            prof("stage_stream_dispatch", dt)
+            if resattr.ACTIVE:
+                resattr.record_dispatch(
+                    "stream_fold", dt,
+                    program=resattr.program_name(fold_sig),
+                )
             # Double-buffer backpressure: block on window k-2's fold so
             # at most two windows are in flight (one transferring, one
             # packing) — bounds host-pinned buffers and the device
@@ -4131,10 +4162,14 @@ class MeshExecutor:
         try:
             with _segment.platform_hint(self.mesh.devices.flat[0].platform):
                 flat_state = list(init_p())
-                fut = pool.submit(
-                    _staging.pack_stream_window, plan, cols, gids, 0,
-                    0 in hits,
+                # Pack workers adopt the query's trace context and
+                # attribution (r15): host CPU burned packing windows
+                # samples under this query's label, not as anonymous
+                # pool-thread time.
+                pack_fn = trace.attributed(
+                    _staging.pack_stream_window, phase="pack"
                 )
+                fut = pool.submit(pack_fn, plan, cols, gids, 0, 0 in hits)
                 for w in range(plan.n_windows):
                     t0 = time.perf_counter()
                     rows, packed, pgids, nbytes = fut.result()
@@ -4143,8 +4178,7 @@ class MeshExecutor:
                         # Window w+1 packs on the background thread while
                         # window w transfers and folds.
                         fut = pool.submit(
-                            _staging.pack_stream_window,
-                            plan, cols, gids, w + 1,
+                            pack_fn, plan, cols, gids, w + 1,
                             (w + 1) in hits,
                         )
                     t0 = time.perf_counter()
@@ -4167,15 +4201,23 @@ class MeshExecutor:
                         if pgids is not None
                         else None
                     )
-                    prof("stage_stream_put", time.perf_counter() - t0)
-                    prof(
-                        "stage_bytes",
-                        float(
-                            plan.window_block_nbytes()
-                            + (pgids.nbytes if pgids is not None else 0)
-                        ),
+                    dt_put = time.perf_counter() - t0
+                    prof("stage_stream_put", dt_put)
+                    wbytes = plan.window_block_nbytes() + (
+                        pgids.nbytes if pgids is not None else 0
                     )
+                    prof("stage_bytes", float(wbytes))
                     prof("wire_bytes", float(nbytes))
+                    if resattr.ACTIVE:
+                        # r15: per-window staging row — staged (decoded
+                        # HBM) vs wire (codec-compressed) bytes become
+                        # attributable per query/tenant.
+                        resattr.record_dispatch(
+                            "stream_window", dt_put,
+                            program=resattr.program_name(fold_sig),
+                            rows=rows, staged_bytes=wbytes,
+                            wire_bytes=nbytes,
+                        )
                     if cacheable:
                         win_blocks.append(dev_cols)
                         win_masks.append(mask)

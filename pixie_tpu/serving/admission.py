@@ -50,11 +50,14 @@ _ADMITTED = _M.counter(
     "admission_admitted_total", "Queries admitted, by tenant."
 )
 _REJECTED = _M.counter(
-    "admission_rejected_total", "Queries rejected, by reason."
+    "admission_rejected_total",
+    "Queries rejected, by reason and tenant (r15: per-tenant SLO rules "
+    "get native series; sum across tenants via Counter.total).",
 )
 _WAIT_SECONDS = _M.histogram(
     "admission_wait_seconds",
-    "Time a query spent in the admission queue before grant/rejection.",
+    "Time a query spent in the admission queue before grant/rejection, "
+    "by tenant (aggregate views read Histogram.agg_quantile).",
 )
 _LOCK_WAIT = _M.histogram(
     "admission_lock_wait_seconds",
@@ -245,7 +248,7 @@ class AdmissionController:
                 self._tenant_vtime[tenant] = self._vclock
                 self._publish()
                 _ADMITTED.inc(tenant=tenant)
-                _WAIT_SECONDS.observe(0.0)
+                _WAIT_SECONDS.observe(0.0, tenant=tenant)
                 return _Ticket(self, tenant, 0.0)
             if self._waiting >= self._queue_cap():
                 self._reject(tenant, "queue_full", t0)
@@ -270,7 +273,7 @@ class AdmissionController:
                 self._cv.wait(timeout=remaining)
             waited = time.monotonic() - t0
             _ADMITTED.inc(tenant=tenant)
-            _WAIT_SECONDS.observe(waited)
+            _WAIT_SECONDS.observe(waited, tenant=tenant)
             return _Ticket(self, tenant, waited)
         finally:
             self._cv.release()
@@ -314,8 +317,8 @@ class AdmissionController:
 
     def _reject(self, tenant: str, reason: str, t0: float, detail=""):
         waited = time.monotonic() - t0
-        _REJECTED.inc(reason=reason)
-        _WAIT_SECONDS.observe(waited)
+        _REJECTED.inc(reason=reason, tenant=tenant)
+        _WAIT_SECONDS.observe(waited, tenant=tenant)
         raise AdmissionRejected(
             tenant,
             reason,
@@ -358,10 +361,10 @@ class AdmissionController:
                     for t, v in sorted(self._tenant_vtime.items())
                 },
                 "wait_p50_ms": round(
-                    _WAIT_SECONDS.quantile(0.5) * 1e3, 3
+                    _WAIT_SECONDS.agg_quantile(0.5) * 1e3, 3
                 ),
                 "wait_p99_ms": round(
-                    _WAIT_SECONDS.quantile(0.99) * 1e3, 3
+                    _WAIT_SECONDS.agg_quantile(0.99) * 1e3, 3
                 ),
                 "lock_wait_p99_ms": round(
                     _LOCK_WAIT.quantile(0.99) * 1e3, 3

@@ -39,7 +39,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
-from pixie_tpu.utils import faults, flags, metrics_registry
+from pixie_tpu.utils import faults, flags, metrics_registry, trace
 
 _M = metrics_registry()
 _STAGED_BYTES = _M.gauge(
@@ -128,6 +128,16 @@ class ResidencyPool:
         # pinned — never LRU-evicted, never OOM-cleared; only the ring
         # itself releases them (its own depth bound / table expiry).
         self._resident: dict = {}
+        # HBM usage sampling (r15): pool state lands in the hbm_usage
+        # self-telemetry table at most every hbm_snapshot_interval_s
+        # (mutation-driven) plus a forced sample per telemetry flush.
+        self._last_usage_ns = 0
+        try:
+            from pixie_tpu.parallel import profiler
+
+            profiler.register_pool(self)
+        except Exception:  # pragma: no cover - recorder is advisory
+            pass
 
     # -- configuration (read per call so flag flips apply live) --------------
     def _cap(self) -> int:
@@ -332,6 +342,81 @@ class ResidencyPool:
         _STAGED_BYTES.set(self._used)
         _PINNED_BYTES.set(self._pinned)
         _ENTRIES.set(len(self._entries))
+        if trace.ATTR_ACTIVE:
+            self._sample_usage_locked(force=False)
+
+    # -- HBM usage sampling (r15) --------------------------------------------
+    def sample_usage(self, force: bool = True) -> None:
+        """Take one hbm_usage snapshot (the telemetry flush forces one so
+        the table is fresh even on an idle pool)."""
+        with self._lock:
+            self._sample_usage_locked(force=force)
+
+    def _sample_usage_locked(self, force: bool) -> None:
+        import time
+
+        from pixie_tpu.parallel import profiler
+
+        if not profiler.ACTIVE:
+            return
+        now_ns = time.time_ns()
+        interval_ns = int(float(flags.hbm_snapshot_interval_s) * 1e9)
+        if not force and now_ns - self._last_usage_ns < interval_ns:
+            return
+        self._last_usage_ns = now_ns
+        # Per-table staged bytes/pins (live entries), per-table ring
+        # bytes (resident keys are ("resident", table, window)), plus
+        # one pool-scope summary row whose used/pinned match the
+        # accounting EXACTLY (zombies included — in-flight folds hold
+        # real HBM).
+        per_table: dict[str, dict] = {}
+        for e in self._entries.values():
+            t = per_table.setdefault(
+                e.table_name,
+                {"used": 0, "pinned": 0, "resident": 0, "entries": 0},
+            )
+            t["used"] += e.nbytes
+            t["pinned"] += e.nbytes if e.pins > 0 else 0
+            t["entries"] += 1
+        for key, nbytes in self._resident.items():
+            name = (
+                str(key[1])
+                if isinstance(key, tuple) and len(key) >= 2
+                else str(key)
+            )
+            t = per_table.setdefault(
+                name, {"used": 0, "pinned": 0, "resident": 0, "entries": 0}
+            )
+            t["used"] += nbytes
+            t["pinned"] += nbytes
+            t["resident"] += nbytes
+        budget = self.budget_bytes()
+        rows = [
+            {
+                "time_ns": now_ns,
+                "scope": "pool",
+                "name": "",
+                "used_bytes": self._used,
+                "pinned_bytes": self._pinned,
+                "resident_bytes": sum(self._resident.values()),
+                "budget_bytes": budget,
+                "entries": len(self._entries),
+            }
+        ]
+        for name, t in sorted(per_table.items()):
+            rows.append(
+                {
+                    "time_ns": now_ns,
+                    "scope": "table",
+                    "name": name,
+                    "used_bytes": t["used"],
+                    "pinned_bytes": t["pinned"],
+                    "resident_bytes": t["resident"],
+                    "budget_bytes": budget,
+                    "entries": t["entries"],
+                }
+            )
+        profiler.record_hbm_rows(rows)
 
     # -- observability -------------------------------------------------------
     def snapshot(self) -> dict:

@@ -30,6 +30,21 @@ class _Metric:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
+    def total(self, **labels) -> float:
+        """Sum across every label set MATCHING the given subset (r15):
+        ``rejected.total(tenant="a")`` sums all reasons for one tenant,
+        ``rejected.total()`` sums everything. ``value()`` stays an exact
+        key lookup."""
+        want = set((labels or {}).items())
+        with self._lock:
+            return float(
+                sum(
+                    v
+                    for key, v in self._values.items()
+                    if not isinstance(v, dict) and want <= set(key)
+                )
+            )
+
     def samples(self) -> list[tuple[tuple, float]]:
         with self._lock:
             return sorted(self._values.items())
@@ -117,7 +132,35 @@ class Histogram(_Metric):
             if not st or not st["count"]:
                 return 0.0
             counts = list(st["counts"])
-            total = st["count"]
+        return self.quantile_of_counts(q, counts)
+
+    def merged_counts(self, **labels) -> list[int]:
+        """Per-bucket counts summed across every label set matching the
+        given subset (r15): a tenant-labeled histogram still yields the
+        aggregate distribution (``merged_counts()``) or one tenant's
+        (``merged_counts(tenant="a")``). The SLO evaluator also diffs
+        two of these snapshots to get a WINDOWED distribution."""
+        want = set((labels or {}).items())
+        out = [0] * (len(self.buckets) + 1)
+        with self._lock:
+            for key, st in self._values.items():
+                if not isinstance(st, dict) or not (want <= set(key)):
+                    continue
+                for i, c in enumerate(st["counts"]):
+                    out[i] += c
+        return out
+
+    def agg_quantile(self, q: float, **labels) -> float:
+        """Quantile over the label-merged distribution (the snapshot
+        views that predate per-tenant labels keep reading the aggregate)."""
+        return self.quantile_of_counts(q, self.merged_counts(**labels))
+
+    def quantile_of_counts(self, q: float, counts: list[int]) -> float:
+        """Interpolated quantile of an explicit per-bucket count vector
+        (shared by the live views and the SLO window-delta evaluator)."""
+        total = sum(counts)
+        if not total:
+            return 0.0
         target = q * total
         cum = 0
         for i, c in enumerate(counts):

@@ -72,6 +72,18 @@ define_flag(
     "payload through the engine's pluggable OTel exporter (the "
     "exec/otel_sink_node.py path) in addition to the query_spans table.",
 )
+define_flag(
+    "resource_attribution",
+    True,
+    help_="Continuous resource attribution (r15): threads executing a "
+    "query carry an ambient (query_id, tenant, phase) label, so host "
+    "profiler stack samples, device dispatch records "
+    "(parallel/profiler.py), and HBM usage snapshots attribute CPU, "
+    "device time, and bytes to the query/tenant that caused them. "
+    "Off = attribution contexts and recorders are never entered (<1% "
+    "residual cost, gated by tools/microbench_fault_overhead.py "
+    "``profiler_overhead``).",
+)
 
 _SPAN_SECONDS = metrics_registry().histogram(
     "span_duration_seconds",
@@ -82,6 +94,10 @@ _SPAN_SECONDS = metrics_registry().histogram(
 # tracing is off). Synced with the ``query_tracing`` flag at import and by
 # set_enabled()/refresh().
 ACTIVE = False
+# Resource-attribution gate (r15, flag ``resource_attribution``):
+# identical posture to ACTIVE — every attribution entry point re-checks
+# it and becomes a no-op immediately when off.
+ATTR_ACTIVE = False
 
 _BUF_LOCK = threading.Lock()
 _FINISHED: "collections.deque[Span]" = collections.deque(
@@ -98,10 +114,21 @@ def set_enabled(on: bool) -> None:
     flags.set("query_tracing", bool(on))
 
 
+def set_attribution_enabled(on: bool) -> None:
+    """Flip resource attribution at runtime (also updates the
+    ``resource_attribution`` flag, and the parallel/profiler.py
+    recorders' gate syncs from the same flag on their next refresh)."""
+    global ATTR_ACTIVE
+    ATTR_ACTIVE = bool(on)
+    flags.set("resource_attribution", bool(on))
+
+
 def refresh() -> None:
-    """Re-read the ``query_tracing`` flag into the ACTIVE gate."""
-    global ACTIVE
+    """Re-read the ``query_tracing``/``resource_attribution`` flags into
+    the ACTIVE/ATTR_ACTIVE gates."""
+    global ACTIVE, ATTR_ACTIVE
     ACTIVE = bool(flags.query_tracing)
+    ATTR_ACTIVE = bool(flags.resource_attribution)
 
 
 def new_id() -> str:
@@ -198,6 +225,102 @@ def context_of(span: "Optional[Span]") -> context:
     if span is None:
         return context(None)
     return context(span.trace_id, span.span_id)
+
+
+# -- resource attribution (r15) ----------------------------------------------
+# Thread ident -> (query_id, tenant, phase) for every thread currently
+# doing work on a query's behalf. Unlike the span context stack (which is
+# thread-LOCAL, invisible to other threads), this registry is readable
+# ACROSS threads: the host profiler samples ``sys._current_frames()``,
+# which is keyed by thread ident, and labels each sampled stack with the
+# attribution the owning thread declared. Plain-dict assignment/removal
+# is GIL-atomic, so readers take consistent snapshots without a lock.
+_THREAD_ATTR: dict[int, tuple[str, str, str]] = {}
+
+
+class attribution:
+    """Declare that work on this thread — until exit — runs on behalf of
+    ``(query_id, tenant, phase)``. Nested scopes restore the outer
+    attribution on exit (a broker thread executing a local telemetry
+    query inside an SLO evaluation re-attributes just that inner span of
+    work). No-op when ``resource_attribution`` is off or query_id is
+    empty."""
+
+    __slots__ = ("_ctx", "_ident", "_prev")
+
+    def __init__(self, query_id: Optional[str], tenant: str = "default",
+                 phase: str = ""):
+        self._ctx = (
+            (str(query_id), str(tenant or "default"), str(phase))
+            if ATTR_ACTIVE and query_id
+            else None
+        )
+
+    def __enter__(self):
+        if self._ctx is not None:
+            self._ident = threading.get_ident()
+            self._prev = _THREAD_ATTR.get(self._ident)
+            _THREAD_ATTR[self._ident] = self._ctx
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            if self._prev is None:
+                _THREAD_ATTR.pop(self._ident, None)
+            else:
+                _THREAD_ATTR[self._ident] = self._prev
+        return False
+
+
+def current_attribution() -> Optional[tuple[str, str, str]]:
+    """(query_id, tenant, phase) this thread is working for, or None."""
+    if not ATTR_ACTIVE:
+        return None
+    return _THREAD_ATTR.get(threading.get_ident())
+
+
+def thread_attributions() -> dict[int, tuple[str, str, str]]:
+    """Snapshot of every attributed thread: ident -> (query_id, tenant,
+    phase). The host profiler joins this against sys._current_frames()."""
+    if not ATTR_ACTIVE:
+        return {}
+    return dict(_THREAD_ATTR)
+
+
+def attributed(fn, phase: Optional[str] = None):
+    """Wrap ``fn`` for submission to a worker thread/pool so the worker
+    runs under the SUBMITTING thread's span context and resource
+    attribution — the explicit cross-thread propagation rule (r11) now
+    covering attribution too: pack/encode/compile workers doing a
+    query's work show up in stack samples labeled with that query.
+    ``phase`` overrides the attribution phase for the worker ("pack",
+    "compile"). Returns ``fn`` unchanged when there is nothing to
+    propagate."""
+    if not (ACTIVE or ATTR_ACTIVE):
+        return fn
+    tctx = current()
+    attr = current_attribution()
+    if tctx is None and attr is None:
+        return fn
+
+    def run(*args, **kwargs):
+        if tctx is not None:
+            _push(tctx)
+        scope = None
+        if attr is not None:
+            scope = attribution(
+                attr[0], attr[1], attr[2] if phase is None else phase
+            )
+            scope.__enter__()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            if scope is not None:
+                scope.__exit__(None, None, None)
+            if tctx is not None:
+                _pop()
+
+    return run
 
 
 # -- span lifecycle ----------------------------------------------------------

@@ -363,13 +363,20 @@ class Agent:
                     return
                 if faults.fires_scoped("agent.execute", self.agent_id):
                     raise faults.FaultInjectedError("agent.execute")
-            with trace.context_of(span):
-                result = self.carnot.execute_plan(
-                    plan,
-                    analyze=msg.get("analyze", False),
-                    manage_router=False,
-                    deadline_s=msg.get("deadline_s"),
-                )
+            # r15: this thread (and the pack/compile workers it spawns,
+            # via trace.attributed) works for (query_id, tenant) — host
+            # profiler stack samples and device dispatch records label
+            # themselves with it.
+            with trace.attribution(
+                query_id, msg.get("tenant") or "default", "execute"
+            ):
+                with trace.context_of(span):
+                    result = self.carnot.execute_plan(
+                        plan,
+                        analyze=msg.get("analyze", False),
+                        manage_router=False,
+                        deadline_s=msg.get("deadline_s"),
+                    )
             rows_out = sum(
                 b.num_rows for bs in result.tables.values() for b in bs
             )

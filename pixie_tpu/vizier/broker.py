@@ -55,7 +55,15 @@ _FORWARD_DROPPED = _M.counter(
     "broker.forward).",
 )
 _QUERY_SECONDS = _M.histogram(
-    "broker_query_seconds", "End-to-end broker query latency."
+    "broker_query_seconds",
+    "End-to-end broker query latency, by tenant (r15: per-tenant SLO "
+    "rules get native series; aggregate views read the label-merged "
+    "distribution via Histogram.agg_quantile).",
+)
+_ALERTS_EMITTED = _M.counter(
+    "broker_alert_events_total",
+    "SLO alert events fanned out through the broker's alert listeners, "
+    "by rule and state.",
 )
 _REOFFERS = _M.counter(
     "broker_launch_reoffers_total",
@@ -343,6 +351,32 @@ class QueryBroker:
         self._launch_lock = threading.Lock()
         self._inflight_launches: dict[str, dict[str, dict]] = {}
         self.tracker.add_register_listener(self._reoffer_launches)
+        # SLO/alert plane (r15, vizier/slo.py): an attached SLOManager
+        # (``broker.slo``) feeds /alertz; alert listeners receive every
+        # rule transition as a structured event (same shape family as
+        # the r10 on_event degradation events).
+        self.slo = None
+        self._alert_listeners: list = []
+
+    # -- SLO alert fan-out (r15) --------------------------------------------
+    def add_alert_listener(self, fn) -> None:
+        """Register ``fn(event: dict)`` for SLO alert transitions
+        ({"type": "slo_alert", "rule", "state", "severity", "value",
+        "threshold", "tenant", ...}). Exceptions are logged and
+        swallowed — alerting must never take the broker down."""
+        self._alert_listeners.append(fn)
+
+    def emit_alert(self, event: dict) -> None:
+        """Fan a structured alert event out to every listener (called by
+        the attached SLOManager on each rule transition)."""
+        _ALERTS_EMITTED.inc(
+            rule=event.get("rule", ""), state=event.get("state", "")
+        )
+        for fn in list(self._alert_listeners):
+            try:
+                fn(dict(event))
+            except Exception:
+                _log.exception("alert listener failed (ignored)")
 
     def start_health_server(self, host: str = "127.0.0.1", port: int = 0):
         """Expose the aggregated cluster health view over HTTP (r10):
@@ -372,6 +406,13 @@ class QueryBroker:
             },
             extra_routes={
                 "/agentz": lambda: self.tracker.agents_snapshot(),
+                # r15: live SLO rule + alert status (empty shell when no
+                # SLOManager is attached, so the route always exists).
+                "/alertz": lambda: (
+                    self.slo.status()
+                    if self.slo is not None
+                    else {"rules": [], "active": [], "recent": []}
+                ),
             },
             host=host,
             port=port,
@@ -483,9 +524,11 @@ class QueryBroker:
         structured ``AdmissionRejected`` instead of queueing without
         bound. Flag off: straight through, the pre-r12 behavior."""
         if not flags.serving_enabled:
+            # Tenant still threads through (r15): attribution and the
+            # per-tenant serving metrics don't require admission control.
             return self._execute_script_inner(
                 query, timeout_s, now_ns, script_args, analyze,
-                exec_funcs, on_batch, on_event,
+                exec_funcs, on_batch, on_event, tenant=tenant,
             )
         # may raise AdmissionRejected
         ticket = self.admission.acquire(
@@ -579,7 +622,13 @@ class QueryBroker:
             except Exception:
                 _log.exception("on_event callback failed (ignored)")
         t0 = time.perf_counter_ns()
-        with trace.span(
+        # r15: broker-side CPU (compile + plan) is attributed to the
+        # query/tenant so host-profiler samples of this thread label
+        # themselves; the forwarding loop below mostly blocks and the
+        # agents attribute their own execution.
+        with trace.attribution(
+            qid, tenant or "default", "broker"
+        ), trace.span(
             "compile", trace_id=qid, parent_id=root_span_id,
             instance="broker",
         ):
@@ -593,7 +642,9 @@ class QueryBroker:
             )
         # Plan only over agents inside the heartbeat-expiry window; the
         # skipped list rides the degraded annotation.
-        with trace.span(
+        with trace.attribution(
+            qid, tenant or "default", "broker"
+        ), trace.span(
             "plan", trace_id=qid, parent_id=root_span_id, instance="broker"
         ) as plan_span:
             state, expired_agents = self.tracker.planning_view()
@@ -665,6 +716,9 @@ class QueryBroker:
                 # Trace-context propagation (Dapper): the agent's
                 # execute span parents to the broker's root span.
                 "trace": {"trace_id": qid, "span_id": root_span_id},
+                # Attribution propagation (r15): the agent labels its
+                # execution threads (and their workers) with the tenant.
+                "tenant": tenant or "default",
             }
             # Track BEFORE publishing (r12): if the agent re-registers
             # between our publish and its subscribe, the register
@@ -688,6 +742,10 @@ class QueryBroker:
         # keyed by span_id: in-process agents share this module's buffer,
         # so the final merge dedups instead of double-counting.
         agent_spans: dict[str, dict] = {}
+        # r15: forwarding (receiving/relaying this query's result
+        # batches on the caller's thread) is per-query work too.
+        fwd_attr = trace.attribution(qid, tenant or "default", "forward")
+        fwd_attr.__enter__()
         try:
             while pending:
                 remaining = deadline - time.monotonic()
@@ -771,6 +829,7 @@ class QueryBroker:
                         for bid in bridges_by_instance.get(aid, ()):
                             self.router.unregister_producer(qid, bid)
         finally:
+            fwd_attr.__exit__(None, None, None)
             results_sub.unsubscribe()
             # cleanup_query also tombstones the id: late pushes from
             # still-running fragments are dropped and their polls abort
@@ -840,7 +899,9 @@ class QueryBroker:
             }
             _DEGRADED.inc()
         exec_ns = time.perf_counter_ns() - t1
-        _QUERY_SECONDS.observe((compile_ns + exec_ns) / 1e9)
+        _QUERY_SECONDS.observe(
+            (compile_ns + exec_ns) / 1e9, tenant=tenant or "default"
+        )
         trace_spans = None
         if root is not None:
             trace.finish(

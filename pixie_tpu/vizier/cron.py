@@ -126,12 +126,18 @@ class ScriptRunner:
         result_store=None,
         sink: Optional[Callable] = None,
         timeout_s: float = 30.0,
+        executor: Optional[Callable] = None,
     ):
         self._broker = broker
         self.store = store
         self._result_store = result_store
         self._sink = sink
         self._timeout_s = timeout_s
+        # r15: an ``executor(script)`` override replaces the default
+        # broker execution per tick — the SLO manager (vizier/slo.py)
+        # rides the same persisted store + ticker machinery with its
+        # rule evaluator plugged in here.
+        self._executor = executor
         self._runners: dict[str, _Runner] = {}
         # One lock serializes store mutation + reconcile: without it, a
         # concurrent sync() that read the store BEFORE a delete can
@@ -179,6 +185,9 @@ class ScriptRunner:
 
     # -- execution -----------------------------------------------------------
     def _run_one(self, script: CronScript) -> None:
+        if self._executor is not None:
+            self._executor(script)
+            return
         result = self._broker.execute_script(
             script.script,
             timeout_s=self._timeout_s,

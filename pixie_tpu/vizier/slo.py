@@ -1,0 +1,364 @@
+"""SLO rules + alerting over the self-telemetry plane (r15).
+
+Ref posture: Monarch (Adya et al., VLDB 2020) — keep the monitoring
+time series queryable in memory NEXT TO the alerting layer that
+evaluates declarative rules over them. Here the series are this engine's
+own metrics registry and self-telemetry tables, and the evaluator rides
+the existing cron machinery (vizier/cron.py): each registered rule is a
+``CronScript`` persisted in a datastore-backed ``CronScriptStore``
+(rules survive broker restarts) whose ticker fires the rule's
+evaluation instead of a PxL execution.
+
+Two rule kinds:
+
+- ``metric``: a windowed predicate over the shared MetricsRegistry —
+  e.g. "``broker_query_seconds`` p99 > 2s over 60s" or "``device_staged_
+  bytes`` value > 80% of budget". Quantiles are computed over the
+  WINDOW's bucket-count delta (the evaluator keeps the previous
+  cumulative snapshot per rule), ``rate`` over the window's counter
+  delta; ``value`` reads the current gauge. Label filters
+  (``labels={"tenant": "X"}``) select per-tenant series — the r15
+  serving metrics carry tenant labels natively.
+- ``pxl``: an arbitrary PxL script executed through the broker — an
+  ordinary fold over the telemetry tables (``engine_metrics``,
+  ``query_spans``, ``device_dispatches``, ``hbm_usage``, ...); the
+  first row of ``column`` in the result's single displayed table is the
+  observed value.
+
+On every firing/ok transition the manager (1) buffers a row for the
+``alerts`` self-telemetry table (drained by
+ingest/self_telemetry.flush_into like spans, so distributed queries see
+it), (2) emits a structured event through
+``QueryBroker.emit_alert`` (same shape family as the r10 on_event
+degradation events), and (3) updates the live status served at the
+broker health server's ``/alertz`` route.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any, Optional
+
+from pixie_tpu.utils import metrics_registry
+from pixie_tpu.utils.metrics import Histogram
+from pixie_tpu.vizier.cron import CronScript, CronScriptStore, ScriptRunner
+from pixie_tpu.vizier.datastore import Datastore
+
+_M = metrics_registry()
+_TRANSITIONS = _M.counter(
+    "slo_alert_transitions_total",
+    "SLO rule state transitions, by rule and new state.",
+)
+_ACTIVE_ALERTS = _M.gauge(
+    "slo_active_alerts", "SLO rules currently in the firing state."
+)
+_EVALS = _M.counter(
+    "slo_rule_evaluations_total", "SLO rule evaluations, by rule."
+)
+
+_OPS = {
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+}
+
+# Pending alert-table rows (fire/clear transitions), drained by the
+# self-telemetry flush exactly like the finished-span buffer.
+_ROWS_LOCK = threading.Lock()
+_ALERT_ROWS: "collections.deque[dict]" = collections.deque(maxlen=4096)
+
+
+def drain_alert_rows() -> list[dict]:
+    with _ROWS_LOCK:
+        out = list(_ALERT_ROWS)
+        _ALERT_ROWS.clear()
+    return out
+
+
+@dataclasses.dataclass
+class SLORule:
+    """One declarative service-level objective.
+
+    metric kind: ``metric`` + ``agg`` (p50/p90/p99 for histograms over
+    the window's bucket delta; ``rate`` for counters over the window;
+    ``value`` for the current gauge/counter reading) + optional
+    ``labels`` filter. pxl kind: ``script`` + ``column``."""
+
+    name: str
+    kind: str = "metric"  # "metric" | "pxl"
+    metric: str = ""
+    labels: dict = dataclasses.field(default_factory=dict)
+    agg: str = "p99"
+    script: str = ""
+    column: str = ""
+    op: str = ">"
+    threshold: float = 0.0
+    window_s: float = 60.0
+    interval_s: float = 5.0
+    severity: str = "warning"
+    description: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SLORule":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+    @property
+    def tenant(self) -> str:
+        return str(self.labels.get("tenant", ""))
+
+
+class _RuleState:
+    __slots__ = (
+        "state", "since_ns", "last_value", "last_eval_ns", "evals",
+        "prev_counts", "prev_total", "prev_total_ns",
+    )
+
+    def __init__(self):
+        self.state = "ok"
+        self.since_ns = 0
+        self.last_value: Optional[float] = None
+        self.last_eval_ns = 0
+        self.evals = 0
+        # Window bookkeeping: previous cumulative histogram bucket counts
+        # (quantile-over-delta) / previous counter total (rate).
+        self.prev_counts: Optional[list[int]] = None
+        self.prev_total: Optional[float] = None
+        self.prev_total_ns = 0
+
+
+class SLOManager:
+    """Evaluates registered SLO rules on the cron runner's tickers and
+    closes the loop: alerts table + broker events + /alertz."""
+
+    _PREFIX = "slo-"
+
+    def __init__(
+        self,
+        broker,
+        datastore: Optional[Datastore] = None,
+        pxl_timeout_s: float = 10.0,
+    ):
+        self._broker = broker
+        self._registry = metrics_registry()
+        self._pxl_timeout_s = pxl_timeout_s
+        self._lock = threading.RLock()
+        self._rules: dict[str, SLORule] = {}
+        self._states: dict[str, _RuleState] = {}
+        self._recent: "collections.deque[dict]" = collections.deque(
+            maxlen=256
+        )
+        # The rules ARE cron scripts: persisted in the store (restart
+        # survival), one ticker per rule at its interval, evaluation
+        # plugged in as the runner's executor.
+        self.store = CronScriptStore(datastore or Datastore())
+        self.runner = ScriptRunner(
+            broker, self.store, executor=self._evaluate_cron
+        )
+        # Adopt persisted rules from a previous incarnation.
+        for sid, script in self.store.all().items():
+            rule_d = (script.configs or {}).get("slo")
+            if sid.startswith(self._PREFIX) and rule_d:
+                rule = SLORule.from_dict(rule_d)
+                self._rules[rule.name] = rule
+                self._states[rule.name] = _RuleState()
+        self.runner.sync()
+        if broker is not None:
+            broker.slo = self
+
+    # -- registration --------------------------------------------------------
+    def register(self, rule: SLORule) -> None:
+        """Persist + schedule a rule (idempotent upsert)."""
+        with self._lock:
+            self._rules[rule.name] = rule
+            self._states.setdefault(rule.name, _RuleState())
+            self.runner.upsert_script(
+                CronScript(
+                    self._PREFIX + rule.name,
+                    rule.script,
+                    rule.interval_s,
+                    configs={"slo": rule.to_dict()},
+                )
+            )
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._rules.pop(name, None)
+            self._states.pop(name, None)
+            self.runner.delete_script(self._PREFIX + name)
+
+    def stop(self) -> None:
+        self.runner.stop()
+
+    # -- evaluation ----------------------------------------------------------
+    def _evaluate_cron(self, script: CronScript) -> None:
+        rule_d = (script.configs or {}).get("slo")
+        if not rule_d:
+            return
+        self.evaluate(SLORule.from_dict(rule_d))
+
+    def evaluate_all(self) -> None:
+        """Deterministic tick over every registered rule (tests and the
+        /alertz freshness path don't wait for the cron tickers)."""
+        with self._lock:
+            rules = list(self._rules.values())
+        for rule in rules:
+            self.evaluate(rule)
+
+    def evaluate(self, rule: SLORule) -> Optional[float]:
+        """One evaluation: observe the value, compare, transition.
+        Returns the observed value (None = no data this window: the rule
+        HOLDS its current state rather than flapping)."""
+        _EVALS.inc(rule=rule.name)
+        with self._lock:
+            # The registered rule object carries the state; a cron tick
+            # for a stale spec still lands on the current state slot.
+            st = self._states.setdefault(rule.name, _RuleState())
+            value = (
+                self._pxl_value(rule)
+                if rule.kind == "pxl"
+                else self._metric_value(rule, st)
+            )
+            now_ns = time.time_ns()
+            st.last_eval_ns = now_ns
+            st.evals += 1
+            if value is None:
+                return None
+            st.last_value = value
+            breach = _OPS.get(rule.op, _OPS[">"])(value, rule.threshold)
+            new_state = "firing" if breach else "ok"
+            if new_state != st.state:
+                st.state = new_state
+                st.since_ns = now_ns
+                self._transition(rule, new_state, value, now_ns)
+            return value
+
+    def _metric_value(
+        self, rule: SLORule, st: _RuleState
+    ) -> Optional[float]:
+        reg = self._registry
+        with reg._lock:
+            metric = reg._metrics.get(rule.metric)
+        if metric is None:
+            return None  # metric not registered (yet): hold state
+        agg = rule.agg
+        if isinstance(metric, Histogram) and agg.startswith("p"):
+            q = float(agg[1:]) / 100.0
+            counts = metric.merged_counts(**rule.labels)
+            prev = st.prev_counts or [0] * len(counts)
+            delta = [c - p for c, p in zip(counts, prev)]
+            st.prev_counts = counts
+            if sum(delta) <= 0:
+                return None  # no observations this window
+            return metric.quantile_of_counts(q, delta)
+        if agg == "rate":
+            total = metric.total(**rule.labels)
+            now_ns = time.time_ns()
+            prev, prev_ns = st.prev_total, st.prev_total_ns
+            st.prev_total, st.prev_total_ns = total, now_ns
+            if prev is None or now_ns <= prev_ns:
+                return None
+            return (total - prev) / ((now_ns - prev_ns) / 1e9)
+        # "value" (gauges, totals): the current reading.
+        return metric.total(**rule.labels)
+
+    def _pxl_value(self, rule: SLORule) -> Optional[float]:
+        """Execute the rule's PxL through the broker — an ordinary fold
+        over the (freshly flushed) telemetry tables — and read the first
+        row of ``column`` from its single displayed table."""
+        try:
+            result = self._broker.execute_script(
+                rule.script, timeout_s=self._pxl_timeout_s
+            )
+            table = result.table()
+            if not table:
+                return None
+            col = rule.column or next(
+                (k for k in table if k != "time_"), None
+            )
+            if col is None or not len(table[col]):
+                return None
+            return float(table[col][0])
+        except Exception:
+            return None  # evaluation failure holds state; cron counts it
+
+    # -- transitions ---------------------------------------------------------
+    def _transition(
+        self, rule: SLORule, state: str, value: float, now_ns: int
+    ) -> None:
+        row = {
+            "time_ns": now_ns,
+            "rule": rule.name,
+            "state": state,
+            "severity": rule.severity,
+            "value": float(value),
+            "threshold": float(rule.threshold),
+            "tenant": rule.tenant,
+            "window_s": float(rule.window_s),
+            "detail": (
+                f"{rule.metric or 'pxl'} {rule.agg if rule.kind == 'metric' else rule.column} "
+                f"{rule.op} {rule.threshold:g} over {rule.window_s:g}s"
+            ),
+        }
+        with _ROWS_LOCK:
+            _ALERT_ROWS.append(row)
+        self._recent.append(dict(row))
+        _TRANSITIONS.inc(rule=rule.name, state=state)
+        _ACTIVE_ALERTS.set(
+            sum(1 for s in self._states.values() if s.state == "firing")
+        )
+        if self._broker is not None:
+            self._broker.emit_alert(
+                {
+                    "type": "slo_alert",
+                    "rule": rule.name,
+                    "state": state,
+                    "severity": rule.severity,
+                    "value": float(value),
+                    "threshold": float(rule.threshold),
+                    "tenant": rule.tenant,
+                    "window_s": float(rule.window_s),
+                    "description": rule.description,
+                }
+            )
+
+    # -- status (/alertz) ----------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            rules = []
+            for name, rule in sorted(self._rules.items()):
+                st = self._states.get(name) or _RuleState()
+                rules.append(
+                    {
+                        "rule": name,
+                        "kind": rule.kind,
+                        "expr": (
+                            f"{rule.metric} {rule.agg}"
+                            if rule.kind == "metric"
+                            else f"pxl:{rule.column or 'auto'}"
+                        ),
+                        "labels": dict(rule.labels),
+                        "op": rule.op,
+                        "threshold": rule.threshold,
+                        "window_s": rule.window_s,
+                        "interval_s": rule.interval_s,
+                        "severity": rule.severity,
+                        "state": st.state,
+                        "since_unix_ns": st.since_ns,
+                        "last_value": st.last_value,
+                        "evaluations": st.evals,
+                        "description": rule.description,
+                    }
+                )
+            return {
+                "rules": rules,
+                "active": [r["rule"] for r in rules if r["state"] == "firing"],
+                "recent": list(self._recent)[-32:],
+            }

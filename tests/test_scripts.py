@@ -114,6 +114,10 @@ def cluster():
         ),
         "stack_trace": np.array([stacks[i] for i in sid], dtype=object),
         "count": counts,
+        # r15 attribution columns: synthetic seed stacks are unattributed.
+        "query_id": np.full(k, "", dtype=object),
+        "tenant": np.full(k, "", dtype=object),
+        "phase": np.full(k, "", dtype=object),
     })
     t3.compact()
     t3.stop()

@@ -1,0 +1,368 @@
+"""SLO rules + alert layer (r15).
+
+Covers the closed loop: a metric rule fires deterministically on a
+breaching window and clears on recovery (window-delta quantiles, so a
+past breach doesn't poison the series forever); rules ride the cron
+runner's tickers and persist across a manager restart; transitions land
+in the alerts self-telemetry table, fan out as structured broker
+events, and show at /alertz; a PxL rule evaluates as an ordinary fold
+over the engine's tables through the broker; and the r15 tenant labels
+on the serving metrics feed per-tenant rules natively.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pixie_tpu.engine import Carnot
+from pixie_tpu.exec.router import BridgeRouter
+from pixie_tpu.ingest import self_telemetry
+from pixie_tpu.table.table_store import TableStore
+from pixie_tpu.types import DataType, Relation
+from pixie_tpu.utils import flags, metrics_registry, trace
+from pixie_tpu.vizier import Agent, MessageBus, QueryBroker
+from pixie_tpu.vizier.slo import SLOManager, SLORule, drain_alert_rows
+
+F, S, T = DataType.FLOAT64, DataType.STRING, DataType.TIME64NS
+
+_uniq = [0]
+
+
+def _metric_name():
+    _uniq[0] += 1
+    return f"slo_test_metric_{_uniq[0]}"
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    trace.set_enabled(True)
+    trace.clear()
+    drain_alert_rows()
+    yield
+    drain_alert_rows()
+
+
+class _FakeBroker:
+    """Just enough broker for metric-rule tests: alert fan-out."""
+
+    slo = None
+
+    def __init__(self):
+        self.events = []
+
+    def emit_alert(self, event):
+        self.events.append(event)
+
+
+def _manager(broker=None):
+    return SLOManager(broker if broker is not None else _FakeBroker())
+
+
+# -- metric rules ------------------------------------------------------------
+def test_metric_rule_fires_and_clears_on_recovery():
+    name = _metric_name()
+    h = metrics_registry().histogram(name)
+    broker = _FakeBroker()
+    mgr = _manager(broker)
+    try:
+        rule = SLORule(
+            name="lat-p99", metric=name, agg="p99", op=">",
+            threshold=1.0, window_s=60.0, interval_s=30.0,
+            severity="page", description="p99 over 1s",
+        )
+        mgr.register(rule)
+        # Window 1: breaching observations -> firing.
+        for _ in range(20):
+            h.observe(4.0)
+        v1 = mgr.evaluate(rule)
+        assert v1 is not None and v1 > 1.0
+        assert mgr.status()["active"] == ["lat-p99"]
+        # Window 2: only fast observations (the evaluator diffs bucket
+        # counts, so the old slow samples don't pin p99 forever) -> ok.
+        for _ in range(50):
+            h.observe(0.01)
+        v2 = mgr.evaluate(rule)
+        assert v2 is not None and v2 < 1.0
+        assert mgr.status()["active"] == []
+        rows = drain_alert_rows()
+        assert [r["state"] for r in rows] == ["firing", "ok"]
+        assert rows[0]["rule"] == "lat-p99"
+        assert rows[0]["severity"] == "page"
+        assert rows[0]["value"] == pytest.approx(v1)
+        assert [e["state"] for e in broker.events] == ["firing", "ok"]
+        assert broker.events[0]["type"] == "slo_alert"
+    finally:
+        mgr.stop()
+
+
+def test_metric_rule_empty_window_holds_state():
+    name = _metric_name()
+    h = metrics_registry().histogram(name)
+    mgr = _manager()
+    try:
+        rule = SLORule(
+            name="hold", metric=name, agg="p50", op=">", threshold=0.5,
+        )
+        mgr.register(rule)
+        for _ in range(10):
+            h.observe(2.0)
+        assert mgr.evaluate(rule) is not None
+        assert mgr.status()["active"] == ["hold"]
+        # No new observations: value is None, state holds, NO flapping
+        # transition is emitted.
+        assert mgr.evaluate(rule) is None
+        assert mgr.status()["active"] == ["hold"]
+        assert len(drain_alert_rows()) == 1  # just the original firing
+    finally:
+        mgr.stop()
+
+
+def test_gauge_value_rule_per_tenant_labels():
+    """A value rule with a label filter reads one tenant's series —
+    e.g. 'tenant X > 80% of HBM budget'."""
+    name = _metric_name()
+    g = metrics_registry().gauge(name)
+    mgr = _manager()
+    try:
+        rule = SLORule(
+            name="hbm-tenant-x", metric=name, agg="value",
+            labels={"tenant": "x"}, op=">", threshold=80.0,
+        )
+        mgr.register(rule)
+        g.set(95.0, tenant="y")  # other tenant breaching: not our rule
+        g.set(10.0, tenant="x")
+        assert mgr.evaluate(rule) == 10.0
+        assert mgr.status()["active"] == []
+        g.set(90.0, tenant="x")
+        assert mgr.evaluate(rule) == 90.0
+        assert mgr.status()["active"] == ["hbm-tenant-x"]
+    finally:
+        mgr.stop()
+
+
+def test_rate_rule_over_counter():
+    name = _metric_name()
+    c = metrics_registry().counter(name)
+    mgr = _manager()
+    try:
+        rule = SLORule(
+            name="reject-rate", metric=name, agg="rate", op=">",
+            threshold=1000.0,
+        )
+        mgr.register(rule)
+        c.inc(5, reason="queue_full", tenant="a")
+        assert mgr.evaluate(rule) is None  # first sample primes the window
+        c.inc(10_000, reason="queue_full", tenant="b")
+        v = mgr.evaluate(rule)
+        assert v is not None and v > 1000.0
+        assert mgr.status()["active"] == ["reject-rate"]
+    finally:
+        mgr.stop()
+
+
+def test_rules_ride_cron_tickers_and_persist():
+    from pixie_tpu.vizier.datastore import Datastore
+
+    name = _metric_name()
+    h = metrics_registry().histogram(name)
+    for _ in range(10):
+        h.observe(3.0)
+    ds = Datastore()
+    broker = _FakeBroker()
+    mgr = SLOManager(broker, datastore=ds)
+    try:
+        mgr.register(
+            SLORule(
+                name="ticked", metric=name, agg="p50", op=">",
+                threshold=1.0, interval_s=0.05,
+            )
+        )
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            st = mgr.status()["rules"][0]
+            if st["evaluations"] >= 2 and st["state"] == "firing":
+                break
+            time.sleep(0.02)
+        st = mgr.status()["rules"][0]
+        assert st["evaluations"] >= 2, "cron ticker never evaluated"
+        assert st["state"] == "firing"
+    finally:
+        mgr.stop()
+    # A new manager over the same datastore adopts the persisted rule
+    # (rules are CronScripts in a CronScriptStore: restart survival).
+    mgr2 = SLOManager(_FakeBroker(), datastore=ds)
+    try:
+        assert [r["rule"] for r in mgr2.status()["rules"]] == ["ticked"]
+    finally:
+        mgr2.stop()
+
+
+# -- end to end through a real broker ----------------------------------------
+REL = Relation.of(("time_", T), ("svc", S), ("latency", F))
+
+
+def _cluster():
+    ts = TableStore()
+    t = ts.create_table("lat_events", REL)
+    t.write_pydict(
+        {
+            "time_": np.arange(10, dtype=np.int64),
+            "svc": np.array(["s"] * 10, dtype=object),
+            "latency": np.full(10, 100.0),
+        }
+    )
+    bus = MessageBus()
+    router = BridgeRouter()
+    broker = QueryBroker(
+        bus, router,
+        table_relations={
+            "lat_events": REL,
+            "alerts": self_telemetry.ALERTS_REL,
+        },
+    )
+    agents = [
+        Agent("pem1", bus, router, table_store=ts),
+        Agent("kelvin", bus, router, is_kelvin=True),
+    ]
+    for a in agents:
+        a.start()
+    time.sleep(0.3)
+    return ts, broker, agents
+
+
+PXL_AVG = (
+    "df = px.DataFrame(table='lat_events')\n"
+    "s = df.groupby(['svc']).agg(\n"
+    "    total=('latency', px.sum), n=('latency', px.count))\n"
+    "s.avg = s.total / s.n\n"
+    "px.display(s, 'out')\n"
+)
+
+
+def test_pxl_rule_fires_and_clears_through_broker():
+    """A PxL rule is an ordinary fold over the engine's tables via the
+    broker: mean latency breaches -> firing; appended fast rows bring
+    the mean down -> clears on recovery."""
+    ts, broker, agents = _cluster()
+    events = []
+    broker.add_alert_listener(events.append)
+    mgr = SLOManager(broker)
+    try:
+        rule = SLORule(
+            name="avg-lat", kind="pxl", script=PXL_AVG, column="avg",
+            op=">", threshold=10.0, interval_s=30.0,
+        )
+        mgr.register(rule)
+        v1 = mgr.evaluate(rule)
+        assert v1 == pytest.approx(100.0)
+        assert mgr.status()["active"] == ["avg-lat"]
+        # Recovery: a flood of fast requests drags the mean under the
+        # threshold.
+        t = ts.get_table("lat_events")
+        t.write_pydict(
+            {
+                "time_": np.arange(10, 5000, dtype=np.int64),
+                "svc": np.array(["s"] * 4990, dtype=object),
+                "latency": np.full(4990, 0.001),
+            }
+        )
+        v2 = mgr.evaluate(rule)
+        assert v2 is not None and v2 < 10.0
+        assert mgr.status()["active"] == []
+        assert [e["state"] for e in events] == ["firing", "ok"]
+        # The transitions are queryable: the agent's flush path lands
+        # them in its alerts table, and the bundled px/slo script reads
+        # them back through the engine itself.
+        from pixie_tpu.scripts.library import ScriptLibrary
+
+        out = ScriptLibrary().run(
+            agents[0].carnot, "px/slo", {"rule": "avg-lat"}
+        )
+        alerts = out.table("alerts")
+        assert list(alerts["state"]) == ["firing", "ok"]
+        assert alerts["value"][0] == pytest.approx(100.0)
+    finally:
+        mgr.stop()
+        broker.stop()
+        for a in agents:
+            a.stop()
+
+
+def test_alertz_route_serves_rule_status():
+    ts, broker, agents = _cluster()
+    mgr = SLOManager(broker)
+    srv = broker.start_health_server()
+    try:
+        rule = SLORule(
+            name="avg-lat", kind="pxl", script=PXL_AVG, column="avg",
+            op=">", threshold=10.0,
+        )
+        mgr.register(rule)
+        mgr.evaluate(rule)
+        host, port = srv.address
+        body = json.loads(
+            urllib.request.urlopen(
+                f"http://{host}:{port}/alertz", timeout=5
+            ).read()
+        )
+        assert body["active"] == ["avg-lat"]
+        (r,) = body["rules"]
+        assert r["state"] == "firing"
+        assert r["last_value"] == pytest.approx(100.0)
+        assert body["recent"][-1]["state"] == "firing"
+    finally:
+        mgr.stop()
+        broker.stop()
+        for a in agents:
+            a.stop()
+
+
+def test_broker_query_seconds_tenant_labels():
+    """r15 satellite: broker_query_seconds and the admission metrics
+    carry native per-tenant series."""
+    ts, broker, agents = _cluster()
+    reg = metrics_registry()
+    h = reg.histogram("broker_query_seconds")
+    before_a = h.value(tenant="slo_ten_a")
+    q = (
+        "df = px.DataFrame(table='lat_events')\n"
+        "s = df.groupby(['svc']).agg(n=('latency', px.count))\n"
+        "px.display(s, 'out')\n"
+    )
+    try:
+        broker.execute_script(q, tenant="slo_ten_a")
+        broker.execute_script(q, tenant="slo_ten_b")
+        assert h.value(tenant="slo_ten_a") == before_a + 1
+        assert h.value(tenant="slo_ten_b") >= 1
+        # Aggregate views still work over the labeled series.
+        assert h.agg_quantile(0.5) > 0.0
+    finally:
+        broker.stop()
+        for a in agents:
+            a.stop()
+
+
+def test_admission_rejections_tenant_labeled():
+    from pixie_tpu.serving.admission import (
+        AdmissionController,
+        AdmissionRejected,
+    )
+
+    reg = metrics_registry()
+    rej = reg.counter("admission_rejected_total")
+    before = rej.value(reason="queue_full", tenant="slo_q_ten")
+    ctl = AdmissionController(max_concurrent=1, max_queue=0)
+    with ctl.acquire("holder"):
+        with pytest.raises(AdmissionRejected):
+            ctl.acquire("slo_q_ten")
+    assert rej.value(reason="queue_full", tenant="slo_q_ten") == before + 1
+    assert rej.total(tenant="slo_q_ten") >= 1
+    # The wait histogram carries the tenant label too and the snapshot's
+    # aggregate quantiles read across label sets.
+    snap = ctl.snapshot()
+    assert "wait_p99_ms" in snap

@@ -26,9 +26,15 @@ query path has zero durability hooks), and reports the enabled cost per
 ``wal_fsync`` policy ('always' fsyncs every windowed frame; 'never'
 rides the page cache — crash-safe, not powerloss-safe).
 
+Also gates (r15) the resource-attribution hooks: <1% modeled on the
+warm fold with attribution DISABLED (bare ``ACTIVE`` branches at the
+dispatch recorders, attribution contexts, and residency usage sampling;
+the transport path has zero attribution hooks).
+
 Prints ONE JSON line on stdout. With MB_WRITE_BENCH_DETAIL=1, merges the
 headline numbers into BENCH_DETAIL.json under the ``fault_overhead``,
-``ack_overhead``, ``trace_overhead`` and ``durability_overhead`` keys.
+``ack_overhead``, ``trace_overhead``, ``durability_overhead`` and
+``profiler_overhead`` keys.
 
 Env knobs: MB_ROWS (default 200k), MB_WARM_RUNS (default 20),
 MB_RTT_MSGS (default 400), MB_THRPT_MSGS (default 2000), JAX_PLATFORMS.
@@ -326,6 +332,68 @@ def main() -> None:
         f"{trace_overhead['rtt_enabled_delta_pct']:+.2f}% rtt"
     )
 
+    # -- resource-attribution overhead (r15) ---------------------------------
+    # Same method as the fault/trace gates: (a) per-check cost of the
+    # disabled call-site idiom (``if trace.ATTR_ACTIVE:`` /
+    # ``if resattr.ACTIVE:`` — one attribute load + branch); (b) census
+    # of attribution hooks per warm query, measured as the records an
+    # ENABLED run creates (each record is one gated check) plus the
+    # attribution-context enters and residency publish checks the warm
+    # path crosses; (c) modeled disabled overhead = census *
+    # per_check_ns / op_ns, gated <1%, plus a direct enabled-vs-disabled
+    # A/B. The transport RTT has ZERO attribution hooks (attribution
+    # never touches the send/ack path) — reported as such.
+    from pixie_tpu.parallel import profiler as resattr
+
+    def _attr_check_ns(iters: int = 1_000_000) -> float:
+        resattr.set_enabled(False)
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            if trace.ACTIVE and trace.ATTR_ACTIVE and resattr.ACTIVE:
+                pass
+            if trace.ATTR_ACTIVE:
+                raise AssertionError
+        return (time.perf_counter_ns() - t0) / iters / 2.0
+
+    attr_check_ns = _attr_check_ns()
+    resattr.set_enabled(True)
+    resattr.clear()
+    c.execute_query(query)
+    counts = resattr.buffered_counts()
+    # Records created (each = one gated check that passed) + the warm
+    # path's constant hooks: the engine's attribution context
+    # (enter/exit), the device.execute record check, and the residency
+    # pin/unpin publish checks.
+    warm_attr_census = (
+        counts["dispatches"] + counts["hbm"] + counts["programs"] + 6
+    )
+    resattr.clear()
+    warm_attr_on_ns = run_warm(warm_runs)
+    resattr.set_enabled(False)
+    warm_attr_off_ns = run_warm(warm_runs)
+    resattr.set_enabled(True)
+    resattr.clear()
+    warm_attr_pct = (
+        100.0 * warm_attr_census * attr_check_ns / warm_attr_off_ns
+    )
+    profiler_overhead = {
+        "attr_check_disabled_ns": round(attr_check_ns, 2),
+        "warm_hooks_per_query": int(warm_attr_census),
+        "warm_disabled_modeled_pct": round(warm_attr_pct, 5),
+        "warm_enabled_delta_pct": round(
+            100.0 * (warm_attr_on_ns - warm_attr_off_ns)
+            / warm_attr_off_ns, 3
+        ),
+        "rtt_hooks_per_rtt": 0,  # no attribution hooks on the transport
+        "rtt_disabled_modeled_pct": 0.0,
+        "pass_under_1pct": bool(warm_attr_pct < 1.0),
+    }
+    log(
+        f"attribution: {warm_attr_census} hooks/warm-query, disabled "
+        f"modeled {warm_attr_pct:.4f}% warm / 0% rtt; enabled A/B "
+        f"{profiler_overhead['warm_enabled_delta_pct']:+.2f}% warm"
+    )
+
     # -- durability spill overhead (r14) -------------------------------------
     # Disabled gate: with no WAL attached, every durability hook on the
     # send/ack path is a bare ``wal is None`` attribute branch —
@@ -443,12 +511,14 @@ def main() -> None:
             and ack_overhead["pass_under_1pct"]
             and trace_overhead["pass_under_1pct"]
             and durability_overhead["pass_under_1pct"]
+            and profiler_overhead["pass_under_1pct"]
         ),
         "platform": jax.devices()[0].platform,
     }
     out["ack_overhead"] = ack_overhead
     out["trace_overhead"] = trace_overhead
     out["durability_overhead"] = durability_overhead
+    out["profiler_overhead"] = profiler_overhead
     print(json.dumps(out))
 
     if os.environ.get("MB_WRITE_BENCH_DETAIL") == "1":
@@ -459,18 +529,20 @@ def main() -> None:
             k: v
             for k, v in out.items()
             if k not in (
-                "ack_overhead", "trace_overhead", "durability_overhead"
+                "ack_overhead", "trace_overhead",
+                "durability_overhead", "profiler_overhead",
             )
         }
         detail["ack_overhead"] = ack_overhead
         detail["trace_overhead"] = trace_overhead
         detail["durability_overhead"] = durability_overhead
+        detail["profiler_overhead"] = profiler_overhead
         with open(path, "w") as f:
             json.dump(detail, f, indent=1)
             f.write("\n")
         log(
             "BENCH_DETAIL.json updated (fault_overhead, ack_overhead, "
-            "trace_overhead, durability_overhead)"
+            "trace_overhead, durability_overhead, profiler_overhead)"
         )
 
     if not out["pass_under_1pct"]:

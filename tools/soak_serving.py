@@ -17,8 +17,11 @@ admission control on and an HBM budget set. It reports:
 
 Env knobs: SOAK_CLIENTS (64), SOAK_REQUESTS (4 per client), SOAK_QPS
 (8.0 per client), SOAK_ROWS (100k), SOAK_HBM_BUDGET_MB (64),
-SOAK_WINDOW_MS (25), SOAK_MAX_CONCURRENT (8), SOAK_JSON (path to also
-write the report).
+SOAK_WINDOW_MS (25), SOAK_MAX_CONCURRENT (8), SOAK_CHAOS (0),
+SOAK_PROFILE (0 — r15 attributed profiling through the concurrent
+phase), SOAK_JSON (path to also write the report),
+SOAK_WRITE_BENCH_DETAIL (1 = record the contention + profile blocks
+into BENCH_DETAIL.json under ``serving_soak``).
 
 Run: JAX_PLATFORMS=cpu python tools/soak_serving.py
 """
@@ -93,6 +96,69 @@ CHAOS_SITES = {
 }
 
 
+# Leaf frames that mean "parked, not burning CPU": Python stack sampling
+# sees blocked threads too, so busy-CPU attribution excludes stacks whose
+# leaf is a wait/poll primitive or a pool worker's idle loop (the r15
+# profile block reports raw and busy-only attribution). A leaf INSIDE
+# threading.py is lock/condition machinery (cv wait re-acquire, lock
+# __enter__, notify) — blocked or about to be, not real work.
+_WAIT_LEAVES = (
+    "wait", "get", "poll", "select", "sleep", "accept", "recv",
+    "read", "join", "_recv_loop", "serve_forever", "_worker",
+)
+_WAIT_LEAF_MODULES = ("threading",)
+# Soak-harness frames (client pacing/bookkeeping loops): a real
+# deployment's clients live in other processes — samples whose leaf is
+# the harness itself are reported separately, not as engine busy time.
+_HARNESS_LEAF_MODULE = "soak_serving"
+
+
+def _profile_report(counts: dict, samples: int) -> dict:
+    """Summarize attributed stack samples: overall + engine-busy-only
+    attribution percentages and the top attributed stacks."""
+    total = busy = attributed = busy_attr = harness = 0
+    per_stack: dict = {}
+    for (upid, folded, qid, tenant, phase), c in counts.items():
+        total += c
+        leaf = folded.rsplit(";", 1)[-1]
+        leaf_mod, _, leaf_fn = leaf.rpartition(".")
+        is_busy = (
+            leaf_mod not in _WAIT_LEAF_MODULES
+            and not any(w in leaf_fn for w in _WAIT_LEAVES)
+        )
+        if is_busy and leaf_mod == _HARNESS_LEAF_MODULE and not qid:
+            harness += c
+            is_busy = False
+        busy += c if is_busy else 0
+        if qid:
+            attributed += c
+            busy_attr += c if is_busy else 0
+        per_stack[(folded, qid, tenant, phase)] = (
+            per_stack.get((folded, qid, tenant, phase), 0) + c
+        )
+    top = sorted(per_stack.items(), key=lambda kv: -kv[1])[:10]
+    return {
+        "samples": samples,
+        "stack_samples": total,
+        "attributed_pct": round(100.0 * attributed / total, 1) if total else 0.0,
+        "busy_stack_samples": busy,
+        "harness_samples": harness,
+        "busy_attributed_pct": (
+            round(100.0 * busy_attr / busy, 1) if busy else 0.0
+        ),
+        "top_stacks": [
+            {
+                "stack": folded[-160:],
+                "query_id": qid[:12],
+                "tenant": tenant,
+                "phase": phase,
+                "count": c,
+            }
+            for (folded, qid, tenant, phase), c in top
+        ],
+    }
+
+
 def run_soak(
     clients: int = 64,
     requests_per_client: int = 4,
@@ -103,6 +169,7 @@ def run_soak(
     max_concurrent: int = 8,
     seed: int = 11,
     chaos: bool = False,
+    profile: bool = False,
 ) -> dict:
     """Build the cluster, run the soak (serving flags pinned for the
     run, restored after), return the report dict. ``chaos`` arms
@@ -126,7 +193,7 @@ def run_soak(
     try:
         return _run_soak_inner(
             clients, requests_per_client, qps_per_client, rows,
-            hbm_budget_mb, window_ms, seed, chaos,
+            hbm_budget_mb, window_ms, seed, chaos, profile,
         )
     finally:
         # Restore env/default flag values so an embedding caller
@@ -137,7 +204,7 @@ def run_soak(
 
 def _run_soak_inner(
     clients, requests_per_client, qps_per_client, rows,
-    hbm_budget_mb, window_ms, seed, chaos=False,
+    hbm_budget_mb, window_ms, seed, chaos=False, profile=False,
 ) -> dict:
     import jax
     from jax.sharding import Mesh
@@ -235,6 +302,35 @@ def _run_soak_inner(
             faults.arm(site, **kw)
         log(f"chaos armed: {sorted(CHAOS_SITES)}")
 
+    # Continuous profiler (r15): sample this process's Python stacks —
+    # broker/agent/worker threads carry their query attribution — through
+    # the concurrent phase; device dispatches are read from the
+    # attribution buffers afterwards.
+    prof_conn = None
+    prof_samples = [0]
+    prof_stop = threading.Event()
+    prof_thread = None
+    if profile:
+        from pixie_tpu.ingest.host_profiler import HostProfilerConnector
+        from pixie_tpu.parallel import profiler as resattr
+
+        resattr.clear()
+        # skip_self: the dedicated sampling thread must not profile the
+        # observer itself.
+        prof_conn = HostProfilerConnector(
+            sample_others=False, skip_self=True
+        )
+        prof_conn.init()
+
+        def prof_loop():
+            while not prof_stop.is_set():
+                prof_conn.sample()
+                prof_samples[0] += 1
+                prof_stop.wait(0.01)
+
+        prof_thread = threading.Thread(target=prof_loop, daemon=True)
+        prof_thread.start()
+
     # Peak-residency sampler (the gauge is also asserted per insert in
     # tests; the sampler catches transients between client requests).
     peak = [0.0]
@@ -299,6 +395,50 @@ def _run_soak_inner(
     wall = time.perf_counter() - wall0
     stop.set()
     sampler_t.join(timeout=2)
+    profile_block = None
+    if profile:
+        prof_stop.set()
+        prof_thread.join(timeout=2)
+        from pixie_tpu.parallel import profiler as resattr
+
+        with prof_conn._lock:
+            stack_counts = dict(prof_conn._counts)
+        profile_block = _profile_report(stack_counts, prof_samples[0])
+        # Device-side attribution: every dispatch row carries the
+        # (query_id, tenant) of the query that caused it.
+        disp = resattr.drain_dispatches()
+        dev_total = sum(d["duration_ns"] for d in disp)
+        dev_attr = sum(d["duration_ns"] for d in disp if d["query_id"])
+        per_prog: dict = {}
+        for d in disp:
+            k = (d["program"], d["kind"])
+            agg = per_prog.setdefault(
+                k, {"dispatches": 0, "device_ns": 0, "tenants": set()}
+            )
+            agg["dispatches"] += 1
+            agg["device_ns"] += d["duration_ns"]
+            if d["tenant"]:
+                agg["tenants"].add(d["tenant"])
+        top_programs = sorted(
+            per_prog.items(), key=lambda kv: -kv[1]["device_ns"]
+        )[:10]
+        profile_block["device"] = {
+            "dispatches": len(disp),
+            "device_time_ms": round(dev_total / 1e6, 2),
+            "attributed_pct": (
+                round(100.0 * dev_attr / dev_total, 1) if dev_total else 0.0
+            ),
+            "top_programs": [
+                {
+                    "program": prog[:80],
+                    "kind": kind,
+                    "dispatches": agg["dispatches"],
+                    "device_ms": round(agg["device_ns"] / 1e6, 2),
+                    "tenants": sorted(agg["tenants"]),
+                }
+                for (prog, kind), agg in top_programs
+            ],
+        }
     chaos_stats = None
     if chaos:
         from pixie_tpu.utils import faults
@@ -345,7 +485,7 @@ def _run_soak_inner(
             "peak_staged_bytes": int(peak[0]),
             "budget_bytes": hbm_budget_mb << 20,
             "within_budget": peak[0] <= (hbm_budget_mb << 20),
-            "evictions": int(evictions.value()),
+            "evictions": int(evictions.total()),
         },
         "admission": broker.admission.snapshot(),
         # Lock contention at depth (r13, the r12 follow-on profiling
@@ -353,23 +493,26 @@ def _run_soak_inner(
         # the two serialization points every concurrent query crosses.
         "contention": {
             "admission_wait_p50_ms": round(
-                reg.histogram("admission_wait_seconds").quantile(0.5)
+                reg.histogram("admission_wait_seconds").agg_quantile(0.5)
                 * 1e3, 3,
             ),
             "admission_wait_p99_ms": round(
-                reg.histogram("admission_wait_seconds").quantile(0.99)
+                reg.histogram("admission_wait_seconds").agg_quantile(0.99)
                 * 1e3, 3,
             ),
             "admission_lock_wait_p99_ms": round(
-                reg.histogram("admission_lock_wait_seconds").quantile(0.99)
-                * 1e3, 3,
+                reg.histogram("admission_lock_wait_seconds").agg_quantile(
+                    0.99
+                ) * 1e3, 3,
             ),
             "bus_lock_wait_p99_ms": round(
-                reg.histogram("bus_lock_wait_seconds").quantile(0.99)
+                reg.histogram("bus_lock_wait_seconds").agg_quantile(0.99)
                 * 1e3, 3,
             ),
         },
     }
+    if profile_block is not None:
+        report["profile"] = profile_block
     if chaos:
         # r14 satellite: with fault sites armed through the concurrent
         # phase, 'recovered' queries completed clean (bit-identical rows)
@@ -435,6 +578,14 @@ def main() -> int:
         "pass gate then requires structured failure handling (zero "
         "mismatches on clean completions) instead of zero degradation.",
     )
+    ap.add_argument(
+        "--profile", action="store_true",
+        default=bool(int(os.environ.get("SOAK_PROFILE", "0"))),
+        help="Run the r15 continuous profiler through the concurrent "
+        "phase: query-attributed CPU stack samples plus device dispatch "
+        "attribution land in the report's 'profile' block (top "
+        "attributed stacks and programs, attribution percentages).",
+    )
     args = ap.parse_args()
     report = run_soak(
         clients=args.clients,
@@ -445,12 +596,34 @@ def main() -> int:
         window_ms=args.window_ms,
         max_concurrent=args.max_concurrent,
         chaos=args.chaos,
+        profile=args.profile,
     )
     print(json.dumps(report, indent=1))
     path = os.environ.get("SOAK_JSON")
     if path:
         with open(path, "w") as f:
             json.dump(report, f, indent=1)
+    if os.environ.get("SOAK_WRITE_BENCH_DETAIL") == "1":
+        # ROADMAP serving follow-on (1): the ~1k-client run's contention
+        # + profile blocks are recorded next to the bench configs.
+        bd_path = os.path.join(REPO, "BENCH_DETAIL.json")
+        with open(bd_path) as f:
+            detail = json.load(f)
+        detail["serving_soak"] = {
+            k: report[k]
+            for k in (
+                "clients", "requests_per_client", "wall_s", "completed",
+                "rejected", "degraded", "queries_per_sec",
+                "latency_p50_ms", "latency_p99_ms", "contention",
+            )
+            if k in report
+        }
+        if "profile" in report:
+            detail["serving_soak"]["profile"] = report["profile"]
+        with open(bd_path, "w") as f:
+            json.dump(detail, f, indent=1)
+            f.write("\n")
+        log("BENCH_DETAIL.json updated (serving_soak)")
     ok = (
         report["bit_identical"]
         and report["residency"]["within_budget"]
