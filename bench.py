@@ -358,8 +358,12 @@ def main() -> None:
         f"hbm_budget_mb={flags.hbm_budget_mb} "
         f"shared_scans={flags.shared_scans}"
         f"@{flags.shared_scan_window_ms}ms "
+        # r16: predicate-batched shared scans + closed-loop admission.
+        f"pred_batching={flags.shared_scan_predicate_batching}"
+        f"<={flags.shared_scan_max_batch} "
         f"admission={flags.admission_max_concurrent}"
         f"/{flags.admission_max_queue}q "
+        f"admission_controller={flags.admission_controller} "
         # r13 knobs: the staging codec (wire compression + device
         # decode) and device-resident incremental ingest (BENCH_RESIDENT
         # enables rings for the http_small table before its build).
@@ -394,6 +398,9 @@ def main() -> None:
         # fold dispatch actually blocked on.
         snap.setdefault("stage_compile", 0.0)
         snap.setdefault("compile_cache_hit", 0.0)
+        # r16: decode-program compiles carry their own key so
+        # stage_compile stays the FOLD compile signal.
+        snap.setdefault("decode_compile", 0.0)
         # r8 keys: warm_compile is the background AOT of the
         # warm/monolithic fold (concurrent with the cold query's tail);
         # prewarm_hit counts query folds served by a table-create

@@ -754,10 +754,15 @@ class MeshExecutor:
             if sig in self._aot_compiled or sig in self._aot_futures:
                 continue
             try:
+                # Own breakdown key (r16): stage_compile stays the FOLD
+                # compile signal (the r8 prewarm contract asserts it
+                # zero on a prewarm hit — a column codec engaging must
+                # not look like a fold recompile).
                 self._aot_compile_async(
                     sig,
                     _codec.decoder(self.mesh, cp, plan.nblk, plan.b),
                     _codec.decode_avals(cp, self.mesh),
+                    profile_key="decode_compile",
                 )
             except Exception:
                 pass  # best-effort: the in-line jit path still works
@@ -2089,6 +2094,7 @@ class MeshExecutor:
                 cell_cols=None,
                 num_groups=max(key_plan.num_groups, 1),
                 has_gids=key_plan.host_gids is not None,
+                gids=key_plan.host_gids,
             )
             if plan.window_rows != ring.window_rows or (
                 (plan.d, plan.nblk, plan.b)
@@ -2135,14 +2141,9 @@ class MeshExecutor:
                     )
                 )
                 win_gids.append(
-                    jax.device_put(
-                        pgids,
-                        NamedSharding(
-                            self.mesh, P(self.mesh.axis_names[0])
-                        ),
+                    _staging.put_window_gids(
+                        self.mesh, pgids, plan.nblk, plan.b
                     )
-                    if pgids is not None
-                    else None
                 )
                 COLD_PROFILE["wire_bytes"] = COLD_PROFILE.get(
                     "wire_bytes", 0.0
@@ -2151,7 +2152,7 @@ class MeshExecutor:
                     "stage_bytes", 0.0
                 ) + float(
                     plan.window_block_nbytes()
-                    + (pgids.nbytes if pgids is not None else 0)
+                    + _staging.staged_gid_nbytes(pgids)
                 )
             return _staging.concat_stream_windows(
                 self.mesh, plan, win_blocks, win_masks, win_gids,
@@ -2876,7 +2877,8 @@ class MeshExecutor:
         )
 
     def _fold_signature(
-        self, m, specs, key_plan, staged, aux_vals, capacity
+        self, m, specs, key_plan, staged, aux_vals, capacity,
+        preds_repr=None,
     ) -> str:
         """Identity of the FOLD unit alone: scan expressions, UDA update
         lanes, key mode, block geometry, capacity, aux shapes — finalize
@@ -2889,7 +2891,13 @@ class MeshExecutor:
         The sort–compact lane decision (r8) is part of the identity: it
         is made at trace time from the per-block row count, so a flag /
         forced-strategy flip must not reuse a fold traced for the other
-        lane."""
+        lane.
+
+        ``preds_repr`` (r16) overrides the predicate component: the
+        predicate-BATCHED fold erases per-query predicates from its
+        identity (they enter as data — per-slot term tables — not as
+        traced expressions), so every predicate-compatible query shape
+        shares one batched executable per batch-width bucket."""
         from pixie_tpu.ops import segment as _segment
 
         with _segment.platform_hint(self.mesh.devices.flat[0].platform):
@@ -2903,7 +2911,11 @@ class MeshExecutor:
             f"narrow:{sorted(staged.narrow_offsets)}",
             f"intdict:{sorted(staged.int_dicts)}",
             f"hostgids:{key_plan.host_gids is not None}",
-            "preds:" + ";".join(repr(p) for p in m.predicates),
+            "preds:" + (
+                preds_repr
+                if preds_repr is not None
+                else ";".join(repr(p) for p in m.predicates)
+            ),
             "lanes:" + self._lane_sig(specs),
             "key:" + (
                 "host" if key_plan.host_gids is not None else (
@@ -3409,10 +3421,23 @@ class MeshExecutor:
         key_lut,
         gid_base,
         use_host_gids,
+        pred_batch=None,
     ):
-        """The per-block scan body shared by the monolithic program and the
-        streaming window-fold program. carry = (states tuple, presence);
-        xs = (cols tuple, mask, gids)."""
+        """The per-block scan body shared by the monolithic program, the
+        streaming window-fold program, and (r16, ``pred_batch``) the
+        predicate-BATCHED fold. carry = (states tuple, presence);
+        xs = (cols tuple, mask, gids).
+
+        With ``pred_batch = (int_cols, flt_cols, term_args)`` the body
+        serves B queries at once: carry leaves gain a leading slot axis,
+        per-query predicates are evaluated as DATA — a (B, T) table of
+        (stack, column index, comparison op, threshold) conjunctive
+        terms over two dtype-preserving column stacks (int64 for
+        int/bool/code columns, float64 for float columns — both casts
+        are exact, so each slot's mask is bit-equal to the serial
+        predicate evaluation) — and the per-spec state updates vmap over
+        the slot axis with env/gids shared. One scan of the staged
+        blocks serves the whole batch."""
 
         def eval_gids(env, blk_mask):
             if device_key is None:
@@ -3436,9 +3461,6 @@ class MeshExecutor:
                 # Widen frame-of-reference narrowed columns (VPU cast
                 # + add; the transfer savings dwarf this).
                 env[nm] = env[nm].astype(jnp.int64) + narrow_vec[ni]
-            mask = blk_mask
-            for p in preds:
-                mask = mask & evaluator.device_eval(p, env, aux)
             gids = (
                 blk_gids if use_host_gids
                 else eval_gids(env, blk_mask)
@@ -3447,7 +3469,7 @@ class MeshExecutor:
             # rows outside it are masked and their updates land on a
             # clipped (masked-out) slot.
             gids = gids.astype(jnp.int32) - gid_base
-            mask = mask & (gids >= 0) & (gids < capacity)
+            gid_ok = (gids >= 0) & (gids < capacity)
             gids = jnp.clip(gids, 0, capacity - 1)
 
             def eval_col(arg_e, uda):
@@ -3463,93 +3485,156 @@ class MeshExecutor:
                     col = lut[jnp.clip(col, 0, lut.shape[0] - 1)]
                 return col
 
-            # Fused-sum lane: every sum-family UDA contributes f32 limb
-            # rows to ONE shared one-hot einsum (plus the engine's
-            # presence row) — the one-hot generation dominates MXU
-            # segment sums, so per-UDA calls pay it k+1 times (r4).
-            use_fused = _segment.matmul_strategy(capacity)
-            fused_slices: dict[str, tuple[int, int]] = {}
-            totals = None
-            if use_fused:
-                rows = []
-                for out, arg_e, uda in specs:
-                    if uda.fused_rows is None:
+            def apply_updates(states, presence, mask):
+                # Fused-sum lane: every sum-family UDA contributes f32
+                # limb rows to ONE shared one-hot einsum (plus the
+                # engine's presence row) — the one-hot generation
+                # dominates MXU segment sums, so per-UDA calls pay it
+                # k+1 times (r4).
+                use_fused = _segment.matmul_strategy(capacity)
+                fused_slices: dict[str, tuple[int, int]] = {}
+                totals = None
+                if use_fused:
+                    rows = []
+                    for out, arg_e, uda in specs:
+                        if uda.fused_rows is None:
+                            continue
+                        if (
+                            uda.cell_update is not None
+                            and isinstance(arg_e, ColumnRef)
+                            and arg_e.name in int_dict_names
+                        ):
+                            continue  # cell lane serves it
+                        col = (
+                            eval_col(arg_e, uda) if uda.reads_args
+                            else None
+                        )
+                        r = uda.fused_rows(col, mask)
+                        fused_slices[out] = (len(rows), len(rows) + len(r))
+                        rows.extend(r)
+                    rows.append(mask.astype(jnp.float32))  # presence
+                    totals = _segment.limb_einsum_sums(rows, gids, capacity)
+                    presence = presence + totals[-1].astype(presence.dtype)
+                else:
+                    presence = presence + _segment.seg_count(
+                        gids, capacity, mask
+                    ).astype(presence.dtype)
+                # Cell lane: per-column (group, code) histograms via one
+                # MXU einsum each; cell-capable UDAs over int-dictionary
+                # columns update per CELL instead of per row (r5).
+                hists: dict[str, Any] = {}
+                for cname in int_dict_names:
+                    lut = aux[f"intdict:{cname}"]
+                    C = lut.shape[0]
+                    if capacity * C > _segment.MATMUL_MAX_SEGMENTS:
+                        # Cache reuse under a bigger pass capacity than
+                        # the staging's max_card assumed: histogram would
+                        # blow the einsum budget — row path (below) takes
+                        # over via a LUT gather instead.
                         continue
+                    flat = gids * C + env[cname].astype(jnp.int32)
+                    h = _segment.limb_einsum_sums(
+                        [mask.astype(jnp.float32)], flat, capacity * C
+                    )
+                    hists[cname] = h[0].astype(jnp.int64).reshape(
+                        capacity, C
+                    )
+                new_states = []
+                for (out, arg_e, uda), st in zip(specs, states):
                     if (
                         uda.cell_update is not None
                         and isinstance(arg_e, ColumnRef)
                         and arg_e.name in int_dict_names
                     ):
-                        continue  # cell lane serves it
-                    col = (
-                        eval_col(arg_e, uda) if uda.reads_args else None
-                    )
-                    r = uda.fused_rows(col, mask)
-                    fused_slices[out] = (len(rows), len(rows) + len(r))
-                    rows.extend(r)
-                rows.append(mask.astype(jnp.float32))  # presence
-                totals = _segment.limb_einsum_sums(rows, gids, capacity)
-                presence = presence + totals[-1].astype(presence.dtype)
-            else:
-                presence = presence + _segment.seg_count(
-                    gids, capacity, mask
-                ).astype(presence.dtype)
-            # Cell lane: per-column (group, code) histograms via one
-            # MXU einsum each; cell-capable UDAs over int-dictionary
-            # columns update per CELL instead of per row (r5).
-            hists: dict[str, Any] = {}
-            for cname in int_dict_names:
-                lut = aux[f"intdict:{cname}"]
-                C = lut.shape[0]
-                if capacity * C > _segment.MATMUL_MAX_SEGMENTS:
-                    # Cache reuse under a bigger pass capacity than
-                    # the staging's max_card assumed: histogram would
-                    # blow the einsum budget — row path (below) takes
-                    # over via a LUT gather instead.
-                    continue
-                flat = gids * C + env[cname].astype(jnp.int32)
-                h = _segment.limb_einsum_sums(
-                    [mask.astype(jnp.float32)], flat, capacity * C
-                )
-                hists[cname] = h[0].astype(jnp.int64).reshape(
-                    capacity, C
-                )
-            new_states = []
-            for (out, arg_e, uda), st in zip(specs, states):
-                if (
-                    uda.cell_update is not None
-                    and isinstance(arg_e, ColumnRef)
-                    and arg_e.name in int_dict_names
-                ):
-                    if arg_e.name in hists:
-                        new_states.append(
-                            uda.cell_update(
-                                st,
-                                hists[arg_e.name],
-                                aux[f"intdict:{arg_e.name}"],
+                        if arg_e.name in hists:
+                            new_states.append(
+                                uda.cell_update(
+                                    st,
+                                    hists[arg_e.name],
+                                    aux[f"intdict:{arg_e.name}"],
+                                )
                             )
-                        )
-                    else:
-                        lut = aux[f"intdict:{arg_e.name}"]
-                        vals = lut[env[arg_e.name].astype(jnp.int32)]
+                        else:
+                            lut = aux[f"intdict:{arg_e.name}"]
+                            vals = lut[env[arg_e.name].astype(jnp.int32)]
+                            new_states.append(
+                                uda.update(st, gids, vals, mask=mask)
+                            )
+                        continue
+                    if out in fused_slices:
+                        a, b = fused_slices[out]
+                        new_states.append(uda.fused_apply(st, totals[a:b]))
+                        continue
+                    if not uda.reads_args:
+                        # Column never read; gids is a shape-correct dummy.
                         new_states.append(
-                            uda.update(st, gids, vals, mask=mask)
+                            uda.update(st, gids, gids, mask=mask)
                         )
-                    continue
-                if out in fused_slices:
-                    a, b = fused_slices[out]
-                    new_states.append(uda.fused_apply(st, totals[a:b]))
-                    continue
-                if not uda.reads_args:
-                    # Column never read; gids is a shape-correct dummy.
+                        continue
                     new_states.append(
-                        uda.update(st, gids, gids, mask=mask)
+                        uda.update(st, gids, eval_col(arg_e, uda), mask=mask)
                     )
-                    continue
-                new_states.append(
-                    uda.update(st, gids, eval_col(arg_e, uda), mask=mask)
+                return tuple(new_states), presence
+
+            if pred_batch is None:
+                mask = blk_mask
+                for p in preds:
+                    mask = mask & evaluator.device_eval(p, env, aux)
+                mask = mask & gid_ok
+                new_states, presence = apply_updates(
+                    states, presence, mask
                 )
-            return (tuple(new_states), presence), None
+                return (new_states, presence), None
+            # Predicate-batched (r16): per-slot masks from the term
+            # table, then the same update logic vmapped over slots.
+            int_cols, flt_cols, term_args = pred_batch
+            (
+                t_stack, t_col_i, t_col_f, t_op,
+                t_thr_i, t_thr_f, t_active, slot_on,
+            ) = term_args
+            base = blk_mask & gid_ok
+            ivals = (
+                jnp.stack(
+                    [env[c].astype(jnp.int64) for c in int_cols]
+                )
+                if int_cols
+                else jnp.zeros((1,) + blk_mask.shape, jnp.int64)
+            )
+            fvals = (
+                jnp.stack(
+                    [env[c].astype(jnp.float64) for c in flt_cols]
+                )
+                if flt_cols
+                else jnp.zeros((1,) + blk_mask.shape, jnp.float64)
+            )
+            iv = ivals[t_col_i]  # (B, T, rows)
+            fv = fvals[t_col_f]
+
+            def cmp_select(op, v, t):
+                # op ids: 0 ==, 1 !=, 2 <, 3 <=, 4 >, 5 >=
+                return (
+                    ((op == 0) & (v == t))
+                    | ((op == 1) & (v != t))
+                    | ((op == 2) & (v < t))
+                    | ((op == 3) & (v <= t))
+                    | ((op == 4) & (v > t))
+                    | ((op == 5) & (v >= t))
+                )
+
+            opb = t_op[:, :, None]
+            ci = cmp_select(opb, iv, t_thr_i[:, :, None])
+            cf = cmp_select(opb, fv, t_thr_f[:, :, None])
+            term_ok = jnp.where(t_stack[:, :, None] == 0, ci, cf)
+            term_ok = term_ok | ~t_active[:, :, None]
+            slot_masks = (
+                base[None, :]
+                & jnp.all(term_ok, axis=1)
+                & slot_on[:, None]
+            )
+            new_states, presence = jax.vmap(
+                apply_updates, in_axes=(0, 0, 0)
+            )(states, presence, slot_masks)
+            return (new_states, presence), None
 
         return body
 
@@ -3946,6 +4031,7 @@ class MeshExecutor:
             cell_cols=cell_cols,
             num_groups=max(key_plan.num_groups, 1),
             has_gids=key_plan.host_gids is not None,
+            gids=key_plan.host_gids,
         )
         if ring is not None and (
             plan.window_rows != ring.window_rows
@@ -4196,15 +4282,13 @@ class MeshExecutor:
                     mask = _staging._build_mask(
                         self.mesh, plan.d, plan.nblk, plan.b, rows
                     )
-                    dev_g = (
-                        jax.device_put(pgids, sharding)
-                        if pgids is not None
-                        else None
+                    dev_g = _staging.put_window_gids(
+                        self.mesh, pgids, plan.nblk, plan.b
                     )
                     dt_put = time.perf_counter() - t0
                     prof("stage_stream_put", dt_put)
                     wbytes = plan.window_block_nbytes() + (
-                        pgids.nbytes if pgids is not None else 0
+                        _staging.staged_gid_nbytes(pgids)
                     )
                     prof("stage_bytes", float(wbytes))
                     prof("wire_bytes", float(nbytes))
@@ -4335,13 +4419,24 @@ class MeshExecutor:
         ``shared_scans``): concurrent queries whose coalescing key
         matches share ONE dispatch and each runs only its own finalize.
 
-        The key is everything the merged states depend on: the staged
-        entry's IDENTITY (same arrays, via the cache key + object id),
-        the fold signature (predicates, UDA lanes, key mode, geometry,
-        aux shapes — output names and finalize modes excluded, so
-        queries differing only there coalesce), and a content digest of
-        the replicated aux values + key LUT (equal shapes with different
-        values must not share)."""
+        The EXACT key is everything the merged states depend on: the
+        staged entry's IDENTITY (same arrays, via the cache key + object
+        id), the fold signature (predicates, UDA lanes, key mode,
+        geometry, aux shapes — output names and finalize modes excluded,
+        so queries differing only there coalesce), the agg stage (a
+        PARTIAL query's packed buffer holds raw states, a FULL query's
+        holds finalized arrays — they must not share an unpack), and a
+        content digest of the replicated aux values + key LUT (equal
+        shapes with different values must not share).
+
+        r16 widens the compatibility ladder: when this query's
+        predicates normalize to data-driven comparison terms
+        (``_normalize_predicates``), a second predicate-ERASED key is
+        offered to the coordinator — queries matching on everything BUT
+        their predicates assemble into one batched dispatch
+        (``_run_program_batched``) whose per-slot mask lanes evaluate
+        each participant's predicates inside a single scan of the staged
+        blocks."""
         from pixie_tpu.serving.shared_scan import aux_digest
 
         aux2 = dict(aux)
@@ -4355,13 +4450,445 @@ class MeshExecutor:
         digest_vals = list(aux_vals)
         if isinstance(key_plan.device_expr, tuple):
             digest_vals.append(np.asarray(key_plan.device_expr[2]))
-        key = (cache_key, fold_sig, aux_digest(digest_vals), id(staged))
+        stage = m.agg_op.stage.value
+        key = (
+            cache_key, fold_sig, stage, aux_digest(digest_vals),
+            id(staged),
+        )
+        batch_key = terms = compute_batch = None
+        if flags.shared_scan_predicate_batching:
+            terms = self._normalize_predicates(m, evaluator, staged, aux2)
+        if terms is not None:
+            # Shared (predicate-independent) aux: the predicate consts/
+            # LUTs ride the term table as data, so they leave both the
+            # batched program's argument list and the compatibility key.
+            pred_keys: set = set()
+            for name, e in evaluator.named_exprs:
+                if name.startswith("pred"):
+                    pred_keys |= set(
+                        evaluator.build_aux(e, staged.dictionaries)
+                    )
+            shared_aux = {
+                k: v for k, v in aux.items() if k not in pred_keys
+            }
+            shared2 = dict(shared_aux)
+            for n2 in sorted(staged.int_dicts):
+                shared2[f"intdict:{n2}"] = np.asarray(
+                    staged.int_dicts[n2]
+                )
+            shared_vals = list(shared2.values())
+            erased = self._fold_signature(
+                m, specs, key_plan, staged, shared_vals, capacity,
+                preds_repr="<batched>",
+            )
+            sdigest = list(shared_vals)
+            if isinstance(key_plan.device_expr, tuple):
+                sdigest.append(np.asarray(key_plan.device_expr[2]))
+            batch_key = (
+                cache_key, erased, stage, aux_digest(sdigest),
+                id(staged),
+            )
+            compute_batch = (
+                lambda slot_terms: self._run_program_batched(
+                    m, specs, evaluator, key_plan, staged, shared_aux,
+                    slot_terms,
+                )
+            )
         return self._shared_scans.run(
             key,
             lambda: self._run_program(
                 m, specs, evaluator, key_plan, staged, aux
             ),
+            batch_key=batch_key,
+            terms=terms,
+            compute_batch=compute_batch,
         )
+
+    # -- predicate-batched shared scans (r16) --------------------------------
+    # Crescando/SharedDB posture: concurrent queries whose fold shapes
+    # agree on everything except their predicates share ONE scan of the
+    # staged blocks. The batched fold stacks per-query partial-agg state
+    # lanes on a leading slot axis, evaluates each slot's predicates as
+    # DATA (a (B, T) table of comparison terms over dtype-exact column
+    # stacks), and fans finalize out per query — so the compiled
+    # executable is keyed by a predicate-ERASED signature plus pow2
+    # batch-width/term buckets, and batch composition changes never
+    # recompile.
+
+    _CMP_OPS = {
+        "equal": 0, "notEqual": 1,
+        "lessThan": 2, "lessThanEqual": 3,
+        "greaterThan": 4, "greaterThanEqual": 5,
+    }
+    # const-on-the-left flips the comparison, not the operands.
+    _CMP_FLIP = {0: 0, 1: 1, 2: 4, 3: 5, 4: 2, 5: 3}
+
+    def _normalize_predicates(self, m, evaluator, staged, aux):
+        """Lower ``m.predicates`` to conjunctive data terms
+        ``(stack, column, op, int_thr, flt_thr)`` — or None when any
+        predicate falls outside the normalizable class (the query then
+        only shares via the identical-signature ladder).
+
+        The class is a direct comparison of a staged column against a
+        constant (either order), plus a bare boolean column. Exactness
+        contract per term: int/bool/code columns compare in int64
+        (every staged int value and dictionary code fits exactly);
+        float columns compare in float64 with the threshold pre-rounded
+        through the column's STAGED dtype (an f32-staged column's
+        serial comparison happens in f32 — float64(f32(c)) preserves
+        both its equalities and its ordering, so the batched mask is
+        bit-equal). String constants ride as their dictionary code from
+        the aux table (-1 for unseen: equal to nothing, exactly the
+        serial code-compare semantics); columns re-encoded for the cell
+        lane (int_dicts) hold codes the serial path would ALSO compare
+        raw, so they are refused rather than guessed at."""
+        from pixie_tpu.types import DataType
+
+        terms = []
+        for p in m.predicates:
+            if isinstance(p, ColumnRef):
+                if (
+                    p.name not in staged.blocks
+                    or p.name in staged.int_dicts
+                    or np.dtype(staged.blocks[p.name].dtype) != np.bool_
+                ):
+                    return None
+                terms.append(("i", p.name, 1, 0, 0.0))  # col != 0
+                continue
+            if not isinstance(p, FuncCall) or len(p.args) != 2:
+                return None
+            op = self._CMP_OPS.get(p.name)
+            if op is None:
+                return None
+            a0, a1 = p.args
+            if isinstance(a0, ColumnRef) and isinstance(a1, Constant):
+                col, const = a0, a1
+            elif isinstance(a1, ColumnRef) and isinstance(a0, Constant):
+                col, const = a1, a0
+                op = self._CMP_FLIP[op]
+            else:
+                return None
+            if col.name not in staged.blocks or (
+                col.name in staged.int_dicts
+            ):
+                return None
+            resolved = evaluator._resolved.get(id(p))
+            if resolved is None:
+                return None
+            _udf, arg_types = resolved
+            t0 = arg_types[0]
+            bdt = np.dtype(staged.blocks[col.name].dtype)
+            if t0 == DataType.STRING:
+                if op > 1:
+                    return None  # only ==/!= have code-space semantics
+                code = aux.get(f"const:{id(const)}")
+                if code is None:
+                    return None
+                terms.append(("i", col.name, op, int(code), 0.0))
+            elif t0 == DataType.FLOAT64:
+                v = const.value
+                if not isinstance(
+                    v, (int, float, np.floating, np.integer)
+                ) or isinstance(v, bool):
+                    return None
+                if bdt == np.float32:
+                    thr = float(np.float64(np.float32(v)))
+                elif bdt == np.float64:
+                    thr = float(v)
+                else:
+                    return None
+                terms.append(("f", col.name, op, 0, thr))
+            elif t0 in (
+                DataType.INT64, DataType.TIME64NS, DataType.BOOLEAN,
+            ):
+                if bdt.kind == "f":
+                    return None
+                try:
+                    thr = int(const.value)
+                except (TypeError, ValueError):
+                    return None
+                if not (-(1 << 63) <= thr < (1 << 63)):
+                    return None
+                terms.append(("i", col.name, op, thr, 0.0))
+            else:
+                return None
+        return terms
+
+    def _pred_stacks(self, staged):
+        """The two dtype-preserving predicate column stacks: int64 for
+        int/bool/code blocks (incl. narrowed columns, which the scan
+        body widens to int64 before stacking), float64 for float
+        blocks. Cell-lane code columns are excluded (normalization
+        refuses them). Derived from the staged geometry alone, so the
+        stack layout is part of the predicate-erased signature."""
+        int_cols, flt_cols = [], []
+        for c in sorted(staged.blocks):
+            if c in staged.int_dicts:
+                continue
+            k = np.dtype(staged.blocks[c].dtype).kind
+            if k in "iub":
+                int_cols.append(c)
+            elif k == "f":
+                flt_cols.append(c)
+        return int_cols, flt_cols
+
+    @staticmethod
+    def _bucket_pow2(n: int, floor: int = 1) -> int:
+        c = max(floor, 1)
+        while c < n:
+            c <<= 1
+        return c
+
+    def _build_batched_init(self, specs, capacity, batch):
+        """Batched identity states: one init per (UDA set, capacity,
+        batch width) — the r7 init unit with a slot axis between the
+        device axis and the state."""
+        d = self.mesh.devices.size
+        (axis_name,) = self.mesh.axis_names
+        sharding = NamedSharding(self.mesh, P(axis_name))
+
+        def init():
+            st = (
+                tuple(uda.init(capacity) for _, _, uda in specs),
+                jnp.zeros(capacity, jnp.int64),
+            )
+            return [
+                jnp.broadcast_to(
+                    leaf[None, None], (d, batch) + leaf.shape
+                )
+                for leaf in jax.tree.leaves(st)
+            ]
+
+        return jax.jit(init, out_shardings=sharding)
+
+    # term-table argument count of the batched fold (t_stack, t_col_i,
+    # t_col_f, t_op, t_thr_i, t_thr_f, t_active, slot_on).
+    _N_TERM_ARGS = 8
+
+    def _build_batched_fold(
+        self,
+        specs,
+        evaluator,
+        key_plan,
+        col_names,
+        narrow_names,
+        int_dict_names,
+        aux_key_order,
+        capacity,
+        n_state_leaves,
+        treedef,
+        int_cols,
+        flt_cols,
+    ):
+        """The batched FOLD unit (r16): same contract as _build_fold —
+        device-local, no collectives, per-device states in and out —
+        but carry leaves have a leading slot axis and the per-query
+        predicate term tables ride as replicated args after the aux
+        lane. One compiled executable serves every predicate-compatible
+        batch at this (geometry, lanes, batch, terms) bucket."""
+        axis = self.mesh.axis_names[0]
+        has_host_gids = key_plan.host_gids is not None
+        has_key_lut = isinstance(key_plan.device_expr, tuple)
+        device_key = key_plan.device_expr
+        n_term = self._N_TERM_ARGS
+
+        def shard_fn(*arrs):
+            # Layout: state leaves..., cols..., mask, [gids], [key_lut],
+            # aux..., [narrow_offsets], term table (8), gid_base.
+            carry = jax.tree.unflatten(
+                treedef, [a[0] for a in arrs[:n_state_leaves]]
+            )
+            i = n_state_leaves
+            cols = {
+                n: a[0]
+                for n, a in zip(col_names, arrs[i : i + len(col_names)])
+            }
+            i += len(col_names)
+            mask_all = arrs[i][0]
+            i += 1
+            gids_all = None
+            if has_host_gids:
+                gids_all = arrs[i][0]
+                i += 1
+            key_lut = None
+            if has_key_lut:
+                key_lut = arrs[i]
+                i += 1
+            gid_base = arrs[-1]
+            term_args = arrs[-(n_term + 1) : -1]
+            if narrow_names:
+                narrow_vec = arrs[-(n_term + 2)]
+                aux_end = -(n_term + 2)
+            else:
+                narrow_vec = None
+                aux_end = -(n_term + 1)
+            aux = dict(zip(aux_key_order, arrs[i:aux_end]))
+            body = self._make_scan_body(
+                specs, evaluator, col_names, narrow_names,
+                int_dict_names, [], device_key, has_key_lut, capacity,
+                aux, narrow_vec, key_lut, gid_base, has_host_gids,
+                pred_batch=(int_cols, flt_cols, term_args),
+            )
+            xs = (
+                tuple(cols[n] for n in col_names),
+                mask_all,
+                gids_all if gids_all is not None else mask_all,
+            )
+            carry, _ = jax.lax.scan(body, carry, xs)
+            return tuple(leaf[None] for leaf in jax.tree.leaves(carry))
+
+        n_sharded = (
+            n_state_leaves + len(col_names) + 1
+            + (1 if has_host_gids else 0)
+        )
+        n_repl = (
+            (1 if has_key_lut else 0)
+            + len(aux_key_order)
+            + (1 if narrow_names else 0)
+            + n_term
+            + 1  # +gid_base
+        )
+        in_specs = tuple([P(axis)] * n_sharded + [P()] * n_repl)
+        out_specs = tuple([P(axis)] * n_state_leaves)
+        return jax.jit(
+            shard_map(
+                shard_fn,
+                mesh=self.mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                **_SM_CHECK_KW,
+            )
+        )
+
+    def _run_program_batched(
+        self, m, specs, evaluator, key_plan, staged, aux, slot_terms
+    ):
+        """Execute ONE batched fold dispatch serving ``len(slot_terms)``
+        predicate-compatible queries, and fan the results out per slot.
+        The slot/term axes pad to pow2 buckets so compiled programs are
+        reused across batch compositions; the merge and finalize units
+        are the EXACT r7 executables the serial path uses, applied to
+        each slot's state slice — per-query results are bit-identical
+        to serial execution by construction of the mask lanes."""
+        aux = dict(aux)
+        for n2 in sorted(staged.int_dicts):
+            aux[f"intdict:{n2}"] = np.asarray(staged.int_dicts[n2])
+        aux_vals = list(aux.values())
+        aux_key_order = list(aux.keys())
+        capacity, n_passes = self._pass_plan(specs, key_plan.num_groups)
+        int_cols, flt_cols = self._pred_stacks(staged)
+        i_idx = {c: i for i, c in enumerate(int_cols)}
+        f_idx = {c: i for i, c in enumerate(flt_cols)}
+        nslots = len(slot_terms)
+        B = self._bucket_pow2(nslots)
+        T = self._bucket_pow2(max([len(t) for t in slot_terms] + [1]))
+        t_stack = np.zeros((B, T), np.int32)
+        t_col_i = np.zeros((B, T), np.int32)
+        t_col_f = np.zeros((B, T), np.int32)
+        t_op = np.zeros((B, T), np.int32)
+        t_thr_i = np.zeros((B, T), np.int64)
+        t_thr_f = np.zeros((B, T), np.float64)
+        t_active = np.zeros((B, T), np.bool_)
+        slot_on = np.zeros((B,), np.bool_)
+        for s, terms in enumerate(slot_terms):
+            slot_on[s] = True
+            for t, (stack, cname, op, thr_i, thr_f) in enumerate(terms):
+                t_active[s, t] = True
+                t_op[s, t] = op
+                if stack == "i":
+                    t_col_i[s, t] = i_idx[cname]
+                    t_thr_i[s, t] = thr_i
+                else:
+                    t_stack[s, t] = 1
+                    t_col_f[s, t] = f_idx[cname]
+                    t_thr_f[s, t] = thr_f
+        erased = self._fold_signature(
+            m, specs, key_plan, staged, aux_vals, capacity,
+            preds_repr="<batched>",
+        )
+        bsig = f"bfold|{erased}|batch:{B}|terms:{T}"
+        treedef, leaves = self._state_template(specs, capacity)
+        lanes = self._uda_set_sig(specs)
+        mesh_s = f"{self.mesh.devices.shape}"
+        col_names = sorted(staged.blocks)
+        narrow_names = sorted(staged.narrow_offsets)
+        int_dict_names = sorted(staged.int_dicts)
+        init_p = self._get_program(
+            f"binit|{lanes}|cap:{capacity}|batch:{B}|mesh:{mesh_s}",
+            lambda: self._build_batched_init(specs, capacity, B),
+        )
+        fold_p = self._get_program(
+            bsig,
+            lambda: self._build_batched_fold(
+                specs, evaluator, key_plan, col_names, narrow_names,
+                int_dict_names, aux_key_order, capacity, len(leaves),
+                treedef, int_cols, flt_cols,
+            ),
+            n_aux=len(aux_vals),
+        )
+        # Merge/finalize are the SAME cached units serial queries use.
+        merge_p = self._get_program(
+            f"merge|{lanes}|cap:{capacity}|mesh:{mesh_s}",
+            lambda: self._build_merge(
+                specs, capacity, len(leaves), treedef
+            ),
+        )
+        force_state = m.agg_op.stage == AggStage.PARTIAL
+        fin_p = self._get_program(
+            f"fin|{lanes}|cap:{capacity}|state:{force_state}|mesh:{mesh_s}",
+            lambda: self._build_fin(specs, capacity, force_state, treedef),
+        )
+        _, templates = self._finalize_modes(specs, capacity, force_state)
+        args = [staged.blocks[n] for n in col_names] + [staged.mask]
+        if key_plan.host_gids is not None:
+            args.append(staged.gids)
+        if isinstance(key_plan.device_expr, tuple):
+            args.append(jnp.asarray(key_plan.device_expr[2]))
+        args.extend(jnp.asarray(v) for v in aux_vals)
+        if staged.narrow_offsets:
+            args.append(
+                jnp.asarray(
+                    [
+                        staged.narrow_offsets[n]
+                        for n in sorted(staged.narrow_offsets)
+                    ],
+                    jnp.int64,
+                )
+            )
+        args.extend(
+            jnp.asarray(x)
+            for x in (
+                t_stack, t_col_i, t_col_f, t_op, t_thr_i, t_thr_f,
+                t_active, slot_on,
+            )
+        )
+        from pixie_tpu.ops import segment as _segment
+
+        per_slot: list[list] = [[] for _ in range(nslots)]
+        with _segment.platform_hint(self.mesh.devices.flat[0].platform):
+            for p in range(n_passes):
+                flat = list(init_p())
+                t0 = time.perf_counter()
+                flat = list(
+                    fold_p(*flat, *args, jnp.int32(p * capacity))
+                )
+                if resattr.ACTIVE:
+                    resattr.record_dispatch(
+                        "batched_fold",
+                        time.perf_counter() - t0,
+                        program=resattr.program_name(bsig),
+                        rows=staged.num_rows,
+                    )
+                for s in range(nslots):
+                    merged_flat = merge_p(*[leaf[:, s] for leaf in flat])
+                    buf = fin_p(*merged_flat)
+                    per_slot[s].append(
+                        self._unpack_outputs(templates, capacity, buf)
+                    )
+        return [
+            self._recombine_passes(per_slot[s], specs, capacity, n_passes)
+            for s in range(nslots)
+        ]
 
     def _record_fold_shape(
         self, m, specs, key_plan, staged, capacity, aux

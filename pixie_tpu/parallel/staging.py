@@ -382,7 +382,35 @@ def stage_columns(
     mask_dev = _build_mask(mesh, d, nblk, b, num_rows)
     gids_dev = None
     if gids is not None:
-        gids_dev = jax.device_put(shape3(_narrow_gids(gids, num_groups), 0), sharding)
+        gflat = flat_pad(_narrow_gids(gids, num_groups), 0)
+        gpayload = None
+        if use_codec and num_rows > 0:
+            # r16: the gids lane rides the codec like any value column —
+            # sorted/low-churn group keys RLE to ~nothing.
+            with timed("stage_encode"):
+                gplan = _codec.plan_codec_local(
+                    gflat, d, nblk, b, num_rows,
+                    float(flags.staging_codec_min_ratio),
+                )
+                if gplan is not None:
+                    try:
+                        gpayload = _codec.encode_window(
+                            gflat, gplan, num_rows
+                        )
+                    except _codec.CodecOverflow:
+                        gpayload = None
+        if gpayload is not None:
+            with timed("stage_transfer"):
+                gargs = _codec.put_payload(mesh, gpayload)
+                COLD_PROFILE["wire_bytes"] = COLD_PROFILE.get(
+                    "wire_bytes", 0.0
+                ) + float(gpayload.nbytes)
+            with timed("stage_decode"):
+                gids_dev = _codec.decoder(mesh, gplan, nblk, b)(*gargs)
+        else:
+            gids_dev = jax.device_put(
+                gflat.reshape(d, nblk, b), sharding
+            )
     return StagedColumns(
         blocks=blocks,
         mask=mask_dev,
@@ -450,6 +478,11 @@ class StreamPlan:
     # from the FULL column like every other recipe entry, so all windows
     # share one decode program. Columns absent here ship passthrough.
     codecs: dict = dataclasses.field(default_factory=dict)
+    # r16: the GIDS stream rides the codec too — rows grouped by sorted
+    # or low-churn keys yield long gid runs that RLE to ~nothing, and
+    # the gids lane is a full extra column of wire bytes on every
+    # host-gids staging. None = passthrough (random-ish gids).
+    gid_codec: Optional[object] = None
 
     def window_block_nbytes(self) -> int:
         """Decoded (HBM) bytes per full window: column blocks only —
@@ -496,6 +529,7 @@ def plan_stream(
     cell_cols: Optional[dict] = None,
     num_groups: int = 1,
     has_gids: bool = False,
+    gids: Optional[np.ndarray] = None,
 ) -> StreamPlan:
     """Fix the pack recipe + window geometry for a streamed staging.
 
@@ -555,6 +589,7 @@ def plan_stream(
     # anything because run boundaries are invariant under the pack
     # transforms (bit-pattern changes map 1:1).
     codecs: dict = {}
+    gid_codec = None
     if flags.staging_codec:
         from pixie_tpu.ops import codec as _codec
 
@@ -568,6 +603,16 @@ def plan_stream(
             )
             if cp is not None:
                 codecs[name] = cp
+        if gids is not None and gid_dtype is not None and gids.size:
+            # r16: the gids lane is an extra full-width column on every
+            # host-gids staging; sorted/low-churn group keys make it
+            # run-heavy, so plan it like any value column. The narrow
+            # cast (astype, values unchanged) preserves both run
+            # boundaries and diffs, so stats on the raw gids are exact.
+            gid_codec = _codec.plan_codec(
+                gids, gid_dtype, d, nblk, b, window_rows, num_rows,
+                float(flags.staging_codec_min_ratio), affine=True,
+            )
     return StreamPlan(
         col_plans=col_plans,
         narrow_offsets=narrow_offsets,
@@ -582,6 +627,7 @@ def plan_stream(
         gid_dtype=gid_dtype,
         num_groups=num_groups,
         codecs=codecs,
+        gid_codec=gid_codec,
     )
 
 
@@ -662,11 +708,54 @@ def pack_stream_window(
             nbytes += packed[name].nbytes
         packed_gids = None
         if gids is not None:
-            packed_gids = shape3(
-                gids[lo:hi].astype(plan.gid_dtype), plan.gid_dtype
-            )
+            if plan.gid_codec is not None:
+                flat = flat_pad(
+                    gids[lo:hi].astype(plan.gid_dtype), plan.gid_dtype
+                )
+                try:
+                    with timed("stage_encode"):
+                        packed_gids = _codec.encode_window(
+                            flat, plan.gid_codec, rows
+                        )
+                except _codec.CodecOverflow:
+                    packed_gids = flat.reshape(
+                        plan.d, plan.nblk, plan.b
+                    )
+            else:
+                packed_gids = shape3(
+                    gids[lo:hi].astype(plan.gid_dtype), plan.gid_dtype
+                )
             nbytes += packed_gids.nbytes
         return rows, packed, packed_gids, nbytes
+
+
+def put_window_gids(mesh: Mesh, pgids, nblk: int, b: int):
+    """Land one window's packed gids on the mesh: a raw [D, nblk, B]
+    ndarray device_puts as before; a CodecPayload (r16 gid codec)
+    transfers the compressed representation and expands on device —
+    bit-identical to the raw put."""
+    from pixie_tpu.ops import codec as _codec
+
+    if pgids is None:
+        return None
+    (axis_name,) = mesh.axis_names
+    if isinstance(pgids, _codec.CodecPayload):
+        args = _codec.put_payload(mesh, pgids)
+        return _codec.decoder(mesh, pgids.plan, nblk, b)(*args)
+    return jax.device_put(pgids, NamedSharding(mesh, P(axis_name)))
+
+
+def staged_gid_nbytes(pgids) -> int:
+    """Decoded (HBM) bytes a packed-gids value lands as — the
+    stage_bytes accounting view; .nbytes on a CodecPayload is WIRE
+    bytes."""
+    from pixie_tpu.ops import codec as _codec
+
+    if pgids is None:
+        return 0
+    if isinstance(pgids, _codec.CodecPayload):
+        return pgids.plan.block_nbytes()
+    return int(pgids.nbytes)
 
 
 @functools.lru_cache(maxsize=16)

@@ -1,4 +1,4 @@
-"""Multi-query serving engine (r12).
+"""Multi-query serving engine (r12, widened r16).
 
 The single-chip hot path (r5-r8) and control plane (r9-r11) assume one
 query owns the chip; the reference's query-broker + script-runner model
@@ -9,25 +9,35 @@ the SAME hot tables. This package is the layer between them:
   query-scoped pinning, LRU eviction with high/low watermarks against
   ``hbm_budget_mb``. Replaces the entry-count OrderedDict the
   MeshExecutor carried since r4.
-- ``shared_scan``: concurrent queries whose fold signatures match (the
-  r7 decomposed init/fold/merge/finalize units make compatibility a
-  string compare) coalesce into ONE device fold dispatch; finalize fans
-  out per query (shared-scan engines: Crescando/SharedDB).
+- ``shared_scan``: concurrent compatible queries coalesce into ONE
+  device fold dispatch on a two-rung ladder (shared-scan engines:
+  Crescando/SharedDB): identical fold signatures share the leader's
+  merged states (r12); predicate-COMPATIBLE queries (r16) batch into a
+  single scan whose per-query predicate mask lanes stack partial-agg
+  states on a slot axis — finalize fans out per query either way,
+  bit-identical to serial.
 - ``admission``: broker-side admission control — concurrency limit,
   per-tenant weighted fair queueing, HBM byte-budget check, structured
   ``AdmissionRejected`` on overload (never a hang).
+- ``controller``: the r16 closed-loop half — an SLO-window adapter on
+  the cron runner that reads admission-wait quantiles, queue depth,
+  device-dispatch wall time, and HBM residency, and actuates
+  ``admission_max_concurrent`` / ``shared_scan_window_ms`` /
+  ``hbm_budget_mb`` within guard rails.
 - ``signatures``: datastore-backed persistence of observed fold shapes
   so ``prewarm_compile`` replays real query shapes across restarts
   instead of guessing the canonical count+sum(f64) shape.
 """
 
 from pixie_tpu.serving.admission import AdmissionController, AdmissionRejected
+from pixie_tpu.serving.controller import AdmissionControlLoop
 from pixie_tpu.serving.residency import ResidencyPool, staged_nbytes
 from pixie_tpu.serving.shared_scan import SharedScanCoordinator
 from pixie_tpu.serving.signatures import FoldSignatureStore
 
 __all__ = [
     "AdmissionController",
+    "AdmissionControlLoop",
     "AdmissionRejected",
     "FoldSignatureStore",
     "ResidencyPool",

@@ -327,6 +327,13 @@ class AdmissionController:
             detail=detail,
         )
 
+    def queue_depth(self) -> int:
+        """Live queue depth, lock-free (an int read is atomic in
+        CPython; this is the advisory gate for the shared-scan window
+        skip and the r16 controller — momentary staleness only costs a
+        window that slept or skipped one arrival too early)."""
+        return self._waiting
+
     def _release(self) -> None:
         with self._cv:
             self._active -= 1

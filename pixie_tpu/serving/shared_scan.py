@@ -2,31 +2,45 @@
 
 Ref posture: shared-scan engines (Crescando, SharedDB) batch concurrent
 queries over the same hot table into one scan whose per-query predicates
-evaluate inline. Here the unit of sharing is even cleaner: the r7
-program decomposition split every device aggregation into
-init/fold/merge/finalize units, with the FOLD signature excluding output
-names and finalize modes — so two queries that differ only in what they
-call their outputs, or how they finalize (FULL vs PARTIAL, a different
-quantile over the same sketch lane), already share one compiled fold
-EXECUTABLE. This module makes them share one fold EXECUTION: the first
-arrival (the leader) dispatches; compatible queries arriving while the
-dispatch is in flight (plus an optional pre-dispatch batching window,
-``shared_scan_window_ms``) attach to it and reuse the leader's merged
-UDA states. Finalize fans out per query, so results are bit-identical
-to serial execution — followers consume the exact arrays the leader's
-dispatch produced.
+evaluate inline. The unit of sharing here is the r7 program
+decomposition: every device aggregation splits into init/fold/merge/
+finalize units, with the FOLD signature excluding output names and
+finalize modes — so queries that differ only there already share one
+compiled fold EXECUTABLE. This module makes them share fold EXECUTIONS,
+on a two-rung compatibility ladder:
 
-Compatibility is a KEY equality, not a heuristic: the key is the staged
-cache identity (table, version, column set, window, key plan, geometry)
-+ the fold signature (predicates, UDA lanes, key mode, aux shapes) + a
-digest of the replicated aux VALUES (two LUTs with equal shapes but
-different contents must not share). Anything that could change the
-merged states is in the key.
+1. **Identical signature** (r12): the first arrival (the leader)
+   dispatches; queries whose EXACT key matches — staged-entry identity +
+   fold signature (incl. predicates) + agg stage + aux-value digest —
+   attach while the dispatch is in flight (plus the optional
+   pre-dispatch window, ``shared_scan_window_ms``) and reuse the
+   leader's merged UDA states. Finalize fans out per query.
+2. **Predicate-compatible** (r16, flag
+   ``shared_scan_predicate_batching``): queries that match on
+   everything EXCEPT their predicates — and whose predicates normalize
+   to data-driven comparison terms (pipeline._normalize_predicates) —
+   assemble into one BATCHED dispatch: the leader's
+   ``compute_batch(slot_terms)`` runs a single scan of the staged
+   blocks with one masked partial-agg state lane per distinct
+   predicate set, and every participant receives its own slot's merged
+   states. Effective concurrency scales with batch width instead of
+   the admission concurrency limit.
+
+Both rungs are bit-identical to serial execution — followers consume
+exactly the arrays a serial run of their query would have produced.
+
+The batching window is demand-gated (r16 satellite): a leader only
+sleeps ``shared_scan_window_ms`` when the admission queue has depth
+(``set_queue_depth_fn``, wired by the broker) — a solo query on an idle
+engine no longer pays the window tax, and the closed-loop admission
+controller (serving/controller.py) drives the window length from
+telemetry otherwise.
 
 Observability: each participating query records a ``serving.shared_scan``
 trace span carrying ``shared_scan_batch_size`` and its role, and the
-shared /metrics registry counts dispatches vs saved dispatches so the
-≥2x dispatch-reduction acceptance bar is measurable.
+shared /metrics registry counts dispatches vs saved dispatches plus the
+per-dispatch BATCH WIDTH histogram (distinct predicate slots served by
+one scan — the r16 headline serving metric).
 """
 
 from __future__ import annotations
@@ -34,7 +48,7 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import numpy as np
 
@@ -55,6 +69,50 @@ _BATCH_SIZE = _M.histogram(
     "Queries served per shared-scan dispatch.",
     buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 64),
 )
+_BATCH_WIDTH = _M.histogram(
+    "serving_shared_scan_batch_width",
+    "Distinct predicate slots served per shared-scan dispatch (r16: >1 "
+    "means predicate-compatible queries shared one batched scan).",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 64),
+)
+_PRED_BATCHED = _M.counter(
+    "serving_shared_scan_predicate_batched_queries_total",
+    "Queries served from a predicate-batched (width > 1) dispatch.",
+)
+_WINDOW_SKIPS = _M.counter(
+    "serving_shared_scan_window_skips_total",
+    "Batching windows skipped because the admission queue was empty "
+    "(the r16 solo-query window-tax fix).",
+)
+
+# Admission-queue depth gate for the batching window. None = unknown
+# (no broker/admission wired): keep the pre-r16 always-sleep behavior
+# so standalone engines batch deterministically under a window.
+_QUEUE_DEPTH_FN: Optional[Callable[[], int]] = None
+
+
+def set_queue_depth_fn(fn: Optional[Callable[[], int]]) -> None:
+    global _QUEUE_DEPTH_FN
+    _QUEUE_DEPTH_FN = fn
+
+
+def clear_queue_depth_fn(fn: Optional[Callable[[], int]] = None) -> None:
+    """Unset the gate — only if ``fn`` still owns it (a stopped broker
+    must not yank a newer broker's wiring)."""
+    global _QUEUE_DEPTH_FN
+    if fn is None or _QUEUE_DEPTH_FN is fn:
+        _QUEUE_DEPTH_FN = None
+
+
+def _queue_depth() -> int:
+    """Live admission queue depth, or -1 when unknown."""
+    fn = _QUEUE_DEPTH_FN
+    if fn is None:
+        return -1
+    try:
+        return int(fn())
+    except Exception:
+        return -1
 
 
 def aux_digest(aux_vals) -> str:
@@ -72,77 +130,159 @@ def aux_digest(aux_vals) -> str:
 
 
 class _Batch:
-    __slots__ = ("event", "result", "error", "joiners", "closed")
+    """One in-flight dispatch: a list of slots (distinct exact keys,
+    each with its normalized predicate terms) plus everyone waiting on
+    the published per-slot results."""
 
-    def __init__(self):
+    __slots__ = (
+        "event", "results", "error", "slots", "terms", "joiners",
+        "closed", "published", "batch_key",
+    )
+
+    def __init__(self, batch_key=None):
         self.event = threading.Event()
-        self.result = None
+        self.results: "list | None" = None
         self.error: "BaseException | None" = None
-        self.joiners = 1  # the leader
-        self.closed = False  # result published; late arrivals start fresh
+        self.slots: dict[Any, int] = {}  # exact key -> slot index
+        self.terms: list = []  # per-slot predicate terms (None = opaque)
+        self.joiners = 0
+        self.closed = False  # slot set frozen: the leader is dispatching
+        self.published = False  # results visible; late arrivals start fresh
+        self.batch_key = batch_key
 
 
 class SharedScanCoordinator:
-    """Coalesces identical-key compute() calls into one execution.
+    """Coalesces compatible compute() calls into shared executions.
 
     ``run(key, compute)`` — the first caller for a key becomes the
-    leader: it (optionally) waits the batching window, executes
-    ``compute()``, publishes the result, and wakes the batch. Callers
-    arriving before publication join the batch and return the leader's
-    result without dispatching. A leader error propagates to every
-    joiner (each would have hit the same error; retrying it N times
-    against a failing device would just churn the breaker)."""
+    leader: it (optionally) waits the batching window, executes,
+    publishes, and wakes the batch. Callers arriving before publication
+    join and return the leader's result without dispatching. With the
+    r16 ladder (``batch_key``/``terms``/``compute_batch``), callers
+    whose exact keys differ but whose batch keys match join the same
+    dispatch as separate SLOTS — the leader then runs ONE
+    ``compute_batch(slot_terms)`` returning a result per slot. A leader
+    error propagates to every joiner (each would have hit the same
+    error; retrying it N times against a failing device would just
+    churn the breaker)."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._inflight: dict[Any, _Batch] = {}
+        self._by_exact: dict[Any, _Batch] = {}
+        self._by_batch: dict[Any, _Batch] = {}
 
-    def run(self, key, compute: Callable[[], Any]):
+    def run(
+        self,
+        key,
+        compute: Callable[[], Any],
+        batch_key=None,
+        terms=None,
+        compute_batch: Optional[Callable[[list], list]] = None,
+    ):
+        batchable = (
+            batch_key is not None
+            and terms is not None
+            and compute_batch is not None
+        )
+        max_width = max(int(flags.shared_scan_max_batch), 1)
         with self._lock:
-            batch = self._inflight.get(key)
-            if batch is not None and not batch.closed:
-                batch.joiners += 1
+            b = self._by_exact.get(key)
+            if b is not None and not b.published:
+                # Rung 1: identical signature — share the slot (works
+                # even after close: the slot's result is determined).
+                b.joiners += 1
+                slot = b.slots[key]
                 leader = False
             else:
-                batch = self._inflight[key] = _Batch()
-                leader = True
-        if leader:
-            window_s = float(flags.shared_scan_window_ms) / 1e3
-            if window_s > 0:
-                time.sleep(window_s)
-            try:
-                result = compute()
-                err = None
-            except BaseException as e:  # propagate to every joiner
-                result, err = None, e
+                g = self._by_batch.get(batch_key) if batchable else None
+                if (
+                    g is not None
+                    and not g.closed
+                    and not g.published
+                    and len(g.terms) < max_width
+                ):
+                    # Rung 2: predicate-compatible — a new slot in an
+                    # open batch.
+                    slot = len(g.terms)
+                    g.slots[key] = slot
+                    g.terms.append(terms)
+                    g.joiners += 1
+                    self._by_exact[key] = g
+                    b = g
+                    leader = False
+                else:
+                    b = _Batch(batch_key if batchable else None)
+                    b.joiners = 1
+                    b.slots[key] = 0
+                    b.terms.append(terms)
+                    slot = 0
+                    self._by_exact[key] = b
+                    if batchable:
+                        self._by_batch[batch_key] = b
+                    leader = True
+        if not leader:
+            b.event.wait()
+            _SAVED.inc()
             with self._lock:
-                batch.result = result
-                batch.error = err
-                batch.closed = True
-                if self._inflight.get(key) is batch:
-                    del self._inflight[key]
-                size = batch.joiners
-            batch.event.set()
-            _DISPATCHES.inc()
-            _BATCH_SIZE.observe(size)
-            self._span(size, role="leader")
-            if err is not None:
-                raise err
-            return result
-        batch.event.wait()
-        _SAVED.inc()
+                size = b.joiners
+                width = len(b.terms)
+            if width > 1:
+                _PRED_BATCHED.inc()
+            self._span(size, width, role="follower")
+            if b.error is not None:
+                raise b.error
+            return b.results[slot]
+        # Leader: batching window (demand-gated, r16), then dispatch.
+        window_s = float(flags.shared_scan_window_ms) / 1e3
+        if window_s > 0:
+            if _queue_depth() == 0:
+                _WINDOW_SKIPS.inc()
+            else:
+                time.sleep(window_s)
         with self._lock:
-            size = batch.joiners
-        self._span(size, role="follower")
-        if batch.error is not None:
-            raise batch.error
-        return batch.result
+            b.closed = True
+            slot_terms = list(b.terms)
+        try:
+            if len(slot_terms) == 1:
+                result_list = [compute()]
+            else:
+                result_list = compute_batch(slot_terms)
+            err = None
+        except BaseException as e:  # propagate to every joiner
+            result_list, err = None, e
+        with self._lock:
+            b.results = result_list
+            b.error = err
+            b.published = True
+            for k2 in b.slots:
+                if self._by_exact.get(k2) is b:
+                    del self._by_exact[k2]
+            if b.batch_key is not None and (
+                self._by_batch.get(b.batch_key) is b
+            ):
+                del self._by_batch[b.batch_key]
+            size = b.joiners
+            width = len(slot_terms)
+        b.event.set()
+        _DISPATCHES.inc()
+        _BATCH_SIZE.observe(size)
+        _BATCH_WIDTH.observe(width)
+        if width > 1:
+            _PRED_BATCHED.inc()
+        self._span(size, width, role="leader")
+        if err is not None:
+            raise err
+        return b.results[0]
 
     @staticmethod
-    def _span(batch_size: int, role: str) -> None:
+    def _span(batch_size: int, width: int, role: str) -> None:
         if trace.ACTIVE:
             trace.record(
                 "serving.shared_scan",
                 0,
-                attrs={"shared_scan_batch_size": batch_size, "role": role},
+                attrs={
+                    "shared_scan_batch_size": batch_size,
+                    "shared_scan_batch_width": width,
+                    "role": role,
+                },
             )

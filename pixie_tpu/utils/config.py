@@ -348,6 +348,81 @@ define_flag(
     "tenant's backlog tail.",
 )
 
+# -- predicate-batched shared scans + closed-loop admission (r16) ------------
+define_flag(
+    "shared_scan_predicate_batching",
+    True,
+    help_="Widen shared-scan compatibility from identical-signature to "
+    "predicate-COMPATIBLE (serving/shared_scan.py ladder rung 2): "
+    "concurrent queries matching on everything except their predicates "
+    "batch into ONE fold dispatch whose per-query predicate masks "
+    "evaluate inside a single scan of the staged blocks (masked "
+    "partial-agg state lanes stacked on a slot axis, per-query finalize "
+    "fan-out — bit-identical to serial). The batched executable is "
+    "keyed by a predicate-ERASED fold signature + pow2 batch-width "
+    "bucket, so batch composition changes never recompile; the "
+    "serving_shared_scan_batch_width histogram is the headline metric.",
+)
+define_flag(
+    "shared_scan_max_batch",
+    16,
+    help_="Most predicate slots one batched shared-scan dispatch "
+    "serves; arrivals past it start the next batch. Bounds the batched "
+    "program's state memory (B x per-query state lanes) and compile "
+    "variety (widths bucket to pow2 up to this).",
+)
+define_flag(
+    "admission_controller",
+    False,
+    help_="Close the admission loop (serving/controller.py): an "
+    "SLO-window-driven adapter riding the cron runner reads admission "
+    "wait quantiles, queue depth, device-dispatch wall time, and HBM "
+    "residency, and actuates admission_max_concurrent / "
+    "shared_scan_window_ms / hbm_budget_mb within guard rails — a "
+    "controller, not a knob. Off = the r12 static flag values.",
+)
+define_flag(
+    "admission_controller_interval_s",
+    2.0,
+    help_="Seconds between admission-controller evaluation ticks (the "
+    "cron ticker period; each tick is one control-law step over the "
+    "window since the previous tick).",
+)
+define_flag(
+    "admission_controller_min_concurrent",
+    2,
+    help_="Guard rail: the controller never moves "
+    "admission_max_concurrent below this floor.",
+)
+define_flag(
+    "admission_controller_max_concurrent",
+    128,
+    help_="Guard rail: the controller never moves "
+    "admission_max_concurrent above this ceiling.",
+)
+define_flag(
+    "admission_controller_max_window_ms",
+    50.0,
+    help_="Guard rail: the controller never raises "
+    "shared_scan_window_ms above this ceiling (floor is 0 — the window "
+    "is already demand-gated on queue depth).",
+)
+define_flag(
+    "admission_controller_max_hbm_mb",
+    0,
+    help_="Guard rail: ceiling for controller-raised hbm_budget_mb. 0 "
+    "disables HBM actuation entirely (the controller never invents a "
+    "budget and never touches one it cannot bound).",
+)
+define_flag(
+    "admission_controller_wait_target_ms",
+    250.0,
+    help_="Control target: windowed admission-wait p50 above this "
+    "raises concurrency (when HBM headroom allows); a p50 under a "
+    "tenth of it with an empty queue decays concurrency back toward "
+    "the configured baseline.",
+)
+
 # -- staging codec + device-resident ingest (r13) ----------------------------
 define_flag(
     "staging_codec",

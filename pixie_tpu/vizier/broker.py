@@ -337,6 +337,23 @@ class QueryBroker:
                 residency.snapshot if residency is not None else None
             )
         )
+        # r16: the shared-scan batching window is demand-gated on live
+        # admission queue depth — a solo query on an idle broker no
+        # longer sleeps shared_scan_window_ms. Registered/unregistered
+        # with THIS broker's bound fn so a stopped broker never yanks a
+        # newer one's wiring.
+        from pixie_tpu.serving import shared_scan as _shared_scan
+
+        self._queue_depth_fn = self.admission.queue_depth
+        _shared_scan.set_queue_depth_fn(self._queue_depth_fn)
+        # r16: closed-loop admission control (flag admission_controller)
+        # — an SLO-window adapter on the cron runner actuating the
+        # serving knobs from the r15 telemetry planes, within guard
+        # rails. Explicit start via start_admission_controller() for
+        # embedders that want their own datastore.
+        self.admission_controller = None
+        if flags.admission_controller:
+            self.start_admission_controller()
         # r13 satellite: table_name -> estimated staging bytes (e.g.
         # serving.admission.make_store_estimator over the agents' table
         # store). With it, admission rejects a query whose staging
@@ -357,6 +374,26 @@ class QueryBroker:
         # the r10 on_event degradation events).
         self.slo = None
         self._alert_listeners: list = []
+
+    def start_admission_controller(self, datastore=None):
+        """Attach the r16 closed-loop admission controller
+        (serving/controller.py): persisted as a CronScript on its own
+        runner (restart survival like SLO rules), reading the broker's
+        admission/residency planes and actuating the serving flags
+        within guard rails. Idempotent; returns the loop."""
+        if self.admission_controller is not None:
+            return self.admission_controller
+        from pixie_tpu.serving.controller import AdmissionControlLoop
+
+        self.admission_controller = AdmissionControlLoop(
+            residency_fn=(
+                self.residency.snapshot
+                if self.residency is not None
+                else None
+            ),
+            queue_depth_fn=self.admission.queue_depth,
+        ).attach(self, datastore=datastore)
+        return self.admission_controller
 
     # -- SLO alert fan-out (r15) --------------------------------------------
     def add_alert_listener(self, fn) -> None:
@@ -398,6 +435,13 @@ class QueryBroker:
                 # per-tenant virtual clocks, and (when wired) the HBM
                 # residency pool's byte accounting.
                 "admission": self.admission.snapshot(),
+                # r16: the closed-loop controller's live knobs, rails,
+                # and recent actuation trail.
+                "admission_controller": (
+                    self.admission_controller.status()
+                    if self.admission_controller is not None
+                    else None
+                ),
                 "residency": (
                     self.residency.snapshot()
                     if self.residency is not None
@@ -949,6 +993,12 @@ class QueryBroker:
             _log.exception("otel span export failed (ignored)")
 
     def stop(self) -> None:
+        from pixie_tpu.serving import shared_scan as _shared_scan
+
+        _shared_scan.clear_queue_depth_fn(self._queue_depth_fn)
+        if self.admission_controller is not None:
+            self.admission_controller.stop()
+            self.admission_controller = None
         self.tracker.stop()
         if self._health_srv is not None:
             self._health_srv.stop()

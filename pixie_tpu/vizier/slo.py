@@ -79,6 +79,64 @@ def drain_alert_rows() -> list[dict]:
     return out
 
 
+# -- windowed metric views (shared by the SLO evaluator's delta logic and
+# the r16 admission controller) ----------------------------------------------
+
+
+class HistogramWindow:
+    """Windowed view over a cumulative Histogram: each ``tick()``
+    returns the per-bucket count DELTA since the previous tick (None
+    until the metric exists, an all-zero delta on an empty window), so
+    quantiles reflect only the observations of the last control
+    interval — the same previous-cumulative-snapshot scheme
+    SLOManager._metric_value uses per rule."""
+
+    def __init__(self, metric_name: str, registry=None, **labels):
+        self._name = metric_name
+        self._labels = dict(labels)
+        self._registry = registry or metrics_registry()
+        self._prev: Optional[list[int]] = None
+
+    def _metric(self) -> Optional[Histogram]:
+        with self._registry._lock:
+            m = self._registry._metrics.get(self._name)
+        return m if isinstance(m, Histogram) else None
+
+    def tick(self) -> Optional[list[int]]:
+        m = self._metric()
+        if m is None:
+            return None
+        counts = m.merged_counts(**self._labels)
+        prev = self._prev or [0] * len(counts)
+        self._prev = counts
+        return [c - p for c, p in zip(counts, prev)]
+
+    def quantile(self, q: float, delta: list[int]) -> float:
+        m = self._metric()
+        return m.quantile_of_counts(q, delta) if m is not None else 0.0
+
+
+class CounterWindow:
+    """Windowed counter rate-ish view: ``tick()`` returns the total's
+    delta since the previous tick (0.0 before the metric exists)."""
+
+    def __init__(self, metric_name: str, registry=None, **labels):
+        self._name = metric_name
+        self._labels = dict(labels)
+        self._registry = registry or metrics_registry()
+        self._prev: Optional[float] = None
+
+    def tick(self) -> float:
+        with self._registry._lock:
+            m = self._registry._metrics.get(self._name)
+        if m is None:
+            return 0.0
+        total = m.total(**self._labels)
+        prev = self._prev
+        self._prev = total
+        return max(total - prev, 0.0) if prev is not None else 0.0
+
+
 @dataclasses.dataclass
 class SLORule:
     """One declarative service-level objective.

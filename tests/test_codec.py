@@ -664,3 +664,170 @@ def test_broker_estimates_from_script_tables(mesh):
     assert broker._estimate_staging(q) == est("tiny")
     assert broker._estimate_staging("no tables here") == 0
     broker.stop()
+
+
+# -- u4 nibble deltas + gid-stream codec (r16) -------------------------------
+
+
+def test_delta_nibble_picked_and_roundtrips(mesh):
+    """A fixed-cadence timestamp column (delta range 0) plans the
+    nibble encoding and round-trips bit-exact; wire bytes are ~half of
+    the u8 delta encoding."""
+    rows = TOTAL - 31
+    flat = _padded(
+        np.arange(rows, dtype=np.int64) * 8 + (3 << 41), rows, np.int64
+    )
+    plan, out = _roundtrip(mesh, flat, rows)
+    assert plan is not None and plan.kind == "delta"
+    assert plan.delta_dtype == "nib"
+    assert np.array_equal(out.reshape(-1), flat)
+    u8 = codec.CodecPlan(
+        kind="delta", dtype=plan.dtype, d=plan.d,
+        shard_len=plan.shard_len,
+        delta_dtype=np.dtype(np.uint8).str, delta_off=plan.delta_off,
+    )
+    assert plan.wire_nbytes() < 0.6 * u8.wire_nbytes()
+
+
+def test_delta_nibble_fuzz_bit_exact(mesh):
+    """Random small-delta columns (range <= 15 around arbitrary — incl.
+    negative — frame offsets, random row counts incl. odd lengths) stay
+    bit-exact through the nibble pack."""
+    rng = np.random.default_rng(23)
+    for trial in range(25):
+        rows = int(rng.integers(1, TOTAL + 1))
+        lo = int(rng.integers(-1000, 1000))
+        width = int(rng.integers(0, 16))
+        deltas = rng.integers(lo, lo + width + 1, rows)
+        base = int(rng.integers(-(1 << 40), 1 << 40))
+        vals = base + np.concatenate(
+            [[0], np.cumsum(deltas[1:])]
+        ).astype(np.int64)
+        flat = _padded(vals, rows, np.int64)
+        plan, out = _roundtrip(mesh, flat, rows, min_ratio=1.01)
+        if plan is None or plan.kind != "delta":
+            continue  # RLE/passthrough may win; exactness covered above
+        assert plan.delta_dtype == "nib", (trial, plan)
+        assert np.array_equal(out.reshape(-1), flat), (trial, rows, lo)
+
+
+def test_delta_nibble_overflow_raises(mesh):
+    bad = codec.CodecPlan(
+        kind="delta",
+        dtype=np.dtype(np.int64).str,
+        d=D,
+        shard_len=NBLK * B,
+        delta_dtype="nib",
+        delta_off=0,
+    )
+    hostile = _padded(
+        np.cumsum(np.full(TOTAL, 200, np.int64)), TOTAL, np.int64
+    )
+    with pytest.raises(codec.CodecOverflow):
+        codec.encode_window(hostile, bad, TOTAL)
+
+
+def test_gid_stream_plans_and_roundtrips(mesh):
+    """Sorted group keys -> run-heavy gids -> the stream plan encodes
+    the gids lane, and the decoded device gids are bit-identical to the
+    raw put."""
+    from pixie_tpu.parallel import staging
+
+    rows = TOTAL
+    # 4 groups, sorted: gids RLE to ~nothing.
+    gids = np.sort(
+        np.random.default_rng(31).integers(0, 4, rows)
+    ).astype(np.int32)
+    cols = {"v": np.arange(rows, dtype=np.int64)}
+    plan = staging.plan_stream(
+        mesh, cols, rows, rows, block_rows=B,
+        num_groups=4, has_gids=True, gids=gids,
+    )
+    assert plan.gid_codec is not None, "gid lane did not plan a codec"
+    _rows, _packed, pgids, _nbytes = staging.pack_stream_window(
+        plan, cols, gids, 0
+    )
+    assert isinstance(pgids, codec.CodecPayload)
+    assert pgids.nbytes < 0.2 * staging.staged_gid_nbytes(pgids)
+    dev = staging.put_window_gids(mesh, pgids, plan.nblk, plan.b)
+    raw = np.zeros(TOTAL, plan.gid_dtype)
+    raw[:rows] = gids.astype(plan.gid_dtype)
+    assert np.array_equal(
+        np.asarray(dev).reshape(-1), raw
+    )
+
+
+def test_gid_stream_random_gids_pass_through(mesh):
+    """High-churn gids defeat both encoders: the plan passes and pack
+    ships the raw blocks (no bloated encodings, no payload)."""
+    from pixie_tpu.parallel import staging
+
+    rows = TOTAL
+    gids = np.random.default_rng(37).integers(0, 50_000, rows).astype(
+        np.int32
+    )
+    cols = {"v": np.arange(rows, dtype=np.int64)}
+    plan = staging.plan_stream(
+        mesh, cols, rows, rows, block_rows=B,
+        num_groups=50_000, has_gids=True, gids=gids,
+    )
+    assert plan.gid_codec is None
+    _rows, _packed, pgids, _n = staging.pack_stream_window(
+        plan, cols, gids, 0
+    )
+    assert isinstance(pgids, np.ndarray)
+
+
+def _seed_sorted_engine(mesh, n=12_000, seed=7):
+    """An engine with a table SORTED by service, so host gids are
+    run-heavy and the gid codec engages."""
+    c = Carnot(device_executor=MeshExecutor(mesh=mesh, block_rows=256))
+    rel = Relation.of(
+        ("time_", T, SemanticType.ST_TIME_NS),
+        ("service", S),
+        ("resp_status", I),
+        ("latency", F),
+    )
+    rng = np.random.default_rng(seed)
+    data = {
+        "time_": np.arange(n) * 10**6,
+        "service": rng.choice(["a", "b", "c"], n).astype(object),
+        "resp_status": rng.choice([200, 400, 500], n),
+        "latency": rng.exponential(30.0, n),
+    }
+    order = np.argsort(data["service"].astype(str), kind="stable")
+    t = c.table_store.create_table("http_sorted", rel)
+    t.write_pydict({k: np.asarray(v)[order] for k, v in data.items()})
+    t.compact()
+    t.stop()
+    return c
+
+
+def test_query_with_sorted_keys_gid_codec_bit_identical(mesh):
+    """Host-gids group-by over a key-sorted table: results with the gid
+    codec riding are bit-identical to codec-off execution."""
+    # A computed string key forces the host-gids path (device
+    # dictionary codes can't carry svc2).
+    q = (
+        "df = px.DataFrame(table='http_sorted')\n"
+        "df.svc2 = df.service + df.service\n"
+        "s = df.groupby(['svc2']).agg(\n"
+        "    n=('time_', px.count),\n"
+        "    total=('latency', px.sum),\n"
+        ")\n"
+        "px.display(s, 'out')\n"
+    )
+    flags.set("staging_codec", True)
+    try:
+        on = _seed_sorted_engine(mesh).execute_query(q).table("out")
+    finally:
+        flags.reset("staging_codec")
+    flags.set("staging_codec", False)
+    try:
+        off = _seed_sorted_engine(mesh).execute_query(q).table("out")
+    finally:
+        flags.reset("staging_codec")
+    assert set(on) == set(off)
+    for col in on:
+        a, b = np.asarray(on[col]), np.asarray(off[col])
+        assert a.dtype == b.dtype and np.array_equal(a, b), col

@@ -723,3 +723,347 @@ def test_fold_signature_store_caps_and_dedups(tmp_path):
         store.record("t", {**shape, "capacity": i})
     assert len(store.shapes("t")) == 8  # MAX_SHAPES_PER_TABLE
     assert store.tables() == ["t"]
+
+
+# -- predicate-batched shared scans (r16) ------------------------------------
+
+
+def _pred_query(pred: str, names=("n", "total")) -> str:
+    return (
+        "df = px.DataFrame(table='http_events')\n"
+        f"df = df[{pred}]\n"
+        "s = df.groupby(['service']).agg(\n"
+        f"    {names[0]}=('time_', px.count),\n"
+        f"    {names[1]}=('latency', px.sum),\n"
+        ")\n"
+        "px.display(s, 'out')\n"
+    )
+
+
+# Mixed predicate families over ONE staged entry (overlapping masks:
+# ==200 vs >25; disjoint: ==200 vs ==400 vs !=200-complement; string
+# code compare; float threshold).
+PRED_QUERIES = [
+    _pred_query("df.resp_status == 200"),
+    _pred_query("df.resp_status == 400", names=("cnt", "s")),
+    _pred_query("df.resp_status != 200"),
+    _pred_query("df.latency > 25.0"),
+    _pred_query("df.service == 'a'"),
+]
+
+
+def test_shared_scan_predicate_batch_assembles_slots():
+    """Coordinator ladder rung 2: distinct exact keys sharing a batch
+    key assemble as slots of ONE compute_batch call, each receiving its
+    own slot result; the batch-width histogram records the width."""
+    coord = SharedScanCoordinator()
+    calls = []
+    barrier = threading.Barrier(3)
+    results = {}
+    lock = threading.Lock()
+    flags.set("shared_scan_window_ms", 150.0)
+    try:
+
+        def compute_batch(slot_terms):
+            calls.append(list(slot_terms))
+            return [("slot", tuple(t)) for t in slot_terms]
+
+        def run(i):
+            barrier.wait()
+            out = coord.run(
+                ("exact", i),
+                lambda: ("solo", i),
+                batch_key=("batch",),
+                terms=[("i", "c", 0, i, 0.0)],
+                compute_batch=compute_batch,
+            )
+            with lock:
+                results[i] = out
+
+        ts = [
+            threading.Thread(target=run, args=(i,)) for i in range(3)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert len(calls) == 1 and len(calls[0]) == 3  # one dispatch
+        for i in range(3):
+            assert results[i] == ("slot", (("i", "c", 0, i, 0.0),))
+    finally:
+        flags.reset("shared_scan_window_ms")
+
+
+def test_shared_scan_identical_keys_share_one_slot():
+    """Identical exact keys inside a predicate batch share a slot (and
+    its result) rather than widening the dispatch."""
+    coord = SharedScanCoordinator()
+    calls = []
+    barrier = threading.Barrier(4)
+    outs = []
+    lock = threading.Lock()
+    flags.set("shared_scan_window_ms", 150.0)
+    try:
+
+        def compute_batch(slot_terms):
+            calls.append(list(slot_terms))
+            return [t[0] for t in slot_terms]  # echo each slot's terms
+
+        def run(i):
+            barrier.wait()
+            out = coord.run(
+                ("exact", i % 2),  # two distinct keys, twice each
+                lambda: "solo",
+                batch_key=("batch",),
+                terms=[i % 2],
+                compute_batch=compute_batch,
+            )
+            with lock:
+                outs.append(((i % 2), out))
+
+        ts = [
+            threading.Thread(target=run, args=(i,)) for i in range(4)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert len(calls) == 1 and len(calls[0]) == 2  # width 2, not 4
+        for key, out in outs:
+            assert out == key  # both joiners of a slot saw its result
+    finally:
+        flags.reset("shared_scan_window_ms")
+
+
+def test_shared_scan_window_skipped_when_queue_empty():
+    """r16 satellite: a leader only sleeps shared_scan_window_ms when
+    the admission queue has depth — the solo-query window tax is gone."""
+    from pixie_tpu.serving import shared_scan
+
+    coord = SharedScanCoordinator()
+    flags.set("shared_scan_window_ms", 300.0)
+    try:
+        shared_scan.set_queue_depth_fn(lambda: 0)
+        t0 = time.perf_counter()
+        assert coord.run(("a",), lambda: 1) == 1
+        assert time.perf_counter() - t0 < 0.25  # skipped the window
+        shared_scan.set_queue_depth_fn(lambda: 5)
+        t0 = time.perf_counter()
+        assert coord.run(("b",), lambda: 2) == 2
+        assert time.perf_counter() - t0 >= 0.3  # queued work: kept it
+    finally:
+        shared_scan.clear_queue_depth_fn()
+        flags.reset("shared_scan_window_ms")
+
+
+def test_predicate_batched_concurrent_bit_identical(mesh):
+    """N concurrent queries with MIXED predicates (disjoint and
+    overlapping masks, int/float/string comparisons) over one staged
+    entry: every result is bit-identical to its serial baseline, and at
+    least one dispatch actually batched (width > 1)."""
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    c = Carnot(device_executor=ex)
+    _make_table(c)
+    serials = [c.execute_query(q).table("out") for q in PRED_QUERIES]
+    batched = metrics_registry().counter(
+        "serving_shared_scan_predicate_batched_queries_total"
+    )
+    flags.set("shared_scans", True)
+    flags.set("shared_scan_predicate_batching", True)
+    flags.set("shared_scan_window_ms", 200.0)
+    try:
+        before = batched.value()
+        results = [None] * len(PRED_QUERIES)
+        errors = []
+        barrier = threading.Barrier(len(PRED_QUERIES))
+
+        def run(i):
+            try:
+                barrier.wait()
+                results[i] = c.execute_query(PRED_QUERIES[i]).table("out")
+            except Exception as e:  # pragma: no cover - assertion aid
+                errors.append(e)
+
+        ts = [
+            threading.Thread(target=run, args=(i,))
+            for i in range(len(PRED_QUERIES))
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errors, errors
+        for serial, got in zip(serials, results):
+            _assert_tables_identical(serial, got)
+        assert batched.value() > before  # a width>1 dispatch happened
+        assert not ex.fallback_errors, ex.fallback_errors
+    finally:
+        flags.reset("shared_scan_window_ms")
+        flags.reset("shared_scan_predicate_batching")
+        flags.reset("shared_scans")
+
+
+def test_predicate_batched_sketch_lanes_bit_identical(mesh):
+    """Sketch-state UDAs (t-digest quantiles, HLL distinct) ride the
+    batched per-slot state lanes bit-identically too."""
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    c = Carnot(device_executor=ex)
+    _make_table(c)
+    queries = [
+        (
+            "df = px.DataFrame(table='http_events')\n"
+            f"df = df[df.resp_status == {status}]\n"
+            "s = df.groupby(['service']).agg(\n"
+            "    q=('latency', px.quantiles),\n"
+            "    u=('resp_status', px.approx_count_distinct),\n"
+            ")\n"
+            "px.display(s, 'out')\n"
+        )
+        for status in (200, 400, 500)
+    ]
+    serials = [c.execute_query(q).table("out") for q in queries]
+    batched = metrics_registry().counter(
+        "serving_shared_scan_predicate_batched_queries_total"
+    )
+    flags.set("shared_scans", True)
+    flags.set("shared_scan_predicate_batching", True)
+    flags.set("shared_scan_window_ms", 200.0)
+    try:
+        before = batched.value()
+        results = [None] * len(queries)
+        errors = []
+        barrier = threading.Barrier(len(queries))
+
+        def run(i):
+            try:
+                barrier.wait()
+                results[i] = c.execute_query(queries[i]).table("out")
+            except Exception as e:  # pragma: no cover - assertion aid
+                errors.append(e)
+
+        ts = [
+            threading.Thread(target=run, args=(i,))
+            for i in range(len(queries))
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errors, errors
+        for serial, got in zip(serials, results):
+            _assert_tables_identical(serial, got)
+        assert batched.value() > before
+        assert not ex.fallback_errors, ex.fallback_errors
+    finally:
+        flags.reset("shared_scan_window_ms")
+        flags.reset("shared_scan_predicate_batching")
+        flags.reset("shared_scans")
+
+
+def test_unnormalizable_predicate_falls_back_to_exact_ladder(mesh):
+    """A predicate outside the normalizable class (computed expression)
+    still executes correctly — it just shares only via the
+    identical-signature rung."""
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    c = Carnot(device_executor=ex)
+    _make_table(c)
+    q = _pred_query("df.latency + df.latency > 50.0")
+    serial = c.execute_query(q).table("out")
+    flags.set("shared_scans", True)
+    flags.set("shared_scan_predicate_batching", True)
+    flags.set("shared_scan_window_ms", 100.0)
+    try:
+        results = [None] * 3
+        errors = []
+        barrier = threading.Barrier(3)
+
+        def run(i):
+            try:
+                barrier.wait()
+                results[i] = c.execute_query(q).table("out")
+            except Exception as e:  # pragma: no cover - assertion aid
+                errors.append(e)
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errors, errors
+        for got in results:
+            _assert_tables_identical(serial, got)
+        assert not ex.fallback_errors, ex.fallback_errors
+    finally:
+        flags.reset("shared_scan_window_ms")
+        flags.reset("shared_scan_predicate_batching")
+        flags.reset("shared_scans")
+
+
+def test_predicate_batched_degraded_agent_structured(cluster):
+    """Degraded-agent case: with an agent's execute fault armed,
+    concurrent predicate-variant scripts through the serving broker
+    resolve structurally — every query returns (clean + bit-identical,
+    degraded-annotated, or admission-rejected), never hangs or returns
+    silently wrong rows."""
+    broker, _agents, _bus = cluster
+    flags.set("serving_enabled", True)
+    flags.set("shared_scans", True)
+    flags.set("shared_scan_predicate_batching", True)
+    flags.set("shared_scan_window_ms", 50.0)
+    queries = [
+        (
+            "df = px.DataFrame(table='http_events')\n"
+            f"df = df[df.latency > {thr}.0]\n"
+            "s = df.groupby(['service']).agg(n=('time_', px.count))\n"
+            "px.display(s, 'out')\n"
+        )
+        for thr in (0, 5, 50)
+    ]
+    def sorted_rows(table):
+        # Two-PEM merge order is arrival-dependent; compare group-sorted.
+        order = np.argsort(np.asarray(table["service"]))
+        return {k: np.asarray(v)[order] for k, v in table.items()}
+
+    try:
+        baselines = [
+            sorted_rows(broker.execute_script(q, timeout_s=60).table("out"))
+            for q in queries
+        ]
+        faults.arm("agent.execute@pem1", p=0.4, seed=17)
+        outcomes = {"clean": 0, "degraded": 0, "rejected": 0}
+        errors = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(6)
+
+        def run(i):
+            qi = i % len(queries)
+            try:
+                barrier.wait()
+                res = broker.execute_script(queries[qi], timeout_s=60)
+                with lock:
+                    if res.degraded is not None:
+                        outcomes["degraded"] += 1
+                    else:
+                        _assert_tables_identical(
+                            baselines[qi], sorted_rows(res.table("out"))
+                        )
+                        outcomes["clean"] += 1
+            except AdmissionRejected:
+                with lock:
+                    outcomes["rejected"] += 1
+            except Exception as e:  # pragma: no cover - assertion aid
+                errors.append(e)
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert sum(outcomes.values()) == 6, outcomes
+    finally:
+        faults.reset()
+        flags.reset("shared_scan_window_ms")
+        flags.reset("shared_scan_predicate_batching")
+        flags.reset("shared_scans")
+        flags.reset("serving_enabled")

@@ -366,3 +366,245 @@ def test_admission_rejections_tenant_labeled():
     # aggregate quantiles read across label sets.
     snap = ctl.snapshot()
     assert "wait_p99_ms" in snap
+
+
+# -- closed-loop admission controller (r16, serving/controller.py) -----------
+
+_CTL_FLAGS = (
+    "admission_controller",
+    "admission_max_concurrent",
+    "shared_scan_window_ms",
+    "hbm_budget_mb",
+    "admission_controller_min_concurrent",
+    "admission_controller_max_concurrent",
+    "admission_controller_max_window_ms",
+    "admission_controller_max_hbm_mb",
+    "admission_controller_wait_target_ms",
+)
+
+
+@pytest.fixture
+def _ctl_flags():
+    yield
+    for name in _CTL_FLAGS:
+        flags.reset(name)
+
+
+def _make_loop(residency=None, depth=0):
+    """A controller with injectable residency snapshot + queue depth,
+    with one absorb tick so window deltas start from THIS test (the
+    serving metrics are process-global and carry other tests' history)."""
+    from pixie_tpu.serving.controller import AdmissionControlLoop
+
+    depth_box = {"v": depth}
+    res_box = {"v": residency or {}}
+    loop = AdmissionControlLoop(
+        residency_fn=lambda: res_box["v"],
+        queue_depth_fn=lambda: depth_box["v"],
+    )
+    loop.step()  # absorb metric history into the window baselines
+    loop.trail.clear()
+    return loop, depth_box, res_box
+
+
+def _drive(n_queries=5, wait_s=2.0, tenant="ctl"):
+    reg = metrics_registry()
+    wait = reg.histogram("admission_wait_seconds")
+    adm = reg.counter("admission_admitted_total")
+    for _ in range(n_queries):
+        wait.observe(wait_s, tenant=tenant)
+        adm.inc(tenant=tenant)
+
+
+def test_controller_disabled_holds_everything(_ctl_flags):
+    flags.set("admission_controller", False)
+    flags.set("admission_max_concurrent", 8)
+    from pixie_tpu.serving.controller import AdmissionControlLoop
+
+    loop = AdmissionControlLoop()
+    _drive()
+    assert loop.step() is None
+    assert flags.admission_max_concurrent == 8
+    assert not loop.trail
+
+
+def test_controller_raises_concurrency_to_ceiling_never_past(_ctl_flags):
+    """Convergence under sustained wait pressure: concurrency climbs
+    multiplicatively and saturates AT the ceiling rail."""
+    flags.set("admission_controller", True)
+    flags.set("admission_controller_max_concurrent", 16)
+    flags.set("admission_controller_min_concurrent", 2)
+    flags.set("admission_controller_wait_target_ms", 100.0)
+    loop, depth, _res = _make_loop()
+    flags.set("admission_max_concurrent", 4)
+    depth["v"] = 6
+    for _ in range(6):
+        _drive(wait_s=2.0)
+        loop.step()
+    assert flags.admission_max_concurrent == 16  # at the rail
+    ups = [
+        a for a in loop.trail if a["knob"] == "admission_max_concurrent"
+    ]
+    assert ups, "controller never actuated"
+    assert all(2 <= a["to"] <= 16 for a in ups)
+    assert all(a["reason"] == "wait_p50_over_target" for a in ups)
+
+
+def test_controller_hbm_pressure_halves_never_below_floor(_ctl_flags):
+    flags.set("admission_controller", True)
+    flags.set("admission_controller_min_concurrent", 4)
+    budget = 64 << 20
+    pressured = {
+        "used_bytes": budget,
+        "pinned_bytes": int(0.95 * budget),
+        "budget_bytes": budget,
+    }
+    loop, _depth, res = _make_loop(residency=pressured)
+    flags.set("admission_max_concurrent", 32)
+    for _ in range(6):
+        _drive(wait_s=0.001)
+        loop.step()
+    assert flags.admission_max_concurrent == 4  # floored, never below
+    downs = [
+        a for a in loop.trail if a["knob"] == "admission_max_concurrent"
+    ]
+    assert downs and all(a["reason"] == "hbm_pressure" for a in downs)
+    assert all(a["to"] >= 4 for a in downs)
+
+
+def test_controller_empty_window_is_stable(_ctl_flags):
+    """Zero admitted queries, zero rejections, empty queue: every knob
+    holds — signal absence never actuates."""
+    flags.set("admission_controller", True)
+    loop, _depth, _res = _make_loop()
+    flags.set("admission_max_concurrent", 8)
+    flags.set("shared_scan_window_ms", 10.0)
+    flags.set("hbm_budget_mb", 64)
+    for _ in range(5):
+        sig = loop.step()
+        assert sig is not None and sig["admitted"] == 0
+    assert flags.admission_max_concurrent == 8
+    assert float(flags.shared_scan_window_ms) == 10.0
+    assert int(flags.hbm_budget_mb) == 64
+    assert not loop.trail
+
+
+def test_controller_window_follows_queue_depth(_ctl_flags):
+    flags.set("admission_controller", True)
+    flags.set("admission_controller_max_window_ms", 40.0)
+    loop, depth, _res = _make_loop()
+    flags.set("shared_scan_window_ms", 0.0)
+    depth["v"] = 3
+    for _ in range(20):
+        _drive(wait_s=0.001)
+        loop.step()
+    assert float(flags.shared_scan_window_ms) == 40.0  # ceiling rail
+    depth["v"] = 0
+    for _ in range(20):
+        _drive(wait_s=0.001)
+        loop.step()
+    assert float(flags.shared_scan_window_ms) == 0.0  # floor
+    widths = [
+        a["to"] for a in loop.trail if a["knob"] == "shared_scan_window_ms"
+    ]
+    assert widths and all(0.0 <= w <= 40.0 for w in widths)
+
+
+def test_controller_hbm_raise_on_rejections_within_rail(_ctl_flags):
+    flags.set("admission_controller", True)
+    flags.set("hbm_budget_mb", 64)
+    flags.set("admission_controller_max_hbm_mb", 100)
+    rej = metrics_registry().counter("admission_rejected_total")
+    loop, _depth, res = _make_loop(
+        residency={
+            "used_bytes": 60 << 20,
+            "pinned_bytes": 0,
+            "budget_bytes": 64 << 20,
+        }
+    )
+    for _ in range(6):
+        rej.inc(reason="hbm_budget", tenant="ctl")
+        loop.step()
+    assert int(flags.hbm_budget_mb) == 100  # capped at the rail
+    ups = [a for a in loop.trail if a["knob"] == "hbm_budget_mb"]
+    assert ups and all(a["to"] <= 100 for a in ups)
+    # No ceiling rail -> HBM is untouchable, even under rejections.
+    flags.set("hbm_budget_mb", 64)
+    flags.set("admission_controller_max_hbm_mb", 0)
+    for _ in range(3):
+        rej.inc(reason="hbm_budget", tenant="ctl")
+        loop.step()
+    assert int(flags.hbm_budget_mb) == 64
+
+
+def test_controller_idle_decay_returns_to_baseline(_ctl_flags):
+    flags.set("admission_controller", True)
+    flags.set("admission_max_concurrent", 8)  # baseline at construction
+    flags.set("admission_controller_wait_target_ms", 100.0)
+    loop, depth, _res = _make_loop()
+    flags.set("admission_max_concurrent", 32)
+    depth["v"] = 0
+    for _ in range(12):
+        _drive(wait_s=0.001)  # admitted, waits ~1ms << 10ms decay bar
+        loop.step()
+    assert flags.admission_max_concurrent == 8  # back to baseline
+    downs = [
+        a
+        for a in loop.trail
+        if a["knob"] == "admission_max_concurrent"
+    ]
+    assert downs and all(a["reason"] == "idle_decay" for a in downs)
+
+
+def test_controller_rides_cron_and_persists(_ctl_flags):
+    """The controller is a CronScript on its own runner (the SLOManager
+    pattern): persisted in the store, ticking step() at its interval."""
+    flags.set("admission_controller", True)
+    flags.set("admission_controller_interval_s", 0.05)
+    from pixie_tpu.serving.controller import AdmissionControlLoop
+
+    loop = AdmissionControlLoop(
+        residency_fn=lambda: {}, queue_depth_fn=lambda: 0
+    )
+    loop.attach(_FakeBroker())
+    try:
+        assert "admission-controller" in loop._runner.store.all()
+        ticks = metrics_registry().counter(
+            "admission_controller_ticks_total"
+        )
+        t0 = ticks.value()
+        deadline = time.monotonic() + 5.0
+        while ticks.value() <= t0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ticks.value() > t0  # the ticker drove step()
+    finally:
+        loop.stop()
+
+
+def test_broker_statusz_carries_controller_status(_ctl_flags):
+    """start_admission_controller wires the loop into the broker and
+    /statusz serves its knobs + rails + actuation trail."""
+    flags.set("admission_controller", False)  # explicit start below
+    bus = MessageBus()
+    router = BridgeRouter()
+    broker = QueryBroker(bus, router, table_relations={})
+    try:
+        loop = broker.start_admission_controller()
+        assert broker.start_admission_controller() is loop  # idempotent
+        st = loop.status()
+        assert set(st["knobs"]) == {
+            "admission_max_concurrent",
+            "shared_scan_window_ms",
+            "hbm_budget_mb",
+        }
+        srv = broker.start_health_server()
+        host, port = srv.address
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/statusz", timeout=5
+        ) as resp:
+            payload = json.loads(resp.read())
+        ctl = payload["status"]["admission_controller"]
+        assert ctl["knobs"]
+        assert "rails" in ctl
+    finally:
+        broker.stop()

@@ -44,21 +44,36 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-# Signature-compatible script set: same predicates, same UDA lanes, same
-# group key — only output names differ, which the r7 fold signature
-# excludes, so ALL of these coalesce onto one fold dispatch when their
-# arrivals overlap. (A distinct-lane control query would not share.)
+# Compatible script set on the r16 two-rung ladder. Within a predicate
+# family only output names differ (identical fold signatures — rung 1);
+# ACROSS families the predicates differ but normalize to comparison
+# terms over one staged entry (rung 2: predicate batching), so a mixed
+# arrival burst coalesces into ONE batched dispatch whose width the
+# serving_shared_scan_batch_width histogram records. The first query
+# (run first by the serial baseline) references every column, so its
+# superset staging serves the whole set.
 def compatible_queries() -> list[str]:
     out = []
-    for names in (("n", "total"), ("cnt", "s"), ("hits", "sum_lat")):
-        out.append(
-            "df = px.DataFrame(table='http_events')\n"
-            "st = df.groupby(['service']).agg(\n"
-            f"    {names[0]}=('time_', px.count),\n"
-            f"    {names[1]}=('latency', px.sum),\n"
-            ")\n"
-            "px.display(st, 'out')\n"
-        )
+    preds = (
+        "df.resp_status == 200",
+        "df.resp_status == 404",
+        "df.resp_status == 500",
+        "df.resp_status != 200",
+        "df.latency > 20000000.0",
+        None,  # unfiltered family (rung-1 only vs itself)
+    )
+    for pred in preds:
+        for names in (("n", "total"), ("cnt", "s")):
+            filt = f"df = df[{pred}]\n" if pred else ""
+            out.append(
+                "df = px.DataFrame(table='http_events')\n"
+                + filt
+                + "st = df.groupby(['service']).agg(\n"
+                f"    {names[0]}=('time_', px.count),\n"
+                f"    {names[1]}=('latency', px.sum),\n"
+                ")\n"
+                "px.display(st, 'out')\n"
+            )
     return out
 
 
@@ -170,24 +185,39 @@ def run_soak(
     seed: int = 11,
     chaos: bool = False,
     profile: bool = False,
+    controller: bool = False,
 ) -> dict:
     """Build the cluster, run the soak (serving flags pinned for the
     run, restored after), return the report dict. ``chaos`` arms
     CHAOS_SITES for the concurrent phase (r14 satellite): the report's
     ``contention.chaos`` block then carries recovered vs degraded vs
-    rejected counts plus per-site fire stats."""
+    rejected counts plus per-site fire stats. ``controller`` (r16)
+    enables the closed-loop admission controller for the run — the
+    report's ``controller`` block carries its actuation trail and
+    final knob values."""
     from pixie_tpu.utils import flags
 
     soak_flags = {
         "serving_enabled": True,
         "hbm_budget_mb": hbm_budget_mb,
         "shared_scans": True,
+        "shared_scan_predicate_batching": True,
         "shared_scan_window_ms": window_ms,
         "admission_max_concurrent": max_concurrent,
         "admission_max_queue": max(4 * clients, 256),
         "admission_timeout_s": 60.0,
         "admission_tenant_weights": "dashboards:2.0,batch:1.0",
     }
+    if controller:
+        soak_flags.update(
+            {
+                "admission_controller": True,
+                "admission_controller_interval_s": 0.5,
+                "admission_controller_max_window_ms": max(
+                    window_ms * 2.0, 25.0
+                ),
+            }
+        )
     for name, value in soak_flags.items():
         flags.set(name, value)
     try:
@@ -198,6 +228,8 @@ def run_soak(
     finally:
         # Restore env/default flag values so an embedding caller
         # (bench.py's concurrency config) is not left in serving mode.
+        # The controller actuates some of these at runtime; reset()
+        # restores the env/default either way.
         for name in soak_flags:
             flags.reset(name)
 
@@ -279,6 +311,15 @@ def _run_soak_inner(
     saved = reg.counter("serving_shared_scan_saved_dispatches_total")
     evictions = reg.counter("device_staged_cache_evictions_total")
     staged_bytes = reg.gauge("device_staged_bytes")
+    # r16: predicate-batched dispatch width (the headline serving
+    # metric) + demand-gated window skips.
+    width_h = reg.histogram("serving_shared_scan_batch_width")
+    pred_batched = reg.counter(
+        "serving_shared_scan_predicate_batched_queries_total"
+    )
+    window_skips = reg.counter(
+        "serving_shared_scan_window_skips_total"
+    )
 
     # Serial baseline: each distinct script once, results recorded for
     # the bit-identical check; also warms the staged cache so the soak
@@ -292,6 +333,8 @@ def _run_soak_inner(
     log(f"serial baseline: {len(queries)} queries in "
         f"{time.perf_counter() - t0:.2f}s")
     d0, s0 = dispatches.value(), saved.value()
+    w0_counts = width_h.merged_counts()
+    pb0, ws0 = pred_batched.value(), window_skips.value()
 
     if chaos:
         # Armed AFTER the unfaulted baselines: every concurrent result
@@ -445,12 +488,36 @@ def _run_soak_inner(
 
         chaos_stats = faults.stats()
         faults.reset()  # teardown runs unfaulted
+    controller_status = (
+        broker.admission_controller.status()
+        if broker.admission_controller is not None
+        else None
+    )
     broker.stop()
     for a in agents:
         a.stop()
 
     d1, s1 = dispatches.value() - d0, saved.value() - s0
     fold_queries = d1 + s1  # queries that reached the fold path
+    # r16: the batch-width distribution of THIS phase's dispatches.
+    # Widths are integers landing exactly on bucket bounds, so the
+    # quantile reads bucket UPPER edges (no sub-integer interpolation).
+    w_delta = [
+        c - p for c, p in zip(width_h.merged_counts(), w0_counts)
+    ]
+
+    def width_pct(q: float) -> float:
+        total = sum(w_delta)
+        if not total:
+            return 0.0
+        edges = list(width_h.buckets) + [width_h.buckets[-1] * 2]
+        cum = 0
+        for edge, cnt in zip(edges, w_delta):
+            cum += cnt
+            if cum >= q * total:
+                return float(edge)
+        return float(edges[-1])
+
     lat = sorted(latencies)
 
     def pct(p: float) -> float:
@@ -480,6 +547,14 @@ def _run_soak_inner(
             "mean_batch": (
                 round(fold_queries / d1, 2) if d1 else None
             ),
+            # r16: predicate-batched scan width (distinct predicate
+            # slots per dispatch) — the new headline serving metric.
+            "batch_width_p50": width_pct(0.5),
+            "batch_width_p99": width_pct(0.99),
+            "predicate_batched_queries": int(
+                pred_batched.value() - pb0
+            ),
+            "window_skips": int(window_skips.value() - ws0),
         },
         "residency": {
             "peak_staged_bytes": int(peak[0]),
@@ -513,6 +588,10 @@ def _run_soak_inner(
     }
     if profile_block is not None:
         report["profile"] = profile_block
+    if controller_status is not None:
+        # r16: the closed-loop controller's actuation trail — what it
+        # moved, from what, why, on which window signals.
+        report["controller"] = controller_status
     if chaos:
         # r14 satellite: with fault sites armed through the concurrent
         # phase, 'recovered' queries completed clean (bit-identical rows)
@@ -586,6 +665,14 @@ def main() -> int:
         "attribution land in the report's 'profile' block (top "
         "attributed stacks and programs, attribution percentages).",
     )
+    ap.add_argument(
+        "--controller", action="store_true",
+        default=bool(int(os.environ.get("SOAK_CONTROLLER", "0"))),
+        help="Enable the r16 closed-loop admission controller for the "
+        "run (flag admission_controller at a 0.5s tick): the report's "
+        "'controller' block carries the actuation trail — which knobs "
+        "moved, from what, why, on which window signals.",
+    )
     args = ap.parse_args()
     report = run_soak(
         clients=args.clients,
@@ -597,6 +684,7 @@ def main() -> int:
         max_concurrent=args.max_concurrent,
         chaos=args.chaos,
         profile=args.profile,
+        controller=args.controller,
     )
     print(json.dumps(report, indent=1))
     path = os.environ.get("SOAK_JSON")
@@ -609,17 +697,37 @@ def main() -> int:
         bd_path = os.path.join(REPO, "BENCH_DETAIL.json")
         with open(bd_path) as f:
             detail = json.load(f)
+        # r16: carry the superseded run's p50 so the ledger shows the
+        # before/after (the r15 1k-client run's ~18s admission-pacing
+        # p50 is the number predicate batching + the controller attack).
+        prev = detail.get("serving_soak") or {}
+        prev_p50 = prev.get("latency_p50_ms")
+        prev_before = prev.get("previous_latency_p50_ms")
         detail["serving_soak"] = {
             k: report[k]
             for k in (
                 "clients", "requests_per_client", "wall_s", "completed",
                 "rejected", "degraded", "queries_per_sec",
                 "latency_p50_ms", "latency_p99_ms", "contention",
+                # r16: dispatch reduction + batch_width_p50/p99 — the
+                # predicate-batching acceptance evidence.
+                "shared_scan",
             )
             if k in report
         }
+        if prev_p50 is not None and prev.get("clients") == report.get(
+            "clients"
+        ):
+            detail["serving_soak"]["previous_latency_p50_ms"] = prev_p50
+        elif prev_before is not None:
+            detail["serving_soak"]["previous_latency_p50_ms"] = prev_before
         if "profile" in report:
             detail["serving_soak"]["profile"] = report["profile"]
+        if "controller" in report:
+            # r16: final knob values + the last actuations.
+            ctl = dict(report["controller"])
+            ctl["actuations"] = ctl.get("actuations", [])[-12:]
+            detail["serving_soak"]["controller"] = ctl
         with open(bd_path, "w") as f:
             json.dump(detail, f, indent=1)
             f.write("\n")
