@@ -375,7 +375,15 @@ def main() -> None:
         # r15 knobs: query-attributed profiling (thread attribution +
         # device dispatch/program records + HBM usage snapshots).
         f"resource_attribution={flags.resource_attribution} "
-        f"hbm_snapshot_interval_s={flags.hbm_snapshot_interval_s}"
+        f"hbm_snapshot_interval_s={flags.hbm_snapshot_interval_s} "
+        # r17 knobs: transparent fragment failover (broker-plane;
+        # this single-engine driver never exercises them, the chaos
+        # soak and tests/test_failover.py do).
+        f"fragment_failover={flags.fragment_failover}"
+        f"x{flags.fragment_max_retries} "
+        f"hedged={flags.hedged_requests}"
+        f"@q{flags.hedge_quantile} "
+        f"ring_replication={flags.ring_replication_factor}"
     )
     carnot = Carnot(
         device_executor=MeshExecutor(mesh=mesh, block_rows=block_rows)

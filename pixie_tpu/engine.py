@@ -9,6 +9,7 @@ per-operator stats to the result destination).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 import uuid
 from typing import Optional
@@ -46,6 +47,14 @@ class QueryResult:
     # trace_id — wire-shaped dicts (utils/trace.py Span.to_dict). None
     # when query_tracing is off.
     trace_spans: Optional[list] = None
+    # Transparent-failover annotation (r17, flag ``fragment_failover``):
+    # set when the result is COMPLETE but one or more fragments had to be
+    # retried onto a surviving agent or won by a hedged duplicate —
+    # {"retried": [{slot, from, to, reason, epoch}], "hedged": [{slot,
+    # winner, loser}], "trace_id"}. A recovered result is NOT degraded
+    # (``ok`` stays True): the rows are bit-identical to an unfaulted
+    # run; the annotation only says failover did work to get them.
+    recovered: Optional[dict] = None
 
     @property
     def ok(self) -> bool:
@@ -197,6 +206,69 @@ class Carnot:
                 )
             )
         self.compiler = Compiler(registry)
+        # Live per-query exec states (r17): lets the broker's hedge path
+        # cancel a losing duplicate mid-flight through the r9 abort
+        # machinery (ExecState.cancel → keep_running False → sources
+        # abort) instead of letting it run to completion. Cancellation
+        # is ATTEMPT-scoped: one engine may host several attempts of
+        # the same query (a hedged merge landing on the straggler's own
+        # agent), and cancelling the loser must not touch its
+        # co-resident siblings.
+        self._active_lock = threading.Lock()
+        self._active_states: dict[str, list] = {}
+        import collections as _collections
+
+        self._cancelled_attempts: set = set()
+        self._cancelled_order: "_collections.deque" = _collections.deque()
+
+    def cancel_query(self, query_id: str, token=None) -> None:
+        """Cancel live exec states of ``query_id`` on this engine (r17
+        hedge-loser cancellation; also usable by embedders). With
+        ``token`` (a failover attempt's (slot, epoch)), only that
+        attempt's states cancel. A query with no live state is a no-op
+        — cancellation is advisory, exactly-once delivery never depends
+        on it; the mark persists so an attempt cancelled between
+        fragments stops (and withholds its output) too."""
+        with self._active_lock:
+            self._cancelled_attempts.add((query_id, token))
+            self._cancelled_order.append((query_id, token))
+            while len(self._cancelled_order) > 1024:
+                self._cancelled_attempts.discard(
+                    self._cancelled_order.popleft()
+                )
+            states = [
+                st
+                for st in self._active_states.get(query_id, ())
+                if token is None or st.bridge_token == token
+            ]
+        for st in states:
+            st.cancel("cancelled by broker (hedge loser / failover)")
+
+    def attempt_cancelled(self, query_id: str, token) -> bool:
+        """True when this (query, attempt) was cancelled by the broker:
+        the attempt must WITHHOLD its output — another attempt won the
+        slot, and partial rows from an aborted run must never look like
+        a completed fragment."""
+        with self._active_lock:
+            return (query_id, token) in self._cancelled_attempts or (
+                (query_id, None) in self._cancelled_attempts
+            )
+
+    def _track_state(self, query_id: str, state) -> None:
+        with self._active_lock:
+            self._active_states.setdefault(query_id, []).append(state)
+
+    def _untrack_states(self, query_id: str, states: list) -> None:
+        with self._active_lock:
+            kept = [
+                st
+                for st in self._active_states.get(query_id, ())
+                if st not in states
+            ]
+            if kept:
+                self._active_states[query_id] = kept
+            else:
+                self._active_states.pop(query_id, None)
 
     # -- the two entry points (carnot.h:72-81) ------------------------------
     def execute_query(
@@ -247,6 +319,7 @@ class Carnot:
         analyze: bool = False,
         manage_router: bool = True,
         deadline_s: Optional[float] = None,
+        bridge_token: Optional[tuple] = None,
     ) -> QueryResult:
         """manage_router=False when a broker coordinates several engine
         instances over one shared router: producer registration and query
@@ -288,6 +361,7 @@ class Carnot:
                 self_telemetry.flush_into(self.table_store)
 
         exec_stats: dict[str, dict] = {}
+        my_states: list = []
         t0 = time.perf_counter_ns()
         try:
             # Producer fragments run before consumers (the reference runs
@@ -295,6 +369,11 @@ class Carnot:
             # own fragments in dependency order — bridge queues buffer).
             ambient = trace.current()
             for frag in plan.fragment_topo_order():
+                if self.attempt_cancelled(qid, bridge_token):
+                    # r17: the broker cancelled this attempt between
+                    # fragments (another attempt won) — stop here; the
+                    # caller withholds whatever was produced.
+                    break
                 fspan = trace.span(
                     "fragment",
                     # Without an ambient context (bare execute_plan), the
@@ -316,7 +395,10 @@ class Carnot:
                         vizier_ctx=self.vizier_ctx,
                         otel_exporter=self.otel_exporter,
                         deadline=deadline,
+                        bridge_token=bridge_token,
                     )
+                    my_states.append(state)
+                    self._track_state(qid, state)
                     if self.device_executor is not None:
                         offloaded = self.device_executor.try_execute_fragment(
                             frag, self.table_store, self.registry,
@@ -351,6 +433,7 @@ class Carnot:
                         for name, s in graph.stats().items():
                             exec_stats[f"f{frag.fragment_id}/{name}"] = s
         finally:
+            self._untrack_states(qid, my_states)
             if manage_router:
                 self.router.cleanup_query(qid)
         exec_ns = time.perf_counter_ns() - t0

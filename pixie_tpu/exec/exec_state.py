@@ -51,6 +51,7 @@ class ExecState:
         vizier_ctx: Any = None,
         otel_exporter: Any = None,
         deadline: Optional[float] = None,
+        bridge_token: Optional[tuple] = None,
     ):
         self.query_id = query_id
         self.table_store = table_store
@@ -89,6 +90,13 @@ class ExecState:
         # on other threads (and the exec graph's end-of-run per-node span
         # emission) can parent to the fragment span even off this thread.
         self.trace_ctx: Optional[tuple] = trace.current()
+        # Fragment-failover attempt identity (r17): the broker-assigned
+        # (slot, epoch) this execution runs as. BridgeSink pushes carry
+        # it (held + committed atomically per attempt at the router) and
+        # BridgeSource polls read through a per-attempt cursor so a
+        # replacement consumer replays the committed stream. None = the
+        # pre-r17 direct push/pop semantics.
+        self.bridge_token = bridge_token
 
     def compute_device(self):
         if self.compute_backend is None:

@@ -162,7 +162,14 @@ class BridgeSourceNode(SourceNode):
             self._expected_producers = live
 
     def generate_next_impl(self, exec_state) -> bool:
-        item = exec_state.router.poll(exec_state.query_id, self.op.bridge_id)
+        # r17: a failover attempt reads through a per-attempt cursor
+        # (retained queue) so a replacement consumer re-reads the whole
+        # committed stream; None keeps the destructive popleft.
+        item = exec_state.router.poll(
+            exec_state.query_id,
+            self.op.bridge_id,
+            consumer=exec_state.bridge_token,
+        )
         if item is None:
             self._refresh_expected(exec_state)
             if (
@@ -485,16 +492,26 @@ class BridgeSinkNode(SinkNode):
     def consume_next_impl(self, exec_state, batch, parent_index) -> None:
         if getattr(batch, "eos", False):
             self._pushed_eos = True
-        exec_state.router.push(exec_state.query_id, self.op.bridge_id, batch)
+        exec_state.router.push(
+            exec_state.query_id,
+            self.op.bridge_id,
+            batch,
+            token=exec_state.bridge_token,
+        )
 
     def flush_cancel(self, exec_state) -> None:
         """On fragment abort (stall/deadline, r9): if no eos crossed this
         bridge yet, push a zero-row eos marker so the consumer fragment
         finalizes with partial input instead of stalling to its own
-        timeout waiting on a producer that aborted."""
+        timeout waiting on a producer that aborted. A failover attempt
+        (r17) skips the flush: committing an empty stream would WIN the
+        slot and lock the retry out — the broker's revoke/replace covers
+        the consumer instead."""
         if self._pushed_eos:
             return
         self._pushed_eos = True
+        if exec_state.bridge_token is not None:
+            return
         exec_state.router.push(
             exec_state.query_id,
             self.op.bridge_id,

@@ -20,6 +20,30 @@ r9 robustness semantics:
   leaks one queue per late pusher), and polls raise ``BridgeCancelled`` so
   consumer fragments parked on the router abort instead of spinning to
   their stall timeout.
+
+r17 failover semantics (flag ``fragment_failover``; all opt-in per push/
+poll via attempt tokens, so the r9 paths above are byte-for-byte
+unchanged when the broker runs without failover):
+
+- **Producer slots + attempt epochs.** A fragment slot (one producer's
+  position on a bridge, stable across retries) is authorized for specific
+  attempt epochs (``authorize_producer``). Pushes carry a
+  ``token=(slot, epoch)`` and are HELD per attempt until that attempt's
+  eos arrives, then committed to the consumer queue atomically — a dead
+  attempt's partial rows are discarded wholesale (``revoke_producer``),
+  never half-consumed, so merges can never double-count. The first
+  attempt to commit wins its slot; anything later (a zombie producer the
+  broker believed dead, or a hedge loser) drops at the router.
+- **Replacement producers.** ``replace_producer`` revokes the dead
+  attempt and authorizes its replacement WITHOUT changing the producer
+  count — downstream BridgeSourceNodes keep expecting the same number of
+  eos markers and simply receive the replacement's committed stream.
+- **Replayable consumption.** Polls carrying a ``consumer`` token read
+  through a per-attempt cursor over a RETAINED committed queue instead of
+  popping — so a retried CONSUMER fragment (a dead merge agent's
+  replacement) re-reads every committed item from the start and produces
+  the same merge a first attempt would have. Buffers drop at
+  ``cleanup_query`` as before.
 """
 
 from __future__ import annotations
@@ -46,6 +70,16 @@ class BridgeRouter:
         # raise. Bounded FIFO so a long-lived router cannot grow forever.
         self._dead: set[str] = set()
         self._dead_order: collections.deque = collections.deque()
+        # r17 failover state, all keyed under (query_id, bridge_id):
+        # slot -> set of authorized attempt epochs; slots already won by
+        # a committed attempt; and per-(slot, epoch) held items awaiting
+        # their atomic commit.
+        self._auth: dict[tuple[str, str], dict[Any, set]] = {}
+        self._committed: dict[tuple[str, str], set] = {}
+        self._held: dict[tuple[str, str, Any, int], list] = {}
+        # Per-consumer-attempt read cursors over retained queues
+        # (replayable consumption), keyed (query_id, bridge_id, token).
+        self._cursors: dict[tuple[str, str, Any], int] = {}
 
     def _mark_dead_locked(self, query_id: str) -> None:
         if query_id in self._dead:
@@ -78,6 +112,52 @@ class BridgeRouter:
             if self._producers[key] > 0:
                 self._producers[key] -= 1
 
+    # -- r17: attempt authorization ------------------------------------------
+    def authorize_producer(
+        self, query_id: str, bridge_id: str, slot: Any, epoch: int
+    ) -> None:
+        """Allow attempt ``epoch`` of fragment ``slot`` to push into this
+        bridge. Does NOT change the producer count — the count is how
+        many SLOTS will eventually commit, authorization is which
+        attempts may fill them."""
+        with self._lock:
+            self._auth.setdefault((query_id, bridge_id), {}).setdefault(
+                slot, set()
+            ).add(epoch)
+
+    def revoke_producer(
+        self, query_id: str, bridge_id: str, slot: Any, epoch: int
+    ) -> None:
+        """Discard a dead/lost attempt: its authorization is removed and
+        any HELD (uncommitted) items it pushed are dropped wholesale —
+        downstream merges never see a partial attempt. Producer count is
+        untouched; the broker unregisters separately when it gives up on
+        the slot entirely (the r9 degrade path)."""
+        with self._lock:
+            auth = self._auth.get((query_id, bridge_id), {}).get(slot)
+            if auth is not None:
+                auth.discard(epoch)
+            self._held.pop((query_id, bridge_id, slot, epoch), None)
+
+    def replace_producer(
+        self,
+        query_id: str,
+        bridge_id: str,
+        slot: Any,
+        old_epoch: int,
+        new_epoch: int,
+    ) -> None:
+        """Swap a slot's authorized attempt: the dead attempt's held
+        items drop, the replacement may produce, and the consumer-side
+        eos expectation is unchanged (same producer count)."""
+        with self._lock:
+            auth = self._auth.setdefault(
+                (query_id, bridge_id), {}
+            ).setdefault(slot, set())
+            auth.discard(old_epoch)
+            auth.add(new_epoch)
+            self._held.pop((query_id, bridge_id, slot, old_epoch), None)
+
     def num_producers(self, query_id: str, bridge_id: str) -> int:
         with self._lock:
             return max(1, self._producers[(query_id, bridge_id)])
@@ -88,20 +168,65 @@ class BridgeRouter:
         with self._lock:
             return self._producers[(query_id, bridge_id)]
 
-    def push(self, query_id: str, bridge_id: str, item: Any) -> None:
+    def push(
+        self,
+        query_id: str,
+        bridge_id: str,
+        item: Any,
+        token: Optional[tuple] = None,
+    ) -> None:
         with self._lock:
             if query_id in self._dead:
                 return  # cancelled/finished: drop, don't re-create buffers
-            self._queues[(query_id, bridge_id)].append(item)
+            if token is None:
+                self._queues[(query_id, bridge_id)].append(item)
+                return
+            # r17 attempt-gated push: hold until this attempt's eos, then
+            # commit atomically; stale/unauthorized attempts drop here.
+            slot, epoch = token
+            key = (query_id, bridge_id)
+            if slot in self._committed.get(key, ()):
+                return  # slot already won by another attempt
+            if epoch not in self._auth.get(key, {}).get(slot, ()):
+                return  # revoked (dead/lost) attempt: discard
+            hk = (query_id, bridge_id, slot, epoch)
+            held = self._held.setdefault(hk, [])
+            held.append(item)
+            if getattr(item, "eos", False):
+                self._queues[key].extend(held)
+                del self._held[hk]
+                self._committed.setdefault(key, set()).add(slot)
+                # Drop any sibling attempt's held items for this slot
+                # (hedge loser racing the winner to commit).
+                for other in [
+                    k for k in self._held
+                    if k[0] == query_id and k[1] == bridge_id
+                    and k[2] == slot
+                ]:
+                    del self._held[other]
 
-    def poll(self, query_id: str, bridge_id: str) -> Optional[Any]:
+    def poll(
+        self,
+        query_id: str,
+        bridge_id: str,
+        consumer: Optional[tuple] = None,
+    ) -> Optional[Any]:
         with self._lock:
             if query_id in self._dead:
                 raise BridgeCancelled(
                     f"query {query_id}: bridge {bridge_id} cancelled"
                 )
             q = self._queues[(query_id, bridge_id)]
-            return q.popleft() if q else None
+            if consumer is None:
+                return q.popleft() if q else None
+            # r17 replayable consumption: a retried consumer fragment
+            # (fresh token) re-reads the committed stream from index 0.
+            ck = (query_id, bridge_id, consumer)
+            cur = self._cursors.get(ck, 0)
+            if cur >= len(q):
+                return None
+            self._cursors[ck] = cur + 1
+            return q[cur]
 
     def cancel_query(self, query_id: str) -> None:
         """Abort a query mid-flight: drop its buffers, tombstone the id so
@@ -116,4 +241,10 @@ class BridgeRouter:
                 del self._queues[key]
             for key in [k for k in self._producers if k[0] == query_id]:
                 del self._producers[key]
+            for d in (self._auth, self._committed):
+                for key in [k for k in d if k[0] == query_id]:
+                    del d[key]
+            for d in (self._held, self._cursors):
+                for key in [k for k in d if k[0] == query_id]:
+                    del d[key]
             self._mark_dead_locked(query_id)

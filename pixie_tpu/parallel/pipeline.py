@@ -683,6 +683,12 @@ class MeshExecutor:
             "resident_ingest": (
                 self._resident.snapshot() if self._resident else {}
             ),
+            # Adopted replica rings (r17): per-table window coverage,
+            # leader watermark, and lag — the broker's failover ranking
+            # prefers agents whose replicas already hold the data.
+            "replicas": (
+                self._resident.replica_snapshot() if self._resident else {}
+            ),
         }
 
     # -- device-resident incremental ingest (r13) ----------------------------
@@ -693,24 +699,57 @@ class MeshExecutor:
         ring or None."""
         if not flags.resident_ingest:
             return None
+        return self._resident_manager().enable(table)
+
+    def _resident_manager(self):
         if self._resident is None:
             from pixie_tpu.serving.resident import ResidentIngestManager
 
             self._resident = ResidentIngestManager(
                 self.mesh, self.block_rows, self._staged_cache
             )
-        return self._resident.enable(table)
+        return self._resident
+
+    # -- ring replication (r17) ----------------------------------------------
+    def set_ring_replication_hook(self, hook) -> None:
+        """Leader side: install ``hook(table, k, start_row, rows,
+        wire_cols, latest_k)`` on every owned ring (current and future)
+        — the agent's replicator ships each staged window's encoded
+        payload to follower agents."""
+        self._resident_manager().set_replication_hook(hook)
+
+    def adopt_replica_window(
+        self, table_name, window_rows, k, start_row, rows, wire_cols,
+        latest_k,
+    ) -> bool:
+        """Follower side: decode one replicated ring window into this
+        executor's HBM (byte-accounted in the residency pool). Works
+        without ``resident_ingest`` — a follower never owns the
+        table's appends."""
+        return self._resident_manager().adopt_replica_window(
+            table_name, window_rows, k, start_row, rows, wire_cols,
+            latest_k,
+        )
+
+    def replica_snapshot(self) -> dict:
+        return (
+            self._resident.replica_snapshot() if self._resident else {}
+        )
 
     def _resident_ring(self, table, src_op):
-        """The table's ring when the resident fast path applies: flag
-        on, a ring exists, and the query has no time bounds (the
-        row-id↔window alignment the ring serves assumes the cursor
-        returns every resident row)."""
-        if self._resident is None or not flags.resident_ingest:
+        """The table's ring when the resident fast path applies: a ring
+        exists and the query has no time bounds (the row-id↔window
+        alignment the ring serves assumes the cursor returns every
+        resident row). With ``resident_ingest`` off, only ADOPTED
+        replica rings serve (r17 failover: the follower never observes
+        appends, so the flag gating owned ingest does not apply)."""
+        if self._resident is None:
             return None
         if src_op.start_time is not None or src_op.stop_time is not None:
             return None
-        return self._resident.ring_for(src_op.table_name)
+        if flags.resident_ingest:
+            return self._resident.ring_for(src_op.table_name)
+        return self._resident.replica_for(src_op.table_name)
 
     def _decode_fn(self, plan, cp, cache: dict):
         """Resolve a window decode program: the background-AOT-compiled
@@ -4494,6 +4533,14 @@ class MeshExecutor:
                     slot_terms,
                 )
             )
+            if flags.aot_compile:
+                # r17 satellite: compile the B=2 bucket's batched fold
+                # in the background NOW — the first real batched
+                # dispatch finds it ready instead of jitting inline.
+                self._kick_batched_fold_aot(
+                    m, specs, evaluator, key_plan, staged, shared_aux,
+                    terms,
+                )
         return self._shared_scans.run(
             key,
             lambda: self._run_program(
@@ -4760,6 +4807,126 @@ class MeshExecutor:
             )
         )
 
+    def _batched_fold_program(
+        self, m, specs, evaluator, key_plan, staged, aux_key_order,
+        aux_vals, capacity, B, T,
+    ):
+        """The batched FOLD unit for one (erased-sig, B, T) bucket plus
+        the abstract argument shapes its AOT compile needs. Shared by
+        the dispatch path and the speculative kick so both resolve the
+        SAME signature (one compile per bucket, in-flight dedup via
+        _aot_futures)."""
+        int_cols, flt_cols = self._pred_stacks(staged)
+        erased = self._fold_signature(
+            m, specs, key_plan, staged, aux_vals, capacity,
+            preds_repr="<batched>",
+        )
+        bsig = f"bfold|{erased}|batch:{B}|terms:{T}"
+        treedef, leaves = self._state_template(specs, capacity)
+        col_names = sorted(staged.blocks)
+        narrow_names = sorted(staged.narrow_offsets)
+        int_dict_names = sorted(staged.int_dicts)
+        fold_p = self._get_program(
+            bsig,
+            lambda: self._build_batched_fold(
+                specs, evaluator, key_plan, col_names, narrow_names,
+                int_dict_names, aux_key_order, capacity, len(leaves),
+                treedef, int_cols, flt_cols,
+            ),
+            n_aux=len(aux_vals),
+        )
+        (axis_name,) = self.mesh.axis_names
+        sharded = NamedSharding(self.mesh, P(axis_name))
+        repl = NamedSharding(self.mesh, P())
+        d = staged.num_devices
+        avals = [
+            jax.ShapeDtypeStruct(
+                (d, B) + tuple(l.shape), l.dtype, sharding=sharded
+            )
+            for l in leaves
+        ]
+        for n2 in col_names:
+            a = staged.blocks[n2]
+            avals.append(
+                jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+            )
+        avals.append(
+            jax.ShapeDtypeStruct(
+                staged.mask.shape, staged.mask.dtype,
+                sharding=staged.mask.sharding,
+            )
+        )
+        if key_plan.host_gids is not None:
+            g = staged.gids
+            avals.append(
+                jax.ShapeDtypeStruct(g.shape, g.dtype, sharding=g.sharding)
+            )
+        if isinstance(key_plan.device_expr, tuple):
+            lut = np.asarray(key_plan.device_expr[2])
+            avals.append(
+                jax.ShapeDtypeStruct(lut.shape, lut.dtype, sharding=repl)
+            )
+        for v in aux_vals:
+            v = np.asarray(v)
+            avals.append(
+                jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=repl)
+            )
+        if staged.narrow_offsets:
+            avals.append(
+                jax.ShapeDtypeStruct(
+                    (len(staged.narrow_offsets),), np.dtype(np.int64),
+                    sharding=repl,
+                )
+            )
+        # The 8-term table (t_stack..t_active, slot_on) + gid_base.
+        for dt in (
+            np.int32, np.int32, np.int32, np.int32, np.int64,
+            np.float64, np.bool_,
+        ):
+            avals.append(
+                jax.ShapeDtypeStruct((B, T), np.dtype(dt), sharding=repl)
+            )
+        avals.append(
+            jax.ShapeDtypeStruct((B,), np.dtype(np.bool_), sharding=repl)
+        )
+        avals.append(
+            jax.ShapeDtypeStruct((), np.dtype(np.int32), sharding=repl)
+        )
+        return bsig, fold_p, tuple(avals)
+
+    def _kick_batched_fold_aot(
+        self, m, specs, evaluator, key_plan, staged, shared_aux, terms
+    ) -> None:
+        """Speculative background compile of the batched fold at the
+        B=2 bucket (the soak's p50 batch width) whenever a query's
+        predicates normalize: by the time two predicate-compatible
+        queries actually coalesce, their bucket's executable is
+        compiled (or compiling) on the AOT worker instead of jitting
+        inline under the batch's leader. Best-effort and deduped per
+        bucket — a kick that never gets used costs one background
+        compile, once."""
+        try:
+            aux = dict(shared_aux)
+            for n2 in sorted(staged.int_dicts):
+                aux[f"intdict:{n2}"] = np.asarray(staged.int_dicts[n2])
+            capacity, _n_passes = self._pass_plan(
+                specs, key_plan.num_groups
+            )
+            bsig, fold_p, avals = self._batched_fold_program(
+                m, specs, evaluator, key_plan, staged,
+                list(aux.keys()), list(aux.values()), capacity,
+                2, self._bucket_pow2(max(len(terms), 1)),
+            )
+            self._aot_compile_async(
+                bsig, fold_p, avals, profile_key="batched_compile"
+            )
+        except Exception:
+            import logging
+
+            logging.getLogger("pixie_tpu.parallel").warning(
+                "batched-fold AOT kick failed (ignored)", exc_info=True
+            )
+
     def _run_program_batched(
         self, m, specs, evaluator, key_plan, staged, aux, slot_terms
     ):
@@ -4802,29 +4969,44 @@ class MeshExecutor:
                     t_stack[s, t] = 1
                     t_col_f[s, t] = f_idx[cname]
                     t_thr_f[s, t] = thr_f
-        erased = self._fold_signature(
-            m, specs, key_plan, staged, aux_vals, capacity,
-            preds_repr="<batched>",
+        bsig, fold_p, avals = self._batched_fold_program(
+            m, specs, evaluator, key_plan, staged, aux_key_order,
+            aux_vals, capacity, B, T,
         )
-        bsig = f"bfold|{erased}|batch:{B}|terms:{T}"
+        # AOT lane (ROADMAP r16 follow-on): resolve the batched fold
+        # through the background compiler like the warm fold — the
+        # executable caches per (erased-sig, B, T) bucket (and in the
+        # persistent .jax_cache), a speculative kick at predicate-
+        # normalization time usually has it compiling already, and a
+        # compile failure falls back to the in-line jit recorded in
+        # stream_fallback_errors.
+        fold_fn = fold_p
+        if flags.aot_compile:
+            try:
+                fold_fn = self._aot_compile_async(
+                    bsig, fold_p, avals, profile_key="batched_compile"
+                ).result()
+            except Exception as e:
+                import logging
+                import traceback
+
+                key = f"batched-aot {type(e).__name__}: {e}"
+                if key not in self.stream_fallback_errors:
+                    self.stream_fallback_errors[key] = (
+                        traceback.format_exc()
+                    )
+                    logging.getLogger("pixie_tpu.parallel").warning(
+                        "batched-fold AOT compile failed, falling back "
+                        "to in-line jit: %s", key,
+                    )
+                fold_fn = fold_p
         treedef, leaves = self._state_template(specs, capacity)
         lanes = self._uda_set_sig(specs)
         mesh_s = f"{self.mesh.devices.shape}"
         col_names = sorted(staged.blocks)
-        narrow_names = sorted(staged.narrow_offsets)
-        int_dict_names = sorted(staged.int_dicts)
         init_p = self._get_program(
             f"binit|{lanes}|cap:{capacity}|batch:{B}|mesh:{mesh_s}",
             lambda: self._build_batched_init(specs, capacity, B),
-        )
-        fold_p = self._get_program(
-            bsig,
-            lambda: self._build_batched_fold(
-                specs, evaluator, key_plan, col_names, narrow_names,
-                int_dict_names, aux_key_order, capacity, len(leaves),
-                treedef, int_cols, flt_cols,
-            ),
-            n_aux=len(aux_vals),
         )
         # Merge/finalize are the SAME cached units serial queries use.
         merge_p = self._get_program(
@@ -4839,24 +5021,35 @@ class MeshExecutor:
             lambda: self._build_fin(specs, capacity, force_state, treedef),
         )
         _, templates = self._finalize_modes(specs, capacity, force_state)
+        # Replicated args are device_put with an explicit sharding so
+        # they match the AOT-compiled executable's input shardings (the
+        # in-line jit path auto-placed them; a Compiled does not).
+        repl = NamedSharding(self.mesh, P())
         args = [staged.blocks[n] for n in col_names] + [staged.mask]
         if key_plan.host_gids is not None:
             args.append(staged.gids)
         if isinstance(key_plan.device_expr, tuple):
-            args.append(jnp.asarray(key_plan.device_expr[2]))
-        args.extend(jnp.asarray(v) for v in aux_vals)
+            args.append(
+                jax.device_put(np.asarray(key_plan.device_expr[2]), repl)
+            )
+        args.extend(
+            jax.device_put(np.asarray(v), repl) for v in aux_vals
+        )
         if staged.narrow_offsets:
             args.append(
-                jnp.asarray(
-                    [
-                        staged.narrow_offsets[n]
-                        for n in sorted(staged.narrow_offsets)
-                    ],
-                    jnp.int64,
+                jax.device_put(
+                    np.asarray(
+                        [
+                            staged.narrow_offsets[n]
+                            for n in sorted(staged.narrow_offsets)
+                        ],
+                        np.int64,
+                    ),
+                    repl,
                 )
             )
         args.extend(
-            jnp.asarray(x)
+            jax.device_put(x, repl)
             for x in (
                 t_stack, t_col_i, t_col_f, t_op, t_thr_i, t_thr_f,
                 t_active, slot_on,
@@ -4870,7 +5063,10 @@ class MeshExecutor:
                 flat = list(init_p())
                 t0 = time.perf_counter()
                 flat = list(
-                    fold_p(*flat, *args, jnp.int32(p * capacity))
+                    fold_fn(
+                        *flat, *args,
+                        jax.device_put(np.int32(p * capacity), repl),
+                    )
                 )
                 if resattr.ACTIVE:
                     resattr.record_dispatch(

@@ -102,6 +102,12 @@ class AdmissionControlLoop:
         self._base_hbm_mb = int(flags.hbm_budget_mb)
         self._idle_windows = 0
         self._low_hbm_windows = 0
+        # Post-brake hold-down (r17 satellite): windows remaining in
+        # which concurrency RAISES are suppressed after an HBM-pressure
+        # halving, so the MIMD law observes the brake's effect instead
+        # of immediately re-climbing into the same pressure (the
+        # 8->128->floor->16 thrash from the 1k-client trail).
+        self._holddown = 0
         self.trail: "collections.deque[dict]" = collections.deque(
             maxlen=256
         )
@@ -244,7 +250,8 @@ class AdmissionControlLoop:
         if self._hbm_pressure(sig):
             # Brake first: admitting more folds into a pool whose
             # pinned bytes crowd the budget converts latency into OOM
-            # rejections.
+            # rejections. Arm the hold-down: no raises until the
+            # brake's effect has been observed.
             self._actuate(
                 "admission_max_concurrent",
                 max(cur // 2, floor),
@@ -252,11 +259,33 @@ class AdmissionControlLoop:
                 sig,
             )
             self._idle_windows = 0
+            self._holddown = max(
+                int(flags.admission_controller_holddown_windows), 0
+            )
             return
         if sig["admitted"] > 0 and sig["wait_p50_ms"] > target_ms and (
             self._hbm_headroom(sig)
         ):
             self._idle_windows = 0
+            if self._holddown > 0:
+                # Post-brake hold-down (r17): the wait signal still
+                # reflects the pre-brake queue — re-climbing now is the
+                # oscillation. Hold, burn one window, record why.
+                self._holddown -= 1
+                self.trail.append(
+                    {
+                        "time_ns": time.time_ns(),
+                        "knob": "admission_max_concurrent",
+                        "from": cur,
+                        "to": cur,
+                        "reason": "holddown_after_brake",
+                        "signals": {
+                            k: round(v, 3) if isinstance(v, float) else v
+                            for k, v in sig.items()
+                        },
+                    }
+                )
+                return
             self._actuate(
                 "admission_max_concurrent",
                 min(cur * 2, ceil),
@@ -264,6 +293,10 @@ class AdmissionControlLoop:
                 sig,
             )
             return
+        if self._holddown > 0:
+            # Quiet window: the hold-down still decays — evidence of a
+            # calmer system counts toward releasing the brake.
+            self._holddown -= 1
         if sig["admitted"] > 0 and sig["queue_depth"] == 0 and (
             sig["wait_p50_ms"] < target_ms / 10.0
         ):
@@ -362,5 +395,8 @@ class AdmissionControlLoop:
                     "admission_max_concurrent": self._base_concurrent,
                     "hbm_budget_mb": self._base_hbm_mb,
                 },
+                # r17: windows left in the post-brake hold-down (raises
+                # suppressed while > 0).
+                "holddown_windows_left": self._holddown,
                 "actuations": list(self.trail)[-32:],
             }

@@ -69,6 +69,27 @@ _INVALID = _M.counter(
     "resident_ring_invalidated_total",
     "Rings permanently invalidated (row-id gap or column mismatch).",
 )
+_REPLICATED = _M.counter(
+    "ring_replicated_windows_total",
+    "Ring windows shipped to follower agents over the codec'd wire "
+    "(r17, flag ring_replication_factor > 1), by table.",
+)
+_REPLICA_ADOPTED = _M.counter(
+    "ring_replica_adopted_windows_total",
+    "Replica windows decoded into a follower's HBM, by table.",
+)
+_REPLICA_HITS = _M.counter(
+    "replica_window_hits_total",
+    "Query stream windows served from a REPLICA ring after failover "
+    "(pack+transfer skipped on an agent that never owned the table).",
+)
+_REPLICA_LAGGED = _M.counter(
+    "ring_replica_lagged_windows_total",
+    "Replica windows NOT adopted (decode failure, geometry mismatch, "
+    "or the resident.replica_lag fault site) — the replica falls "
+    "behind the leader's watermark and failover queries re-stage those "
+    "rows from the table store instead.",
+)
 _RESTAGED = _M.counter(
     "ring_restaged_windows_total",
     "Ring windows re-staged into HBM from the durable spill after a "
@@ -110,6 +131,13 @@ class ResidentRing:
 
         self.mesh = mesh
         self.table_name = table.name
+        # Replication hook (r17, flag ring_replication_factor > 1): set
+        # by the owning agent's replicator; called as hook(table_name,
+        # k, start_row, rows, wire_cols, latest_k) with the EXACT
+        # encoded payloads the leader's own decode consumed — the wire
+        # representation is shared, not recomputed. Called under the
+        # ring lock: the hook must only enqueue, never block.
+        self.replication_hook = None
         self.window_rows = int(flags.resident_window_rows)
         self.d = mesh.devices.size
         self.b, self.nblk = block_geometry(
@@ -248,6 +276,7 @@ class ResidentRing:
         blocks = {}
         nbytes = 0
         wire = 0
+        wire_cols = {} if self.replication_hook is not None else None
         for name, a in win_cols.items():
             flat = np.zeros(total, dtype=a.dtype)
             flat[:W] = a
@@ -267,16 +296,32 @@ class ResidentRing:
                     self.mesh, cp, self.nblk, self.b
                 )(*args)
                 wire += payload.nbytes
+                if wire_cols is not None:
+                    wire_cols[name] = ("codec", payload)
             else:
                 blocks[name] = jax.device_put(
                     flat.reshape(self.d, self.nblk, self.b), sharding
                 )
                 wire += flat.nbytes
+                if wire_cols is not None:
+                    wire_cols[name] = ("raw", flat)
             nbytes += flat.nbytes
         win = ResidentWindow(k, k * W, W, blocks, nbytes)
         self.windows[k] = win
         _WINDOWS.inc()
         _WIRE.inc(wire)
+        if wire_cols is not None and record:
+            # Ship the SAME encoded payloads to followers (r17): the
+            # replica pays the compressed wire, never a re-encode.
+            try:
+                self.replication_hook(
+                    self.table_name, k, k * W, W, wire_cols, k
+                )
+                _REPLICATED.inc(table=self.table_name)
+            except Exception:
+                _log_serving().exception(
+                    "ring replication hook failed (ignored)"
+                )
         if self._pool is not None:
             self._pool.register_resident(
                 ("resident", self.table_name, k), nbytes
@@ -423,8 +468,160 @@ class ResidentRing:
             }
 
 
+class ReplicaRing:
+    """A follower agent's HBM mirror of another agent's ResidentRing
+    (r17, flag ``ring_replication_factor`` > 1).
+
+    Windows arrive as the leader's EXACT wire representation (codec
+    payload or raw flat column) and decode device-side into the same
+    [D, nblk, B] raw-dtype blocks a local ring would hold — so a
+    failover query on this agent finds the hot span already resident
+    (wire ~ 0) and ``lookup`` serves it bit-identically to the leader.
+    The replica never observes table appends; its freshness is bounded
+    by the leader's advertised watermark (``leader_latest``), and any
+    window it lacks — decode failure, geometry mismatch, the
+    ``resident.replica_lag`` fault site, or plain lag — silently falls
+    back to staging from the table store (the ring-miss path queries
+    already take)."""
+
+    def __init__(self, mesh, table_name: str, window_rows: int,
+                 block_rows: int, pool=None):
+        from pixie_tpu.parallel.staging import block_geometry
+
+        self.mesh = mesh
+        self.table_name = table_name
+        self.window_rows = int(window_rows)
+        self.d = mesh.devices.size
+        self.b, self.nblk = block_geometry(
+            self.window_rows, self.d, block_rows
+        )
+        self._pool = pool
+        self._lock = threading.Lock()
+        self.windows: dict[int, ResidentWindow] = {}
+        self.leader_latest = -1  # highest window index the leader staged
+
+    def adopt_window(
+        self, k: int, start_row: int, rows: int, wire_cols: dict,
+        latest_k: int,
+    ) -> bool:
+        """Decode one replicated window into HBM. Returns False (and
+        counts the lag) when the window cannot be adopted — the replica
+        stays behind and correctness rides the staging fallback."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from pixie_tpu.ops import codec as _codec
+        from pixie_tpu.utils import faults
+
+        with self._lock:
+            self.leader_latest = max(self.leader_latest, int(latest_k))
+            W = self.window_rows
+            if rows != W or start_row != k * W:
+                _REPLICA_LAGGED.inc(table=self.table_name)
+                return False
+            if faults.ACTIVE and faults.fires("resident.replica_lag"):
+                # A dropped/late replication frame: the replica is now
+                # behind the leader's watermark for this window.
+                _REPLICA_LAGGED.inc(table=self.table_name)
+                return False
+            (axis_name,) = self.mesh.axis_names
+            sharding = NamedSharding(self.mesh, P(axis_name))
+            shard_len = self.nblk * self.b
+            blocks = {}
+            nbytes = 0
+            try:
+                for name, (kind, data) in wire_cols.items():
+                    if kind == "codec":
+                        cp = data.plan
+                        if cp.d != self.d or cp.shard_len != shard_len:
+                            raise ValueError("replica geometry mismatch")
+                        args = _codec.put_payload(self.mesh, data)
+                        blocks[name] = _codec.decoder(
+                            self.mesh, cp, self.nblk, self.b
+                        )(*args)
+                        nbytes += cp.block_nbytes()
+                    else:
+                        flat = np.asarray(data)
+                        if flat.size != self.d * shard_len:
+                            raise ValueError("replica geometry mismatch")
+                        blocks[name] = jax.device_put(
+                            flat.reshape(self.d, self.nblk, self.b),
+                            sharding,
+                        )
+                        nbytes += flat.nbytes
+            except Exception:
+                _log_serving().exception(
+                    "replica window %d of %r not adopted",
+                    k, self.table_name,
+                )
+                _REPLICA_LAGGED.inc(table=self.table_name)
+                return False
+            self.windows[k] = ResidentWindow(k, start_row, rows, blocks,
+                                             nbytes)
+            _REPLICA_ADOPTED.inc(table=self.table_name)
+            if self._pool is not None:
+                self._pool.register_resident(
+                    ("replica", self.table_name, k), nbytes
+                )
+            cap = max(int(flags.resident_max_windows), 1)
+            while len(self.windows) > cap:
+                self._release_locked(min(self.windows))
+            return True
+
+    def _release_locked(self, k: int) -> None:
+        self.windows.pop(k, None)
+        if self._pool is not None:
+            self._pool.release_resident(("replica", self.table_name, k))
+
+    def release_all(self) -> None:
+        with self._lock:
+            for k in list(self.windows):
+                self._release_locked(k)
+
+    # -- read side: same contract as ResidentRing.lookup ---------------------
+    def lookup(
+        self, start_row: int, rows: int, needed_cols
+    ) -> Optional[ResidentWindow]:
+        W = self.window_rows
+        if rows != W or start_row % W != 0:
+            return None
+        with self._lock:
+            win = self.windows.get(start_row // W)
+        if win is None:
+            return None
+        for name in needed_cols:
+            if name not in win.blocks:
+                return None
+        _REPLICA_HITS.inc()
+        return win
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            latest = max(self.windows) if self.windows else -1
+            # Lag counts every window inside the leader's retention
+            # span this replica lacks — holes from dropped replication
+            # frames included, not just a short tail.
+            cap = max(int(flags.resident_max_windows), 1)
+            span_start = max(self.leader_latest - cap + 1, 0)
+            lag = sum(
+                1
+                for k in range(span_start, self.leader_latest + 1)
+                if k not in self.windows
+            )
+            return {
+                "table": self.table_name,
+                "window_rows": self.window_rows,
+                "windows": len(self.windows),
+                "latest": latest,
+                "leader_latest": self.leader_latest,
+                "lag": lag,
+                "bytes": sum(w.nbytes for w in self.windows.values()),
+            }
+
+
 class ResidentIngestManager:
-    """The MeshExecutor's registry of per-table rings."""
+    """The MeshExecutor's registry of per-table rings — owned
+    (append-fed) rings plus adopted replica rings (r17)."""
 
     def __init__(self, mesh, block_rows: int, pool=None):
         self.mesh = mesh
@@ -432,6 +629,9 @@ class ResidentIngestManager:
         self.pool = pool
         self._lock = threading.Lock()
         self._rings: dict[str, ResidentRing] = {}
+        self._replicas: dict[str, ReplicaRing] = {}
+        # Replication hook applied to rings created later (r17).
+        self._replication_hook = None
 
     def enable(self, table) -> Optional[ResidentRing]:
         """Attach a ring to ``table`` (idempotent per table name).
@@ -444,13 +644,55 @@ class ResidentIngestManager:
             ring = ResidentRing(self.mesh, table, self.block_rows, self.pool)
             if not ring.columns:
                 return None
+            ring.replication_hook = self._replication_hook
             self._rings[table.name] = ring
         table.add_append_listener(ring.on_append)
         return ring
 
-    def ring_for(self, table_name: str) -> Optional[ResidentRing]:
+    def set_replication_hook(self, hook) -> None:
+        """Install the leader-side replication hook on every owned ring
+        (current and future)."""
         with self._lock:
-            return self._rings.get(table_name)
+            self._replication_hook = hook
+            for ring in self._rings.values():
+                ring.replication_hook = hook
+
+    def adopt_replica_window(
+        self, table_name: str, window_rows: int, k: int, start_row: int,
+        rows: int, wire_cols: dict, latest_k: int,
+    ) -> bool:
+        """Follower side: decode a replicated window into this agent's
+        HBM (creating the table's ReplicaRing on first sight)."""
+        with self._lock:
+            rep = self._replicas.get(table_name)
+            if rep is None or rep.window_rows != int(window_rows):
+                if rep is not None:
+                    rep.release_all()
+                rep = ReplicaRing(
+                    self.mesh, table_name, window_rows, self.block_rows,
+                    self.pool,
+                )
+                self._replicas[table_name] = rep
+        return rep.adopt_window(k, start_row, rows, wire_cols, latest_k)
+
+    def ring_for(self, table_name: str):
+        """The table's serving ring: the owned (append-fed) ring when
+        one exists, else an adopted replica ring (r17 failover — the
+        agent never owned the table but its HBM already holds the hot
+        windows)."""
+        with self._lock:
+            return self._rings.get(table_name) or self._replicas.get(
+                table_name
+            )
+
+    def replica_for(self, table_name: str) -> Optional[ReplicaRing]:
+        with self._lock:
+            return self._replicas.get(table_name)
+
+    def replica_snapshot(self) -> dict:
+        with self._lock:
+            reps = list(self._replicas.values())
+        return {r.table_name: r.snapshot() for r in reps}
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -84,6 +84,13 @@ _WINDOW_SKIPS = _M.counter(
     "Batching windows skipped because the admission queue was empty "
     "(the r16 solo-query window-tax fix).",
 )
+_DETACHED = _M.counter(
+    "serving_shared_scan_follower_detach_total",
+    "Followers that detached from a batch whose leader died mid-"
+    "dispatch and completed SOLO (r17): the leader's failure is not "
+    "contagious — each follower re-runs its own compute, bit-identical "
+    "to never having joined.",
+)
 
 # Admission-queue depth gate for the batching window. None = unknown
 # (no broker/admission wired): keep the pre-r16 always-sleep behavior
@@ -162,9 +169,10 @@ class SharedScanCoordinator:
     whose exact keys differ but whose batch keys match join the same
     dispatch as separate SLOTS — the leader then runs ONE
     ``compute_batch(slot_terms)`` returning a result per slot. A leader
-    error propagates to every joiner (each would have hit the same
-    error; retrying it N times against a failing device would just
-    churn the breaker)."""
+    error makes every follower DETACH and complete solo (r17: a killed
+    leader must not take its batch down with it); a failure the
+    follower would hit too simply re-raises from its solo run and
+    rides the r9 breaker."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -230,7 +238,14 @@ class SharedScanCoordinator:
                 _PRED_BATCHED.inc()
             self._span(size, width, role="follower")
             if b.error is not None:
-                raise b.error
+                # Leader died mid-batch (r17): detach and complete SOLO
+                # — the follower re-runs ITS OWN compute, bit-identical
+                # to never having joined the batch. A failure that
+                # would hit the follower too (a sick device) re-raises
+                # from the solo run and rides the r9 breaker as usual.
+                _DETACHED.inc()
+                self._span(1, 1, role="detached")
+                return compute()
             return b.results[slot]
         # Leader: batching window (demand-gated, r16), then dispatch.
         window_s = float(flags.shared_scan_window_ms) / 1e3

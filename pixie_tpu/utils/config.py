@@ -415,6 +415,17 @@ define_flag(
     "budget and never touches one it cannot bound).",
 )
 define_flag(
+    "admission_controller_holddown_windows",
+    3,
+    help_="Post-brake hold-down (r17 satellite): after the controller "
+    "HALVES admission_max_concurrent on HBM pressure, concurrency "
+    "raises are suppressed for this many evaluation windows — the "
+    "brake's effect must be observed before the MIMD law may climb "
+    "again (damps the 8->128->floor->16 oscillation the 1k-client "
+    "trail showed). Further braking is always allowed; 0 disables "
+    "the hold-down.",
+)
+define_flag(
     "admission_controller_wait_target_ms",
     250.0,
     help_="Control target: windowed admission-wait p50 above this "
@@ -519,6 +530,70 @@ define_flag(
     "transport WAL is on; older unacked frames keep only their seq and "
     "byte count in RAM and are re-read from the WAL at replay time "
     "(the ARIES-style spill bound).",
+)
+
+# -- transparent fragment failover (r17) -------------------------------------
+define_flag(
+    "fragment_failover",
+    False,
+    help_="Transparent fragment failover (vizier/broker.py): when a "
+    "fragment is lost mid-query (heartbeat death, execute error, "
+    "restart refusal, forwarder drop) the broker re-launches it on a "
+    "surviving capable agent instead of synthesizing eos — the query "
+    "completes with FULL, bit-identical results and a ``recovered`` "
+    "annotation instead of a ``degraded`` one. Retries are "
+    "exactly-once: every attempt carries a per-fragment result epoch, "
+    "the broker applies exactly one attempt's output, and bridge "
+    "pushes commit atomically per attempt (exec/router.py). Off = the "
+    "r9 partial-results behavior.",
+)
+define_flag(
+    "fragment_max_retries",
+    2,
+    help_="Most failover re-launches one fragment slot gets before the "
+    "broker gives up and degrades the query (the r9 partial-results "
+    "fallback). Hedged duplicates do not count against this budget.",
+)
+define_flag(
+    "hedged_requests",
+    False,
+    help_="Hedged fragment dispatch (vizier/broker.py; Dean & Barroso, "
+    "'The Tail at Scale'): when a fragment is still pending past the "
+    "hedge delay — the per-program-key fold-latency quantile from "
+    "agent heartbeats (``hedge_quantile``), or ``hedge_delay_ms`` when "
+    "set — the broker launches a duplicate attempt on another capable "
+    "agent. First fragment_done wins; the loser is cancelled through "
+    "the r9 abort path and its output is dropped by the same "
+    "fragment-epoch dedup retries use. Requires fragment_failover.",
+)
+define_flag(
+    "hedge_quantile",
+    0.99,
+    help_="Fold-latency quantile (from the r11 per-program-key "
+    "heartbeat histograms) a pending fragment must exceed before a "
+    "hedge launches. Only 0.5 and 0.99 are tracked; values >= 0.99 "
+    "read p99, lower values p50.",
+)
+define_flag(
+    "hedge_delay_ms",
+    0.0,
+    help_="Fixed hedge delay override in milliseconds. 0 derives the "
+    "delay from the fold-latency view (no latency data for the "
+    "fragment's program keys = no hedge).",
+)
+define_flag(
+    "ring_replication_factor",
+    1,
+    help_="Resident-ring replication (serving/resident.py + "
+    "vizier/agent.py): hot ring windows replicate to factor-1 follower "
+    "agents over the existing codec'd wire (the encoded window payload "
+    "is republished, follower decodes device-side), byte-accounted in "
+    "the follower's ResidencyPool and advertised in heartbeat "
+    "residency snapshots — so fragment failover lands on an agent "
+    "whose HBM already holds the hot windows (wire ~ 0) instead of a "
+    "cold re-stage. A lagging replica (bounded by the leader's "
+    "advertised watermark) falls back to re-staging from the table "
+    "store — bit-identical either way. 1 disables replication.",
 )
 
 # -- robustness (r10): acked delivery + cluster health plane -----------------

@@ -25,7 +25,15 @@ cut, no drain) immediately AFTER a frame reaches the wire and the WAL,
 ``wal.torn_write`` — a WAL append crashes mid-write() leaving a torn
 record for recovery to truncate, ``resident.spill_corrupt`` — a ring
 spill window record reads back corrupt and recovery must skip it, never
-serve it), and tests/operators arm them deterministically.
+serve it; r17 failover sites: ``agent.kill_holding_fragment`` — the
+agent process dies WHILE holding a fragment (heartbeats stop, results
+withheld; the broker must fail the fragment over to a survivor),
+``resident.replica_lag`` — a ring-replication frame is dropped so the
+follower falls behind the leader's watermark (failover queries must
+re-stage from the table store, bit-identical), ``hedge.both_complete``
+— the broker skips cancelling a hedge loser so BOTH attempts complete
+and the fragment-epoch dedup must drop exactly one), and
+tests/operators arm them deterministically.
 
 Design contract:
 

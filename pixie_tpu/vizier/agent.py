@@ -35,6 +35,10 @@ _RECOVERY_SECONDS = metrics_registry().gauge(
 HEARTBEAT_INTERVAL_S = flags.agent_heartbeat_interval_s
 AGENT_STATUS_TOPIC = "agent_status"  # ref: agent_topic_listener's channel
 RESULTS_TOPIC_PREFIX = "results/"
+# Ring-replication plane (r17, flag ring_replication_factor > 1): ring
+# leaders publish each staged window's encoded payload here; replica-
+# capable followers subscribe and adopt windows for tables they hold.
+RING_REPLICA_TOPIC = "ring_replica"
 
 
 class Agent:
@@ -53,10 +57,25 @@ class Agent:
         device_executor=None,
         vizier_ctx=None,
         wal_dir: Optional[str] = None,
+        owned_tables: "Optional[list[str]]" = None,
     ):
         self.agent_id = agent_id
         self.bus = bus
         self.is_kelvin = is_kelvin
+        # Data-plane ownership (r17): ``owned_tables`` is what this agent
+        # ADVERTISES for query planning. None = every table in its store
+        # (the pre-r17 behavior). A REPLICA agent passes an explicit
+        # subset (typically []): its store (shared/durable) holds the
+        # data and its HBM may hold replicated ring windows, but the
+        # planner never scans it — only fragment failover lands here,
+        # via the heartbeat's ``replica_tables`` advertisement.
+        self.owned_tables = (
+            None if owned_tables is None else sorted(owned_tables)
+        )
+        # Simulated process death (fault site agent.kill_holding_fragment):
+        # heartbeats stop, in-flight results are withheld, and the run
+        # loop goes deaf — exactly what the broker sees when a node dies.
+        self._killed = threading.Event()
         # Durable restart recovery (r14): with a per-agent wal_dir, the
         # agent persists its registration epoch and per-query
         # started/done markers (durability.AgentDurableState) so a
@@ -100,9 +119,18 @@ class Agent:
         # the dedup key). Bounded so a long-lived agent never leaks.
         import collections
 
-        self._seen_queries: "collections.OrderedDict[str, bool]" = (
+        # Keyed (query_id, slot, epoch) since r17: a failover RETRY of
+        # the same query (higher epoch) is a fresh execution, while the
+        # broker's re-offer of the SAME attempt still dedups.
+        self._seen_queries: "collections.OrderedDict[tuple, bool]" = (
             collections.OrderedDict()
         )
+        # Ring replication (r17): leader-side publish queue + follower-
+        # side peer view, wired in start() when the factor enables it.
+        self._replica_pub: "Optional[object]" = None
+        self._replica_sub = None
+        self._status_sub = None
+        self._replica_peers: dict[str, float] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def _recover(self) -> None:
@@ -170,6 +198,7 @@ class Agent:
         if self.durable is not None:
             self._recover()
         self._sub = self.bus.subscribe(agent_topic(self.agent_id))
+        self._start_replication()
         # On a transport reconnect (RemoteBus backoff, r9), re-register so
         # the broker's tracker re-learns our tables without waiting a full
         # heartbeat interval (ref: re-registration after NATS reconnect).
@@ -189,6 +218,130 @@ class Agent:
             t.join(timeout=2)
         if self._sub is not None:
             self._sub.unsubscribe()
+        for sub in (self._replica_sub, self._status_sub):
+            if sub is not None:
+                sub.unsubscribe()
+
+    # -- ring replication (r17) ---------------------------------------------
+    def _replica_capable(self) -> bool:
+        return (
+            int(flags.ring_replication_factor) > 1
+            and not self.is_kelvin
+            and getattr(self.carnot, "device_executor", None) is not None
+            and hasattr(
+                self.carnot.device_executor, "adopt_replica_window"
+            )
+        )
+
+    def _start_replication(self) -> None:
+        """Wire both replication roles when the factor enables them:
+        leader (every staged ring window's encoded payload republishes
+        on RING_REPLICA_TOPIC through a small publish queue — the ring
+        hook runs under the ring lock and must not block) and follower
+        (a loop adopting windows for tables this agent holds, with a
+        peer view from agent_status heartbeats bounding adoption to the
+        first factor-1 replica-capable followers)."""
+        if not self._replica_capable():
+            return
+        import queue
+
+        dev = self.carnot.device_executor
+        self._replica_pub = queue.Queue(maxsize=256)
+
+        def hook(table, k, start_row, rows, wire_cols, latest_k):
+            try:
+                self._replica_pub.put_nowait(
+                    {
+                        "type": "ring_replica_window",
+                        "origin": self.agent_id,
+                        "table": table,
+                        "window_rows": int(flags.resident_window_rows),
+                        "k": int(k),
+                        "start_row": int(start_row),
+                        "rows": int(rows),
+                        "cols": wire_cols,
+                        "latest_k": int(latest_k),
+                    }
+                )
+            except queue.Full:
+                pass  # replication is best-effort; followers just lag
+
+        dev.set_ring_replication_hook(hook)
+        self._replica_sub = self.bus.subscribe(RING_REPLICA_TOPIC)
+        self._status_sub = self.bus.subscribe(AGENT_STATUS_TOPIC)
+        rt = threading.Thread(target=self._replica_loop, daemon=True)
+        rt.start()
+        self._threads.append(rt)
+
+    def _my_replica_rank_ok(self, origin: str) -> bool:
+        """Bound adoption to ``ring_replication_factor - 1`` followers:
+        replica-capable agents learn each other from heartbeats and
+        adopt only when they rank among the first factor-1 peer ids
+        (sorted, origin excluded) — a deterministic choice every
+        follower computes identically."""
+        cap = max(int(flags.ring_replication_factor) - 1, 0)
+        now = time.monotonic()
+        peers = sorted(
+            aid
+            for aid, seen in self._replica_peers.items()
+            if aid != origin and now - seen < 10 * HEARTBEAT_INTERVAL_S
+        )
+        if self.agent_id not in peers:
+            peers.append(self.agent_id)
+            peers.sort()
+        return self.agent_id in peers[:cap]
+
+    def _replica_loop(self) -> None:
+        dev = self.carnot.device_executor
+        while not self._stop.is_set():
+            msg = self._status_sub.get(timeout=0.0) if (
+                self._status_sub.depth()
+            ) else None
+            if msg is not None:
+                if msg.get("type") in ("register", "heartbeat") and (
+                    msg.get("replica_ok")
+                ):
+                    self._replica_peers[msg["agent_id"]] = time.monotonic()
+                continue
+            msg = self._replica_sub.get(timeout=0.05)
+            if msg is None or self._killed.is_set():
+                continue
+            if msg.get("type") != "ring_replica_window":
+                continue
+            if msg.get("origin") == self.agent_id:
+                continue  # our own publish looping back
+            table = msg["table"]
+            if self.carnot.table_store.get_table(table) is None:
+                continue  # we could never serve a failover scan of it
+            if not self._my_replica_rank_ok(msg["origin"]):
+                continue
+            try:
+                dev.adopt_replica_window(
+                    table, msg["window_rows"], msg["k"],
+                    msg["start_row"], msg["rows"], msg["cols"],
+                    msg["latest_k"],
+                )
+            except Exception:
+                _log.exception(
+                    "replica adoption failed for %r (ignored)", table
+                )
+
+    def _publish_replicas(self) -> None:
+        """Drain the leader-side publish queue (called from the
+        heartbeat loop cadence AND opportunistically from the run
+        loop so replication lag stays ~one poll interval)."""
+        q = self._replica_pub
+        if q is None:
+            return
+        while True:
+            try:
+                msg = q.get_nowait()
+            except Exception:
+                return
+            try:
+                self.bus.publish(RING_REPLICA_TOPIC, msg)
+            except (OSError, ConnectionError):
+                return
 
     # -- registration + heartbeat (registration.*, heartbeat.{h,cc}) --------
     def _health(self) -> "dict | None":
@@ -212,21 +365,45 @@ class Agent:
             health["recovery"] = self.recovery_info
         return health
 
+    def _advertised_tables(self) -> list[str]:
+        if self.owned_tables is not None:
+            return list(self.owned_tables)
+        return sorted(self.carnot.table_store.table_names())
+
+    def _replica_tables(self) -> list[str]:
+        """Tables this agent can serve a failover scan for WITHOUT
+        owning them (r17): every store table it does not advertise.
+        Rides register/heartbeat so the broker's failover candidate
+        selection (and no-owner planning fallback) can route here."""
+        owned = set(self._advertised_tables())
+        return sorted(
+            set(self.carnot.table_store.table_names()) - owned
+        )
+
+    def _status_msg(self, kind: str) -> dict:
+        msg = {
+            "type": kind,
+            "agent_id": self.agent_id,
+            "epoch": self._epoch,
+            "is_kelvin": self.is_kelvin,
+            "tables": self._advertised_tables(),
+            "replica_tables": self._replica_tables(),
+            "health": self._health(),
+        }
+        if self._replica_capable():
+            msg["replica_ok"] = True
+        return msg
+
     def _register(self) -> None:
+        if self._killed.is_set():
+            return  # a "dead" process does not re-register
         self._epoch += 1
         if self.durable is not None:
             # Persist BEFORE publishing: a crash right after this
             # register restarts with a strictly higher epoch, so the
             # tracker always supersedes the zombie entry.
             self.durable.save_epoch(self._epoch)
-        msg = {
-            "type": "register",
-            "agent_id": self.agent_id,
-            "epoch": self._epoch,
-            "is_kelvin": self.is_kelvin,
-            "tables": sorted(self.carnot.table_store.table_names()),
-            "health": self._health(),
-        }
+        msg = self._status_msg("register")
         if self._restarted_pending:
             # First registration of a restarted incarnation: the tracker
             # distinguishes it from a plain reconnect re-register.
@@ -236,6 +413,11 @@ class Agent:
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(HEARTBEAT_INTERVAL_S):
+            if self._killed.is_set():
+                # Simulated process death (agent.kill_holding_fragment):
+                # the broker must see a silent agent.
+                continue
+            self._publish_replicas()
             # Fault site: a silent agent (chaos tests prove the broker
             # reaps it from plans and from in-flight queries).
             if faults.ACTIVE and faults.fires_scoped(
@@ -243,20 +425,9 @@ class Agent:
             ):
                 continue
             try:
-                self.bus.publish(
-                    AGENT_STATUS_TOPIC,
-                    {
-                        "type": "heartbeat",
-                        "agent_id": self.agent_id,
-                        "epoch": self._epoch,
-                        "is_kelvin": self.is_kelvin,
-                        "tables": sorted(
-                            self.carnot.table_store.table_names()
-                        ),
-                        "ts": time.monotonic(),
-                        "health": self._health(),
-                    },
-                )
+                msg = self._status_msg("heartbeat")
+                msg["ts"] = time.monotonic()
+                self.bus.publish(AGENT_STATUS_TOPIC, msg)
             except (OSError, ConnectionError):
                 # A dead transport must not kill the loop: the bus
                 # reconnects (or the process is crashing and stop() is
@@ -265,14 +436,53 @@ class Agent:
                 continue
 
     # -- query execution (exec.{h,cc}) --------------------------------------
+    @staticmethod
+    def _attempt_key(msg: dict) -> tuple:
+        """Execution-attempt identity: (query_id, slot, epoch). A r17
+        failover retry re-launches the SAME query_id at a higher result
+        epoch — a fresh attempt, not a duplicate — while the broker's
+        reconnect-gap re-offer of the same attempt still dedups."""
+        return (
+            msg.get("query_id"),
+            msg.get("slot", ""),
+            msg.get("result_epoch", 0),
+        )
+
+    @staticmethod
+    def _marker_key(msg: dict) -> str:
+        """Durable-marker key for an attempt: plain query_id pre-r17;
+        with failover fields, each (slot, epoch) attempt is its own
+        exactly-once unit (the broker's epoch filter guarantees at most
+        one attempt's output is ever applied)."""
+        qid = msg["query_id"]
+        if msg.get("result_epoch"):
+            return f"{qid}@{msg.get('slot', '')}#{msg['result_epoch']}"
+        return qid
+
     def _run_loop(self) -> None:
         while not self._stop.is_set():
+            self._publish_replicas()
             msg = self._sub.get(timeout=0.05)
-            if msg is None:
+            if msg is None or self._killed.is_set():
+                continue
+            if msg.get("type") == "cancel_query":
+                # r17 hedge-loser / failover cancellation: advisory
+                # abort through the r9 cancel machinery, scoped to ONE
+                # attempt — this agent may host several attempts of the
+                # same query (a hedged merge landing here), and only
+                # the named loser may die. Exactly-once never depends
+                # on it (stale epochs drop everywhere).
+                token = None
+                if msg.get("result_epoch") is not None:
+                    token = (msg.get("slot"), msg["result_epoch"])
+                try:
+                    self.carnot.cancel_query(msg["query_id"], token=token)
+                except Exception:
+                    _log.exception("cancel_query failed (ignored)")
                 continue
             if msg.get("type") == "execute_fragment":
-                qid = msg.get("query_id")
-                if qid in self._seen_queries:
+                akey = self._attempt_key(msg)
+                if akey in self._seen_queries:
                     continue  # re-offered launch we already ran
                 if self.durable is not None:
                     # Exactly-once across restart (r14): a durable
@@ -282,16 +492,17 @@ class Agent:
                     # double-apply. A ``started``-but-not-done marker
                     # means execution died mid-flight with partial output
                     # possibly applied — refuse the re-offer with a
-                    # structured error (the broker degrades the query and
-                    # releases our bridges) rather than re-execute into
+                    # structured error (the broker degrades the query —
+                    # or, with fragment_failover, retries it at a HIGHER
+                    # epoch, a fresh attempt) rather than re-execute into
                     # duplicate application.
-                    state = self.durable.query_state(qid)
+                    state = self.durable.query_state(self._marker_key(msg))
                     if state == "done":
                         continue
                     if state == "started":
                         self._refuse_restarted_query(msg)
                         continue
-                self._seen_queries[qid] = True
+                self._seen_queries[akey] = True
                 while len(self._seen_queries) > 512:
                     self._seen_queries.popitem(last=False)
                 threading.Thread(
@@ -315,6 +526,8 @@ class Agent:
                 {
                     "type": "fragment_error",
                     "agent_id": self.agent_id,
+                    "slot": msg.get("slot"),
+                    "result_epoch": msg.get("result_epoch"),
                     "error": "agent restarted mid-execution; partial "
                     "output withheld for exactly-once delivery",
                     "error_kind": "restart_lost",
@@ -335,6 +548,19 @@ class Agent:
     def _execute_fragment(self, msg: dict) -> None:
         query_id = msg["query_id"]
         plan: Plan = msg["plan"]  # in-process handoff; DCN would serialize
+        # Failover attempt identity (r17): echoed on every result frame
+        # so the broker's epoch filter applies exactly one attempt's
+        # output, and threaded into the exec state so bridge pushes
+        # commit atomically per attempt.
+        slot = msg.get("slot")
+        epoch = msg.get("result_epoch")
+        echo = (
+            {"slot": slot, "result_epoch": epoch}
+            if epoch is not None
+            else {}
+        )
+        bridge_token = (slot, epoch) if epoch is not None else None
+        marker = self._marker_key(msg)
         # Adopt the broker's propagated trace context (Dapper-style): this
         # agent's execute span — and the exec-node/device spans nested
         # under it — join the query's trace tree.
@@ -352,9 +578,22 @@ class Agent:
             # from here until mark_done leaves a ``started`` marker, and
             # the restarted incarnation refuses the re-offer instead of
             # re-executing into duplicate application.
-            self.durable.mark_started(query_id)
+            self.durable.mark_started(marker)
         try:
             if faults.ACTIVE:
+                if faults.fires_scoped(
+                    "agent.kill_holding_fragment", self.agent_id
+                ):
+                    # Simulated process death while holding a fragment
+                    # (r17): heartbeats stop, this attempt's results are
+                    # withheld, the run loop goes deaf. The broker's
+                    # reaper must fail the fragment over to a survivor.
+                    self._killed.set()
+                    trace.finish(
+                        span, status="error",
+                        attrs={"error": "killed holding fragment"},
+                    )
+                    return
                 if faults.fires_scoped("agent.execute_hang", self.agent_id):
                     # Simulate an agent wedged mid-query (alive but never
                     # reporting): park until the agent stops. Chaos tests
@@ -376,11 +615,20 @@ class Agent:
                         analyze=msg.get("analyze", False),
                         manage_router=False,
                         deadline_s=msg.get("deadline_s"),
+                        bridge_token=bridge_token,
                     )
             rows_out = sum(
                 b.num_rows for bs in result.tables.values() for b in bs
             )
             trace.finish(span, attrs={"rows_out": rows_out})
+            if self._killed.is_set():
+                return  # "died" while executing: withhold everything
+            if self.carnot.attempt_cancelled(query_id, bridge_token):
+                # r17: the broker cancelled THIS attempt (another won
+                # the slot). Its partially-aborted output must never
+                # masquerade as a completed fragment — withhold it; the
+                # winner's results complete the query.
+                return
             for name, batches in result.tables.items():
                 for b in batches:
                     self.bus.publish(
@@ -390,6 +638,7 @@ class Agent:
                             "agent_id": self.agent_id,
                             "table": name,
                             "batch": b,
+                            **echo,
                         },
                     )
             self.bus.publish(
@@ -399,13 +648,14 @@ class Agent:
                     "agent_id": self.agent_id,
                     "exec_stats": result.exec_stats,
                     "spans": self._trace_spans_for(trace_id),
+                    **echo,
                 },
             )
             if self.durable is not None:
                 # Every result frame (batches + fragment_done) is now in
                 # the transport window/WAL: replay alone completes the
                 # query, so a re-offered launch is dropped, not re-run.
-                self.durable.mark_done(query_id)
+                self.durable.mark_done(marker)
         except Exception as e:  # surfaced to the forwarder (ref: error chunks)
             trace.finish(span, status="error", attrs={"error": str(e)[:200]})
             self.bus.publish(
@@ -422,9 +672,10 @@ class Agent:
                         else "error"
                     ),
                     "spans": self._trace_spans_for(trace_id),
+                    **echo,
                 },
             )
             if self.durable is not None:
                 # The structured error is windowed: replay delivers it,
                 # so this query is complete for exactly-once purposes.
-                self.durable.mark_done(query_id)
+                self.durable.mark_done(marker)

@@ -21,7 +21,7 @@ from pixie_tpu.compiler import Compiler
 from pixie_tpu.distributed import AgentInfo, DistributedPlanner, DistributedState
 from pixie_tpu.engine import QueryResult
 from pixie_tpu.exec import BridgeRouter
-from pixie_tpu.plan.operators import BridgeSinkOp
+from pixie_tpu.plan.operators import BridgeSinkOp, MemorySourceOp
 from pixie_tpu.plan.plan import Plan
 from pixie_tpu.plan.program_key import fragment_program_key
 from pixie_tpu.types import Relation
@@ -71,6 +71,37 @@ _REOFFERS = _M.counter(
     "while a launch was still unacknowledged (reconnect-gap hole, r12), "
     "by reason: 'reconnect' (same process, new connection) vs 'restart' "
     "(new process with durable identity, r14).",
+)
+_RETRIES = _M.counter(
+    "broker_fragment_retries_total",
+    "Fragments re-launched onto a surviving agent after their executing "
+    "agent was lost mid-query (r17, flag fragment_failover), by reason: "
+    "agent_lost | agent_error | restart_lost | forward_dropped.",
+)
+_HEDGES = _M.counter(
+    "broker_hedged_fragments_total",
+    "Duplicate fragment attempts launched because the original was "
+    "still pending past the hedge delay (r17, flag hedged_requests).",
+)
+_HEDGE_BOTH = _M.counter(
+    "broker_hedge_both_complete_total",
+    "Hedge/retry attempts whose results arrived AFTER another attempt "
+    "already won the slot — dropped by the fragment-epoch dedup (the "
+    "wasted-work count; fault site hedge.both_complete forces the "
+    "race deterministically).",
+)
+_RECOVERED_Q = _M.counter(
+    "broker_recovered_queries_total",
+    "Queries that completed with FULL results only because fragment "
+    "failover retried or hedged at least one fragment (the degraded "
+    "annotation these queries would have carried pre-r17 is replaced "
+    "by a recovered annotation).",
+)
+_RECOVERY_SECONDS_H = _M.histogram(
+    "broker_fragment_recovery_seconds",
+    "Wall seconds from a fragment attempt's detected loss to the "
+    "replacement attempt completing its slot (r17: what failover adds "
+    "to a faulted query's latency).",
 )
 _RESTARTS = _M.counter(
     "broker_agent_restarts_total",
@@ -130,6 +161,13 @@ class AgentTracker:
                     self._agents[msg["agent_id"]] = {
                         "is_kelvin": msg["is_kelvin"],
                         "tables": frozenset(msg.get("tables", ())),
+                        # r17: tables this agent can serve WITHOUT
+                        # owning (replica rings / shared store) — never
+                        # planned over, but failover and the no-owner
+                        # planning fallback route here.
+                        "replica_tables": frozenset(
+                            msg.get("replica_tables", ())
+                        ),
                         "last_seen": time.monotonic(),
                         "epoch": epoch,
                         "health": msg.get("health"),
@@ -200,6 +238,27 @@ class AgentTracker:
                 if aid not in self._agents
                 or now - self._agents[aid]["last_seen"] >= AGENT_EXPIRY_S
             )
+
+    def failover_view(self) -> list[dict]:
+        """Alive agents with everything failover candidate selection
+        needs (r17): owned tables, replica tables, role, and the latest
+        heartbeat health (replica ring coverage/lag rides in
+        health['replicas'])."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                {
+                    "agent_id": aid,
+                    "tables": frozenset(a["tables"]),
+                    "replica_tables": frozenset(
+                        a.get("replica_tables") or ()
+                    ),
+                    "is_kelvin": a["is_kelvin"],
+                    "health": a.get("health"),
+                }
+                for aid, a in sorted(self._agents.items())
+                if now - a["last_seen"] < AGENT_EXPIRY_S
+            ]
 
     def health_view(self) -> dict[str, dict]:
         """Aggregated broker-side cluster health (r10): agent_id ->
@@ -502,6 +561,130 @@ class QueryBroker:
             return plan, []
         return replanned, sorted(sick)
 
+    # -- transparent fragment failover (r17) ---------------------------------
+    @staticmethod
+    def _plan_tables(frag_or_plan) -> frozenset:
+        """Table names a fragment (or sub-plan) scans — what a failover
+        replacement must be able to serve."""
+        frags = getattr(frag_or_plan, "fragments", None) or [frag_or_plan]
+        return frozenset(
+            f.node(nid).table_name
+            for f in frags
+            for nid in f.nodes()
+            if isinstance(f.node(nid), MemorySourceOp)
+        )
+
+    def _failover_candidate(
+        self,
+        needed: frozenset,
+        tried: set,
+        prefer_kelvin: bool,
+        exclude: "tuple | set" = (),
+    ) -> Optional[str]:
+        """The best surviving agent to re-run a lost fragment on: it
+        must cover every scanned table (owned or replica); among
+        eligible agents, prefer the matching role, then owners, then
+        the agent whose replica rings already hold the MOST windows of
+        the needed tables with the least lag (wire ~ 0 on landing),
+        then stable name order. When every capable agent has already
+        been tried (retry budget permitting), a still-alive
+        previously-tried agent is eligible again — transient faults
+        (a dropped forwarder frame, one injected error) don't condemn
+        an agent — except the one that just failed (``exclude``)."""
+        pick = self._best_failover_candidate(
+            needed, set(tried) | set(exclude), prefer_kelvin
+        )
+        if pick is None and tried:
+            pick = self._best_failover_candidate(
+                needed, set(exclude), prefer_kelvin
+            )
+        return pick
+
+    def _best_failover_candidate(
+        self, needed: frozenset, skip: set, prefer_kelvin: bool
+    ) -> Optional[str]:
+        best = None
+        for a in self.tracker.failover_view():
+            aid = a["agent_id"]
+            if aid in skip:
+                continue
+            owned = needed <= a["tables"]
+            if not (owned or needed <= (a["tables"] | a["replica_tables"])):
+                continue
+            reps = (a.get("health") or {}).get("replicas") or {}
+            hot = sum(
+                int((reps.get(t) or {}).get("windows", 0)) for t in needed
+            )
+            lag = sum(
+                int((reps.get(t) or {}).get("lag", 0)) for t in needed
+            )
+            rank = (
+                0 if a["is_kelvin"] == prefer_kelvin else 1,
+                0 if owned else 1,
+                -hot,
+                lag,
+                aid,
+            )
+            if best is None or rank < best[0]:
+                best = (rank, aid)
+        return best[1] if best else None
+
+    def _hedge_delay_s(self, sub_plan: Plan) -> Optional[float]:
+        """How long a fragment may stay pending before a hedge launches:
+        ``hedge_delay_ms`` when set, else the ``hedge_quantile`` of the
+        per-program-key fold-latency view from agent heartbeats (r11).
+        None = no data, no hedge (hedging on a guess just doubles
+        load)."""
+        ms = float(flags.hedge_delay_ms)
+        if ms > 0:
+            return ms / 1e3
+        view = self.tracker.fold_latency_view()
+        if not view:
+            return None
+        q = "p99_ms" if float(flags.hedge_quantile) >= 0.99 else "p50_ms"
+        vals = []
+        for frag in sub_plan.fragments:
+            for st in view.get(fragment_program_key(frag), {}).values():
+                v = st.get(q)
+                if v:
+                    vals.append(float(v))
+        return max(vals) / 1e3 if vals else None
+
+    def _plan_with_replica_fallback(self, planner, logical, state):
+        """Distributed planning, with a failover-mode fallback: when NO
+        alive agent owns the scanned tables (the owner died between
+        queries), plan over ONE replica agent that covers them — its
+        shared-store/replicated-ring data serves the scan, so the query
+        runs instead of failing with 'no agent holds tables'. Exactly
+        one replica is promoted (promoting several would double-count
+        the un-sharded data)."""
+        try:
+            return planner.plan(logical, state), None
+        except ValueError:
+            if not flags.fragment_failover:
+                raise
+            needed = self._plan_tables(logical.fragments[0])
+            pick = self._failover_candidate(needed, set(), False)
+            if pick is None:
+                raise
+            promoted = DistributedState(
+                agents=[
+                    AgentInfo(
+                        a.agent_id,
+                        frozenset(a.tables) | needed
+                        if a.agent_id == pick
+                        else a.tables,
+                        a.is_kelvin,
+                    )
+                    for a in state.agents
+                ]
+            )
+            _log.info(
+                "failover planning: no alive owner for %s; promoting "
+                "replica agent %s", sorted(needed), pick,
+            )
+            return planner.plan(logical, promoted), pick
+
     def _reoffer_launches(
         self, agent_id: str, epoch: int, restarted: bool = False
     ) -> None:
@@ -693,7 +876,11 @@ class QueryBroker:
         ) as plan_span:
             state, expired_agents = self.tracker.planning_view()
             planner = DistributedPlanner(self.registry, self.table_relations)
-            plan = planner.plan(logical, state)
+            # r17: with failover on, a dead owner's tables can be served
+            # by a promoted replica agent instead of failing the plan.
+            plan, promoted_replica = self._plan_with_replica_fallback(
+                planner, logical, state
+            )
             # Health plane: route around agents whose device breaker is
             # open for this query's program shape.
             breaker_skipped: list[str] = []
@@ -708,6 +895,16 @@ class QueryBroker:
                     for f in plan.fragments
                 }),
             )
+        if promoted_replica:
+            # r17: a promoted replica COVERS the data the dead owner(s)
+            # held — the plan scans every table the query needs, from an
+            # agent advertising full replica coverage, so the expired
+            # owners' data is NOT missing from this result. Suppress
+            # their skip entries: the query is complete and must carry a
+            # recovered annotation, not a degraded one. (Tables an
+            # expired agent owned that this query never scans are
+            # irrelevant to this result's completeness.)
+            expired_agents = []
         skipped = [
             {"agent_id": aid, "reason": "heartbeat_expired"}
             for aid in expired_agents
@@ -718,6 +915,12 @@ class QueryBroker:
         skipped_agents = sorted(expired_agents + breaker_skipped)
         for entry in skipped:
             emit({"type": "agent_skipped", **entry})
+        if promoted_replica:
+            # r17: no alive owner held the scanned tables — a replica
+            # agent was promoted at planning time.
+            emit({
+                "type": "replica_promoted", "agent_id": promoted_replica,
+            })
         compile_ns = time.perf_counter_ns() - t0
 
         # The broker's deadline is also the propagated per-query deadline:
@@ -749,6 +952,13 @@ class QueryBroker:
             sub = by_instance.setdefault(inst, Plan(qid))
             sub.fragments.append(frag)
             sub.executing_instance[frag.fragment_id] = inst
+        # r17 failover bookkeeping: each original instance is a SLOT
+        # (stable across retries) whose live attempts carry result
+        # epochs; exactly one attempt's output is ever applied.
+        failover = flags.fragment_failover
+        hedging = failover and flags.hedged_requests
+        kelvin_ids = {a.agent_id for a in state.agents if a.is_kelvin}
+        slots: dict[str, dict] = {}
         t1 = time.perf_counter_ns()
         for inst, sub_plan in by_instance.items():
             msg = {
@@ -764,6 +974,28 @@ class QueryBroker:
                 # execution threads (and their workers) with the tenant.
                 "tenant": tenant or "default",
             }
+            if failover:
+                msg["slot"] = inst
+                msg["result_epoch"] = 1
+                slots[inst] = {
+                    "plan": sub_plan,
+                    "analyze": analyze,
+                    "bridges": list(bridges_by_instance.get(inst, ())),
+                    "needed_tables": self._plan_tables(sub_plan),
+                    "is_kelvin": inst in kelvin_ids,
+                    "live": {inst: 1},
+                    "epoch": 1,
+                    "done": False,
+                    "tried": {inst},
+                    "bufs": {(inst, 1): []},
+                    "retried": [],
+                    "retries": 0,
+                    "hedge": None,
+                    "hedge_at": None,
+                    "lost_at": None,
+                }
+                for bid in slots[inst]["bridges"]:
+                    self.router.authorize_producer(qid, bid, inst, 1)
             # Track BEFORE publishing (r12): if the agent re-registers
             # between our publish and its subscribe, the register
             # listener re-offers this launch instead of losing it to
@@ -771,6 +1003,11 @@ class QueryBroker:
             with self._launch_lock:
                 self._inflight_launches.setdefault(inst, {})[qid] = msg
             self.bus.publish(agent_topic(inst), msg)
+        if hedging:
+            now = time.monotonic()
+            for st in slots.values():
+                d = self._hedge_delay_s(st["plan"])
+                st["hedge_at"] = now + d if d is not None else None
 
         # Forward results (query_result_forwarder.go:502,571).
         partial_ok = flags.partial_results
@@ -790,11 +1027,158 @@ class QueryBroker:
         # batches on the caller's thread) is per-query work too.
         fwd_attr = trace.attribution(qid, tenant or "default", "forward")
         fwd_attr.__enter__()
+
+        # -- r17 failover machinery (no-ops when the flag is off) ------------
+        def _revoke_attempt(st, slot_id, aid, ep):
+            st["bufs"].pop((aid, ep), None)
+            for bid in st["bridges"]:
+                self.router.revoke_producer(qid, bid, slot_id, ep)
+
+        def _launch_attempt(slot_id, st, aid, remaining):
+            st["epoch"] += 1
+            ep = st["epoch"]
+            st["live"][aid] = ep
+            st["tried"].add(aid)
+            st["bufs"][(aid, ep)] = []
+            for bid in st["bridges"]:
+                self.router.authorize_producer(qid, bid, slot_id, ep)
+            msg2 = {
+                "type": "execute_fragment",
+                "query_id": qid,
+                "plan": st["plan"],
+                "analyze": st["analyze"],
+                "deadline_s": max(remaining, 0.1),
+                "trace": {"trace_id": qid, "span_id": root_span_id},
+                "tenant": tenant or "default",
+                "slot": slot_id,
+                "result_epoch": ep,
+            }
+            with self._launch_lock:
+                self._inflight_launches.setdefault(aid, {})[qid] = msg2
+            self.bus.publish(agent_topic(aid), msg2)
+            return ep
+
+        def _try_failover(slot_id, st, failed_agent, reason) -> bool:
+            remaining = deadline - time.monotonic()
+            if (
+                st["retries"] >= max(int(flags.fragment_max_retries), 0)
+                or remaining <= 0.05
+            ):
+                return False
+            cand = self._failover_candidate(
+                st["needed_tables"], st["tried"], st["is_kelvin"],
+                exclude={failed_agent},
+            )
+            if cand is None:
+                return False
+            st["retries"] += 1
+            ep = _launch_attempt(slot_id, st, cand, remaining)
+            _RETRIES.inc(reason=reason)
+            entry = {
+                "slot": slot_id,
+                "from": failed_agent,
+                "to": cand,
+                "reason": reason,
+                "epoch": ep,
+            }
+            st["retried"].append(entry)
+            emit({"type": "fragment_retry", **entry})
+            if trace.ACTIVE:
+                trace.record(
+                    "broker.fragment_retry", 0, trace_id=qid,
+                    parent_id=root_span_id, instance="broker",
+                    attrs=entry,
+                )
+            _log.info(
+                "query %s: fragment slot %s lost on %s (%s); retrying "
+                "on %s at epoch %d",
+                qid, slot_id, failed_agent, reason, cand, ep,
+            )
+            return True
+
+        def _attempt_lost(slot_id, st, aid, ep, reason, error, kind="error"):
+            """One live attempt died: revoke its bridge authorization
+            and discard its buffered output (exactly-once: a dead
+            attempt contributes NOTHING). A live hedge sibling keeps
+            the slot; else retry; else give the slot up exactly the way
+            r9 would have degraded it. Returns True while the slot is
+            still going to complete (sibling or retry)."""
+            st["live"].pop(aid, None)
+            _revoke_attempt(st, slot_id, aid, ep)
+            if st["lost_at"] is None:
+                st["lost_at"] = time.monotonic()
+            if st["live"]:
+                return True  # a hedge sibling still owns the slot
+            if _try_failover(slot_id, st, aid, reason):
+                return True
+            pending.discard(slot_id)
+            agent_errors.setdefault(aid, error)
+            if reason == "agent_lost":
+                lost_agents.append(aid)
+                emit({"type": "agent_lost", "agent_id": aid,
+                      "error": error})
+            else:
+                if kind == "deadline":
+                    timed_out_agents.append(aid)
+                emit({
+                    "type": "agent_error", "agent_id": aid,
+                    "error": error, "error_kind": kind,
+                })
+            for bid in st["bridges"]:
+                self.router.unregister_producer(qid, bid)
+            return False
+
+        def _maybe_hedge():
+            now = time.monotonic()
+            for s2 in list(pending):
+                st = slots[s2]
+                if (
+                    st["hedge_at"] is None
+                    or now < st["hedge_at"]
+                    or len(st["live"]) != 1
+                    or st["hedge"] is not None
+                ):
+                    continue
+                (orig_aid,) = st["live"]
+                cand = self._failover_candidate(
+                    st["needed_tables"], st["tried"], st["is_kelvin"],
+                    exclude=set(st["live"]),
+                )
+                if cand is None:
+                    st["hedge_at"] = None  # nobody to hedge onto
+                    continue
+                _launch_attempt(s2, st, cand, deadline - now)
+                _HEDGES.inc()
+                st["hedge"] = {
+                    "slot": s2, "original": orig_aid,
+                    "duplicate": cand, "winner": None,
+                }
+                emit({
+                    "type": "fragment_hedged", "slot": s2,
+                    "original": orig_aid, "duplicate": cand,
+                })
+                if trace.ACTIVE:
+                    trace.record(
+                        "broker.fragment_hedged", 0, trace_id=qid,
+                        parent_id=root_span_id, instance="broker",
+                        attrs={"slot": s2, "duplicate": cand},
+                    )
+
         try:
             while pending:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    timed_out_agents = sorted(pending)
+                    if failover:
+                        timed_out_agents = sorted(
+                            {
+                                aid
+                                for s in pending
+                                for aid in slots[s]["live"]
+                            }
+                            | {s for s in pending if not slots[s]["live"]}
+                        )
+                    else:
+                        timed_out_agents = sorted(pending)
                     if not partial_ok:
                         raise TimeoutError(
                             f"query {qid}: {len(pending)} agents still "
@@ -809,9 +1193,29 @@ class QueryBroker:
                 msg = results_sub.get(timeout=min(remaining, 0.1))
                 if msg is None:
                     # Reap agents that stopped heartbeating mid-query:
-                    # release their bridges so merge fragments finalize
-                    # with partial input instead of stalling.
-                    if partial_ok:
+                    # with failover, their attempts retry onto survivors;
+                    # otherwise release their bridges so merge fragments
+                    # finalize with partial input instead of stalling.
+                    if failover:
+                        live_agents = {
+                            aid
+                            for s in pending
+                            for aid in slots[s]["live"]
+                        }
+                        for aid in self.tracker.expired_among(live_agents):
+                            for s in list(pending):
+                                st = slots[s]
+                                if st["done"] or aid not in st["live"]:
+                                    continue
+                                _attempt_lost(
+                                    s, st, aid, st["live"][aid],
+                                    "agent_lost",
+                                    "agent lost: heartbeat expired "
+                                    "mid-query",
+                                )
+                        if hedging:
+                            _maybe_hedge()
+                    elif partial_ok:
                         for inst in self.tracker.expired_among(pending):
                             pending.discard(inst)
                             lost_agents.append(inst)
@@ -828,6 +1232,97 @@ class QueryBroker:
                             )
                             for bid in bridges_by_instance.get(inst, ()):
                                 self.router.unregister_producer(qid, bid)
+                    continue
+                if failover and msg["type"] in (
+                    "result_batch", "fragment_done", "fragment_error"
+                ):
+                    s = msg.get("slot")
+                    st = slots.get(s)
+                    aid = msg.get("agent_id")
+                    ep = msg.get("result_epoch")
+                    if (
+                        st is None
+                        or st["done"]
+                        or st["live"].get(aid) != ep
+                    ):
+                        # Stale attempt (zombie the reaper declared dead,
+                        # hedge loser, superseded epoch): exactly-once is
+                        # THIS drop.
+                        if msg["type"] == "fragment_done":
+                            _HEDGE_BOTH.inc()
+                        continue
+                    if msg["type"] == "result_batch":
+                        if faults.ACTIVE and faults.fires("broker.forward"):
+                            # The attempt's stream is now incomplete —
+                            # fail the ATTEMPT over instead of silently
+                            # applying a truncated buffer. Only an
+                            # UNRECOVERED drop degrades the result.
+                            _FORWARD_DROPPED.inc()
+                            if not _attempt_lost(
+                                s, st, aid, ep, "forward_dropped",
+                                "result batch dropped in the broker "
+                                "forwarder",
+                            ):
+                                forward_dropped += 1
+                            continue
+                        st["bufs"][(aid, ep)].append(
+                            (msg["table"], msg["batch"])
+                        )
+                    elif msg["type"] == "fragment_done":
+                        # First completed attempt wins the slot: apply
+                        # its buffered output atomically, cancel any
+                        # sibling through the r9 abort path.
+                        st["done"] = True
+                        pending.discard(s)
+                        self._launch_done(aid, qid)
+                        for table, batch in st["bufs"].pop((aid, ep), ()):
+                            if on_batch is not None:
+                                on_batch(table, batch)
+                            else:
+                                tables.setdefault(table, []).append(batch)
+                        for k, v in msg.get("exec_stats", {}).items():
+                            exec_stats[f"{aid}/{k}"] = v
+                        for sp in msg.get("spans") or ():
+                            agent_spans[sp["span_id"]] = sp
+                        if st["lost_at"] is not None:
+                            _RECOVERY_SECONDS_H.observe(
+                                time.monotonic() - st["lost_at"]
+                            )
+                        if st["hedge"] is not None:
+                            st["hedge"]["winner"] = aid
+                        siblings = {
+                            a: e for a, e in st["live"].items() if a != aid
+                        }
+                        st["live"] = {aid: ep}
+                        if siblings and not (
+                            faults.ACTIVE
+                            and faults.fires("hedge.both_complete")
+                        ):
+                            for sib, sib_ep in siblings.items():
+                                _revoke_attempt(st, s, sib, sib_ep)
+                                self.bus.publish(
+                                    agent_topic(sib),
+                                    {
+                                        "type": "cancel_query",
+                                        "query_id": qid,
+                                        "slot": s,
+                                        "result_epoch": sib_ep,
+                                    },
+                                )
+                    else:  # fragment_error
+                        self._launch_done(aid, qid)
+                        for sp in msg.get("spans") or ():
+                            agent_spans[sp["span_id"]] = sp
+                        kind = msg.get("error_kind", "error")
+                        reason = (
+                            kind
+                            if kind in ("restart_lost", "deadline")
+                            else "agent_error"
+                        )
+                        _attempt_lost(
+                            s, st, aid, ep, reason, msg["error"],
+                            kind=kind,
+                        )
                     continue
                 if msg["type"] == "result_batch":
                     if faults.ACTIVE and faults.fires("broker.forward"):
@@ -903,6 +1398,20 @@ class QueryBroker:
                 f"query {qid} failed on agents:\n"
                 + "\n".join(f"{a}: {e}" for a, e in sorted(agent_errors.items()))
             )
+        # r17: what failover did for this query. A fully-recovered query
+        # carries a ``recovered`` annotation INSTEAD of the degraded one
+        # (the rows are complete and bit-identical to an unfaulted run);
+        # a query that still degraded carries the attempt history inside
+        # the degraded annotation for diagnosis.
+        retried_all = [
+            e for st in slots.values() for e in st["retried"]
+        ]
+        hedged_all = [
+            dict(st["hedge"])
+            for st in slots.values()
+            if st["hedge"] is not None
+        ]
+        recovered = None
         degraded = None
         if partial_ok and (
             agent_errors
@@ -941,21 +1450,40 @@ class QueryBroker:
                 # events (r11 satellite; trace_id == query_id).
                 "trace_id": qid,
             }
+            if retried_all or hedged_all:
+                degraded["failover"] = {
+                    "retried": retried_all, "hedged": hedged_all,
+                }
             _DEGRADED.inc()
+        elif retried_all or hedged_all or promoted_replica:
+            recovered = {
+                "retried": retried_all,
+                "hedged": hedged_all,
+                "trace_id": qid,
+            }
+            if promoted_replica:
+                recovered["promoted_replica"] = promoted_replica
+            _RECOVERED_Q.inc()
         exec_ns = time.perf_counter_ns() - t1
         _QUERY_SECONDS.observe(
             (compile_ns + exec_ns) / 1e9, tenant=tenant or "default"
         )
         trace_spans = None
         if root is not None:
+            root_attrs2 = None
+            if degraded:
+                root_attrs2 = {
+                    "degraded_reasons": ",".join(degraded["reasons"])
+                }
+            elif recovered:
+                root_attrs2 = {
+                    "recovered_fragments": len(retried_all)
+                    + len(hedged_all)
+                }
             trace.finish(
                 root,
                 status="degraded" if degraded else "ok",
-                attrs=(
-                    {"degraded_reasons": ",".join(degraded["reasons"])}
-                    if degraded
-                    else None
-                ),
+                attrs=root_attrs2,
             )
             # Merge broker-side spans with agent-shipped ones by span_id
             # (one trace_id across the cluster; agents that died mid-query
@@ -977,6 +1505,7 @@ class QueryBroker:
             compile_time_ns=compile_ns,
             exec_time_ns=exec_ns,
             degraded=degraded,
+            recovered=recovered,
             trace_spans=trace_spans,
         )
 

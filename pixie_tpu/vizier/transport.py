@@ -922,8 +922,10 @@ class BusTransportServer:
                 frame["query_id"], frame["bridge_id"]
             )
         elif kind == "bridge_push":
+            token = frame.get("token")
             self.router.push(
-                frame["query_id"], frame["bridge_id"], frame["item"]
+                frame["query_id"], frame["bridge_id"], frame["item"],
+                token=tuple(token) if token is not None else None,
             )
 
     def stop(self) -> None:
@@ -1571,19 +1573,24 @@ class RemoteRouter(BridgeRouter):
             }
         )
 
-    def push(self, query_id: str, bridge_id: str, item: Any) -> None:
+    def push(
+        self, query_id: str, bridge_id: str, item: Any, token=None
+    ) -> None:
         # Data plane: may block under flow control without starving the
-        # control connection's heartbeats.
-        self._bus._send_data(
-            {
-                "kind": "bridge_push",
-                "query_id": query_id,
-                "bridge_id": bridge_id,
-                "item": item,
-            }
-        )
+        # control connection's heartbeats. The r17 attempt token rides
+        # the frame so the broker-process router applies the same
+        # per-attempt hold/commit gating for remote producers.
+        frame = {
+            "kind": "bridge_push",
+            "query_id": query_id,
+            "bridge_id": bridge_id,
+            "item": item,
+        }
+        if token is not None:
+            frame["token"] = tuple(token)
+        self._bus._send_data(frame)
 
-    def poll(self, query_id: str, bridge_id: str):
+    def poll(self, query_id: str, bridge_id: str, consumer=None):
         raise NotImplementedError(
             "remote agents only produce into bridges; merge fragments run "
             "in the broker process (splitter invariant)"

@@ -1,0 +1,520 @@
+"""Transparent fragment failover chaos suite (r17).
+
+The contract under test: with ``fragment_failover`` on, an agent dying
+mid-query no longer degrades the answer — the broker re-plans the lost
+fragment onto a surviving agent that holds the data (shared table store
+and/or replicated resident rings) and the query completes with FULL,
+bit-identical results carrying a ``recovered`` annotation instead of a
+``degraded`` one. Retries and hedges are exactly-once: per-fragment
+result epochs gate the broker's apply, and the bridge router holds each
+attempt's pushes until its eos commits them atomically, so merges can
+never double-count a dead attempt's partial rows. All scenarios are
+driven by seeded fault sites — nothing here flakes on scheduling.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec import BridgeRouter
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.table.table_store import TableStore
+from pixie_tpu.types import DataType, Relation
+from pixie_tpu.utils import faults, flags, metrics_registry
+from pixie_tpu.vizier import Agent, MessageBus, QueryBroker
+from pixie_tpu.vizier import agent as agent_mod
+from pixie_tpu.vizier import broker as broker_mod
+
+F, I, S, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+REL = Relation.of(("time_", T), ("service", S), ("latency", F))
+TABLES = {"http_events": REL}
+
+AGG_QUERY = (
+    "df = px.DataFrame(table='http_events')\n"
+    "stats = df.groupby(['service']).agg(\n"
+    "    total=('latency', px.sum), n=('latency', px.count))\n"
+    "px.display(stats, 'out')\n"
+)
+
+N_ROWS = 2000
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture
+def flagset():
+    saved = {}
+
+    def set_(name, value):
+        if name not in saved:
+            saved[name] = flags.get(name)
+        flags.set(name, value)
+
+    yield set_
+    for name, value in saved.items():
+        flags.set(name, value)
+
+
+def _make_store(n=N_ROWS):
+    rng = np.random.default_rng(7)
+    ts = TableStore()
+    t = ts.create_table("http_events", REL)
+    t.write_pydict(
+        {
+            "time_": np.arange(n),
+            "service": rng.choice(["a", "b", "c"], n).astype(object),
+            # Integer-valued latencies: float sums are exact regardless
+            # of reduction order, so retried rows compare bit-equal.
+            "latency": rng.integers(1, 100, n).astype(np.float64),
+        }
+    )
+    t.stop()
+    return ts
+
+
+def _sorted_rows(res, name="out"):
+    batches = [b for b in res.tables.get(name, []) if b.num_rows]
+    if not batches:
+        return []
+    d = RowBatch.concat(batches).to_pydict()
+    cols = sorted(d)
+    return sorted(zip(*[d[c] for c in cols]))
+
+
+def _wait_agents(broker, count, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(broker.tracker.distributed_state().agents) >= count:
+            return
+        time.sleep(0.02)
+    pytest.fail(f"{count} agents never registered")
+
+
+@pytest.fixture
+def cluster(monkeypatch, flagset):
+    """pem1 OWNS http_events; pem2 is a replica agent over the SAME
+    store (advertises no tables — only failover routes to it); kelvin
+    merges. This is the r17 serving topology: the table store is the
+    durable truth, agents are interchangeable compute."""
+    monkeypatch.setattr(agent_mod, "HEARTBEAT_INTERVAL_S", 0.05)
+    flagset("fragment_failover", True)
+    store = _make_store()
+    bus = MessageBus()
+    router = BridgeRouter()
+    broker = QueryBroker(bus, router, table_relations=TABLES)
+    agents = [
+        Agent("pem1", bus, router, table_store=store),
+        Agent("pem2", bus, router, table_store=store, owned_tables=[]),
+        Agent("kelvin", bus, router, is_kelvin=True),
+    ]
+    for a in agents:
+        a.start()
+    _wait_agents(broker, 3)
+    yield broker, agents
+    broker.stop()
+    for a in agents:
+        a.stop()
+
+
+def _baseline(broker):
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None and res.recovered is None
+    return _sorted_rows(res)
+
+
+# -- broker-level failover ---------------------------------------------------
+
+
+def test_execute_error_retries_bit_identical(cluster):
+    """pem1's fragment errors once; the broker retries it on pem2 (same
+    store) and the query completes FULL — bit-identical rows, recovered
+    annotation, no degraded annotation."""
+    broker, _ = cluster
+    baseline = _baseline(broker)
+    retries0 = metrics_registry().counter(
+        "broker_fragment_retries_total"
+    ).total()
+    faults.arm("agent.execute@pem1", count=1)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None, res.degraded
+    assert res.recovered is not None
+    (entry,) = res.recovered["retried"]
+    assert entry["from"] == "pem1" and entry["to"] == "pem2"
+    assert entry["reason"] == "agent_error" and entry["epoch"] == 2
+    assert _sorted_rows(res) == baseline
+    assert metrics_registry().counter(
+        "broker_fragment_retries_total"
+    ).total() > retries0
+
+
+def test_kill_holding_fragment_fails_over(cluster, monkeypatch):
+    """Simulated process death WHILE holding a fragment (heartbeats
+    stop, results withheld): the reaper detects the silence mid-query
+    and fails the fragment over — full results, not partial."""
+    broker, _ = cluster
+    monkeypatch.setattr(broker_mod, "AGENT_EXPIRY_S", 0.4)
+    baseline = _baseline(broker)
+    faults.arm("agent.kill_holding_fragment@pem1", count=1)
+    t0 = time.monotonic()
+    res = broker.execute_script(AGG_QUERY, timeout_s=20)
+    assert time.monotonic() - t0 < 10
+    assert res.degraded is None, res.degraded
+    assert res.recovered is not None
+    (entry,) = res.recovered["retried"]
+    assert entry["reason"] == "agent_lost"
+    assert entry["from"] == "pem1" and entry["to"] == "pem2"
+    assert _sorted_rows(res) == baseline
+
+
+def test_dead_owner_promotes_replica_for_new_queries(cluster, monkeypatch):
+    """After the owner dies ENTIRELY, fresh queries still run: planning
+    falls back to promoting the replica agent that covers the tables
+    (no 'no agent holds tables' error) and annotates the promotion."""
+    broker, agents = cluster
+    monkeypatch.setattr(broker_mod, "AGENT_EXPIRY_S", 0.3)
+    baseline = _baseline(broker)
+    agents[0].stop()  # pem1 gone for good
+    time.sleep(0.5)  # expire from the planning window
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None, res.degraded
+    assert res.recovered is not None
+    assert res.recovered.get("promoted_replica") == "pem2"
+    assert _sorted_rows(res) == baseline
+
+
+def test_zombie_attempt_output_is_deduped(cluster):
+    """The previously-ambiguous race: the broker declares an attempt
+    dead (its first result frame was dropped in the forwarder) and
+    retries — but the 'dead' attempt was alive all along and completes
+    too. The fragment-epoch filter applies exactly ONE attempt's
+    output: rows stay bit-identical, the stale completion lands on the
+    wasted-work counter."""
+    broker, _ = cluster
+    baseline = _baseline(broker)
+    both0 = metrics_registry().counter(
+        "broker_hedge_both_complete_total"
+    ).total()
+    # Drop pem1's FIRST result batch: failover treats the attempt as
+    # poisoned and retries on pem2, while pem1 keeps publishing its
+    # remaining frames (incl. fragment_done) at the superseded epoch.
+    faults.arm("broker.forward", count=1)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None, res.degraded
+    assert res.recovered is not None
+    (entry,) = res.recovered["retried"]
+    assert entry["reason"] == "forward_dropped"
+    assert _sorted_rows(res) == baseline
+    assert metrics_registry().counter(
+        "broker_hedge_both_complete_total"
+    ).total() > both0
+
+
+def test_transient_double_fault_retries_same_agent(cluster, flagset):
+    """Both agents fail ONCE (transient): with budget left, failover
+    re-tries a previously-tried (still alive) agent rather than
+    condemning the query — third attempt completes bit-identical."""
+    broker, _ = cluster
+    baseline = _baseline(broker)
+    flagset("fragment_max_retries", 3)
+    faults.arm("agent.execute@pem1", count=1)
+    faults.arm("agent.execute@pem2", count=1)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None, res.degraded
+    assert len(res.recovered["retried"]) == 2
+    assert _sorted_rows(res) == baseline
+
+
+def test_retries_exhausted_degrades_like_r9(cluster, flagset):
+    """When every capable agent PERSISTENTLY fails, failover exhausts
+    its budget and gives up exactly the way r9 degraded: partial rows
+    + structured annotation (with the attempt history attached), never
+    a hang or a wrong answer."""
+    broker, _ = cluster
+    flagset("fragment_max_retries", 2)
+    faults.arm("agent.execute@pem1")  # unlimited: never transient
+    faults.arm("agent.execute@pem2")
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is not None
+    assert "agent_error" in res.degraded["reasons"]
+    assert res.degraded["failover"]["retried"], "attempt history rides"
+    assert res.recovered is None
+
+
+def test_failover_off_keeps_r9_behavior(cluster, flagset):
+    """Flag off: the r9 partial-results contract, byte for byte."""
+    broker, _ = cluster
+    flagset("fragment_failover", False)
+    faults.arm("agent.execute@pem1", count=1)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is not None
+    assert "pem1" in res.degraded["agent_errors"]
+    assert res.recovered is None
+
+
+def test_hedged_dispatch_beats_straggler(cluster, flagset):
+    """A wedged-but-heartbeating straggler holds the original attempt
+    forever; with hedging on, a duplicate launches after the hedge
+    delay and wins — the query completes fast and FULL where the
+    unhedged run rode the deadline into a degraded partial. This is
+    the p99-under-straggler acceptance: hedged latency must beat the
+    unhedged run's."""
+    broker, _ = cluster
+    baseline = _baseline(broker)
+    # Unhedged: the straggler defines the tail (deadline, degraded).
+    faults.arm("agent.execute_hang@pem1", count=1)
+    t0 = time.monotonic()
+    res_slow = broker.execute_script(AGG_QUERY, timeout_s=4)
+    unhedged_s = time.monotonic() - t0
+    assert res_slow.degraded is not None
+    faults.reset()
+    # Hedged: same fault, duplicate launches after 100ms and wins.
+    flagset("hedged_requests", True)
+    flagset("hedge_delay_ms", 100.0)
+    hedges0 = metrics_registry().counter(
+        "broker_hedged_fragments_total"
+    ).total()
+    faults.arm("agent.execute_hang@pem1", count=1)
+    t0 = time.monotonic()
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    hedged_s = time.monotonic() - t0
+    assert res.degraded is None, res.degraded
+    assert res.recovered is not None
+    # The wedged scan slot hedged onto the replica and the duplicate
+    # won. (The merge slot, idle while its input stalls, may hedge
+    # too — harmless: first completion wins either way.)
+    h = next(
+        e for e in res.recovered["hedged"] if e["original"] == "pem1"
+    )
+    assert h["duplicate"] == "pem2" and h["winner"] == "pem2"
+    assert _sorted_rows(res) == baseline
+    assert hedged_s < unhedged_s, (hedged_s, unhedged_s)
+    assert metrics_registry().counter(
+        "broker_hedged_fragments_total"
+    ).total() > hedges0
+
+
+def test_hedge_winner_cancels_loser(cluster, flagset):
+    """The losing attempt is cancelled through the r9 abort path: the
+    wedged agent's exec state is cancelled (advisory) and, critically,
+    anything it later produces is dropped by the epoch filter — the
+    result holds exactly one attempt's rows."""
+    broker, agents = cluster
+    baseline = _baseline(broker)
+    flagset("hedged_requests", True)
+    flagset("hedge_delay_ms", 50.0)
+    faults.arm("agent.execute_hang@pem1", count=1)
+    res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert res.degraded is None
+    assert _sorted_rows(res) == baseline
+    # The loser's engine saw the cancel (advisory; delivery async).
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if not agents[0].carnot._active_states:
+            break
+        time.sleep(0.05)
+
+
+# -- router-level exactly-once ------------------------------------------------
+
+
+class _Item:
+    def __init__(self, v, eos=False):
+        self.v = v
+        self.eos = eos
+
+
+def test_router_holds_until_commit_and_discards_dead_attempts():
+    """A dead attempt's partial pushes never reach the consumer; the
+    replacement's full stream commits atomically."""
+    r = BridgeRouter()
+    r.register_producer("q", "b")
+    r.authorize_producer("q", "b", "slot0", 1)
+    r.push("q", "b", _Item(1), token=("slot0", 1))
+    r.push("q", "b", _Item(2), token=("slot0", 1))
+    assert r.poll("q", "b") is None  # held, not visible
+    # Attempt 1 dies mid-stream: discard wholesale, replace with 2.
+    r.replace_producer("q", "b", "slot0", 1, 2)
+    r.push("q", "b", _Item(3), token=("slot0", 1))  # zombie push: dropped
+    r.push("q", "b", _Item(10), token=("slot0", 2))
+    r.push("q", "b", _Item(11, eos=True), token=("slot0", 2))
+    got = [r.poll("q", "b"), r.poll("q", "b")]
+    assert [g.v for g in got] == [10, 11]
+    assert r.poll("q", "b") is None
+    assert r.producer_count("q", "b") == 1  # replacement kept the count
+
+
+def test_router_first_commit_wins_slot():
+    """Two live attempts (a hedge): the first to commit wins; the
+    loser's full stream — even a complete one — drops at the router."""
+    r = BridgeRouter()
+    r.register_producer("q", "b")
+    r.authorize_producer("q", "b", "s", 1)
+    r.authorize_producer("q", "b", "s", 2)
+    r.push("q", "b", _Item(1), token=("s", 2))
+    r.push("q", "b", _Item(2, eos=True), token=("s", 2))  # 2 commits
+    r.push("q", "b", _Item(8), token=("s", 1))
+    r.push("q", "b", _Item(9, eos=True), token=("s", 1))  # loser: dropped
+    vals = []
+    while True:
+        it = r.poll("q", "b")
+        if it is None:
+            break
+        vals.append(it.v)
+    assert vals == [1, 2]
+
+
+def test_router_consumer_cursor_replays_for_replacement():
+    """A retried CONSUMER attempt re-reads the committed stream from
+    the start (the dead merge attempt's reads don't consume it)."""
+    r = BridgeRouter()
+    r.register_producer("q", "b")
+    r.authorize_producer("q", "b", "p", 1)
+    r.push("q", "b", _Item(1), token=("p", 1))
+    r.push("q", "b", _Item(2, eos=True), token=("p", 1))
+    # First consumer attempt reads one item, then dies.
+    assert r.poll("q", "b", consumer=("k", 1)).v == 1
+    # Replacement attempt replays from index 0.
+    assert r.poll("q", "b", consumer=("k", 2)).v == 1
+    assert r.poll("q", "b", consumer=("k", 2)).v == 2
+    assert r.poll("q", "b", consumer=("k", 2)) is None
+    r.cleanup_query("q")
+
+
+# -- ring replication ---------------------------------------------------------
+
+
+WINDOW_ROWS = 2048
+
+
+@pytest.fixture
+def replicated_cluster(monkeypatch, flagset):
+    """pem1 owns the table with resident ingest + replication on; pem2
+    (replica agent, own MeshExecutor) adopts the ring windows."""
+    import jax
+    from jax.sharding import Mesh
+
+    from pixie_tpu.parallel import MeshExecutor
+
+    monkeypatch.setattr(agent_mod, "HEARTBEAT_INTERVAL_S", 0.05)
+    flagset("fragment_failover", True)
+    flagset("resident_ingest", True)
+    flagset("resident_window_rows", WINDOW_ROWS)
+    flagset("ring_replication_factor", 2)
+    store = TableStore()
+    t = store.create_table("http_events", REL, size_limit=1 << 40)
+    mesh1 = Mesh(np.array(jax.devices()), ("d",))
+    ex1 = MeshExecutor(mesh=mesh1)
+    ex2 = MeshExecutor(mesh=Mesh(np.array(jax.devices()), ("d",)))
+    bus = MessageBus()
+    router = BridgeRouter()
+    broker = QueryBroker(bus, router, table_relations=TABLES)
+    agents = [
+        Agent("pem1", bus, router, table_store=store, device_executor=ex1),
+        Agent(
+            "pem2", bus, router, table_store=store, device_executor=ex2,
+            owned_tables=[],
+        ),
+        Agent("kelvin", bus, router, is_kelvin=True),
+    ]
+    ex1.enable_resident_ingest(t)
+    for a in agents:
+        a.start()
+    _wait_agents(broker, 3)
+    yield broker, agents, store, t, ex1, ex2
+    broker.stop()
+    for a in agents:
+        a.stop()
+    t.stop()
+
+
+def _fill(t, n):
+    rng = np.random.default_rng(11)
+    t.write_pydict(
+        {
+            "time_": np.arange(n),
+            "service": rng.choice(["a", "b", "c"], n).astype(object),
+            "latency": rng.integers(1, 100, n).astype(np.float64),
+        }
+    )
+
+
+def _wait_replica_windows(ex2, want, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snap = ex2.replica_snapshot().get("http_events") or {}
+        if snap.get("windows", 0) >= want:
+            return snap
+        time.sleep(0.05)
+    pytest.fail(
+        f"replica never reached {want} windows: {ex2.replica_snapshot()}"
+    )
+
+
+def test_replica_adopts_windows_and_serves_failover(
+    replicated_cluster, monkeypatch
+):
+    """Appends stage ring windows on the owner and replicate to the
+    follower's HBM (byte-accounted, heartbeat-advertised). When the
+    owner dies, the promoted replica serves the SAME query with its
+    replica windows (replica_window_hits_total > 0) — bit-identical."""
+    broker, agents, store, t, ex1, ex2 = replicated_cluster
+    _fill(t, 3 * WINDOW_ROWS)
+    snap = _wait_replica_windows(ex2, 3)
+    assert snap["lag"] == 0 and snap["bytes"] > 0
+    # Follower bytes are accounted in ITS residency pool.
+    assert ex2._staged_cache.snapshot()["resident_bytes"] > 0
+    baseline = _baseline(broker)
+    # The broker's failover view sees the replica advertisement.
+    view = {a["agent_id"]: a for a in broker.tracker.failover_view()}
+    assert "http_events" in view["pem2"]["replica_tables"]
+    assert (view["pem2"]["health"]["replicas"]["http_events"]["windows"]
+            >= 3)
+    # Owner dies; planning promotes the replica; replica windows serve.
+    monkeypatch.setattr(broker_mod, "AGENT_EXPIRY_S", 0.3)
+    hits = metrics_registry().counter("replica_window_hits_total")
+    hits0 = hits.total()
+    agents[0].stop()
+    time.sleep(0.5)
+    res = broker.execute_script(AGG_QUERY, timeout_s=60)
+    assert res.degraded is None, res.degraded
+    assert res.recovered is not None
+    assert res.recovered.get("promoted_replica") == "pem2"
+    assert _sorted_rows(res) == baseline
+    assert hits.total() > hits0, "failover should land on hot windows"
+
+
+def test_lagging_replica_falls_back_to_store_bit_identical(
+    replicated_cluster, monkeypatch
+):
+    """The replica_lag fault drops one replication frame: the replica
+    is behind the leader's watermark, and a failover query re-stages
+    the missing window from the table store — bit-identical anyway."""
+    broker, agents, store, t, ex1, ex2 = replicated_cluster
+    faults.arm("resident.replica_lag", count=1)
+    _fill(t, 3 * WINDOW_ROWS)
+    snap = _wait_replica_windows(ex2, 2)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and snap.get("lag", 0) < 1:
+        time.sleep(0.05)
+        snap = ex2.replica_snapshot().get("http_events") or {}
+    assert snap["lag"] >= 1, snap
+    faults.reset()
+    baseline = _baseline(broker)
+    monkeypatch.setattr(broker_mod, "AGENT_EXPIRY_S", 0.3)
+    agents[0].stop()
+    time.sleep(0.5)
+    res = broker.execute_script(AGG_QUERY, timeout_s=60)
+    assert res.degraded is None, res.degraded
+    assert _sorted_rows(res) == baseline

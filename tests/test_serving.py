@@ -219,7 +219,11 @@ def test_shared_scan_distinct_keys_do_not_share():
     assert coord.run(("b",), lambda: 2) == 2
 
 
-def test_shared_scan_leader_error_propagates_to_joiners():
+def test_shared_scan_leader_error_still_fails_followers_whose_solo_fails():
+    """r17 semantics: a leader error makes followers detach and re-run
+    solo. When the failure is systemic (every solo run hits it too —
+    a sick device), everyone still gets the error: detach never turns
+    a real failure into a hang or a silent wrong answer."""
     coord = SharedScanCoordinator()
     flags.set("shared_scan_window_ms", 100.0)
     errors = []
@@ -242,6 +246,74 @@ def test_shared_scan_leader_error_propagates_to_joiners():
         for t in ts:
             t.join(timeout=10)
         assert errors == ["boom"] * 3
+    finally:
+        flags.reset("shared_scan_window_ms")
+
+
+def test_shared_scan_leader_killed_mid_batch_followers_detach_solo():
+    """r17 chaos satellite: the leader dies mid-batch (its compute is
+    killed) — followers DETACH and complete solo, each producing
+    exactly what a serial run of its own query would have (here: its
+    own distinct value), and the detach counter proves the path."""
+    coord = SharedScanCoordinator()
+    flags.set("shared_scan_window_ms", 150.0)
+    detached = metrics_registry().counter(
+        "serving_shared_scan_follower_detach_total"
+    )
+    d0 = detached.total()
+    results = {}
+    errors = []
+    barrier = threading.Barrier(4)
+    started = threading.Event()
+
+    def leader():
+        barrier.wait()
+        try:
+            coord.run(
+                ("leader",),
+                lambda: (_ for _ in ()).throw(
+                    RuntimeError("leader killed mid-batch")
+                ),
+                batch_key=("b",),
+                terms=[("i", "c", 0, 1, 0.0)],
+                compute_batch=lambda terms: (_ for _ in ()).throw(
+                    RuntimeError("leader killed mid-batch")
+                ),
+            )
+        except RuntimeError as e:
+            errors.append(str(e))
+        started.set()
+
+    def follower(i):
+        barrier.wait()
+        time.sleep(0.02)  # join the leader's open window
+        try:
+            results[i] = coord.run(
+                (f"f{i}",),
+                lambda: ("solo", i),
+                batch_key=("b",),
+                terms=[("i", "c", 0, 10 + i, 0.0)],
+                compute_batch=lambda terms: (_ for _ in ()).throw(
+                    AssertionError("followers must not lead this batch")
+                ),
+            )
+        except BaseException as e:  # pragma: no cover - failure detail
+            errors.append(f"follower {i}: {e}")
+
+    ts = [threading.Thread(target=leader)] + [
+        threading.Thread(target=follower, args=(i,)) for i in range(3)
+    ]
+    try:
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        # The leader's own query fails loudly; every follower detached
+        # and completed solo with ITS OWN result — bit-identical to a
+        # serial run of that query.
+        assert errors == ["leader killed mid-batch"]
+        assert results == {i: ("solo", i) for i in range(3)}
+        assert detached.total() - d0 == 3
     finally:
         flags.reset("shared_scan_window_ms")
 
@@ -651,9 +723,12 @@ def test_agent_dedups_reoffered_launch(cluster):
     broker, agents, bus = cluster
     res = broker.execute_script(AGG_QUERY, timeout_s=20)
     assert res.degraded is None
-    # Replay the same query_id at pem1: dropped by the dedup set.
+    # Replay the same query_id at pem1: dropped by the dedup set
+    # (keyed (query_id, slot, epoch) since r17 — a failover RETRY at a
+    # higher epoch is a fresh attempt, a re-offer of the same one is
+    # not).
     qid = res.query_id
-    assert qid in agents[0]._seen_queries
+    assert (qid, "", 0) in agents[0]._seen_queries
     n_before = len(agents[0]._seen_queries)
     bus.publish(
         agent_topic("pem1"),
@@ -954,6 +1029,61 @@ def test_predicate_batched_sketch_lanes_bit_identical(mesh):
             _assert_tables_identical(serial, got)
         assert batched.value() > before
         assert not ex.fallback_errors, ex.fallback_errors
+    finally:
+        flags.reset("shared_scan_window_ms")
+        flags.reset("shared_scan_predicate_batching")
+        flags.reset("shared_scans")
+
+
+def test_batched_fold_rides_the_aot_worker(mesh):
+    """r17 satellite (ROADMAP r16 follow-on): the predicate-batched
+    fold compiles through _aot_compile_async like the warm fold — a
+    batched dispatch resolves a ``bfold|...|batch:B|terms:T``
+    executable from the AOT cache (never a silent in-line jit), and a
+    solo predicate-normalizable query speculatively kicks the B=2
+    bucket so the FIRST real batch finds its executable compiled or
+    compiling."""
+    ex = MeshExecutor(mesh=mesh, block_rows=1024)
+    c = Carnot(device_executor=ex)
+    _make_table(c)
+    flags.set("shared_scans", True)
+    flags.set("shared_scan_predicate_batching", True)
+    flags.set("shared_scan_window_ms", 200.0)
+    try:
+        # A solo predicate query kicks the speculative B=2 compile
+        # (the shared-scan ladder — and so the kick — sits on the warm
+        # path; the first run cold-stages the entry).
+        c.execute_query(PRED_QUERIES[0])
+        c.execute_query(PRED_QUERIES[0])
+        kicked = [s for s in ex._aot_futures if s.startswith("bfold|")]
+        assert kicked, "solo predicate query never kicked the AOT lane"
+        assert "|batch:2|" in kicked[0]
+        # A real batched dispatch resolves through the AOT cache.
+        results = [None] * len(PRED_QUERIES)
+        errors = []
+        barrier = threading.Barrier(len(PRED_QUERIES))
+
+        def run(i):
+            try:
+                barrier.wait()
+                results[i] = c.execute_query(PRED_QUERIES[i]).table("out")
+            except Exception as e:  # pragma: no cover - assertion aid
+                errors.append(e)
+
+        ts = [
+            threading.Thread(target=run, args=(i,))
+            for i in range(len(PRED_QUERIES))
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errors, errors
+        compiled = [s for s in ex._aot_compiled if s.startswith("bfold|")]
+        assert compiled, "batched dispatch never reached _aot_compiled"
+        assert not any(
+            k.startswith("batched-aot") for k in ex.stream_fallback_errors
+        ), ex.stream_fallback_errors
     finally:
         flags.reset("shared_scan_window_ms")
         flags.reset("shared_scan_predicate_batching")

@@ -380,6 +380,7 @@ _CTL_FLAGS = (
     "admission_controller_max_window_ms",
     "admission_controller_max_hbm_mb",
     "admission_controller_wait_target_ms",
+    "admission_controller_holddown_windows",
 )
 
 
@@ -470,6 +471,55 @@ def test_controller_hbm_pressure_halves_never_below_floor(_ctl_flags):
     ]
     assert downs and all(a["reason"] == "hbm_pressure" for a in downs)
     assert all(a["to"] >= 4 for a in downs)
+
+
+def test_controller_post_brake_holddown_damps_oscillation(_ctl_flags):
+    """r17 satellite: after an HBM-pressure halving, wait-over-target
+    windows must NOT re-raise concurrency until the hold-down expires
+    (the 8->128->floor->16 MIMD thrash from the 1k-client trail was
+    exactly this re-climb); further braking stays allowed, and each
+    held window lands on the trail with its reason."""
+    flags.set("admission_controller", True)
+    flags.set("admission_controller_min_concurrent", 2)
+    flags.set("admission_controller_max_concurrent", 128)
+    flags.set("admission_controller_wait_target_ms", 100.0)
+    flags.set("admission_controller_holddown_windows", 3)
+    budget = 64 << 20
+    pressured = {
+        "used_bytes": budget,
+        "pinned_bytes": int(0.95 * budget),
+        "budget_bytes": budget,
+    }
+    loop, depth, res = _make_loop(residency=pressured)
+    flags.set("admission_max_concurrent", 32)
+    _drive(wait_s=2.0)
+    loop.step()  # brake: 32 -> 16, hold-down armed
+    assert flags.admission_max_concurrent == 16
+    assert loop.status()["holddown_windows_left"] == 3
+    # Pressure clears but wait is still over target: the pre-r17 law
+    # would double straight back. The hold-down burns three windows.
+    res["v"] = {
+        "used_bytes": 0, "pinned_bytes": 0, "budget_bytes": budget,
+    }
+    depth["v"] = 6
+    for _ in range(3):
+        _drive(wait_s=2.0)
+        loop.step()
+        assert flags.admission_max_concurrent == 16
+    holds = [
+        a for a in loop.trail if a["reason"] == "holddown_after_brake"
+    ]
+    assert len(holds) == 3
+    # Hold-down expired: the raise law resumes.
+    _drive(wait_s=2.0)
+    loop.step()
+    assert flags.admission_max_concurrent == 32
+    # A NEW pressure window brakes immediately even inside a hold-down
+    # (braking is never suppressed).
+    res["v"] = pressured
+    _drive(wait_s=2.0)
+    loop.step()
+    assert flags.admission_max_concurrent == 16
 
 
 def test_controller_empty_window_is_stable(_ctl_flags):

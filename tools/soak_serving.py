@@ -98,13 +98,18 @@ def _tables_equal(a: dict, b: dict) -> bool:
 # (transport.* sites need a RemoteBus and are exercised by the
 # test_durability/test_faults chaos suites instead). Probabilities are
 # low: the soak's point is that a steady stream of injected failures
-# yields structured rejections and degraded annotations — never hangs,
-# never wrong rows on the queries that complete clean.
+# — including the OWNER AGENT DYING outright mid-query — yields, with
+# r17 fragment failover on, ZERO degraded results: every query
+# completes bit-identical to the unfaulted run, with
+# broker_fragment_retries_total proving failover (not luck) did it.
 CHAOS_SITES = {
     "serving.admission_reject": dict(p=0.03, seed=101),
     "agent.execute@pem1": dict(p=0.03, seed=102),
     "broker.forward": dict(p=0.01, seed=103),
-    "staging.pack": dict(p=0.01, seed=104),
+    # r17: kill pem1 WHILE it holds fragments (heartbeats stop, results
+    # withheld) partway into the concurrent phase — everything after
+    # this lands on the replica agent via retry/promotion.
+    "agent.kill_holding_fragment@pem1": dict(count=1, after=20, seed=106),
     # Checked when an eviction pass SKIPS a pinned entry: p=0 arming
     # makes it a pure census (fired stays 0, checks count pin holds).
     "serving.evict_pinned_attempt": dict(p=0.0, seed=105),
@@ -218,6 +223,11 @@ def run_soak(
                 ),
             }
         )
+    if chaos:
+        # r17: chaos runs with transparent failover ON — the acceptance
+        # bar is zero degraded results (bit-identical completion via
+        # retry onto the replica agent), not structured degradation.
+        soak_flags["fragment_failover"] = True
     for name, value in soak_flags.items():
         flags.set(name, value)
     try:
@@ -301,6 +311,19 @@ def _run_soak_inner(
         ),
         Agent("kelvin", bus, router, is_kelvin=True),
     ]
+    if chaos:
+        # r17 replica agent: same (shared) table store, its own device
+        # executor at the same mesh geometry (device folds stay
+        # bit-identical), advertised as replica-only — the planner
+        # never scans it, failover does.
+        ex2 = MeshExecutor(mesh=Mesh(np.array(jax.devices()), ("d",)))
+        agents.insert(
+            1,
+            Agent(
+                "pem2", bus, router, table_store=store,
+                device_executor=ex2, owned_tables=[],
+            ),
+        )
     for a in agents:
         a.start()
     time.sleep(0.3)
@@ -336,6 +359,12 @@ def _run_soak_inner(
     w0_counts = width_h.merged_counts()
     pb0, ws0 = pred_batched.value(), window_skips.value()
 
+    retries_c = reg.counter("broker_fragment_retries_total")
+    recovered_c = reg.counter("broker_recovered_queries_total")
+    wasted_c = reg.counter("broker_hedge_both_complete_total")
+    r0, rec0, w0 = (
+        retries_c.total(), recovered_c.total(), wasted_c.total()
+    )
     if chaos:
         # Armed AFTER the unfaulted baselines: every concurrent result
         # is still judged against clean truth.
@@ -593,11 +622,11 @@ def _run_soak_inner(
         # moved, from what, why, on which window signals.
         report["controller"] = controller_status
     if chaos:
-        # r14 satellite: with fault sites armed through the concurrent
-        # phase, 'recovered' queries completed clean (bit-identical rows)
-        # despite live injection; the rest degraded structurally (partial
-        # + annotation) or were rejected structurally — never a hang,
-        # never silently-wrong rows.
+        # r17: with fragment failover ON under live injection —
+        # including the owner agent dying outright — the bar is ZERO
+        # degraded results: every completed query is bit-identical to
+        # the unfaulted baseline, and the broker's retry counter proves
+        # failover (not luck) carried the faulted ones.
         report["contention"]["chaos"] = {
             "sites": {
                 site: {"checks": c, "fired": f}
@@ -607,6 +636,11 @@ def _run_soak_inner(
             "degraded": degraded[0],
             "rejected": rejected[0],
             "mismatched": mismatches[0],
+            "failover": {
+                "fragment_retries": int(retries_c.total() - r0),
+                "recovered_queries": int(recovered_c.total() - rec0),
+                "hedge_both_complete": int(wasted_c.total() - w0),
+            },
         }
     return report
 
@@ -651,11 +685,12 @@ def main() -> int:
     ap.add_argument(
         "--chaos", action="store_true",
         default=bool(int(os.environ.get("SOAK_CHAOS", "0"))),
-        help="Arm transport/serving/agent fault sites (CHAOS_SITES) "
-        "through the concurrent phase; the report's contention.chaos "
-        "block carries recovered vs degraded vs rejected counts. The "
-        "pass gate then requires structured failure handling (zero "
-        "mismatches on clean completions) instead of zero degradation.",
+        help="Arm serving/agent fault sites (CHAOS_SITES) — incl. "
+        "killing the owner agent mid-query — through the concurrent "
+        "phase, with r17 fragment failover ON and a replica agent in "
+        "the cluster. The pass gate requires ZERO degraded results "
+        "(every query bit-identical to the unfaulted baseline) and "
+        "broker_fragment_retries_total > 0 (failover, not luck).",
     )
     ap.add_argument(
         "--profile", action="store_true",
@@ -732,16 +767,26 @@ def main() -> int:
             json.dump(detail, f, indent=1)
             f.write("\n")
         log("BENCH_DETAIL.json updated (serving_soak)")
-    ok = (
-        report["bit_identical"]
-        and report["residency"]["within_budget"]
-        and (report["shared_scan"]["dispatch_reduction_x"] or 0) >= 2.0
-    )
+    ok = report["bit_identical"] and report["residency"]["within_budget"]
+    if not args.chaos:
+        # The dispatch-reduction bar is the NORMAL-mode gate; a chaos
+        # run kills the owner executor mid-phase, splitting dispatches
+        # across two devices — it gates on failover outcomes instead.
+        ok = ok and (
+            (report["shared_scan"]["dispatch_reduction_x"] or 0) >= 2.0
+        )
     if args.chaos:
-        # Under injection, degradation is EXPECTED; the bar is that
-        # every query resolved structurally and clean completions stayed
-        # bit-identical (checked above), with a healthy recovered count.
-        ok = ok and report["contention"]["chaos"]["recovered"] > 0
+        # r17 acceptance: with failover on, injected failures — incl.
+        # the owner agent dying mid-query — must yield ZERO degraded
+        # results (every query completes bit-identical), and the retry
+        # counter must prove failover actually carried faulted queries.
+        chaos_block = report["contention"]["chaos"]
+        ok = (
+            ok
+            and report["degraded"] == 0
+            and chaos_block["recovered"] > 0
+            and chaos_block["failover"]["fragment_retries"] > 0
+        )
     else:
         ok = ok and report["degraded"] == 0
     log(f"soak {'PASS' if ok else 'FAIL'}")
