@@ -98,6 +98,14 @@ BENCH_DETAIL.json. The single-engine configs run with serving OFF
 change behavior under concurrency, and the residency pool with no
 byte budget reproduces the old entry-count LRU exactly.
 
+Fleet placement (r18): config 7 (opt-in, BENCH_CONFIGS=...,7) runs
+the fleet workload twice — a 1-agent thrash baseline, then
+BENCH_FLEET_AGENTS (4) placement-routed agents — and records
+placement hit-rate, per-agent balance, and the aggregate device-
+capacity QPS scaling into BENCH_DETAIL.json's ``fleet`` block
+(capacity, not wall-clock: in-process chips share one host core, so
+scaling is measured per-chip like the rows/s/chip configs).
+
 Env knobs: BENCH_ROWS (configs 2/5; default 256M), BENCH_SMALL_ROWS
 (configs 1/3/4; default 64M), BENCH_HOST_ROWS (config 0; default 8M),
 BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS (comma list, default
@@ -105,7 +113,8 @@ BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS (comma list, default
 BENCH_BLOCK_ROWS, BENCH_CACHE_DIR, BENCH_NO_DATA_CACHE=1 to force
 regeneration, BENCH_CLEAR_JAX_CACHE=1 to clear the persistent compile
 cache, BENCH_SOAK_CLIENTS/BENCH_SOAK_REQUESTS/BENCH_SOAK_ROWS for
-config 6.
+config 6, BENCH_FLEET_AGENTS/BENCH_FLEET_CLIENTS/BENCH_FLEET_ROWS/
+BENCH_FLEET_TABLES/BENCH_FLEET_HBM_MB for config 7.
 """
 
 import copy
@@ -291,7 +300,7 @@ def main() -> None:
         for c in os.environ.get("BENCH_CONFIGS", "2,5,4,1,0,3").split(",")
         if c.strip()
     ]
-    unknown = set(order) - {"0", "1", "2", "3", "4", "5", "6"}
+    unknown = set(order) - {"0", "1", "2", "3", "4", "5", "6", "7"}
     if unknown:
         raise SystemExit(f"BENCH_CONFIGS has unknown entries: {unknown}")
     configs = set(order)
@@ -948,6 +957,60 @@ def main() -> None:
             }
         )
 
+    # ---- config 7: residency-aware fleet placement soak (r18) -------------
+    def run_config_7():
+        # 1-agent thrash baseline vs an N-agent placement-routed fleet
+        # over the same hot-table workload (opt-in, BENCH_CONFIGS=...,7).
+        # Records placement hit-rate, per-agent balance, and QPS-vs-
+        # agent-count into BENCH_DETAIL.json's ``fleet`` block. Scaling
+        # is aggregate per-agent device capacity (serialized device
+        # clock in the soak harness) because the simulated chips share
+        # one host core — same convention as the rows/s/chip configs.
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import soak_serving
+
+        agents = int(os.environ.get("BENCH_FLEET_AGENTS", 4))
+        kw = dict(
+            clients=int(os.environ.get("BENCH_FLEET_CLIENTS", 256)),
+            requests_per_client=1,
+            qps_per_client=50.0,
+            rows=int(os.environ.get("BENCH_FLEET_ROWS", 100_000)),
+            hbm_budget_mb=int(os.environ.get("BENCH_FLEET_HBM_MB", 4)),
+            fleet_tables=int(os.environ.get("BENCH_FLEET_TABLES", 8)),
+        )
+        base = soak_serving.run_soak(agents=1, **kw)
+        fleet = soak_serving.run_soak(agents=agents, **kw)
+        for rep in (base, fleet):
+            assert rep["degraded"] == 0, rep
+            assert rep["bit_identical"], "fleet results diverged"
+        pb0, pb = base["placement"], fleet["placement"]
+        cap0 = pb0["device_capacity"]["aggregate_qps_capacity"]
+        cap = pb["device_capacity"]["aggregate_qps_capacity"]
+        scaling = round(cap / cap0, 2) if cap0 else 0.0
+        assert pb["hit_rate"] >= 0.7, pb
+        assert pb["balance_max_min"] <= 2.0, pb
+        assert len(pb["per_agent_share"]) == agents, pb
+        ledger.add(
+            {
+                "config": 7,
+                "agents": agents,
+                "placement_hit_rate": pb["hit_rate"],
+                "baseline_hit_rate": pb0["hit_rate"],
+                "balance_max_min": pb["balance_max_min"],
+                "qps_wall": fleet["queries_per_sec"],
+                "baseline_qps_capacity": cap0,
+                "aggregate_qps_capacity": cap,
+                "metric": "fleet_qps_capacity_scaling_x",
+                "value": scaling,
+                "unit": "x_vs_1_agent",
+            }
+        )
+        # Full runs keyed by agent count (incl. the rebalancer trail)
+        # merge into the ``fleet`` block AFTER the ledger flush so both
+        # records land in BENCH_DETAIL.json.
+        soak_serving.record_fleet_detail(base, 1)
+        soak_serving.record_fleet_detail(fleet, agents)
+
     runners = {
         "0": run_config_0,
         "1": run_config_1,
@@ -956,6 +1019,7 @@ def main() -> None:
         "4": run_config_4,
         "5": run_config_5,
         "6": run_config_6,
+        "7": run_config_7,
     }
     ran = set()
     for c in order:  # BENCH_CONFIGS order IS the execution order

@@ -304,6 +304,34 @@ class ASTVisitor:
         if isinstance(node, ast.Compare):
             if len(node.ops) != 1:
                 raise CompilerError("chained comparisons are not supported")
+            if isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                left = self._eval(node.left, scope)
+                items = self._eval(node.comparators[0], scope)
+                if not isinstance(left, ColumnExpr):
+                    contained = left in items
+                    return (
+                        contained
+                        if isinstance(node.ops[0], ast.In)
+                        else not contained
+                    )
+                if not isinstance(items, (list, tuple)) or not items:
+                    raise CompilerError(
+                        "'in' over a column requires a non-empty "
+                        "list/tuple of constants"
+                    )
+                # Lower to the equal-chains the engine already executes:
+                # OR of == for `in`, AND of != for `not in`. The serving
+                # normalizer re-folds the OR-of-equals shape into one
+                # LUT-lane IN term for predicate batching.
+                eq, join = (
+                    ("__eq__", "__or__")
+                    if isinstance(node.ops[0], ast.In)
+                    else ("__ne__", "__and__")
+                )
+                out = _apply_binop(left, eq, items[0])
+                for v in items[1:]:
+                    out = _apply_binop(out, join, _apply_binop(left, eq, v))
+                return out
             fn = _CMPOP_FUNCS.get(type(node.ops[0]))
             if fn is None:
                 raise CompilerError(f"unsupported comparison {node.ops[0]}")

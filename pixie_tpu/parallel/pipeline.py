@@ -3629,7 +3629,8 @@ class MeshExecutor:
             int_cols, flt_cols, term_args = pred_batch
             (
                 t_stack, t_col_i, t_col_f, t_op,
-                t_thr_i, t_thr_f, t_active, slot_on,
+                t_thr_i, t_thr_f, t_lut_i, t_lut_v,
+                t_active, slot_on,
             ) = term_args
             base = blk_mask & gid_ok
             ivals = (
@@ -3661,7 +3662,19 @@ class MeshExecutor:
                 )
 
             opb = t_op[:, :, None]
-            ci = cmp_select(opb, iv, t_thr_i[:, :, None])
+            # r18: op 6 = IN-list membership over the int stack via the
+            # per-term LUT lanes — any valid member equal to the row's
+            # value. Codes compare in int64 like op 0/1 (an unseen
+            # string const rides as -1 and matches no row code), so the
+            # batched mask is bit-equal to the serial OR-of-equals.
+            in_ok = jnp.any(
+                (iv[:, :, None, :] == t_lut_i[:, :, :, None])
+                & t_lut_v[:, :, :, None],
+                axis=2,
+            )
+            ci = cmp_select(opb, iv, t_thr_i[:, :, None]) | (
+                (opb == 6) & in_ok
+            )
             cf = cmp_select(opb, fv, t_thr_f[:, :, None])
             term_ok = jnp.where(t_stack[:, :, None] == 0, ci, cf)
             term_ok = term_ok | ~t_active[:, :, None]
@@ -4572,94 +4585,196 @@ class MeshExecutor:
 
     def _normalize_predicates(self, m, evaluator, staged, aux):
         """Lower ``m.predicates`` to conjunctive data terms
-        ``(stack, column, op, int_thr, flt_thr)`` — or None when any
-        predicate falls outside the normalizable class (the query then
-        only shares via the identical-signature ladder).
+        ``(stack, column, op, int_thr, flt_thr, in_vals)`` — or None
+        when any predicate falls outside the normalizable class (the
+        query then only shares via the identical-signature ladder).
 
         The class is a direct comparison of a staged column against a
-        constant (either order), plus a bare boolean column. Exactness
-        contract per term: int/bool/code columns compare in int64
-        (every staged int value and dictionary code fits exactly);
-        float columns compare in float64 with the threshold pre-rounded
-        through the column's STAGED dtype (an f32-staged column's
-        serial comparison happens in f32 — float64(f32(c)) preserves
-        both its equalities and its ordering, so the batched mask is
-        bit-equal). String constants ride as their dictionary code from
-        the aux table (-1 for unseen: equal to nothing, exactly the
-        serial code-compare semantics); columns re-encoded for the cell
-        lane (int_dicts) hold codes the serial path would ALSO compare
-        raw, so they are refused rather than guessed at."""
-        from pixie_tpu.types import DataType
-
+        constant (either order), a bare boolean column, a conjunction
+        (logical_and splits into more terms), and — r18 — an IN-list:
+        a logical_or tree whose leaves are all ``equal(same_col,
+        const)`` folds into ONE membership term (op 6) whose values
+        ride a per-term LUT lane in the batched fold, so IN-heavy
+        query families join predicate batches instead of falling back
+        to solo folds. Exactness contract per term: int/bool/code
+        columns compare in int64 (every staged int value and
+        dictionary code fits exactly); float columns compare in
+        float64 with the threshold pre-rounded through the column's
+        STAGED dtype (an f32-staged column's serial comparison happens
+        in f32 — float64(f32(c)) preserves both its equalities and its
+        ordering, so the batched mask is bit-equal). Float IN-lists
+        are refused (the serial OR-of-equals is exact, but folding it
+        through one LUT dtype is not worth proving). String constants
+        ride as their dictionary code from the aux table (-1 for
+        unseen: equal to nothing, exactly the serial code-compare
+        semantics — including inside an IN LUT, where -1 matches no
+        row code); columns re-encoded for the cell lane (int_dicts)
+        hold codes the serial path would ALSO compare raw, so they are
+        refused rather than guessed at."""
         terms = []
         for p in m.predicates:
-            if isinstance(p, ColumnRef):
-                if (
-                    p.name not in staged.blocks
-                    or p.name in staged.int_dicts
-                    or np.dtype(staged.blocks[p.name].dtype) != np.bool_
-                ):
-                    return None
-                terms.append(("i", p.name, 1, 0, 0.0))  # col != 0
-                continue
-            if not isinstance(p, FuncCall) or len(p.args) != 2:
+            if not self._normalize_pred(p, evaluator, staged, aux, terms):
                 return None
-            op = self._CMP_OPS.get(p.name)
-            if op is None:
+        return terms
+
+    def _normalize_pred(self, p, evaluator, staged, aux, terms):
+        """Normalize one predicate tree into ``terms``. True on
+        success; False means the whole batch attempt is refused."""
+        from pixie_tpu.types import DataType
+
+        if isinstance(p, ColumnRef):
+            if (
+                p.name not in staged.blocks
+                or p.name in staged.int_dicts
+                or np.dtype(staged.blocks[p.name].dtype) != np.bool_
+            ):
+                return False
+            terms.append(("i", p.name, 1, 0, 0.0, ()))  # col != 0
+            return True
+        if not isinstance(p, FuncCall) or len(p.args) != 2:
+            return False
+        if p.name == "logical_and":
+            # A conjunction is just more terms.
+            return self._normalize_pred(
+                p.args[0], evaluator, staged, aux, terms
+            ) and self._normalize_pred(
+                p.args[1], evaluator, staged, aux, terms
+            )
+        if p.name == "logical_or":
+            t = self._in_list_term(p, evaluator, staged, aux)
+            if t is None:
+                return False
+            terms.append(t)
+            return True
+        op = self._CMP_OPS.get(p.name)
+        if op is None:
+            return False
+        a0, a1 = p.args
+        if isinstance(a0, ColumnRef) and isinstance(a1, Constant):
+            col, const = a0, a1
+        elif isinstance(a1, ColumnRef) and isinstance(a0, Constant):
+            col, const = a1, a0
+            op = self._CMP_FLIP[op]
+        else:
+            return False
+        if col.name not in staged.blocks or (
+            col.name in staged.int_dicts
+        ):
+            return False
+        resolved = evaluator._resolved.get(id(p))
+        if resolved is None:
+            return False
+        _udf, arg_types = resolved
+        t0 = arg_types[0]
+        bdt = np.dtype(staged.blocks[col.name].dtype)
+        if t0 == DataType.STRING:
+            if op > 1:
+                return False  # only ==/!= have code-space semantics
+            code = aux.get(f"const:{id(const)}")
+            if code is None:
+                return False
+            terms.append(("i", col.name, op, int(code), 0.0, ()))
+        elif t0 == DataType.FLOAT64:
+            v = const.value
+            if not isinstance(
+                v, (int, float, np.floating, np.integer)
+            ) or isinstance(v, bool):
+                return False
+            if bdt == np.float32:
+                thr = float(np.float64(np.float32(v)))
+            elif bdt == np.float64:
+                thr = float(v)
+            else:
+                return False
+            terms.append(("f", col.name, op, 0, thr, ()))
+        elif t0 in (
+            DataType.INT64, DataType.TIME64NS, DataType.BOOLEAN,
+        ):
+            if bdt.kind == "f":
+                return False
+            try:
+                thr = int(const.value)
+            except (TypeError, ValueError):
+                return False
+            if not (-(1 << 63) <= thr < (1 << 63)):
+                return False
+            terms.append(("i", col.name, op, thr, 0.0, ()))
+        else:
+            return False
+        return True
+
+    def _in_list_term(self, p, evaluator, staged, aux):
+        """Fold a ``logical_or`` tree whose leaves are all
+        ``equal(same_col, const)`` into one membership term
+        ``("i", col, 6, 0, 0.0, codes)`` — the compiler lowers
+        ``col in [a, b, ...]`` to exactly this shape. None refuses."""
+        from pixie_tpu.types import DataType
+
+        leaves = []
+        stack = [p]
+        while stack:
+            n = stack.pop()
+            if (
+                isinstance(n, FuncCall)
+                and n.name == "logical_or"
+                and len(n.args) == 2
+            ):
+                stack.extend(n.args)
+            else:
+                leaves.append(n)
+        col_name = None
+        vals = []
+        for leaf in leaves:
+            if (
+                not isinstance(leaf, FuncCall)
+                or leaf.name != "equal"
+                or len(leaf.args) != 2
+            ):
                 return None
-            a0, a1 = p.args
+            a0, a1 = leaf.args
             if isinstance(a0, ColumnRef) and isinstance(a1, Constant):
                 col, const = a0, a1
             elif isinstance(a1, ColumnRef) and isinstance(a0, Constant):
                 col, const = a1, a0
-                op = self._CMP_FLIP[op]
             else:
+                return None
+            if col_name is None:
+                col_name = col.name
+            elif col.name != col_name:
                 return None
             if col.name not in staged.blocks or (
                 col.name in staged.int_dicts
             ):
                 return None
-            resolved = evaluator._resolved.get(id(p))
+            resolved = evaluator._resolved.get(id(leaf))
             if resolved is None:
                 return None
             _udf, arg_types = resolved
             t0 = arg_types[0]
-            bdt = np.dtype(staged.blocks[col.name].dtype)
             if t0 == DataType.STRING:
-                if op > 1:
-                    return None  # only ==/!= have code-space semantics
                 code = aux.get(f"const:{id(const)}")
                 if code is None:
                     return None
-                terms.append(("i", col.name, op, int(code), 0.0))
-            elif t0 == DataType.FLOAT64:
-                v = const.value
-                if not isinstance(
-                    v, (int, float, np.floating, np.integer)
-                ) or isinstance(v, bool):
-                    return None
-                if bdt == np.float32:
-                    thr = float(np.float64(np.float32(v)))
-                elif bdt == np.float64:
-                    thr = float(v)
-                else:
-                    return None
-                terms.append(("f", col.name, op, 0, thr))
+                vals.append(int(code))
             elif t0 in (
                 DataType.INT64, DataType.TIME64NS, DataType.BOOLEAN,
             ):
-                if bdt.kind == "f":
+                if np.dtype(staged.blocks[col.name].dtype).kind == "f":
                     return None
                 try:
-                    thr = int(const.value)
+                    v = int(const.value)
                 except (TypeError, ValueError):
                     return None
-                if not (-(1 << 63) <= thr < (1 << 63)):
+                if not (-(1 << 63) <= v < (1 << 63)):
                     return None
-                terms.append(("i", col.name, op, thr, 0.0))
+                vals.append(v)
             else:
-                return None
-        return terms
+                return None  # float IN-lists are refused
+        if col_name is None or not vals:
+            return None
+        # Membership is order/multiplicity-insensitive; sort+dedup so
+        # equivalent IN-lists share one slot under the exact-key ladder.
+        return ("i", col_name, 6, 0, 0.0, tuple(sorted(set(vals))))
 
     def _pred_stacks(self, staged):
         """The two dtype-preserving predicate column stacks: int64 for
@@ -4709,8 +4824,10 @@ class MeshExecutor:
         return jax.jit(init, out_shardings=sharding)
 
     # term-table argument count of the batched fold (t_stack, t_col_i,
-    # t_col_f, t_op, t_thr_i, t_thr_f, t_active, slot_on).
-    _N_TERM_ARGS = 8
+    # t_col_f, t_op, t_thr_i, t_thr_f, t_lut_i, t_lut_v, t_active,
+    # slot_on). t_lut_i/t_lut_v are the r18 per-term IN-list LUT lanes:
+    # (B, T, L) member values + validity, consulted when t_op == 6.
+    _N_TERM_ARGS = 10
 
     def _build_batched_fold(
         self,
@@ -4809,19 +4926,19 @@ class MeshExecutor:
 
     def _batched_fold_program(
         self, m, specs, evaluator, key_plan, staged, aux_key_order,
-        aux_vals, capacity, B, T,
+        aux_vals, capacity, B, T, L=1,
     ):
-        """The batched FOLD unit for one (erased-sig, B, T) bucket plus
-        the abstract argument shapes its AOT compile needs. Shared by
-        the dispatch path and the speculative kick so both resolve the
-        SAME signature (one compile per bucket, in-flight dedup via
-        _aot_futures)."""
+        """The batched FOLD unit for one (erased-sig, B, T, L) bucket
+        plus the abstract argument shapes its AOT compile needs (L is
+        the r18 IN-list LUT lane width). Shared by the dispatch path
+        and the speculative kick so both resolve the SAME signature
+        (one compile per bucket, in-flight dedup via _aot_futures)."""
         int_cols, flt_cols = self._pred_stacks(staged)
         erased = self._fold_signature(
             m, specs, key_plan, staged, aux_vals, capacity,
             preds_repr="<batched>",
         )
-        bsig = f"bfold|{erased}|batch:{B}|terms:{T}"
+        bsig = f"bfold|{erased}|batch:{B}|terms:{T}|inlist:{L}"
         treedef, leaves = self._state_template(specs, capacity)
         col_names = sorted(staged.blocks)
         narrow_names = sorted(staged.narrow_offsets)
@@ -4878,14 +4995,24 @@ class MeshExecutor:
                     sharding=repl,
                 )
             )
-        # The 8-term table (t_stack..t_active, slot_on) + gid_base.
+        # The 10-term table (t_stack..t_thr_f, the (B, T, L) IN LUT
+        # lanes, t_active, slot_on) + gid_base.
         for dt in (
             np.int32, np.int32, np.int32, np.int32, np.int64,
-            np.float64, np.bool_,
+            np.float64,
         ):
             avals.append(
                 jax.ShapeDtypeStruct((B, T), np.dtype(dt), sharding=repl)
             )
+        avals.append(
+            jax.ShapeDtypeStruct((B, T, L), np.dtype(np.int64), sharding=repl)
+        )
+        avals.append(
+            jax.ShapeDtypeStruct((B, T, L), np.dtype(np.bool_), sharding=repl)
+        )
+        avals.append(
+            jax.ShapeDtypeStruct((B, T), np.dtype(np.bool_), sharding=repl)
+        )
         avals.append(
             jax.ShapeDtypeStruct((B,), np.dtype(np.bool_), sharding=repl)
         )
@@ -4916,6 +5043,9 @@ class MeshExecutor:
                 m, specs, evaluator, key_plan, staged,
                 list(aux.keys()), list(aux.values()), capacity,
                 2, self._bucket_pow2(max(len(terms), 1)),
+                self._bucket_pow2(
+                    max([len(t[5]) for t in terms] + [1])
+                ),
             )
             self._aot_compile_async(
                 bsig, fold_p, avals, profile_key="batched_compile"
@@ -4949,29 +5079,42 @@ class MeshExecutor:
         nslots = len(slot_terms)
         B = self._bucket_pow2(nslots)
         T = self._bucket_pow2(max([len(t) for t in slot_terms] + [1]))
+        # r18: IN-list LUT lane width — the longest member list across
+        # every slot's op-6 terms, pow2-bucketed so the executable is
+        # shared across IN-list lengths within a bucket.
+        L = self._bucket_pow2(
+            max([len(t[5]) for terms in slot_terms for t in terms] + [1])
+        )
         t_stack = np.zeros((B, T), np.int32)
         t_col_i = np.zeros((B, T), np.int32)
         t_col_f = np.zeros((B, T), np.int32)
         t_op = np.zeros((B, T), np.int32)
         t_thr_i = np.zeros((B, T), np.int64)
         t_thr_f = np.zeros((B, T), np.float64)
+        t_lut_i = np.zeros((B, T, L), np.int64)
+        t_lut_v = np.zeros((B, T, L), np.bool_)
         t_active = np.zeros((B, T), np.bool_)
         slot_on = np.zeros((B,), np.bool_)
         for s, terms in enumerate(slot_terms):
             slot_on[s] = True
-            for t, (stack, cname, op, thr_i, thr_f) in enumerate(terms):
+            for t, (stack, cname, op, thr_i, thr_f, in_vals) in (
+                enumerate(terms)
+            ):
                 t_active[s, t] = True
                 t_op[s, t] = op
                 if stack == "i":
                     t_col_i[s, t] = i_idx[cname]
                     t_thr_i[s, t] = thr_i
+                    if op == 6:
+                        t_lut_i[s, t, : len(in_vals)] = in_vals
+                        t_lut_v[s, t, : len(in_vals)] = True
                 else:
                     t_stack[s, t] = 1
                     t_col_f[s, t] = f_idx[cname]
                     t_thr_f[s, t] = thr_f
         bsig, fold_p, avals = self._batched_fold_program(
             m, specs, evaluator, key_plan, staged, aux_key_order,
-            aux_vals, capacity, B, T,
+            aux_vals, capacity, B, T, L,
         )
         # AOT lane (ROADMAP r16 follow-on): resolve the batched fold
         # through the background compiler like the warm fold — the
@@ -5052,7 +5195,7 @@ class MeshExecutor:
             jax.device_put(x, repl)
             for x in (
                 t_stack, t_col_i, t_col_f, t_op, t_thr_i, t_thr_f,
-                t_active, slot_on,
+                t_lut_i, t_lut_v, t_active, slot_on,
             )
         )
         from pixie_tpu.ops import segment as _segment

@@ -595,6 +595,47 @@ define_flag(
     "advertised watermark) falls back to re-staging from the table "
     "store — bit-identical either way. 1 disables replication.",
 )
+define_flag(
+    "residency_placement",
+    False,
+    help_="Admission-time placement plane (serving/placement.py + "
+    "vizier/broker.py): before planning, score every live data-plane "
+    "agent for the query's table span by heartbeat-advertised HBM "
+    "residency (staged-cache tables + resident/replica rings), then "
+    "the r11 fold-latency view, then WFQ-weighted load, and route the "
+    "scan to the winner by narrowing the planner's agent->table view. "
+    "Shares one scorer with r17 fragment failover. Decisions surface "
+    "as broker_placement_decisions_total{outcome=} and the /statusz "
+    "placement section. Off routes by the planner's static ownership "
+    "view as before.",
+)
+define_flag(
+    "ring_rebalance",
+    False,
+    help_="Adaptive replica-ring rebalancer (serving/placement.py): a "
+    "broker loop drains per-table placement heat each interval and "
+    "reassigns WHICH tables replicate to WHICH followers, skipping "
+    "followers above ring_rebalance_high_pct of their heartbeat HBM "
+    "budget. Assignments ride the ring_replica topic as "
+    "ring_replica_assign messages; agents without an assignment keep "
+    "the deterministic r17 leader-rank attachment. Every move lands on "
+    "an actuation trail (statusz placement.rebalancer). Requires "
+    "residency_placement for the heat signal.",
+)
+define_flag(
+    "ring_rebalance_interval_s",
+    1.0,
+    help_="Seconds between rebalancer ticks. Each tick is a hold "
+    "unless the placement-heat window since the last tick is non-empty.",
+)
+define_flag(
+    "ring_rebalance_high_pct",
+    0.9,
+    help_="HBM rail for the rebalancer: followers whose heartbeat "
+    "ResidencyPool reports used_bytes above this fraction of "
+    "budget_bytes are skipped when assigning replica followers "
+    "(budget 0 = unlimited = always eligible).",
+)
 
 # -- robustness (r10): acked delivery + cluster health plane -----------------
 # (transport_ack_* / transport_window_block_s are declared next to their

@@ -131,6 +131,9 @@ class Agent:
         self._replica_sub = None
         self._status_sub = None
         self._replica_peers: dict[str, float] = {}
+        # r18: rebalancer-assigned follower sets, table -> (seq, frozenset
+        # of agent ids). Overrides the deterministic rank when present.
+        self._replica_assignments: dict[str, tuple[int, frozenset]] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def _recover(self) -> None:
@@ -273,12 +276,20 @@ class Agent:
         rt.start()
         self._threads.append(rt)
 
-    def _my_replica_rank_ok(self, origin: str) -> bool:
-        """Bound adoption to ``ring_replication_factor - 1`` followers:
-        replica-capable agents learn each other from heartbeats and
-        adopt only when they rank among the first factor-1 peer ids
-        (sorted, origin excluded) — a deterministic choice every
-        follower computes identically."""
+    def _my_replica_rank_ok(self, origin: str, table: str = None) -> bool:
+        """Bound adoption to ``ring_replication_factor - 1`` followers.
+
+        r18: a rebalancer assignment (ring_replica_assign from the
+        broker) overrides the default — the agent adopts the table's
+        windows iff it is in the assigned follower set. Without an
+        assignment, the r17 deterministic rank applies: replica-capable
+        agents learn each other from heartbeats and adopt only when
+        they rank among the first factor-1 peer ids (sorted, origin
+        excluded) — a choice every follower computes identically."""
+        if table is not None:
+            assigned = self._replica_assignments.get(table)
+            if assigned is not None:
+                return self.agent_id in assigned[1]
         cap = max(int(flags.ring_replication_factor) - 1, 0)
         now = time.monotonic()
         peers = sorted(
@@ -306,14 +317,26 @@ class Agent:
             msg = self._replica_sub.get(timeout=0.05)
             if msg is None or self._killed.is_set():
                 continue
-            if msg.get("type") != "ring_replica_window":
+            mtype = msg.get("type")
+            if mtype == "ring_replica_assign":
+                # r18: rebalancer-directed follower set for one table.
+                # Monotonic seq guard drops reordered/stale deliveries.
+                seq = int(msg.get("seq", 0))
+                cur = self._replica_assignments.get(msg["table"])
+                if cur is None or seq >= cur[0]:
+                    self._replica_assignments[msg["table"]] = (
+                        seq,
+                        frozenset(msg.get("followers") or ()),
+                    )
+                continue
+            if mtype != "ring_replica_window":
                 continue
             if msg.get("origin") == self.agent_id:
                 continue  # our own publish looping back
             table = msg["table"]
             if self.carnot.table_store.get_table(table) is None:
                 continue  # we could never serve a failover scan of it
-            if not self._my_replica_rank_ok(msg["origin"]):
+            if not self._my_replica_rank_ok(msg["origin"], table):
                 continue
             try:
                 dev.adopt_replica_window(

@@ -413,6 +413,21 @@ class QueryBroker:
         self.admission_controller = None
         if flags.admission_controller:
             self.start_admission_controller()
+        # r18: admission-time placement plane (flag residency_placement)
+        # — score live agents by heartbeat-advertised HBM residency /
+        # fold latency / WFQ load and route each query's scan to the
+        # winner. Shares its scorer with the r17 failover ranking. The
+        # companion ring rebalancer (flag ring_rebalance) drains the
+        # plane's per-table heat and adapts replica-follower
+        # assignments over the ring_replica topic.
+        self.placement = None
+        if flags.residency_placement:
+            from pixie_tpu.serving.placement import PlacementPlane
+
+            self.placement = PlacementPlane()
+        self.ring_rebalancer = None
+        if flags.ring_rebalance:
+            self.start_ring_rebalancer()
         # r13 satellite: table_name -> estimated staging bytes (e.g.
         # serving.admission.make_store_estimator over the agents' table
         # store). With it, admission rejects a query whose staging
@@ -453,6 +468,29 @@ class QueryBroker:
             queue_depth_fn=self.admission.queue_depth,
         ).attach(self, datastore=datastore)
         return self.admission_controller
+
+    def start_ring_rebalancer(self, interval_s=None):
+        """Attach the r18 adaptive replica-ring rebalancer
+        (serving/placement.py): drains the placement plane's per-table
+        heat each interval and reassigns replica followers over the
+        ring_replica topic, railed by heartbeat HBM budgets. Creates
+        the placement plane if routing isn't already on (the heat
+        window then only fills once placement routing runs, so ticks
+        hold). Idempotent; returns the rebalancer."""
+        if self.ring_rebalancer is not None:
+            return self.ring_rebalancer
+        from pixie_tpu.serving.placement import PlacementPlane, RingRebalancer
+        from pixie_tpu.vizier.agent import RING_REPLICA_TOPIC
+
+        if self.placement is None:
+            self.placement = PlacementPlane()
+        self.ring_rebalancer = RingRebalancer(
+            publish=lambda msg: self.bus.publish(RING_REPLICA_TOPIC, msg),
+            view_fn=self.tracker.failover_view,
+            heat_fn=self.placement.drain_heat,
+        )
+        self.ring_rebalancer.start(interval_s)
+        return self.ring_rebalancer
 
     # -- SLO alert fan-out (r15) --------------------------------------------
     def add_alert_listener(self, fn) -> None:
@@ -504,6 +542,21 @@ class QueryBroker:
                 "residency": (
                     self.residency.snapshot()
                     if self.residency is not None
+                    else None
+                ),
+                # r18: placement decisions/hit-rate/per-agent shares,
+                # plus the ring rebalancer's assignments and actuation
+                # trail.
+                "placement": (
+                    {
+                        **self.placement.status(),
+                        "rebalancer": (
+                            self.ring_rebalancer.status()
+                            if self.ring_rebalancer is not None
+                            else None
+                        ),
+                    }
+                    if self.placement is not None
                     else None
                 ),
             },
@@ -603,31 +656,14 @@ class QueryBroker:
     def _best_failover_candidate(
         self, needed: frozenset, skip: set, prefer_kelvin: bool
     ) -> Optional[str]:
-        best = None
-        for a in self.tracker.failover_view():
-            aid = a["agent_id"]
-            if aid in skip:
-                continue
-            owned = needed <= a["tables"]
-            if not (owned or needed <= (a["tables"] | a["replica_tables"])):
-                continue
-            reps = (a.get("health") or {}).get("replicas") or {}
-            hot = sum(
-                int((reps.get(t) or {}).get("windows", 0)) for t in needed
-            )
-            lag = sum(
-                int((reps.get(t) or {}).get("lag", 0)) for t in needed
-            )
-            rank = (
-                0 if a["is_kelvin"] == prefer_kelvin else 1,
-                0 if owned else 1,
-                -hot,
-                lag,
-                aid,
-            )
-            if best is None or rank < best[0]:
-                best = (rank, aid)
-        return best[1] if best else None
+        # r18: failover and admission-time placement share one scorer
+        # (serving/placement.py) — the rank tuple is the r17 one:
+        # role match, ownership, replica warmth, lag, name.
+        from pixie_tpu.serving.placement import best_failover_candidate
+
+        return best_failover_candidate(
+            self.tracker.failover_view(), needed, skip, prefer_kelvin
+        )
 
     def _hedge_delay_s(self, sub_plan: Plan) -> Optional[float]:
         """How long a fragment may stay pending before a hedge launches:
@@ -876,11 +912,59 @@ class QueryBroker:
         ) as plan_span:
             state, expired_agents = self.tracker.planning_view()
             planner = DistributedPlanner(self.registry, self.table_relations)
-            # r17: with failover on, a dead owner's tables can be served
-            # by a promoted replica agent instead of failing the plan.
-            plan, promoted_replica = self._plan_with_replica_fallback(
-                planner, logical, state
-            )
+            # r18: admission-time placement — route the scan to the
+            # agent whose HBM already holds the span (or the warmest
+            # fallback) by narrowing the planner's agent->table view to
+            # the pick. decide() is pure; commit() only fires once the
+            # placed plan actually succeeds, so a planner refusal falls
+            # through to the normal path without polluting metrics.
+            plan = None
+            promoted_replica = None
+            placed_agent = None
+            placement_outcome = None
+            if self.placement is not None:
+                needed = self._plan_tables(logical.fragments[0])
+                pick, outcome = self.placement.decide(
+                    self.tracker.failover_view(),
+                    needed,
+                    fold_latency=self.tracker.fold_latency_view(),
+                )
+                if pick is not None:
+                    placed_state = DistributedState(
+                        agents=[
+                            AgentInfo(
+                                a.agent_id,
+                                frozenset(a.tables) | needed
+                                if a.agent_id == pick
+                                else (
+                                    a.tables
+                                    if a.is_kelvin
+                                    else frozenset(a.tables) - needed
+                                ),
+                                a.is_kelvin,
+                            )
+                            for a in state.agents
+                        ]
+                    )
+                    try:
+                        plan = planner.plan(logical, placed_state)
+                    except ValueError:
+                        plan = None
+                    if plan is not None:
+                        placed_agent, placement_outcome = pick, outcome
+                        self.placement.commit(
+                            pick,
+                            outcome,
+                            needed,
+                            weight=self.admission._weight(tenant or "default"),
+                        )
+            if plan is None:
+                # r17: with failover on, a dead owner's tables can be
+                # served by a promoted replica agent instead of failing
+                # the plan.
+                plan, promoted_replica = self._plan_with_replica_fallback(
+                    planner, logical, state
+                )
             # Health plane: route around agents whose device breaker is
             # open for this query's program shape.
             breaker_skipped: list[str] = []
@@ -894,6 +978,11 @@ class QueryBroker:
                     plan.executing_instance[f.fragment_id]
                     for f in plan.fragments
                 }),
+                **(
+                    {"placed": placed_agent, "placement": placement_outcome}
+                    if placed_agent is not None
+                    else {}
+                ),
             )
         if promoted_replica:
             # r17: a promoted replica COVERS the data the dead owner(s)
@@ -920,6 +1009,12 @@ class QueryBroker:
             # agent was promoted at planning time.
             emit({
                 "type": "replica_promoted", "agent_id": promoted_replica,
+            })
+        if placed_agent is not None:
+            emit({
+                "type": "query_placed",
+                "agent_id": placed_agent,
+                "outcome": placement_outcome,
             })
         compile_ns = time.perf_counter_ns() - t0
 
@@ -1370,6 +1465,9 @@ class QueryBroker:
         finally:
             fwd_attr.__exit__(None, None, None)
             results_sub.unsubscribe()
+            if placed_agent is not None and self.placement is not None:
+                # Inflight occupancy feeds the placement load tie-break.
+                self.placement.release(placed_agent)
             # cleanup_query also tombstones the id: late pushes from
             # still-running fragments are dropped and their polls abort
             # (BridgeCancelled) instead of leaking buffers.
@@ -1528,6 +1626,9 @@ class QueryBroker:
         if self.admission_controller is not None:
             self.admission_controller.stop()
             self.admission_controller = None
+        if self.ring_rebalancer is not None:
+            self.ring_rebalancer.stop()
+            self.ring_rebalancer = None
         self.tracker.stop()
         if self._health_srv is not None:
             self._health_srv.stop()

@@ -60,6 +60,9 @@ def compatible_queries() -> list[str]:
         "df.resp_status == 500",
         "df.resp_status != 200",
         "df.latency > 20000000.0",
+        # r18: IN-list family — normalizes to one LUT-lane membership
+        # term, so it joins predicate batches with the families above.
+        "df.resp_status in [200, 404]",
         None,  # unfiltered family (rung-1 only vs itself)
     )
     for pred in preds:
@@ -69,6 +72,29 @@ def compatible_queries() -> list[str]:
                 "df = px.DataFrame(table='http_events')\n"
                 + filt
                 + "st = df.groupby(['service']).agg(\n"
+                f"    {names[0]}=('time_', px.count),\n"
+                f"    {names[1]}=('latency', px.sum),\n"
+                ")\n"
+                "px.display(st, 'out')\n"
+            )
+    return out
+
+
+# Fleet workload (r18): T hot tables, each with a HIGH-cardinality
+# dict-encoded string key — staging one is expensive (np.unique +
+# encode + host pack), a warm fold is cheap. The HBM budget is set so
+# ONE agent can hold only a couple of staged entries: a 1-agent fleet
+# LRU-thrashes (every query re-stages), while N placement-routed agents
+# partition the tables (~T/N each) and serve every query from hot HBM.
+# That working-set-vs-cluster-HBM gap, not parallel compute, is what
+# the QPS-vs-agent-count scaling measures.
+def fleet_queries(num_tables: int) -> list[str]:
+    out = []
+    for i in range(num_tables):
+        for names in (("n", "total"), ("cnt", "s")):
+            out.append(
+                f"df = px.DataFrame(table='hot_{i}')\n"
+                "st = df.groupby(['service']).agg(\n"
                 f"    {names[0]}=('time_', px.count),\n"
                 f"    {names[1]}=('latency', px.sum),\n"
                 ")\n"
@@ -191,6 +217,8 @@ def run_soak(
     chaos: bool = False,
     profile: bool = False,
     controller: bool = False,
+    agents: int = 1,
+    fleet_tables: int = 0,
 ) -> dict:
     """Build the cluster, run the soak (serving flags pinned for the
     run, restored after), return the report dict. ``chaos`` arms
@@ -199,7 +227,11 @@ def run_soak(
     rejected counts plus per-site fire stats. ``controller`` (r16)
     enables the closed-loop admission controller for the run — the
     report's ``controller`` block carries its actuation trail and
-    final knob values."""
+    final knob values. ``fleet_tables`` > 0 (r18) switches to the
+    fleet workload (``fleet_tables`` hot tables, ``rows`` rows each)
+    over ``agents`` data-plane agents with residency placement ON; the
+    report gains a ``placement`` block (hit rate, per-agent shares,
+    rebalancer trail)."""
     from pixie_tpu.utils import flags
 
     soak_flags = {
@@ -228,12 +260,37 @@ def run_soak(
         # bar is zero degraded results (bit-identical completion via
         # retry onto the replica agent), not structured degradation.
         soak_flags["fragment_failover"] = True
+    if fleet_tables > 0:
+        # r18 fleet mode: placement routes at admission; the entry cap
+        # is lifted above the table count so the BYTE budget is the
+        # only residency rail (that's the thrash the 1-agent baseline
+        # must hit); with >1 agent the rebalancer runs too, assigning
+        # replica followers from placement heat.
+        soak_flags.update(
+            {
+                "residency_placement": True,
+                "fragment_failover": True,
+                "staged_cache_cap": fleet_tables + 2,
+                "ring_replication_factor": 2 if agents > 1 else 1,
+                "ring_rebalance": agents > 1,
+                "ring_rebalance_interval_s": 0.5,
+                # The fleet harness serializes device offloads on one
+                # clock (see _run_soak_inner) to meter per-chip time;
+                # shared-scan joiners block INSIDE the offload waiting
+                # for their leader, which would deadlock under that
+                # serialization — and per-agent capacity must meter
+                # un-coalesced folds anyway.
+                "shared_scans": False,
+                "shared_scan_predicate_batching": False,
+            }
+        )
     for name, value in soak_flags.items():
         flags.set(name, value)
     try:
         return _run_soak_inner(
             clients, requests_per_client, qps_per_client, rows,
             hbm_budget_mb, window_ms, seed, chaos, profile,
+            agents, fleet_tables,
         )
     finally:
         # Restore env/default flag values so an embedding caller
@@ -247,6 +304,7 @@ def run_soak(
 def _run_soak_inner(
     clients, requests_per_client, qps_per_client, rows,
     hbm_budget_mb, window_ms, seed, chaos=False, profile=False,
+    n_agents=1, fleet_tables=0,
 ) -> dict:
     import jax
     from jax.sharding import Mesh
@@ -274,23 +332,48 @@ def _run_soak_inner(
     mesh = Mesh(np.array(jax.devices()), ("d",))
     ex = MeshExecutor(mesh=mesh)
     store = TableStore()
-    t = store.create_table("http_events", rel, size_limit=1 << 40)
     rng = np.random.default_rng(seed)
-    chunk = 1 << 18
-    for off in range(0, rows, chunk):
-        m = min(chunk, rows - off)
-        t.write_pydict(
-            {
-                "time_": np.arange(off, off + m, dtype=np.int64) * 1000,
-                "service": rng.choice(
-                    [f"svc-{i}" for i in range(8)], m
-                ).astype(object),
-                "resp_status": rng.choice([200, 404, 500], m),
-                "latency": rng.exponential(3e7, m),
-            }
-        )
-    t.compact()
-    t.stop()
+    fleet = fleet_tables > 0
+    table_relations = {}
+    if fleet:
+        # r18 fleet workload: fleet_tables hot tables × rows each, with
+        # a ~2000-value service key — dict-encoding it is the expensive
+        # part of staging, so re-staging (1-agent thrash) vs warm HBM
+        # (placement across N agents) is the measured contrast.
+        services = [f"svc-{i}" for i in range(2000)]
+        for i in range(fleet_tables):
+            name = f"hot_{i}"
+            table_relations[name] = rel
+            ht = store.create_table(name, rel, size_limit=1 << 40)
+            ht.write_pydict(
+                {
+                    "time_": np.arange(rows, dtype=np.int64) * 1000,
+                    "service": rng.choice(services, rows).astype(object),
+                    "resp_status": rng.choice([200, 404, 500], rows),
+                    "latency": rng.exponential(3e7, rows),
+                }
+            )
+            ht.compact()
+            ht.stop()
+    else:
+        table_relations["http_events"] = rel
+        t = store.create_table("http_events", rel, size_limit=1 << 40)
+        chunk = 1 << 18
+        for off in range(0, rows, chunk):
+            m = min(chunk, rows - off)
+            t.write_pydict(
+                {
+                    "time_": np.arange(off, off + m, dtype=np.int64)
+                    * 1000,
+                    "service": rng.choice(
+                        [f"svc-{i}" for i in range(8)], m
+                    ).astype(object),
+                    "resp_status": rng.choice([200, 404, 500], m),
+                    "latency": rng.exponential(3e7, m),
+                }
+            )
+        t.compact()
+        t.stop()
 
     from pixie_tpu.serving.admission import make_store_estimator
 
@@ -299,11 +382,15 @@ def _run_soak_inner(
     broker = QueryBroker(
         bus,
         router,
-        table_relations={"http_events": rel},
-        residency=ex._staged_cache,
+        table_relations=table_relations,
+        # Fleet mode: admission's single-pool byte gate would judge the
+        # whole fleet by pem1's pool — the 1-agent thrash baseline is
+        # the POINT, so the broker-side residency gate stays off and
+        # each agent's own ResidencyPool enforces its budget.
+        residency=None if fleet else ex._staged_cache,
         # r13: metadata staging-bytes estimates gate admission BEFORE a
         # doomed cold stage (row count × encoded column widths).
-        staging_estimator=make_store_estimator(store),
+        staging_estimator=None if fleet else make_store_estimator(store),
     )
     agents = [
         Agent(
@@ -311,6 +398,22 @@ def _run_soak_inner(
         ),
         Agent("kelvin", bus, router, is_kelvin=True),
     ]
+    if fleet:
+        # r18: N data-plane agents over the SHARED store — pem1 owns
+        # every table (the planner's fallback target); pem2..pemN are
+        # replica-capable (owned_tables=[]) with their OWN executors at
+        # the same mesh geometry, so a placement-routed fold is
+        # bit-identical wherever it lands (the r17 pem2 construction,
+        # N-wide).
+        for i in range(2, n_agents + 1):
+            exn = MeshExecutor(mesh=Mesh(np.array(jax.devices()), ("d",)))
+            agents.insert(
+                i - 1,
+                Agent(
+                    f"pem{i}", bus, router, table_store=store,
+                    device_executor=exn, owned_tables=[],
+                ),
+            )
     if chaos:
         # r17 replica agent: same (shared) table store, its own device
         # executor at the same mesh geometry (device folds stay
@@ -324,11 +427,44 @@ def _run_soak_inner(
                 device_executor=ex2, owned_tables=[],
             ),
         )
+    # r18: per-agent device capacity meter. The N simulated chips share
+    # ONE host core, so wall-clock QPS cannot show chip parallelism —
+    # the same reason the kernel benches report rows/s/chip. A harness
+    # lock serializes offloads (one chip's work in flight at a time), so
+    # each agent's busy clock is EXCLUSIVE device time: per-agent
+    # capacity = offloads / busy_s is what that chip sustains alone, and
+    # the fleet aggregate is their sum — the throughput N independent
+    # devices deliver in deployment. The 1-agent baseline's meter
+    # naturally absorbs its re-staging thrash (the offload span covers
+    # stage hit/miss + fold), which is exactly the contrast under test.
+    agent_busy: dict = {}
+    if fleet:
+        device_clock = threading.Lock()
+
+        def _meter(aid, dex):
+            orig = dex.try_execute_fragment
+            rec = agent_busy.setdefault(aid, [0, 0])
+
+            def timed(*a, **k):
+                with device_clock:
+                    t0 = time.perf_counter_ns()
+                    try:
+                        return orig(*a, **k)
+                    finally:
+                        rec[0] += time.perf_counter_ns() - t0
+                        rec[1] += 1
+
+            dex.try_execute_fragment = timed
+
+        for a in agents:
+            dev = getattr(a.carnot, "device_executor", None)
+            if dev is not None:
+                _meter(a.agent_id, dev)
     for a in agents:
         a.start()
     time.sleep(0.3)
 
-    queries = compatible_queries()
+    queries = fleet_queries(fleet_tables) if fleet else compatible_queries()
     reg = metrics_registry()
     dispatches = reg.counter("serving_shared_scan_dispatches_total")
     saved = reg.counter("serving_shared_scan_saved_dispatches_total")
@@ -358,6 +494,15 @@ def _run_soak_inner(
     d0, s0 = dispatches.value(), saved.value()
     w0_counts = width_h.merged_counts()
     pb0, ws0 = pred_batched.value(), window_skips.value()
+    # r18: placement counters AFTER the serial baselines (which also
+    # warm span affinity + per-agent residency) — the report's hit rate
+    # and per-agent shares are concurrent-phase deltas.
+    placement0 = (
+        broker.placement.status() if broker.placement is not None else None
+    )
+    # Device-meter snapshot after the baselines: capacity is a
+    # concurrent-phase delta like the placement counters above.
+    busy0 = {aid: list(rec) for aid, rec in agent_busy.items()}
 
     retries_c = reg.counter("broker_fragment_retries_total")
     recovered_c = reg.counter("broker_recovered_queries_total")
@@ -522,6 +667,69 @@ def _run_soak_inner(
         if broker.admission_controller is not None
         else None
     )
+    # r18: concurrent-phase placement deltas + the rebalancer's trail.
+    placement_block = None
+    if broker.placement is not None and placement0 is not None:
+        p1 = broker.placement.status()
+        deltas = {
+            k: int(p1["decisions"].get(k, 0))
+            - int(placement0["decisions"].get(k, 0))
+            for k in p1["decisions"]
+        }
+        total_d = sum(deltas.values())
+        hits = deltas.get("ring_hit", 0) + deltas.get("replica_hit", 0)
+        shares = {}
+        for aid, st in p1["per_agent"].items():
+            prev = placement0["per_agent"].get(aid, {}).get("placed", 0)
+            delta = int(st["placed"]) - int(prev)
+            if delta > 0:
+                shares[aid] = delta
+        # Per-agent device capacity (concurrent-phase delta): each
+        # agent's exclusive device seconds and offload count under the
+        # serialized device clock. qps_capacity sums per-chip rates —
+        # what the fleet sustains when every agent folds on its own
+        # device (in-sim, all chips share one host core, so wall-clock
+        # queries_per_sec cannot show this; rows/s/chip convention).
+        capacity = {}
+        for aid, rec in sorted(agent_busy.items()):
+            b0, o0 = busy0.get(aid, [0, 0])
+            d_busy, d_off = rec[0] - b0, rec[1] - o0
+            if d_off > 0 and d_busy > 0:
+                capacity[aid] = {
+                    "offloads": int(d_off),
+                    "busy_s": round(d_busy / 1e9, 3),
+                    "service_ms": round(d_busy / 1e6 / d_off, 2),
+                    "qps_capacity": round(d_off / (d_busy / 1e9), 1),
+                }
+        placement_block = {
+            "agents": n_agents,
+            "decisions": deltas,
+            "device_capacity": {
+                "per_agent": capacity,
+                "aggregate_qps_capacity": round(
+                    sum(v["qps_capacity"] for v in capacity.values()), 1
+                ),
+            },
+            "hit_rate": round(hits / total_d, 4) if total_d else None,
+            "per_agent_share": shares,
+            "balance_max_min": (
+                round(max(shares.values()) / min(shares.values()), 2)
+                if shares
+                else None
+            ),
+            "rebalancer": (
+                {
+                    "assignments": broker.ring_rebalancer.status()[
+                        "assignments"
+                    ],
+                    "actuations": broker.ring_rebalancer.status()[
+                        "actuations"
+                    ][-8:],
+                }
+                if broker.ring_rebalancer is not None
+                else None
+            ),
+        }
     broker.stop()
     for a in agents:
         a.stop()
@@ -615,6 +823,8 @@ def _run_soak_inner(
             ),
         },
     }
+    if placement_block is not None:
+        report["placement"] = placement_block
     if profile_block is not None:
         report["profile"] = profile_block
     if controller_status is not None:
@@ -643,6 +853,59 @@ def _run_soak_inner(
             },
         }
     return report
+
+
+def record_fleet_detail(report: dict, agents: int, path: str = None) -> None:
+    """Merge one fleet soak run into BENCH_DETAIL.json's ``fleet`` block,
+    keyed by agent count (read-modify-write: the other recorded blocks
+    survive). Once a 1-agent baseline and an N-agent run are both
+    present, each multi-agent run gains ``qps_scaling_x`` — aggregate
+    device capacity vs the baseline's. Scaling is measured at the
+    per-agent device level because the simulated chips share one host
+    core (the same reason the kernel benches report rows/s/chip):
+    wall-clock QPS cannot show chip parallelism in-process, exclusive
+    per-chip busy time can."""
+    bd_path = path or os.path.join(REPO, "BENCH_DETAIL.json")
+    with open(bd_path) as f:
+        detail = json.load(f)
+    pb = report.get("placement") or {}
+    cap = pb.get("device_capacity") or {}
+    fleet = detail.get("fleet") or {}
+    runs = fleet.get("runs") or {}
+    runs[str(agents)] = {
+        "agents": agents,
+        "clients": report["clients"],
+        "requests_per_client": report["requests_per_client"],
+        "completed": report["completed"],
+        "degraded": report["degraded"],
+        "bit_identical": report["bit_identical"],
+        "qps_wall": report["queries_per_sec"],
+        "placement_hit_rate": pb.get("hit_rate"),
+        "decisions": pb.get("decisions"),
+        "per_agent_share": pb.get("per_agent_share"),
+        "balance_max_min": pb.get("balance_max_min"),
+        "per_agent_capacity": cap.get("per_agent"),
+        "aggregate_qps_capacity": cap.get("aggregate_qps_capacity"),
+        "rebalancer": pb.get("rebalancer"),
+    }
+    base_cap = (runs.get("1") or {}).get("aggregate_qps_capacity")
+    for k, r in runs.items():
+        if k != "1" and base_cap:
+            r["qps_scaling_x"] = round(
+                (r.get("aggregate_qps_capacity") or 0.0) / base_cap, 2
+            )
+    fleet["runs"] = runs
+    fleet["capacity_model"] = (
+        "per-agent device capacity on a serialized device clock "
+        "(offloads / exclusive busy seconds, summed across agents); "
+        "in-sim chips share one host core, so scaling is measured at "
+        "the chip level like the rows/s/chip kernel benches"
+    )
+    detail["fleet"] = fleet
+    with open(bd_path, "w") as f:
+        json.dump(detail, f, indent=1)
+        f.write("\n")
+    log(f"BENCH_DETAIL.json updated (fleet, agents={agents})")
 
 
 def main() -> int:
@@ -701,6 +964,24 @@ def main() -> int:
         "attributed stacks and programs, attribution percentages).",
     )
     ap.add_argument(
+        "--agents", type=int,
+        default=int(os.environ.get("SOAK_AGENTS", 1)),
+        help="r18: data-plane agent count for the fleet workload "
+        "(pem1 owns every table; pem2..pemN are replica-capable with "
+        "their own executors at the same mesh geometry). Only "
+        "meaningful with --fleet-tables > 0.",
+    )
+    ap.add_argument(
+        "--fleet-tables", type=int,
+        default=int(os.environ.get("SOAK_FLEET_TABLES", 0)),
+        help="r18: switch to the fleet workload — this many hot "
+        "tables (--rows rows EACH, ~2000-value dict key) with "
+        "residency placement ON. With --agents > 1 the pass gate "
+        "becomes the placement criteria: bit-identical completion, "
+        "hit-rate >= 0.7, per-agent share spread <= 2x; --agents 1 is "
+        "the thrash baseline (gated on completion/bit-identity only).",
+    )
+    ap.add_argument(
         "--controller", action="store_true",
         default=bool(int(os.environ.get("SOAK_CONTROLLER", "0"))),
         help="Enable the r16 closed-loop admission controller for the "
@@ -720,13 +1001,22 @@ def main() -> int:
         chaos=args.chaos,
         profile=args.profile,
         controller=args.controller,
+        agents=args.agents,
+        fleet_tables=args.fleet_tables,
     )
     print(json.dumps(report, indent=1))
     path = os.environ.get("SOAK_JSON")
     if path:
         with open(path, "w") as f:
             json.dump(report, f, indent=1)
-    if os.environ.get("SOAK_WRITE_BENCH_DETAIL") == "1":
+    if os.environ.get("SOAK_WRITE_BENCH_DETAIL") == "1" and (
+        args.fleet_tables > 0
+    ):
+        # r18 fleet mode records under ``fleet`` (keyed by agent count)
+        # and must not clobber the standard workload's serving_soak
+        # numbers.
+        record_fleet_detail(report, args.agents)
+    elif os.environ.get("SOAK_WRITE_BENCH_DETAIL") == "1":
         # ROADMAP serving follow-on (1): the ~1k-client run's contention
         # + profile blocks are recorded next to the bench configs.
         bd_path = os.path.join(REPO, "BENCH_DETAIL.json")
@@ -768,13 +1058,27 @@ def main() -> int:
             f.write("\n")
         log("BENCH_DETAIL.json updated (serving_soak)")
     ok = report["bit_identical"] and report["residency"]["within_budget"]
-    if not args.chaos:
+    fleet = args.fleet_tables > 0
+    if not args.chaos and not fleet:
         # The dispatch-reduction bar is the NORMAL-mode gate; a chaos
         # run kills the owner executor mid-phase, splitting dispatches
-        # across two devices — it gates on failover outcomes instead.
+        # across two devices — it gates on failover outcomes instead,
+        # and the fleet workload (solo per-table families) gates on
+        # the placement criteria below.
         ok = ok and (
             (report["shared_scan"]["dispatch_reduction_x"] or 0) >= 2.0
         )
+    if fleet:
+        # r18 acceptance (multi-agent): every query bit-identical,
+        # placement hit-rate >= 70% on the hot-table workload, and
+        # every agent carried a share with max/min spread <= 2x. The
+        # 1-agent run is the THRASH BASELINE — its hit rate is supposed
+        # to be low — so it gates on completion/bit-identity only.
+        pb = report.get("placement") or {}
+        if args.agents > 1:
+            ok = ok and (pb.get("hit_rate") or 0.0) >= 0.7
+            ok = ok and len(pb.get("per_agent_share") or {}) == args.agents
+            ok = ok and (pb.get("balance_max_min") or 99.0) <= 2.0
     if args.chaos:
         # r17 acceptance: with failover on, injected failures — incl.
         # the owner agent dying mid-query — must yield ZERO degraded
