@@ -106,6 +106,19 @@ capacity QPS scaling into BENCH_DETAIL.json's ``fleet`` block
 (capacity, not wall-clock: in-process chips share one host core, so
 scaling is measured per-chip like the rows/s/chip configs).
 
+The join lane (r19): config 8 (opt-in, BENCH_CONFIGS=...,8) runs a
+representative dim×fact equijoin (svc_owners × join_fact on service)
+and records ``join_lane`` ("device" when the program cache traced the
+sort-merge lane — ops/segment.LANE_COUNTS key ``join_sort_merge`` —
+"host" when any gate declined) and ``join_rows_per_sec`` next to the
+per-chip metric, both ALWAYS present so a lane-selection regression is
+visible even inside gate tolerance. Output correctness is asserted
+in-run (both key columns of every emitted pair are equal, row count
+matches the host-computed expectation). Knobs: ``device_join`` /
+``device_join_min_rows`` / ``device_join_max_out`` are logged at
+startup; BENCH_JOIN_ROWS sizes the fact side (default 4M — inside the
+default device_join_max_out so the lane engages at stock flags).
+
 Env knobs: BENCH_ROWS (configs 2/5; default 256M), BENCH_SMALL_ROWS
 (configs 1/3/4; default 64M), BENCH_HOST_ROWS (config 0; default 8M),
 BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS (comma list, default
@@ -114,7 +127,8 @@ BENCH_BLOCK_ROWS, BENCH_CACHE_DIR, BENCH_NO_DATA_CACHE=1 to force
 regeneration, BENCH_CLEAR_JAX_CACHE=1 to clear the persistent compile
 cache, BENCH_SOAK_CLIENTS/BENCH_SOAK_REQUESTS/BENCH_SOAK_ROWS for
 config 6, BENCH_FLEET_AGENTS/BENCH_FLEET_CLIENTS/BENCH_FLEET_ROWS/
-BENCH_FLEET_TABLES/BENCH_FLEET_HBM_MB for config 7.
+BENCH_FLEET_TABLES/BENCH_FLEET_HBM_MB for config 7, BENCH_JOIN_ROWS
+for config 8.
 """
 
 import copy
@@ -300,7 +314,7 @@ def main() -> None:
         for c in os.environ.get("BENCH_CONFIGS", "2,5,4,1,0,3").split(",")
         if c.strip()
     ]
-    unknown = set(order) - {"0", "1", "2", "3", "4", "5", "6", "7"}
+    unknown = set(order) - {"0", "1", "2", "3", "4", "5", "6", "7", "8"}
     if unknown:
         raise SystemExit(f"BENCH_CONFIGS has unknown entries: {unknown}")
     configs = set(order)
@@ -392,7 +406,12 @@ def main() -> None:
         f"x{flags.fragment_max_retries} "
         f"hedged={flags.hedged_requests}"
         f"@q{flags.hedge_quantile} "
-        f"ring_replication={flags.ring_replication_factor}"
+        f"ring_replication={flags.ring_replication_factor} "
+        # r19 knobs: the device sort-merge join lane (config 8; joins in
+        # any config's queries take it when the gates admit the shape).
+        f"device_join={flags.device_join}"
+        f">={flags.device_join_min_rows}rows"
+        f"<={flags.device_join_max_out}out"
     )
     carnot = Carnot(
         device_executor=MeshExecutor(mesh=mesh, block_rows=block_rows)
@@ -1011,6 +1030,109 @@ def main() -> None:
         soak_serving.record_fleet_detail(base, 1)
         soak_serving.record_fleet_detail(fleet, agents)
 
+    # ---- config 8: device sort-merge join lane (r19) ----------------------
+    def run_config_8():
+        # Representative telemetry equijoin: a small service→owner dim
+        # table joined INNER against a fact stream on the service key.
+        # Build side = left (dim), probe = right (fact) — the planner's
+        # convention — so the device lane sorts 16 rows and merges the
+        # fact side through searchsorted. At stock flags the lane
+        # engages (4M rows ≥ device_join_min_rows, output ≤
+        # device_join_max_out); join_lane records what actually ran.
+        n_join = int(os.environ.get("BENCH_JOIN_ROWS", 4_000_000))
+        dim_rel = Relation.of(
+            ("svc", S, SemanticType.ST_SERVICE_NAME),
+            ("owner", S),
+        )
+        td = create_table_no_ring("svc_owners", dim_rel)
+        td.write_pydict(
+            {
+                "svc": services,
+                "owner": np.array(
+                    [f"team-{i % 4}" for i in range(n_services)],
+                    dtype=object,
+                ),
+            }
+        )
+        td.compact()
+        td.stop()
+        fact_rel = Relation.of(
+            ("time_", T, SemanticType.ST_TIME_NS),
+            ("service", S, SemanticType.ST_SERVICE_NAME),
+            ("latency", F, SemanticType.ST_DURATION_NS),
+        )
+        tf = create_table_no_ring("join_fact", fact_rel, size_limit=1 << 42)
+        fd = tf.dictionaries["service"]
+        for name in services:
+            fd.get_code(name)
+
+        def build_join_fact():
+            rng = np.random.default_rng(46)
+            return {
+                "svc_idx": rng.integers(
+                    0, n_services, n_join, dtype=np.uint8
+                ),
+                "latency": rng.exponential(3e7, n_join),
+            }
+
+        d8 = cache.get_or_build(f"joinfact_{n_join}_s46", build_join_fact)
+        chunk = 16_000_000
+        for off in range(0, n_join, chunk):
+            m = min(chunk, n_join - off)
+            tf.write_pydict(
+                {
+                    "time_": np.arange(off, off + m, dtype=np.int64) * 1000,
+                    "service": DictColumn(
+                        d8["svc_idx"][off : off + m].astype(np.int32), fd
+                    ),
+                    "latency": d8["latency"][off : off + m],
+                }
+            )
+        tf.compact()
+        tf.stop()
+        q8 = (
+            "l = px.DataFrame(table='svc_owners')\n"
+            "r = px.DataFrame(table='join_fact')\n"
+            "j = l.merge(r, how='inner', left_on=['svc'],"
+            " right_on=['service'], suffixes=['', '_r'])\n"
+            "px.display(j, 'joined')\n"
+        )
+
+        def verify(result) -> None:
+            rows = result.table("joined")
+            assert len(rows["time_"]) == n_join, len(rows["time_"])
+            # Every emitted pair carries equal key columns from both
+            # sides — a wrong gather/merge shows up here immediately.
+            assert np.array_equal(
+                np.asarray(rows["svc"], dtype=object),
+                np.asarray(rows["service"], dtype=object),
+            ), "join key mismatch between sides"
+
+        result, cold8, bd = cold_run(q8)
+        verify(result)
+        best, last = best_of(lambda: carnot.execute_query(q8), runs)
+        verify(last)
+        lanes = segment_ops.reduce_lanes(reset=True)
+        ledger.add(
+            {
+                "config": 8,
+                "cold_s": cold8,
+                "cold_breakdown": bd,
+                "rows_per_sec": round(n_join / best),
+                "reduction_lanes": lanes,
+                # Always-present lane keys: a gate that silently bounced
+                # the join to the host engine is a visible "host" here,
+                # not a quietly slower rows/s.
+                "join_lane": (
+                    "device" if lanes.get("join_sort_merge") else "host"
+                ),
+                "join_rows_per_sec": round(n_join / best),
+                "metric": "join_sort_merge_rows_per_sec_per_chip",
+                "value": round(n_join / best / n_chips),
+                "unit": "rows/s/chip",
+            }
+        )
+
     runners = {
         "0": run_config_0,
         "1": run_config_1,
@@ -1020,6 +1142,7 @@ def main() -> None:
         "5": run_config_5,
         "6": run_config_6,
         "7": run_config_7,
+        "8": run_config_8,
     }
     ran = set()
     for c in order:  # BENCH_CONFIGS order IS the execution order

@@ -467,6 +467,65 @@ def sorted_segment_max_small(flat, values, value_bits: int, nseg: int, mask=None
     return out[:-1]
 
 
+# -- r19: sort-merge join primitives ----------------------------------------
+# The join lane reuses the r8 idioms directly: a stable packed-key sort
+# orders the build side (reproducing the host JoinNode's per-key original
+# row order), searchsorted runs the merge, and the sentinel-sort
+# compaction brings unmatched rows to the front for the outer variants.
+# Output is bounded by host-computed caps (exact match/unmatched counts
+# from bincount, padded to a power of two) so every shape is static.
+
+
+def merge_join_pairs(sorted_build_keys, build_order, probe_keys, pair_cap: int):
+    """Emit up to ``pair_cap`` (build_row, probe_row) match pairs of an
+    equijoin between a SORTED build side and an unsorted probe side.
+
+    ``sorted_build_keys``/``build_order`` come from one stable sort of the
+    build keys (order = original row index), so within each key the build
+    rows appear in original order — matching the host JoinNode's stable
+    ``_build_order``. Pairs are probe-row-major: for probe row p with
+    fanout f, its f pairs occupy slots [prefix[p]-f, prefix[p]).
+
+    Returns ``(build_rows, probe_rows, valid, fanout)`` — all int32 except
+    the bool ``valid`` mask; slots past the true match count are invalid
+    (clipped gathers; callers mask or slice them away). ``fanout`` is the
+    per-probe-row match count (0 for masked/padded rows whose key is a
+    sentinel absent from the build side). Callers guarantee the true match
+    total fits ``pair_cap`` and int32."""
+    nb = sorted_build_keys.shape[0]
+    np_ = probe_keys.shape[0]
+    lo = jnp.searchsorted(
+        sorted_build_keys, probe_keys, side="left"
+    ).astype(jnp.int32)
+    hi = jnp.searchsorted(
+        sorted_build_keys, probe_keys, side="right"
+    ).astype(jnp.int32)
+    fanout = hi - lo
+    prefix = jnp.cumsum(fanout)
+    t = jnp.arange(pair_cap, dtype=jnp.int32)
+    # Slot t belongs to the first probe row whose prefix exceeds t.
+    probe_rows = jnp.minimum(
+        jnp.searchsorted(prefix, t, side="right").astype(jnp.int32),
+        jnp.int32(np_ - 1),
+    )
+    base = prefix[probe_rows] - fanout[probe_rows]
+    build_pos = jnp.clip(lo[probe_rows] + (t - base), 0, nb - 1)
+    return build_order[build_pos], probe_rows, t < prefix[-1], fanout
+
+
+def compact_unmatched_rows(unmatched, cap: int):
+    """Compact the indices of ``unmatched`` rows to the front, preserving
+    original row order — the r8 sentinel-sort idiom (losers collapse onto
+    sentinel ``n``, one sort, static slice). Returns int32[cap]; entries
+    >= n are padding."""
+    n = unmatched.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    out = jnp.sort(jnp.where(unmatched, idx, jnp.int32(n)))[: min(cap, n)]
+    if cap > n:
+        out = jnp.concatenate([out, jnp.full(cap - n, n, jnp.int32)])
+    return out
+
+
 def seg_sum(values, seg_ids, num_segments: int, mask=None):
     if _use_matmul(num_segments) and jnp.issubdtype(
         values.dtype, jnp.floating

@@ -210,6 +210,30 @@ define_flag(
     "the offload).",
 )
 define_flag(
+    "device_join",
+    True,
+    help_="Device sort-merge join lane (r19): standalone INNER/LEFT/RIGHT/"
+    "OUTER equijoins ride the r8 sort–compact machinery instead of the "
+    "host JoinNode when the shape qualifies (parallel/pipeline.py "
+    "match_join). Off = every join runs on the host engine.",
+)
+define_flag(
+    "device_join_min_rows",
+    1 << 18,
+    help_="Combined build+probe row floor below which a join stays on the "
+    "host engine — staging two sides for a small join costs more than "
+    "the Python hash join (analogous to SORTED_MIN_ROWS; provisional, "
+    "CPU-tuned, pending the TPU campaign).",
+)
+define_flag(
+    "device_join_max_out",
+    1 << 24,
+    help_="Largest device-join output cardinality (matches + sentinel "
+    "null rows) accepted on the merge lane; bigger joins are host work "
+    "(the bounded-fanout gather pads to a power-of-two cap and i32 "
+    "prefix math must stay exact).",
+)
+define_flag(
     "agent_expiry_s",
     2.0,
     help_="Heartbeat silence before an agent is pruned from plans "

@@ -32,8 +32,11 @@ withheld; the broker must fail the fragment over to a survivor),
 follower falls behind the leader's watermark (failover queries must
 re-stage from the table store, bit-identical), ``hedge.both_complete``
 — the broker skips cancelling a hedge loser so BOTH attempts complete
-and the fragment-epoch dedup must drop exactly one), and
-tests/operators arm them deterministically.
+and the fragment-epoch dedup must drop exactly one; r19 join site:
+``device.join_dispatch`` — the device sort-merge join lane fails after
+planning accepts the shape, before staging (chaos tests prove the r9
+breaker trips and the query completes bit-identical on the host
+JoinNode)), and tests/operators arm them deterministically.
 
 Design contract:
 

@@ -1,0 +1,263 @@
+"""Device sort-merge join lane (r19) on the 8-virtual-device CPU mesh.
+
+The contract under test: the device lane is BIT-IDENTICAL to the host
+EquijoinNode for INNER/LEFT/RIGHT/OUTER across duplicate keys on both
+sides, unmatched keys in both directions, string (dictionary-code) and
+int keys, and ragged tails — and the planner falls back to the host
+engine below the row gate, on unsupported shapes, and when the flag is
+off.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from pixie_tpu.engine import Carnot
+from pixie_tpu.parallel import MeshExecutor
+from pixie_tpu.types import DataType, Relation, SemanticType
+from pixie_tpu.utils import flags
+
+F, I, S, T = (
+    DataType.FLOAT64,
+    DataType.INT64,
+    DataType.STRING,
+    DataType.TIME64NS,
+)
+
+NL, NR = 5000, 3100  # not block-aligned: ragged padded tails on 8 devices
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.array(jax.devices("cpu"))
+    assert devs.size == 8, "conftest must provide 8 virtual devices"
+    return Mesh(devs, ("d",))
+
+
+@pytest.fixture
+def flagset():
+    """flags.set with automatic restore."""
+    saved = {}
+
+    def set_(name, value):
+        if name not in saved:
+            saved[name] = flags.get(name)
+        flags.set(name, value)
+
+    yield set_
+    for name, value in saved.items():
+        flags.set(name, value)
+
+
+REL_L = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("svc", S),
+    ("code", I),
+    ("lat", F),
+)
+REL_R = Relation.of(
+    ("time_", T, SemanticType.ST_TIME_NS),
+    ("svc2", S),
+    ("code2", I),
+    ("cost", F),
+)
+
+
+def _data(rng, n, keys, key_ints):
+    return {
+        "time_": np.arange(n, dtype=np.int64) * 10,
+        # Duplicate keys on both sides + keys unique to each side.
+        "svc": rng.choice(keys, n).astype(object),
+        "code": rng.choice(key_ints, n),
+        "lat": rng.normal(100.0, 10.0, n),
+    }
+
+
+def build_carnot(device_executor, nl=NL, nr=NR):
+    rng = np.random.default_rng(7)
+    c = Carnot(device_executor=device_executor)
+    dl = _data(rng, nl, [f"s{i}" for i in range(18)], [1, 2, 3, 4, 99])
+    dr = _data(rng, nr, [f"s{i}" for i in range(12, 30)], [2, 3, 4, 5, 77])
+    tl = c.table_store.create_table("lhs", REL_L)
+    if nl:
+        tl.write_pydict(dl)
+    tl.compact()
+    tl.stop()
+    tr = c.table_store.create_table("rhs", REL_R)
+    if nr:
+        tr.write_pydict(
+            {
+                "time_": dr["time_"],
+                "svc2": dr["svc"],
+                "code2": dr["code"],
+                "cost": dr["lat"],
+            }
+        )
+    tr.compact()
+    tr.stop()
+    return c
+
+
+def _join_query(how, on=("svc", "svc2")):
+    return (
+        "l = px.DataFrame(table='lhs')\n"
+        "r = px.DataFrame(table='rhs')\n"
+        f"j = l.merge(r, how='{how}', left_on=['{on[0]}'],"
+        f" right_on=['{on[1]}'], suffixes=['', '_r'])\n"
+        "px.display(j, 'out')\n"
+    )
+
+
+def _canon(rows):
+    """Order-insensitive canonical form: rows as sorted tuples."""
+    names = sorted(rows)
+    return sorted(zip(*[rows[n] for n in names])), names
+
+
+def run_both(mesh, q, nl=NL, nr=NR):
+    cd = build_carnot(MeshExecutor(mesh=mesh, block_rows=512), nl, nr)
+    ch = build_carnot(None, nl, nr)
+    res_d = cd.execute_query(q)
+    res_h = ch.execute_query(q)
+    assert not cd.device_executor.fallback_errors, (
+        cd.device_executor.fallback_errors
+    )
+    return cd, res_d.table("out"), res_h.table("out")
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+def test_device_join_bit_identical_string_key(mesh, flagset, how):
+    flagset("device_join_min_rows", 0)
+    cd, rows_d, rows_h = run_both(mesh, _join_query(how))
+    assert any(
+        s.startswith("join|") for s in cd.device_executor._program_cache
+    ), "join did not offload"
+    canon_d, names = _canon(rows_d)
+    canon_h, _ = _canon(rows_h)
+    assert canon_d == canon_h
+    assert len(canon_d) > 0
+    if how in ("inner", "left"):
+        # INNER/LEFT device row ORDER matches the host engine exactly
+        # (probe-row-major matches, stable build order within key, then
+        # unmatched build rows); the outer-probe variants interleave
+        # unmatched probe rows per host probe batch, so only the
+        # multiset is the contract there.
+        for n in names:
+            assert rows_d[n] == rows_h[n]
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+def test_device_join_bit_identical_int_key(mesh, flagset, how):
+    flagset("device_join_min_rows", 0)
+    cd, rows_d, rows_h = run_both(
+        mesh, _join_query(how, on=("code", "code2"))
+    )
+    assert any(
+        s.startswith("join|") for s in cd.device_executor._program_cache
+    )
+    assert _canon(rows_d)[0] == _canon(rows_h)[0]
+
+
+def test_device_join_all_unmatched_outer(mesh, flagset):
+    """Disjoint key spaces: OUTER output is both sides null-padded."""
+    flagset("device_join_min_rows", 0)
+    q = (
+        "l = px.DataFrame(table='lhs')\n"
+        "r = px.DataFrame(table='rhs')\n"
+        "j = l.merge(r, how='outer', left_on=['code'], right_on=['time_'],"
+        " suffixes=['', '_r'])\n"
+        "px.display(j, 'out')\n"
+    )
+    cd, rows_d, rows_h = run_both(mesh, q)
+    assert _canon(rows_d)[0] == _canon(rows_h)[0]
+    assert len(rows_d["svc"]) == NL + NR
+
+
+def test_device_join_empty_build_side_falls_back(mesh, flagset):
+    """Zero-row build side: the lane declines (host hash join wins
+    outright) and the host result comes back unchanged."""
+    flagset("device_join_min_rows", 0)
+    cd, rows_d, rows_h = run_both(mesh, _join_query("outer"), nl=0)
+    assert not any(
+        s.startswith("join|") for s in cd.device_executor._program_cache
+    )
+    assert _canon(rows_d)[0] == _canon(rows_h)[0]
+    assert len(rows_d["svc"]) == NR
+
+
+def test_device_join_row_gate_falls_back(mesh, flagset):
+    """Below device_join_min_rows the join stays on the host engine."""
+    flagset("device_join_min_rows", 1 << 18)
+    cd, rows_d, rows_h = run_both(mesh, _join_query("inner"))
+    assert not any(
+        s.startswith("join|") for s in cd.device_executor._program_cache
+    )
+    assert _canon(rows_d)[0] == _canon(rows_h)[0]
+
+
+def test_device_join_flag_off_falls_back(mesh, flagset):
+    flagset("device_join", False)
+    flagset("device_join_min_rows", 0)
+    cd, rows_d, rows_h = run_both(mesh, _join_query("left"))
+    assert not any(
+        s.startswith("join|") for s in cd.device_executor._program_cache
+    )
+    assert _canon(rows_d)[0] == _canon(rows_h)[0]
+
+
+def test_device_join_prejoin_filter_falls_back(mesh, flagset):
+    """v1 gate: pre-join predicates keep the join on the host engine,
+    bit-identical."""
+    flagset("device_join_min_rows", 0)
+    q = (
+        "l = px.DataFrame(table='lhs')\n"
+        "r = px.DataFrame(table='rhs')\n"
+        "r = r[r.cost > 100.0]\n"
+        "j = l.merge(r, how='inner', left_on=['svc'], right_on=['svc2'],"
+        " suffixes=['', '_r'])\n"
+        "px.display(j, 'out')\n"
+    )
+    cd, rows_d, rows_h = run_both(mesh, q)
+    assert not any(
+        s.startswith("join|") for s in cd.device_executor._program_cache
+    )
+    assert _canon(rows_d)[0] == _canon(rows_h)[0]
+
+
+def test_device_join_host_suffix_agg(mesh, flagset):
+    """A non-decomposable suffix below the join (groupby quantiles is
+    not in the join-agg decomposition set) runs on the host against the
+    spliced device join batch."""
+    flagset("device_join_min_rows", 0)
+    q = (
+        "l = px.DataFrame(table='lhs')\n"
+        "r = px.DataFrame(table='rhs')\n"
+        "j = l.merge(r, how='inner', left_on=['svc'], right_on=['svc2'],"
+        " suffixes=['', '_r'])\n"
+        "s = j.groupby(['svc']).agg(q=('cost', px.quantiles),"
+        " n=('time_', px.count))\n"
+        "px.display(s, 'out')\n"
+    )
+    cd, rows_d, rows_h = run_both(mesh, q)
+    assert any(
+        s.startswith("join|") for s in cd.device_executor._program_cache
+    )
+    assert _canon(rows_d)[0] == _canon(rows_h)[0]
+
+
+def test_device_join_staged_sides_accounted(mesh, flagset):
+    """Both staged sides land in the ResidencyPool with byte accounting,
+    and a repeat query reuses them (no re-staging)."""
+    flagset("device_join_min_rows", 0)
+    cd = build_carnot(MeshExecutor(mesh=mesh, block_rows=512))
+    q = _join_query("inner")
+    cd.execute_query(q)
+    pool = cd.device_executor._staged_cache
+    tags = [k[6] for k, _v in pool.items() if isinstance(k, tuple)]
+    assert any(":joindevL:" in t for t in tags)
+    assert any(":joindevR:" in t for t in tags)
+    n_programs = len(cd.device_executor._program_cache)
+    cd.execute_query(q)
+    assert len(cd.device_executor._program_cache) == n_programs
+    assert not cd.device_executor.fallback_errors

@@ -730,3 +730,138 @@ def test_join_vectorized_throughput(store):
     # Vectorized probe measures ~0.5s here; a per-row Python loop is >10s.
     # 2.5s tolerates loaded CI hosts without masking that regression.
     assert dt < 2.5, f"probe took {dt:.2f}s for {n_probe} rows"
+
+
+# -- host JoinNode edge cases (r19: the oracle the device lane matches) ------
+
+
+def _join_fragment(f_how, build_table, output_columns):
+    f = PlanFragment()
+    build = f.add(MemorySourceOp(build_table))
+    probe = f.add(MemorySourceOp("http_events"))
+    join = f.add(
+        JoinOp(
+            how=f_how,
+            left_on=("service",),
+            right_on=("service",),
+            output_columns=output_columns,
+        ),
+        [build, probe],
+    )
+    f.add(MemorySinkOp("out"), [join])
+    return f
+
+
+@pytest.mark.parametrize(
+    "how,expect_rows",
+    [(JoinType.INNER, 0), (JoinType.LEFT, 0), (JoinType.RIGHT, 6),
+     (JoinType.OUTER, 6)],
+)
+def test_join_empty_build_side(store, how, expect_rows):
+    """Zero-row build side: INNER/LEFT emit nothing, RIGHT/OUTER emit
+    every probe row with type-default build columns."""
+    ts = store
+    rel = Relation.of(("service", S), ("tag", I))
+    t = ts.create_table("empty_build", rel)
+    t.stop()
+    f = _join_fragment(
+        how,
+        "empty_build",
+        (
+            (1, "service", "psvc"),
+            (0, "tag", "tag"),
+            (1, "latency", "latency"),
+        ),
+    )
+    rows = sink_rows(run_fragment(f, store))
+    n = len(rows.get("psvc", []))
+    assert n == expect_rows
+    if expect_rows:
+        assert rows["tag"] == [0] * expect_rows  # null-padded build side
+        assert sorted(rows["psvc"]) == ["a", "a", "a", "b", "b", "c"]
+
+
+def test_join_duplicate_keys_both_sides(store):
+    """Dup keys on BOTH sides produce the full per-key cross product, with
+    build rows in stable original order within each probe row."""
+    ts = store
+    rel = Relation.of(("service", S), ("tag", I))
+    t = ts.create_table("dups", rel)
+    # 'a' twice, 'b' twice on the build side; probe has a,b,a,c,b,a.
+    t.write_pydict({"service": ["a", "b", "a", "b"], "tag": [1, 2, 3, 4]})
+    t.stop()
+    f = _join_fragment(
+        JoinType.INNER,
+        "dups",
+        ((1, "service", "psvc"), (0, "tag", "tag"), (1, "time_", "pt")),
+    )
+    rows = sink_rows(run_fragment(f, store))
+    # 3 probe 'a' x 2 build 'a' + 2 probe 'b' x 2 build 'b' = 10 pairs.
+    assert len(rows["psvc"]) == 10
+    pairs = list(zip(rows["pt"], rows["tag"]))
+    for pt in (1, 3, 6):  # probe 'a' rows, each against build tags [1, 3]
+        assert pairs.count((pt, 1)) == 1 and pairs.count((pt, 3)) == 1
+    for pt in (2, 5):  # probe 'b' rows against build tags [2, 4]
+        assert pairs.count((pt, 2)) == 1 and pairs.count((pt, 4)) == 1
+    # Within each probe row, build rows surface in original build order.
+    a_rows = [tag for pt, tag in pairs if pt == 1]
+    assert a_rows == [1, 3]
+
+
+def test_join_string_keys_separate_dictionaries(store):
+    """String keys joined across tables with DIFFERENT dictionaries: probe
+    codes realign into the build dictionary space, and string columns from
+    both sides decode correctly."""
+    ts = store
+    rel = Relation.of(("service", S), ("owner", S))
+    t = ts.create_table("owners", rel)
+    # Dictionary order differs from http_events' (c first), plus a
+    # build-only key 'q'.
+    t.write_pydict(
+        {"service": ["c", "q", "a"], "owner": ["t_c", "t_q", "t_a"]}
+    )
+    t.stop()
+    f = _join_fragment(
+        JoinType.OUTER,
+        "owners",
+        (
+            (1, "service", "psvc"),
+            (0, "service", "bsvc"),
+            (0, "owner", "owner"),
+        ),
+    )
+    rows = sink_rows(run_fragment(f, store))
+    trip = set(zip(rows["psvc"], rows["bsvc"], rows["owner"]))
+    assert ("a", "a", "t_a") in trip
+    assert ("c", "c", "t_c") in trip
+    assert ("b", "", "") in trip  # probe-only key: build strings pad to ""
+    assert ("", "q", "t_q") in trip  # build-only key: probe strings pad
+    assert len(rows["psvc"]) == 3 + 1 + 2 + 1  # a x3, c x1, b x2 pad, q pad
+
+
+def test_join_all_unmatched_outer(store):
+    """Disjoint key spaces: OUTER output is exactly build+probe rows, every
+    one half null-padded."""
+    ts = store
+    rel = Relation.of(("service", S), ("tag", I))
+    t = ts.create_table("disjoint", rel)
+    t.write_pydict({"service": ["x", "y"], "tag": [7, 8]})
+    t.stop()
+    f = _join_fragment(
+        JoinType.OUTER,
+        "disjoint",
+        (
+            (1, "service", "psvc"),
+            (0, "service", "bsvc"),
+            (0, "tag", "tag"),
+            (1, "latency", "latency"),
+        ),
+    )
+    rows = sink_rows(run_fragment(f, store))
+    assert len(rows["psvc"]) == 6 + 2
+    matched = [p for p in zip(rows["psvc"], rows["bsvc"]) if p[0] and p[1]]
+    assert matched == []
+    assert sorted(t for t in rows["tag"] if t) == [7, 8]
+    assert all(
+        lat == 0.0 for b, lat in zip(rows["bsvc"], rows["latency"]) if b
+    )

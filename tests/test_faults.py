@@ -787,6 +787,70 @@ def test_breaker_reopens_on_failed_halfopen_trial(mesh, flagset):
     assert faults.stats()["pipeline.fold"][0] == 2
 
 
+JOIN_QUERY = (
+    "l = px.DataFrame(table='http_events')\n"
+    "r = px.DataFrame(table='owners')\n"
+    "j = l.merge(r, how='left', left_on=['service'], right_on=['svc'],"
+    " suffixes=['', '_r'])\n"
+    "px.display(j, 'out')\n"
+)
+
+
+def _seed_join_carnot(mesh):
+    c, dev = _seed_device_carnot(mesh)
+    rel = Relation.of(("svc", S), ("owner", S))
+    t = c.table_store.create_table("owners", rel)
+    t.write_pydict(
+        {"svc": ["a", "b", "zz"], "owner": ["t1", "t2", "ghost"]}
+    )
+    t.compact()
+    t.stop()
+    return c, dev
+
+
+def test_device_join_poison_trips_breaker_and_recovers(mesh, flagset):
+    """r19 chaos acceptance: a poisoned device sort-merge join (1) falls
+    back to the host JoinNode with bit-identical rows, (2) trips the r9
+    circuit breaker after N consecutive failures so the device is not
+    even attempted, (3) recovers after the cooldown."""
+    flagset("device_breaker_threshold", 2)
+    flagset("device_breaker_cooldown_s", 0.3)
+    flagset("device_join_min_rows", 0)
+    c, dev = _seed_join_carnot(mesh)
+    m = metrics_registry()
+    hits = m.counter("device_offload_total")
+    trips = m.counter("device_offload_fallback_breaker_trips_total")
+    skips = m.counter("device_offload_fallback_breaker_open_total")
+
+    hits0 = hits.value()
+    baseline = _sorted_rows(c.execute_query(JOIN_QUERY))
+    assert hits.value() > hits0, "baseline join must run on the device"
+    assert any(s.startswith("join|") for s in dev._program_cache)
+
+    faults.arm("device.join_dispatch", count=2)
+    trips0, skips0 = trips.value(), skips.value()
+    r1 = _sorted_rows(c.execute_query(JOIN_QUERY))
+    assert r1 == baseline, "host JoinNode fallback must be bit-identical"
+    r2 = _sorted_rows(c.execute_query(JOIN_QUERY))
+    assert r2 == baseline
+    assert trips.value() == trips0 + 1, "2 consecutive failures trip"
+
+    # Breaker open: the device is skipped outright — the join site is not
+    # even checked (checks stay at 2) and the skip counter moves.
+    r3 = _sorted_rows(c.execute_query(JOIN_QUERY))
+    assert r3 == baseline
+    assert skips.value() == skips0 + 1
+    assert faults.stats()["device.join_dispatch"][0] == 2, (
+        "open breaker must not attempt device join dispatch"
+    )
+
+    time.sleep(0.35)  # cooldown elapses -> half-open trial
+    hits1 = hits.value()
+    r4 = _sorted_rows(c.execute_query(JOIN_QUERY))
+    assert r4 == baseline
+    assert hits.value() > hits1, "post-cooldown join recovered to device"
+
+
 def test_staging_pack_poison_falls_back_to_monolithic(mesh, flagset):
     """A poisoned stream pack falls back to monolithic staging (still
     on-device) and the query stays correct."""

@@ -77,6 +77,25 @@ def compatible_queries() -> list[str]:
                 ")\n"
                 "px.display(st, 'out')\n"
             )
+    # r19 join family: INNER/LEFT merges against the owners dim table,
+    # aggregated per owner so the forwarded result stays small. These
+    # are safe under the ORDER-SENSITIVE bit-identity gate: the host
+    # equijoin emits matches in probe-stream order (deterministic per
+    # bridge) with unmatched build rows trailing, and the device lane
+    # reproduces that order exactly for INNER/LEFT. RIGHT/OUTER
+    # interleave unmatched probe rows per batch and are excluded.
+    for how in ("inner", "left"):
+        out.append(
+            "l = px.DataFrame(table='owners')\n"
+            "r = px.DataFrame(table='http_events')\n"
+            f"j = l.merge(r, how='{how}', left_on=['svc'],"
+            " right_on=['service'], suffixes=['', '_r'])\n"
+            "st = j.groupby(['owner']).agg(\n"
+            "    n=('time_', px.count),\n"
+            "    s=('latency', px.sum),\n"
+            ")\n"
+            "px.display(st, 'out')\n"
+        )
     return out
 
 
@@ -374,6 +393,26 @@ def _run_soak_inner(
             )
         t.compact()
         t.stop()
+        # r19: the join family's dim side. One owner per service plus an
+        # ownerless extra key, so LEFT joins exercise the unmatched-build
+        # null padding through the serving path.
+        owners_rel = Relation.of(("svc", S), ("owner", S))
+        table_relations["owners"] = owners_rel
+        to = store.create_table("owners", owners_rel, size_limit=1 << 30)
+        to.write_pydict(
+            {
+                "svc": np.array(
+                    [f"svc-{i}" for i in range(8)] + ["svc-unowned"],
+                    dtype=object,
+                ),
+                "owner": np.array(
+                    [f"team-{i % 3}" for i in range(8)] + ["team-none"],
+                    dtype=object,
+                ),
+            }
+        )
+        to.compact()
+        to.stop()
 
     from pixie_tpu.serving.admission import make_store_estimator
 
