@@ -119,6 +119,15 @@ matches the host-computed expectation). Knobs: ``device_join`` /
 startup; BENCH_JOIN_ROWS sizes the fact side (default 4M — inside the
 default device_join_max_out so the lane engages at stock flags).
 
+Materialized views (r20): config 9 (opt-in, BENCH_CONFIGS=...,9) runs
+the dashboard-repeat soak workload with the view plane ON — the panel
+scripts are registered as materialized views, clients re-run them, and
+reads merge persisted partial-agg state with a tail delta fold instead
+of folding from scratch. Asserts hit rate >= 0.9 and fold-dispatch
+reduction >= 5x vs the views-off one-fold-per-request cost, with the
+in-run bit-identity verify as the correctness gate; the full block
+lands in BENCH_DETAIL.json's ``views`` key.
+
 Env knobs: BENCH_ROWS (configs 2/5; default 256M), BENCH_SMALL_ROWS
 (configs 1/3/4; default 64M), BENCH_HOST_ROWS (config 0; default 8M),
 BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS (comma list, default
@@ -128,7 +137,8 @@ regeneration, BENCH_CLEAR_JAX_CACHE=1 to clear the persistent compile
 cache, BENCH_SOAK_CLIENTS/BENCH_SOAK_REQUESTS/BENCH_SOAK_ROWS for
 config 6, BENCH_FLEET_AGENTS/BENCH_FLEET_CLIENTS/BENCH_FLEET_ROWS/
 BENCH_FLEET_TABLES/BENCH_FLEET_HBM_MB for config 7, BENCH_JOIN_ROWS
-for config 8.
+for config 8, BENCH_VIEWS_CLIENTS/BENCH_VIEWS_REQUESTS/
+BENCH_VIEWS_ROWS for config 9.
 """
 
 import copy
@@ -314,7 +324,9 @@ def main() -> None:
         for c in os.environ.get("BENCH_CONFIGS", "2,5,4,1,0,3").split(",")
         if c.strip()
     ]
-    unknown = set(order) - {"0", "1", "2", "3", "4", "5", "6", "7", "8"}
+    unknown = set(order) - {
+        "0", "1", "2", "3", "4", "5", "6", "7", "8", "9",
+    }
     if unknown:
         raise SystemExit(f"BENCH_CONFIGS has unknown entries: {unknown}")
     configs = set(order)
@@ -1133,6 +1145,59 @@ def main() -> None:
             }
         )
 
+    # ---- config 9: materialized-view dashboard soak (r20) -----------------
+    def run_config_9():
+        # Dashboard-repeat workload through the r20 view plane: the
+        # panel scripts are registered as materialized views after the
+        # serial baselines, clients re-run them, and reads merge the
+        # persisted partial-agg state with a tail delta fold instead of
+        # folding from scratch. The acceptance pair — view hit rate
+        # >= 0.9 and fold-dispatch reduction >= 5x vs one full fold per
+        # request — is asserted here and recorded in BENCH_DETAIL.json's
+        # ``views`` block, with the in-run bit-identity verify (every
+        # view-served read == the from-scratch baseline, and the
+        # post-append delta folded via maintenance) as the correctness
+        # gate. Opt-in via BENCH_CONFIGS=...,9.
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import soak_serving
+
+        report = soak_serving.run_soak(
+            clients=int(os.environ.get("BENCH_VIEWS_CLIENTS", 64)),
+            requests_per_client=int(
+                os.environ.get("BENCH_VIEWS_REQUESTS", 4)
+            ),
+            rows=int(os.environ.get("BENCH_VIEWS_ROWS", 100_000)),
+            views=True,
+        )
+        assert report["degraded"] == 0, report
+        assert report["bit_identical"], "view-served reads diverged"
+        vb = report["views"]
+        assert vb["hit_rate"] >= 0.9, vb
+        assert vb["fold_dispatch_reduction_x"] >= 5.0, vb
+        assert vb["post_append_bit_identical"], vb
+        ledger.add(
+            {
+                "config": 9,
+                "view_queries": vb["queries"],
+                "view_hit_rate": vb["hit_rate"],
+                "view_read_p50_ms": vb["read_p50_ms"],
+                "view_read_p99_ms": vb["read_p99_ms"],
+                "fold_dispatches_views_on": vb["fold_dispatches_views_on"],
+                "fold_dispatches_views_off": vb[
+                    "fold_dispatches_views_off"
+                ],
+                "post_append_bit_identical": vb[
+                    "post_append_bit_identical"
+                ],
+                "metric": "view_fold_dispatch_reduction_x",
+                "value": vb["fold_dispatch_reduction_x"],
+                "unit": "x_vs_views_off",
+            }
+        )
+        # The full block (incl. the dispatch model note) merges into
+        # BENCH_DETAIL.json's ``views`` key after the ledger flush.
+        soak_serving.record_views_detail(report)
+
     runners = {
         "0": run_config_0,
         "1": run_config_1,
@@ -1143,6 +1208,7 @@ def main() -> None:
         "6": run_config_6,
         "7": run_config_7,
         "8": run_config_8,
+        "9": run_config_9,
     }
     ran = set()
     for c in order:  # BENCH_CONFIGS order IS the execution order

@@ -55,6 +55,15 @@ class QueryResult:
     # (``ok`` stays True): the rows are bit-identical to an unfaulted
     # run; the annotation only says failover did work to get them.
     recovered: Optional[dict] = None
+    # Materialized-view freshness stamp (r20, flag ``materialized_views``):
+    # set when the result was served from a view's merged partial-agg
+    # state instead of a fold — {"view", "view_id", "staleness_s"
+    # (seconds since the view's last successful maintenance),
+    # "watermark" (table row-id the carried state covers), "tail_rows"
+    # (unflushed rows delta-folded at read time)}. A view-served result
+    # is bit-identical to folding from scratch; the stamp only says how
+    # the rows were produced and how fresh the carried state was.
+    view: Optional[dict] = None
 
     @property
     def ok(self) -> bool:

@@ -66,6 +66,7 @@ from pixie_tpu.plan.expressions import (
     FuncCall,
     expr_data_type,
     referenced_columns,
+    walk,
 )
 from pixie_tpu.plan.operators import (
     AggOp,
@@ -81,6 +82,7 @@ from pixie_tpu.plan.plan import PlanFragment
 from pixie_tpu.table.column import DictColumn, StringDictionary
 from pixie_tpu.table.row_batch import RowBatch
 from pixie_tpu.types import DataType
+from pixie_tpu.types.dtypes import host_dtype
 from pixie_tpu.udf.udf import Executor, MergeKind
 from pixie_tpu.parallel import profiler as resattr
 from pixie_tpu.utils import faults, flags, metrics_registry, trace
@@ -206,6 +208,297 @@ def match_fragment(fragment: PlanFragment, relations) -> Optional[_Match]:
         predicates=preds,
         source_relation=source_rel,
     )
+
+
+# -- predicate normalization (r16; module-level since r20) -------------------
+# Lowers conjunctive predicate trees to data terms
+# ``(stack, column, op, int_thr, flt_thr, in_vals)``. One normalizer,
+# three consumers with the identical refusal class: the predicate-batched
+# shared scans (MeshExecutor), the r20 join-side pushdown, and the
+# materialized-view predicate digest (serving/views.py).
+
+_CMP_OPS = {
+    "equal": 0, "notEqual": 1,
+    "lessThan": 2, "lessThanEqual": 3,
+    "greaterThan": 4, "greaterThanEqual": 5,
+}
+# const-on-the-left flips the comparison, not the operands.
+_CMP_FLIP = {0: 0, 1: 1, 2: 4, 3: 5, 4: 2, 5: 3}
+
+
+def normalize_predicates(predicates, evaluator, staged, aux):
+    """Lower ``predicates`` to conjunctive data terms
+    ``(stack, column, op, int_thr, flt_thr, in_vals)`` — or None
+    when any predicate falls outside the normalizable class (the
+    query then only shares via the identical-signature ladder).
+
+    The class is a direct comparison of a staged column against a
+    constant (either order), a bare boolean column, a conjunction
+    (logical_and splits into more terms), and — r18 — an IN-list:
+    a logical_or tree whose leaves are all ``equal(same_col,
+    const)`` folds into ONE membership term (op 6) whose values
+    ride a per-term LUT lane in the batched fold, so IN-heavy
+    query families join predicate batches instead of falling back
+    to solo folds. Exactness contract per term: int/bool/code
+    columns compare in int64 (every staged int value and
+    dictionary code fits exactly); float columns compare in
+    float64 with the threshold pre-rounded through the column's
+    STAGED dtype (an f32-staged column's serial comparison happens
+    in f32 — float64(f32(c)) preserves both its equalities and its
+    ordering, so the batched mask is bit-equal). Float IN-lists
+    are refused (the serial OR-of-equals is exact, but folding it
+    through one LUT dtype is not worth proving). String constants
+    ride as their dictionary code from the aux table (-1 for
+    unseen: equal to nothing, exactly the serial code-compare
+    semantics — including inside an IN LUT, where -1 matches no
+    row code); columns re-encoded for the cell lane (int_dicts)
+    hold codes the serial path would ALSO compare raw, so they are
+    refused rather than guessed at."""
+    terms = []
+    for p in predicates:
+        if not _normalize_pred(p, evaluator, staged, aux, terms):
+            return None
+    return terms
+
+
+def _normalize_pred(p, evaluator, staged, aux, terms):
+    """Normalize one predicate tree into ``terms``. True on
+    success; False means the whole attempt is refused."""
+    if isinstance(p, ColumnRef):
+        if (
+            p.name not in staged.blocks
+            or p.name in staged.int_dicts
+            or np.dtype(staged.blocks[p.name].dtype) != np.bool_
+        ):
+            return False
+        terms.append(("i", p.name, 1, 0, 0.0, ()))  # col != 0
+        return True
+    if not isinstance(p, FuncCall) or len(p.args) != 2:
+        return False
+    if p.name == "logical_and":
+        # A conjunction is just more terms.
+        return _normalize_pred(
+            p.args[0], evaluator, staged, aux, terms
+        ) and _normalize_pred(
+            p.args[1], evaluator, staged, aux, terms
+        )
+    if p.name == "logical_or":
+        t = _in_list_term(p, evaluator, staged, aux)
+        if t is None:
+            return False
+        terms.append(t)
+        return True
+    op = _CMP_OPS.get(p.name)
+    if op is None:
+        return False
+    a0, a1 = p.args
+    if isinstance(a0, ColumnRef) and isinstance(a1, Constant):
+        col, const = a0, a1
+    elif isinstance(a1, ColumnRef) and isinstance(a0, Constant):
+        col, const = a1, a0
+        op = _CMP_FLIP[op]
+    else:
+        return False
+    if col.name not in staged.blocks or (
+        col.name in staged.int_dicts
+    ):
+        return False
+    resolved = evaluator._resolved.get(id(p))
+    if resolved is None:
+        return False
+    _udf, arg_types = resolved
+    t0 = arg_types[0]
+    bdt = np.dtype(staged.blocks[col.name].dtype)
+    if t0 == DataType.STRING:
+        if op > 1:
+            return False  # only ==/!= have code-space semantics
+        code = aux.get(f"const:{id(const)}")
+        if code is None:
+            return False
+        terms.append(("i", col.name, op, int(code), 0.0, ()))
+    elif t0 == DataType.FLOAT64:
+        v = const.value
+        if not isinstance(
+            v, (int, float, np.floating, np.integer)
+        ) or isinstance(v, bool):
+            return False
+        if bdt == np.float32:
+            thr = float(np.float64(np.float32(v)))
+        elif bdt == np.float64:
+            thr = float(v)
+        else:
+            return False
+        terms.append(("f", col.name, op, 0, thr, ()))
+    elif t0 in (
+        DataType.INT64, DataType.TIME64NS, DataType.BOOLEAN,
+    ):
+        if bdt.kind == "f":
+            return False
+        try:
+            thr = int(const.value)
+        except (TypeError, ValueError):
+            return False
+        if not (-(1 << 63) <= thr < (1 << 63)):
+            return False
+        terms.append(("i", col.name, op, thr, 0.0, ()))
+    else:
+        return False
+    return True
+
+
+def _in_list_term(p, evaluator, staged, aux):
+    """Fold a ``logical_or`` tree whose leaves are all
+    ``equal(same_col, const)`` into one membership term
+    ``("i", col, 6, 0, 0.0, codes)`` — the compiler lowers
+    ``col in [a, b, ...]`` to exactly this shape. None refuses."""
+    leaves = []
+    stack = [p]
+    while stack:
+        n = stack.pop()
+        if (
+            isinstance(n, FuncCall)
+            and n.name == "logical_or"
+            and len(n.args) == 2
+        ):
+            stack.extend(n.args)
+        else:
+            leaves.append(n)
+    col_name = None
+    vals = []
+    for leaf in leaves:
+        if (
+            not isinstance(leaf, FuncCall)
+            or leaf.name != "equal"
+            or len(leaf.args) != 2
+        ):
+            return None
+        a0, a1 = leaf.args
+        if isinstance(a0, ColumnRef) and isinstance(a1, Constant):
+            col, const = a0, a1
+        elif isinstance(a1, ColumnRef) and isinstance(a0, Constant):
+            col, const = a1, a0
+        else:
+            return None
+        if col_name is None:
+            col_name = col.name
+        elif col.name != col_name:
+            return None
+        if col.name not in staged.blocks or (
+            col.name in staged.int_dicts
+        ):
+            return None
+        resolved = evaluator._resolved.get(id(leaf))
+        if resolved is None:
+            return None
+        _udf, arg_types = resolved
+        t0 = arg_types[0]
+        if t0 == DataType.STRING:
+            code = aux.get(f"const:{id(const)}")
+            if code is None:
+                return None
+            vals.append(int(code))
+        elif t0 in (
+            DataType.INT64, DataType.TIME64NS, DataType.BOOLEAN,
+        ):
+            if np.dtype(staged.blocks[col.name].dtype).kind == "f":
+                return None
+            try:
+                v = int(const.value)
+            except (TypeError, ValueError):
+                return None
+            if not (-(1 << 63) <= v < (1 << 63)):
+                return None
+            vals.append(v)
+        else:
+            return None  # float IN-lists are refused
+    if col_name is None or not vals:
+        return None
+    # Membership is order/multiplicity-insensitive; sort+dedup so
+    # equivalent IN-lists share one slot under the exact-key ladder.
+    return ("i", col_name, 6, 0, 0.0, tuple(sorted(set(vals))))
+
+
+@dataclasses.dataclass
+class _HostNormShim:
+    """Duck-typed StagedColumns stand-in for normalizing predicates
+    WITHOUT a device staging (r20): ``blocks`` carries zero-length
+    arrays in each column's HOST dtype (int32 for STRING code
+    columns, ``host_dtype`` otherwise) so the normalizer's dtype
+    gates resolve exactly as they would against a host-geometry
+    staging; no cell-lane re-encoding ever applies."""
+
+    blocks: dict
+    int_dicts: dict = dataclasses.field(default_factory=dict)
+
+
+def host_norm_shim(relation) -> _HostNormShim:
+    blocks = {}
+    for schema in relation:
+        if schema.data_type == DataType.STRING:
+            blocks[schema.name] = np.empty(0, dtype=np.int32)
+        else:
+            blocks[schema.name] = np.empty(
+                0, dtype=host_dtype(schema.data_type)
+            )
+    return _HostNormShim(blocks)
+
+
+def predicate_fold_digest(predicates, relation, registry, func_ctx=None):
+    """Canonical digest of a conjunctive predicate list over
+    ``relation``, or None when any predicate falls outside the
+    normalizable class. Two suffixes with the same digest keep or
+    drop exactly the same rows.
+
+    String constants canonicalize BY VALUE, never by dictionary
+    code: codes drift as dictionaries grow (and every unseen
+    constant would collide on -1), so the normalizer runs over a
+    private value-sorted code assignment whose codes are translated
+    back to the string values in the emitted digest. Terms sort —
+    a conjunction commutes — so predicate ORDER never splits a
+    digest. Consumers: the r20 materialized-view match (a view
+    serves a query only when the fold signature AND this digest
+    agree) and the join-side pushdown's staging identity."""
+    named = [(f"pred{i}", p) for i, p in enumerate(predicates)]
+    try:
+        evaluator = ExpressionEvaluator(
+            named, relation, registry, func_ctx
+        )
+    except (ValueError, KeyError):
+        return None
+    svals = sorted(
+        {
+            e.value
+            for _n, p in named
+            for e in walk(p)
+            if isinstance(e, Constant) and isinstance(e.value, str)
+        }
+    )
+    code_of = {v: i for i, v in enumerate(svals)}
+    aux = {}
+    for _n, p in named:
+        for e in walk(p):
+            if isinstance(e, Constant) and isinstance(e.value, str):
+                aux[f"const:{id(e)}"] = code_of[e.value]
+    shim = host_norm_shim(relation)
+    terms = normalize_predicates(predicates, evaluator, shim, aux)
+    if terms is None:
+        return None
+    val_of_code = {c: v for v, c in code_of.items()}
+    string_cols = {
+        s.name for s in relation if s.data_type == DataType.STRING
+    }
+    canon = []
+    for stack, col, op, ithr, fthr, invals in terms:
+        if col in string_cols and op in (0, 1):
+            canon.append((col, op, "s", val_of_code[ithr]))
+        elif col in string_cols and op == 6:
+            canon.append(
+                (col, op, "s",
+                 tuple(sorted(val_of_code[c] for c in invals)))
+            )
+        else:
+            canon.append((col, op, stack, ithr, fthr, invals))
+    return "preds:" + repr(sorted(canon, key=repr))
 
 
 @dataclasses.dataclass
@@ -2027,10 +2320,28 @@ class MeshExecutor:
         rt = table_store.get_table(m.right_source_op.table_name)
         if lt is None or rt is None:
             return None
-        # v1 gates: bare-column keys and outputs, no pre-join predicates —
-        # joins over filtered/computed inputs stay on the host engine.
-        if m.left_preds or m.right_preds:
-            return None
+        # v1 gates: bare-column keys and outputs. r20 lifts the pre-join
+        # predicate refusal: single-table conjunctive predicates from the
+        # script suffix lower through the r16 normalizer (the digest pins
+        # the staging identity) and filter each side ON THE HOST before
+        # staging — boolean-mask selection preserves original row order,
+        # so the device merge sees exactly the rows the host engine's
+        # pre-join FilterNode keeps, in the same order, and INNER/LEFT
+        # row-order bit-identity carries over unchanged. A predicate
+        # outside the normalizable class still refuses to the host.
+        lpred_digest = rpred_digest = ""
+        if m.left_preds:
+            lpred_digest = predicate_fold_digest(
+                m.left_preds, m.left_relation, registry, func_ctx
+            )
+            if lpred_digest is None:
+                return None
+        if m.right_preds:
+            rpred_digest = predicate_fold_digest(
+                m.right_preds, m.right_relation, registry, func_ctx
+            )
+            if rpred_digest is None:
+                return None
         if not all(
             isinstance(e, ColumnRef)
             for e in m.left_key_exprs + m.right_key_exprs
@@ -2048,18 +2359,46 @@ class MeshExecutor:
             ):
                 return None
             out_plan.append((side, e.name, out_name, dt))
+        lneed = {e.name for e in m.left_key_exprs}
+        for p in m.left_preds:
+            lneed |= referenced_columns(p)
+        rneed = {e.name for e in m.right_key_exprs}
+        for p in m.right_preds:
+            rneed |= referenced_columns(p)
         lcols, nl = read_columns(
             lt,
-            sorted({e.name for e in m.left_key_exprs}),
+            sorted(lneed),
             m.left_source_op.start_time,
             m.left_source_op.stop_time,
         )
         rcols, nr = read_columns(
             rt,
-            sorted({e.name for e in m.right_key_exprs}),
+            sorted(rneed),
             m.right_source_op.start_time,
             m.right_source_op.stop_time,
         )
+        # Host-evaluate each side's predicate mask over the same read the
+        # keys came from (one snapshot), then filter keys before encoding;
+        # the mask rides into staging as ``row_sel``.
+        left_sel = right_sel = None
+        if m.left_preds:
+            left_sel = self._host_pred_mask(
+                m.left_preds, m.left_relation, lt, lcols, registry,
+                func_ctx,
+            )
+            if left_sel is None or len(left_sel) != nl:
+                return None
+            lcols = {c: np.asarray(a)[left_sel] for c, a in lcols.items()}
+            nl = int(np.count_nonzero(left_sel))
+        if m.right_preds:
+            right_sel = self._host_pred_mask(
+                m.right_preds, m.right_relation, rt, rcols, registry,
+                func_ctx,
+            )
+            if right_sel is None or len(right_sel) != nr:
+                return None
+            rcols = {c: np.asarray(a)[right_sel] for c, a in rcols.items()}
+            nr = int(np.count_nonzero(right_sel))
         if nl == 0 or nr == 0:
             return None  # trivial side: the host hash join wins outright
         if nl + nr < flags.device_join_min_rows:
@@ -2139,6 +2478,8 @@ class MeshExecutor:
             m.left_source_op.stop_time,
             m.right_source_op.start_time,
             m.right_source_op.stop_time,
+            lpred_digest,
+            rpred_digest,
         )
         # A side with no output columns still needs mask+gids lanes on
         # device; stage its (cheap, already-read) first key column.
@@ -2175,12 +2516,14 @@ class MeshExecutor:
         staged_l = self._stage_cached(
             ck_l, lt, m.left_source_op, cols_l,
             _KeyPlan(host_gids=kl.astype(np.int32), num_groups=K),
+            row_sel=left_sel,
         )
         if staged_l is None or staged_l.num_rows != nl:
             return None
         staged_r = self._stage_cached(
             ck_r, rt, m.right_source_op, cols_r,
             _KeyPlan(host_gids=kr.astype(np.int32), num_groups=K),
+            row_sel=right_sel,
         )
         if staged_r is None or staged_r.num_rows != nr:
             return None
@@ -2191,6 +2534,50 @@ class MeshExecutor:
         if out is None:
             return None
         return m.join_nid, out
+
+    def _host_pred_mask(
+        self, preds, relation, table, cols, registry, func_ctx
+    ):
+        """AND of pre-join predicates evaluated on the host over the
+        already-read columns — the same ExpressionEvaluator the host
+        FilterNode runs, so the kept-row set (and its order under
+        boolean-mask selection) is bit-identical to the host plan's
+        pre-join filter. None refuses: missing dictionary, column not
+        read, or an unresolvable UDF sends the join back to the host
+        engine."""
+        from pixie_tpu.types import Relation as _Relation
+
+        needed = set()
+        for p in preds:
+            needed |= referenced_columns(p)
+        if not needed:
+            return None  # constant predicates: host engine's job
+        schemas, batch_cols = [], []
+        for name in sorted(needed):
+            arr = cols.get(name)
+            if arr is None:
+                return None
+            schema = relation.col(name)
+            if schema.data_type == DataType.STRING:
+                d = table.dictionaries.get(name)
+                if d is None:
+                    return None
+                arr = DictColumn(np.asarray(arr).astype(np.int32), d)
+            schemas.append(schema)
+            batch_cols.append(arr)
+        sub_rel = _Relation(schemas)
+        batch = RowBatch(sub_rel, batch_cols)
+        mask = None
+        try:
+            for i, p in enumerate(preds):
+                ev = ExpressionEvaluator(
+                    [(f"p{i}", p)], sub_rel, registry, func_ctx
+                )
+                m2 = ev.evaluate_predicate(batch)
+                mask = m2 if mask is None else (mask & m2)
+        except (ValueError, KeyError):
+            return None
+        return mask
 
     def _run_device_join(
         self, m, lt, rt, staged_l, staged_r, ck_l, ck_r, out_plan,
@@ -2578,11 +2965,19 @@ class MeshExecutor:
         key_plan,
         extra_cols=None,
         f32_cols=None,
+        row_sel=None,
     ):
         """Cache-or-stage with the shared OOM clear-and-retry policy.
         Returns the StagedColumns (staged.num_rows tells callers what the
         cursor actually saw). One implementation for the scan and join
-        paths — three hand-rolled copies drifted in r4 review."""
+        paths — three hand-rolled copies drifted in r4 review.
+
+        ``row_sel`` (r20): a boolean mask over the UNFILTERED read —
+        the join pushdown's host-evaluated pre-join predicates. The
+        selection applies after the read (boolean-mask indexing keeps
+        original row order, matching the host FilterNode), the mask
+        length doubling as the table-moved race guard; ``key_plan``
+        gids are the caller's FILTERED encoding."""
         staged = self._staged_lookup(cache_key)
         if staged is not None:
             return staged
@@ -2593,13 +2988,18 @@ class MeshExecutor:
             src_op.start_time,
             src_op.stop_time,
         )
+        if row_sel is not None:
+            if len(row_sel) != n:
+                return None  # table moved under us
+            cols = {c: np.asarray(a)[row_sel] for c, a in cols.items()}
+            n = int(np.count_nonzero(row_sel))
         for name, arr in (extra_cols or {}).items():
             if len(arr) != n:
                 return None  # table moved under us
             cols[name] = arr
         if key_plan.host_gids is not None and len(key_plan.host_gids) != n:
             return None
-        if not extra_cols:
+        if not extra_cols and row_sel is None:
             # Resident-ingest fast path (r13): assemble the staging from
             # HBM ring windows + a compressed cold tail — the scan/join
             # analogue of the stream loop's per-window substitution.
@@ -5001,7 +5401,7 @@ class MeshExecutor:
 
         r16 widens the compatibility ladder: when this query's
         predicates normalize to data-driven comparison terms
-        (``_normalize_predicates``), a second predicate-ERASED key is
+        (``normalize_predicates``), a second predicate-ERASED key is
         offered to the coordinator — queries matching on everything BUT
         their predicates assemble into one batched dispatch
         (``_run_program_batched``) whose per-slot mask lanes evaluate
@@ -5027,7 +5427,9 @@ class MeshExecutor:
         )
         batch_key = terms = compute_batch = None
         if flags.shared_scan_predicate_batching:
-            terms = self._normalize_predicates(m, evaluator, staged, aux2)
+            terms = normalize_predicates(
+                m.predicates, evaluator, staged, aux2
+            )
         if terms is not None:
             # Shared (predicate-independent) aux: the predicate consts/
             # LUTs ride the term table as data, so they leave both the
@@ -5092,207 +5494,6 @@ class MeshExecutor:
     # executable is keyed by a predicate-ERASED signature plus pow2
     # batch-width/term buckets, and batch composition changes never
     # recompile.
-
-    _CMP_OPS = {
-        "equal": 0, "notEqual": 1,
-        "lessThan": 2, "lessThanEqual": 3,
-        "greaterThan": 4, "greaterThanEqual": 5,
-    }
-    # const-on-the-left flips the comparison, not the operands.
-    _CMP_FLIP = {0: 0, 1: 1, 2: 4, 3: 5, 4: 2, 5: 3}
-
-    def _normalize_predicates(self, m, evaluator, staged, aux):
-        """Lower ``m.predicates`` to conjunctive data terms
-        ``(stack, column, op, int_thr, flt_thr, in_vals)`` — or None
-        when any predicate falls outside the normalizable class (the
-        query then only shares via the identical-signature ladder).
-
-        The class is a direct comparison of a staged column against a
-        constant (either order), a bare boolean column, a conjunction
-        (logical_and splits into more terms), and — r18 — an IN-list:
-        a logical_or tree whose leaves are all ``equal(same_col,
-        const)`` folds into ONE membership term (op 6) whose values
-        ride a per-term LUT lane in the batched fold, so IN-heavy
-        query families join predicate batches instead of falling back
-        to solo folds. Exactness contract per term: int/bool/code
-        columns compare in int64 (every staged int value and
-        dictionary code fits exactly); float columns compare in
-        float64 with the threshold pre-rounded through the column's
-        STAGED dtype (an f32-staged column's serial comparison happens
-        in f32 — float64(f32(c)) preserves both its equalities and its
-        ordering, so the batched mask is bit-equal). Float IN-lists
-        are refused (the serial OR-of-equals is exact, but folding it
-        through one LUT dtype is not worth proving). String constants
-        ride as their dictionary code from the aux table (-1 for
-        unseen: equal to nothing, exactly the serial code-compare
-        semantics — including inside an IN LUT, where -1 matches no
-        row code); columns re-encoded for the cell lane (int_dicts)
-        hold codes the serial path would ALSO compare raw, so they are
-        refused rather than guessed at."""
-        terms = []
-        for p in m.predicates:
-            if not self._normalize_pred(p, evaluator, staged, aux, terms):
-                return None
-        return terms
-
-    def _normalize_pred(self, p, evaluator, staged, aux, terms):
-        """Normalize one predicate tree into ``terms``. True on
-        success; False means the whole batch attempt is refused."""
-        from pixie_tpu.types import DataType
-
-        if isinstance(p, ColumnRef):
-            if (
-                p.name not in staged.blocks
-                or p.name in staged.int_dicts
-                or np.dtype(staged.blocks[p.name].dtype) != np.bool_
-            ):
-                return False
-            terms.append(("i", p.name, 1, 0, 0.0, ()))  # col != 0
-            return True
-        if not isinstance(p, FuncCall) or len(p.args) != 2:
-            return False
-        if p.name == "logical_and":
-            # A conjunction is just more terms.
-            return self._normalize_pred(
-                p.args[0], evaluator, staged, aux, terms
-            ) and self._normalize_pred(
-                p.args[1], evaluator, staged, aux, terms
-            )
-        if p.name == "logical_or":
-            t = self._in_list_term(p, evaluator, staged, aux)
-            if t is None:
-                return False
-            terms.append(t)
-            return True
-        op = self._CMP_OPS.get(p.name)
-        if op is None:
-            return False
-        a0, a1 = p.args
-        if isinstance(a0, ColumnRef) and isinstance(a1, Constant):
-            col, const = a0, a1
-        elif isinstance(a1, ColumnRef) and isinstance(a0, Constant):
-            col, const = a1, a0
-            op = self._CMP_FLIP[op]
-        else:
-            return False
-        if col.name not in staged.blocks or (
-            col.name in staged.int_dicts
-        ):
-            return False
-        resolved = evaluator._resolved.get(id(p))
-        if resolved is None:
-            return False
-        _udf, arg_types = resolved
-        t0 = arg_types[0]
-        bdt = np.dtype(staged.blocks[col.name].dtype)
-        if t0 == DataType.STRING:
-            if op > 1:
-                return False  # only ==/!= have code-space semantics
-            code = aux.get(f"const:{id(const)}")
-            if code is None:
-                return False
-            terms.append(("i", col.name, op, int(code), 0.0, ()))
-        elif t0 == DataType.FLOAT64:
-            v = const.value
-            if not isinstance(
-                v, (int, float, np.floating, np.integer)
-            ) or isinstance(v, bool):
-                return False
-            if bdt == np.float32:
-                thr = float(np.float64(np.float32(v)))
-            elif bdt == np.float64:
-                thr = float(v)
-            else:
-                return False
-            terms.append(("f", col.name, op, 0, thr, ()))
-        elif t0 in (
-            DataType.INT64, DataType.TIME64NS, DataType.BOOLEAN,
-        ):
-            if bdt.kind == "f":
-                return False
-            try:
-                thr = int(const.value)
-            except (TypeError, ValueError):
-                return False
-            if not (-(1 << 63) <= thr < (1 << 63)):
-                return False
-            terms.append(("i", col.name, op, thr, 0.0, ()))
-        else:
-            return False
-        return True
-
-    def _in_list_term(self, p, evaluator, staged, aux):
-        """Fold a ``logical_or`` tree whose leaves are all
-        ``equal(same_col, const)`` into one membership term
-        ``("i", col, 6, 0, 0.0, codes)`` — the compiler lowers
-        ``col in [a, b, ...]`` to exactly this shape. None refuses."""
-        from pixie_tpu.types import DataType
-
-        leaves = []
-        stack = [p]
-        while stack:
-            n = stack.pop()
-            if (
-                isinstance(n, FuncCall)
-                and n.name == "logical_or"
-                and len(n.args) == 2
-            ):
-                stack.extend(n.args)
-            else:
-                leaves.append(n)
-        col_name = None
-        vals = []
-        for leaf in leaves:
-            if (
-                not isinstance(leaf, FuncCall)
-                or leaf.name != "equal"
-                or len(leaf.args) != 2
-            ):
-                return None
-            a0, a1 = leaf.args
-            if isinstance(a0, ColumnRef) and isinstance(a1, Constant):
-                col, const = a0, a1
-            elif isinstance(a1, ColumnRef) and isinstance(a0, Constant):
-                col, const = a1, a0
-            else:
-                return None
-            if col_name is None:
-                col_name = col.name
-            elif col.name != col_name:
-                return None
-            if col.name not in staged.blocks or (
-                col.name in staged.int_dicts
-            ):
-                return None
-            resolved = evaluator._resolved.get(id(leaf))
-            if resolved is None:
-                return None
-            _udf, arg_types = resolved
-            t0 = arg_types[0]
-            if t0 == DataType.STRING:
-                code = aux.get(f"const:{id(const)}")
-                if code is None:
-                    return None
-                vals.append(int(code))
-            elif t0 in (
-                DataType.INT64, DataType.TIME64NS, DataType.BOOLEAN,
-            ):
-                if np.dtype(staged.blocks[col.name].dtype).kind == "f":
-                    return None
-                try:
-                    v = int(const.value)
-                except (TypeError, ValueError):
-                    return None
-                if not (-(1 << 63) <= v < (1 << 63)):
-                    return None
-                vals.append(v)
-            else:
-                return None  # float IN-lists are refused
-        if col_name is None or not vals:
-            return None
-        # Membership is order/multiplicity-insensitive; sort+dedup so
-        # equivalent IN-lists share one slot under the exact-key ladder.
-        return ("i", col_name, 6, 0, 0.0, tuple(sorted(set(vals))))
 
     def _pred_stacks(self, staged):
         """The two dtype-preserving predicate column stacks: int64 for

@@ -65,12 +65,17 @@ _REBALANCE_MOVES = _M.counter(
     "Replica-ring follower reassignments published by the rebalancer.",
 )
 
-# Outcome ladder, most preferred first. latency_fallback and cold
-# share one RANK rung (they are both "no residency" — ranked by load
-# then latency then name, so a fresh agent isn't starved just because
-# a warmer-history one exists); the labels stay distinct for metrics.
-OUTCOMES = ("ring_hit", "replica_hit", "latency_fallback", "cold")
+# Outcome ladder, most preferred first. view_hit (r20) sits ABOVE
+# ring_hit: a query answered from a materialized view's merged state
+# never reaches admission, so no agent ranking happens at all — the
+# broker records it via record_view_hit(), and decide() never returns
+# it. latency_fallback and cold share one RANK rung (they are both
+# "no residency" — ranked by load then latency then name, so a fresh
+# agent isn't starved just because a warmer-history one exists); the
+# labels stay distinct for metrics.
+OUTCOMES = ("view_hit", "ring_hit", "replica_hit", "latency_fallback", "cold")
 _OUTCOME_ORDER = {
+    "view_hit": -1,
     "ring_hit": 0,
     "replica_hit": 1,
     "latency_fallback": 2,
@@ -256,7 +261,26 @@ class PlacementPlane:
                 self._heat[t] += 1
                 self._heat_total[t] += 1
             total = sum(self._outcomes.values())
-            hits = self._outcomes["ring_hit"] + self._outcomes["replica_hit"]
+            hits = (
+                self._outcomes["view_hit"]
+                + self._outcomes["ring_hit"]
+                + self._outcomes["replica_hit"]
+            )
+        _HIT_RATE.set(hits / total if total else 0.0)
+
+    def record_view_hit(self) -> None:
+        """r20: a query served from a materialized view before admission.
+        Top rung of the ladder — counts as a hit (the whole point is
+        that NO agent had to fold), no agent load/affinity to record."""
+        _DECISIONS.inc(outcome="view_hit")
+        with self._lock:
+            self._outcomes["view_hit"] += 1
+            total = sum(self._outcomes.values())
+            hits = (
+                self._outcomes["view_hit"]
+                + self._outcomes["ring_hit"]
+                + self._outcomes["replica_hit"]
+            )
         _HIT_RATE.set(hits / total if total else 0.0)
 
     def release(self, agent_id: str) -> None:
@@ -291,7 +315,11 @@ class PlacementPlane:
             heat = dict(self._heat_total)
             affinity_spans = len(self._affinity)
         total = sum(outcomes.values())
-        hits = outcomes.get("ring_hit", 0) + outcomes.get("replica_hit", 0)
+        hits = (
+            outcomes.get("view_hit", 0)
+            + outcomes.get("ring_hit", 0)
+            + outcomes.get("replica_hit", 0)
+        )
         shares = [c for c in placed.values() if c > 0]
         return {
             "decisions": {o: int(outcomes.get(o, 0)) for o in OUTCOMES},

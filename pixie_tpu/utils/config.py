@@ -674,3 +674,39 @@ define_flag(
     "degraded.skipped with reason breaker_open) instead of discovering "
     "them sick mid-query. Half-open breakers plan normally.",
 )
+
+# -- materialized views (r20) ------------------------------------------------
+define_flag(
+    "materialized_views",
+    False,
+    help_="Incremental materialized-view plane (serving/views.py + "
+    "vizier/broker.py): registered PxL aggregation scripts are "
+    "maintained by folding only new-since-watermark rows into "
+    "persisted partial-agg state (StateBatch codec, datastore-backed "
+    "like SLO rules / the admission controller), and "
+    "QueryBroker.execute_script answers view-matching queries (fold "
+    "signature + normalized predicate digest) from the merged state "
+    "BEFORE admission ever queues them — a view_hit rung above "
+    "ring_hit on the placement ladder. Reads merge the carried state "
+    "with a delta fold over the unflushed tail and finalize, "
+    "bit-identical to folding from scratch; freshness is stamped on "
+    "every served QueryResult. Off: the probe short-circuits to a "
+    "single attribute check on the query path.",
+)
+define_flag(
+    "view_refresh_interval_s",
+    1.0,
+    help_="Default maintenance cadence for registered views: each "
+    "view's CronScript ticker folds the new-since-watermark rows into "
+    "the carried StateBatch and persists state + watermark every this "
+    "many seconds (per-view override at register()).",
+)
+define_flag(
+    "view_max_staleness_s",
+    30.0,
+    help_="Stale-view rail: when a view's last successful maintenance "
+    "is older than this (maintenance wedged, breaker open, agent "
+    "restarted long ago), the probe reports a miss and the query "
+    "falls through to normal admission + execution instead of paying "
+    "an unbounded tail fold on the read path. 0 disables the rail.",
+)

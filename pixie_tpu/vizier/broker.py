@@ -448,6 +448,12 @@ class QueryBroker:
         # the r10 on_event degradation events).
         self.slo = None
         self._alert_listeners: list = []
+        # r20: materialized-view plane (flag materialized_views) —
+        # registered aggregation scripts maintained as persisted
+        # partial-agg state; matching queries are served from the
+        # merged state BEFORE admission. Explicit start via
+        # start_views() (needs a table store to fold against).
+        self.views = None
 
     def start_admission_controller(self, datastore=None):
         """Attach the r16 closed-loop admission controller
@@ -491,6 +497,23 @@ class QueryBroker:
         )
         self.ring_rebalancer.start(interval_s)
         return self.ring_rebalancer
+
+    def start_views(self, table_store, datastore=None):
+        """Attach the r20 materialized-view plane (serving/views.py):
+        view definitions persist as CronScripts in their own keyspace
+        (``/view_scripts/``) on their own runner — restart-surviving
+        like the r15 SLO rules and the r16 controller — and carried
+        partial-agg state persists under ``/view_state/``, so a
+        recovered broker's first read folds only the unflushed tail.
+        Idempotent; returns the registry."""
+        if self.views is not None:
+            return self.views
+        from pixie_tpu.serving.views import ViewRegistry
+
+        self.views = ViewRegistry(
+            self, table_store, datastore=datastore
+        ).attach()
+        return self.views
 
     # -- SLO alert fan-out (r15) --------------------------------------------
     def add_alert_listener(self, fn) -> None:
@@ -559,9 +582,24 @@ class QueryBroker:
                     if self.placement is not None
                     else None
                 ),
+                # r20: materialized-view plane — per-view watermark,
+                # staleness, hit counts, breaker state.
+                "views": (
+                    self.views.status()
+                    if self.views is not None
+                    else None
+                ),
             },
             extra_routes={
                 "/agentz": lambda: self.tracker.agents_snapshot(),
+                # r20: the view plane's own route (empty shell when no
+                # registry is attached, so the route always exists).
+                "/viewz": lambda: (
+                    self.views.status()
+                    if self.views is not None
+                    else {"enabled": False, "views": [],
+                          "hits": 0, "misses": 0, "hit_rate": 0.0}
+                ),
                 # r15: live SLO rule + alert status (empty shell when no
                 # SLOManager is attached, so the route always exists).
                 "/alertz": lambda: (
@@ -785,7 +823,27 @@ class QueryBroker:
         with per-tenant weighted fair queueing (``tenant`` is the WFQ
         key) and an HBM byte-budget check — on overload it raises a
         structured ``AdmissionRejected`` instead of queueing without
-        bound. Flag off: straight through, the pre-r12 behavior."""
+        bound. Flag off: straight through, the pre-r12 behavior.
+
+        r20: with ``flags.materialized_views`` and an attached view
+        plane, plain queries (no args/exec_funcs/analyze/streaming)
+        probe the ViewRegistry FIRST — a fresh matching view answers
+        from its merged partial-agg state before admission ever queues
+        the query (``view_hit``, the top rung of the placement
+        ladder)."""
+        if (
+            self.views is not None
+            and flags.materialized_views
+            and not script_args
+            and not exec_funcs
+            and not analyze
+            and on_batch is None
+        ):
+            served = self.views.try_serve(query, tenant=tenant)
+            if served is not None:
+                if self.placement is not None:
+                    self.placement.record_view_hit()
+                return served
         if not flags.serving_enabled:
             # Tenant still threads through (r15): attribution and the
             # per-tenant serving metrics don't require admission control.
@@ -1629,6 +1687,9 @@ class QueryBroker:
         if self.ring_rebalancer is not None:
             self.ring_rebalancer.stop()
             self.ring_rebalancer = None
+        if self.views is not None:
+            self.views.stop()
+            self.views = None
         self.tracker.stop()
         if self._health_srv is not None:
             self._health_srv.stop()

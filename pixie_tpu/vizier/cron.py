@@ -63,21 +63,28 @@ class CronScript:
 
 class CronScriptStore:
     """Persisted cron-script set (ref: metadata controllers/cronscript/
-    backed by the datastore; survives broker restarts)."""
+    backed by the datastore; survives broker restarts).
 
-    def __init__(self, datastore: Datastore):
+    ``prefix`` namespaces the stored set: planes that ride the same
+    ticker machinery but own a different script population (r20: the
+    materialized-view registry's maintenance scripts) get their own
+    keyspace instead of leaking into the default cron set — a runner
+    syncing ``/cron_scripts/`` must never tick a view script."""
+
+    def __init__(self, datastore: Datastore, prefix: str = _PREFIX):
         self._ds = datastore
+        self._prefix = prefix
 
     def upsert(self, script: CronScript) -> None:
-        self._ds.set(_PREFIX + script.script_id, script.to_json())
+        self._ds.set(self._prefix + script.script_id, script.to_json())
 
     def delete(self, script_id: str) -> None:
-        self._ds.delete(_PREFIX + script_id)
+        self._ds.delete(self._prefix + script_id)
 
     def all(self) -> dict[str, CronScript]:
         return {
-            k[len(_PREFIX) :]: CronScript.from_json(v)
-            for k, v in self._ds.get_prefix(_PREFIX)
+            k[len(self._prefix) :]: CronScript.from_json(v)
+            for k, v in self._ds.get_prefix(self._prefix)
         }
 
 

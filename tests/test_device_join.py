@@ -206,14 +206,45 @@ def test_device_join_flag_off_falls_back(mesh, flagset):
     assert _canon(rows_d)[0] == _canon(rows_h)[0]
 
 
-def test_device_join_prejoin_filter_falls_back(mesh, flagset):
-    """v1 gate: pre-join predicates keep the join on the host engine,
-    bit-identical."""
+@pytest.mark.parametrize("how", ["inner", "left", "outer"])
+def test_device_join_prejoin_filter_pushdown(mesh, flagset, how):
+    """r20: normalizable pre-join predicates no longer refuse — each
+    side filters on the host (same FilterNode mask, same order) before
+    staging, and the device merge runs on the filtered sides,
+    bit-identical to the host plan."""
     flagset("device_join_min_rows", 0)
     q = (
         "l = px.DataFrame(table='lhs')\n"
         "r = px.DataFrame(table='rhs')\n"
+        "l = l[l.code == 2]\n"
         "r = r[r.cost > 100.0]\n"
+        f"j = l.merge(r, how='{how}', left_on=['svc'],"
+        " right_on=['svc2'], suffixes=['', '_r'])\n"
+        "px.display(j, 'out')\n"
+    )
+    cd, rows_d, rows_h = run_both(mesh, q)
+    assert any(
+        s.startswith("join|") for s in cd.device_executor._program_cache
+    )
+    canon_d = _canon(rows_d)
+    canon_h = _canon(rows_h)
+    assert canon_d[0] == canon_h[0]
+    if how in ("inner", "left"):
+        # Row-order exactness survives the pushdown for the ordered
+        # variants (boolean-mask selection is stable).
+        assert {k: list(v) for k, v in rows_d.items()} == {
+            k: list(v) for k, v in rows_h.items()
+        }
+
+
+def test_device_join_prejoin_filter_unsupported_falls_back(mesh, flagset):
+    """A pre-join predicate outside the normalizable class (column vs
+    column) still refuses to the host engine, bit-identical."""
+    flagset("device_join_min_rows", 0)
+    q = (
+        "l = px.DataFrame(table='lhs')\n"
+        "r = px.DataFrame(table='rhs')\n"
+        "r = r[r.cost > r.time_]\n"
         "j = l.merge(r, how='inner', left_on=['svc'], right_on=['svc2'],"
         " suffixes=['', '_r'])\n"
         "px.display(j, 'out')\n"

@@ -1,0 +1,326 @@
+"""Materialized-view plane tests (r20).
+
+The contract under test: a registered PxL aggregation is maintained as
+persisted partial-agg state folded forward from a watermark, and every
+view-served read — merged carried state ⊕ unflushed-tail delta fold —
+is BIT-IDENTICAL to executing the script from scratch, across the UDA
+lanes (count / sum / HLL / count-min sketches), under concurrent
+appends, and across a broker restart (datastore-recovered state, zero
+full refold). A stale or digest-mismatched probe falls through to
+normal admission, untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec.router import BridgeRouter
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.table.table_store import TableStore
+from pixie_tpu.types import DataType, Relation, SemanticType
+from pixie_tpu.utils import flags
+from pixie_tpu.vizier.agent import Agent
+from pixie_tpu.vizier.broker import QueryBroker
+from pixie_tpu.vizier.bus import MessageBus
+from pixie_tpu.vizier.datastore import Datastore
+
+REL = Relation.of(
+    ("time_", DataType.TIME64NS, SemanticType.ST_TIME_NS),
+    ("service", DataType.STRING),
+    ("status", DataType.INT64),
+    ("lat", DataType.FLOAT64),
+)
+
+N = 4000
+
+# All four UDA state families: scalar count, scalar sum, HLL register
+# set, count-min cells — the r6 mergeable lanes the view plane carries.
+QUERY = (
+    "df = px.DataFrame(table='http')\n"
+    "df = df[df.status == 200]\n"
+    "s = df.groupby(['service']).agg(\n"
+    "    n=('time_', px.count),\n"
+    "    tot=('lat', px.sum),\n"
+    "    u=('status', px.approx_count_distinct),\n"
+    "    cm=('status', px.count_min),\n"
+    ")\n"
+    "px.display(s, 'out')\n"
+)
+
+
+def _rows(rng, n, start=0):
+    # Integer-valued float64 latencies: float sums stay EXACT under any
+    # fold grouping, so carried+delta merge is bit-identical to scratch.
+    return {
+        "time_": np.arange(start, start + n, dtype=np.int64) * 10,
+        "service": rng.choice(
+            [f"s{i}" for i in range(6)], n
+        ).astype(object),
+        "status": rng.choice([200, 404, 500], n),
+        "lat": np.floor(rng.exponential(3e7, n)),
+    }
+
+
+@pytest.fixture
+def flagset():
+    saved = {}
+
+    def set_(name, value):
+        if name not in saved:
+            saved[name] = flags.get(name)
+        flags.set(name, value)
+
+    yield set_
+    for name, value in saved.items():
+        flags.set(name, value)
+
+
+@pytest.fixture
+def cluster():
+    store = TableStore()
+    t = store.create_table("http", REL)
+    t.write_pydict(_rows(np.random.default_rng(3), N))
+    bus = MessageBus()
+    router = BridgeRouter()
+    agent = Agent("pem0", bus, router, table_store=store)
+    agent.start()
+    kelvin = Agent("kelvin", bus, router, is_kelvin=True)
+    kelvin.start()
+    broker = QueryBroker(bus, router, table_relations={"http": REL})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if len(broker.tracker.distributed_state().agents) >= 2:
+            break
+        time.sleep(0.02)
+    yield broker, store, t
+    broker.stop()
+    agent.stop()
+    kelvin.stop()
+
+
+def _pydict(result, table="out"):
+    batches = [b for b in result.tables[table] if b.num_rows]
+    if not batches:
+        return result.tables[table][0].to_pydict()
+    return RowBatch.concat(batches).to_pydict()
+
+
+def _scratch(broker, query):
+    """Execute through the normal path with the view probe off."""
+    saved = flags.get("materialized_views")
+    flags.set("materialized_views", False)
+    try:
+        return _pydict(broker.execute_script(query))
+    finally:
+        flags.set("materialized_views", saved)
+
+
+def test_view_bit_identical_across_uda_lanes(cluster, flagset):
+    broker, store, t = cluster
+    flagset("materialized_views", True)
+    scratch = _scratch(broker, QUERY)
+    broker.start_views(store, datastore=Datastore())
+    broker.views.register(QUERY, name="lanes", refresh_interval_s=30)
+    res = broker.execute_script(QUERY)
+    assert res.view is not None, "expected a view-served result"
+    assert res.view["view"] == "lanes"
+    assert res.view["tail_rows"] == 0
+    # Bit-identical: values AND group emission order, including the
+    # serialized HLL/count-min sketch states.
+    assert _pydict(res) == scratch
+    # The hit rode the placement ladder's new top rung.
+    assert broker.views.status()["hits"] == 1
+
+
+def test_view_tail_fold_and_watermark_under_concurrent_appends(
+    cluster, flagset
+):
+    broker, store, t = cluster
+    flagset("materialized_views", True)
+    broker.start_views(store, datastore=Datastore())
+    broker.views.register(QUERY, name="con", refresh_interval_s=0.05)
+    view = next(iter(broker.views._views.values()))
+    assert view.watermark == N  # synchronous first maintenance
+
+    stop = threading.Event()
+    appended = [0]
+
+    def writer():
+        rng = np.random.default_rng(11)
+        while not stop.is_set() and appended[0] < 2000:
+            t.write_pydict(_rows(rng, 100, start=N + appended[0]))
+            appended[0] += 100
+            time.sleep(0.005)
+
+    th = threading.Thread(target=writer)
+    th.start()
+    try:
+        # Reads during the append storm serve and stay self-consistent
+        # (merged state at SOME snapshot ≤ end at read time).
+        for _ in range(5):
+            res = broker.execute_script(QUERY)
+            assert res.view is not None
+            time.sleep(0.02)
+    finally:
+        stop.set()
+        th.join()
+    # Watermark advances past the initial snapshot via ticks.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if view.watermark >= N + appended[0]:
+            break
+        time.sleep(0.02)
+    assert view.watermark == N + appended[0]
+    # Quiesced: the view answer equals the from-scratch fold exactly.
+    res = broker.execute_script(QUERY)
+    assert res.view is not None
+    assert _pydict(res) == _scratch(broker, QUERY)
+
+
+def test_view_restart_survival_zero_full_refold(cluster, flagset):
+    broker, store, t = cluster
+    flagset("materialized_views", True)
+    ds = Datastore()
+    broker.start_views(store, datastore=ds)
+    broker.views.register(QUERY, name="surv", refresh_interval_s=30)
+    scratch = _scratch(broker, QUERY)
+    broker.views.stop()
+    broker.views = None  # the broker "dies"
+
+    # A new broker over the SAME datastore recovers definitions + state.
+    bus2 = MessageBus()
+    broker2 = QueryBroker(bus2, BridgeRouter(), table_relations={"http": REL})
+    try:
+        broker2.start_views(store, datastore=ds)
+        view = next(iter(broker2.views._views.values()))
+        assert view.watermark == N
+        assert view.state is not None and view.state.num_groups > 0
+
+        # Zero full refold: the first read must not re-read any row
+        # below the recovered watermark.
+        reads = []
+        orig = t._read_from
+
+        def counting_read(row_id, max_rows, start_time, stop_time):
+            reads.append(row_id)
+            return orig(row_id, max_rows, start_time, stop_time)
+
+        t._read_from = counting_read
+        try:
+            res = broker2.execute_script(QUERY)
+        finally:
+            t._read_from = orig
+        assert res.view is not None
+        assert res.view["tail_rows"] == 0
+        assert reads == []  # watermark == end: not one row re-read
+        assert _pydict(res) == scratch
+    finally:
+        broker2.stop()
+
+
+def test_stale_view_falls_back_to_normal_admission(cluster, flagset):
+    broker, store, t = cluster
+    flagset("materialized_views", True)
+    flagset("view_max_staleness_s", 0.05)
+    broker.start_views(store, datastore=Datastore())
+    broker.views.register(QUERY, name="stale", refresh_interval_s=30)
+    time.sleep(0.12)  # age past the staleness rail; no tick for 30s
+    res = broker.execute_script(QUERY)
+    # Fell through the probe: executed normally, still correct.
+    assert res.view is None
+    assert broker.views.misses >= 1
+    assert _pydict(res) == _scratch(broker, QUERY)
+
+
+def test_predicate_digest_mismatch_misses(cluster, flagset):
+    broker, store, t = cluster
+    flagset("materialized_views", True)
+    broker.start_views(store, datastore=Datastore())
+    broker.views.register(QUERY, name="p200", refresh_interval_s=30)
+    q404 = QUERY.replace("df.status == 200", "df.status == 404")
+    res = broker.execute_script(q404)
+    # Same fold signature, different predicate digest: MUST miss.
+    assert res.view is None
+    assert _pydict(res) == _scratch(broker, q404)
+    # And the view itself still serves its own predicate.
+    res200 = broker.execute_script(QUERY)
+    assert res200.view is not None
+
+
+def test_renamed_outputs_match_same_view(cluster, flagset):
+    """The r7 posture: fold identity excludes output names. A query
+    differing ONLY in output naming is served from the same view, with
+    the state remapped to ITS names."""
+    broker, store, t = cluster
+    flagset("materialized_views", True)
+    broker.start_views(store, datastore=Datastore())
+    broker.views.register(QUERY, name="orig", refresh_interval_s=30)
+    renamed = (
+        QUERY
+        .replace("n=('time_'", "cnt=('time_'")
+        .replace("tot=('lat'", "total=('lat'")
+    )
+    scratch = _scratch(broker, renamed)
+    res = broker.execute_script(renamed)
+    assert res.view is not None
+    got = _pydict(res)
+    assert set(got) == {"service", "cnt", "total", "u", "cm"}
+    assert got == scratch
+
+
+def test_view_breaker_opens_on_maintenance_faults(cluster, flagset):
+    """views.maintain fault site: consecutive maintenance failures open
+    the per-view breaker — an open breaker serves NOTHING (fall through
+    to normal admission) until a clean tick closes it."""
+    from pixie_tpu.utils import faults
+    from pixie_tpu.vizier.cron import CronScript
+
+    broker, store, t = cluster
+    flagset("materialized_views", True)
+    broker.start_views(store, datastore=Datastore())
+    vid = broker.views.register(QUERY, name="brk", refresh_interval_s=30)
+    view = broker.views._views[vid]
+    cs = CronScript(vid, QUERY, 30, {"name": "brk"})
+    try:
+        faults.arm("views.maintain")
+        for _ in range(3):
+            with pytest.raises(faults.FaultInjectedError):
+                broker.views._tick(cs)
+        assert view.breaker_open
+        res = broker.execute_script(QUERY)
+        assert res.view is None  # breaker open: normal path, correct
+        assert _pydict(res) == _scratch(broker, QUERY)
+    finally:
+        faults.reset()
+    # A clean tick closes the breaker and serving resumes.
+    broker.views._tick(cs)
+    assert not view.breaker_open
+    assert broker.execute_script(QUERY).view is not None
+
+
+def test_time_bucket_view_serves_windowed_aggregation(cluster, flagset):
+    """Windowed aggregation as the special case: a px.bin time-bucket
+    group key is just another composed group expression — one state row
+    per bucket, maintained and served like any other view."""
+    broker, store, t = cluster
+    q = (
+        "df = px.DataFrame(table='http')\n"
+        "df.bucket = px.bin(df.time_, 5000)\n"
+        "s = df.groupby(['bucket']).agg(\n"
+        "    n=('time_', px.count),\n"
+        "    tot=('lat', px.sum),\n"
+        ")\n"
+        "px.display(s, 'out')\n"
+    )
+    flagset("materialized_views", True)
+    scratch = _scratch(broker, q)
+    broker.start_views(store, datastore=Datastore())
+    broker.views.register(q, name="buckets", refresh_interval_s=30)
+    res = broker.execute_script(q)
+    assert res.view is not None
+    assert _pydict(res) == scratch
+    assert len(scratch["bucket"]) > 1  # actually bucketed

@@ -31,6 +31,11 @@ warm fold with attribution DISABLED (bare ``ACTIVE`` branches at the
 dispatch recorders, attribution contexts, and residency usage sampling;
 the transport path has zero attribution hooks).
 
+Also gates (r20) the materialized-view probe: <1% modeled on the warm
+broker query for a script NO view serves — with a live registry and a
+registered decoy view, the non-view path pays one flag check plus a
+probe-cache lookup resolving to a cached miss entry.
+
 Prints ONE JSON line on stdout. With MB_WRITE_BENCH_DETAIL=1, merges the
 headline numbers into BENCH_DETAIL.json under the ``fault_overhead``,
 ``ack_overhead``, ``trace_overhead``, ``durability_overhead`` and
@@ -537,6 +542,68 @@ def main() -> None:
     run_broker_warm(3)
     broker_on_ns = run_broker_warm(warm_runs)
     flags.set("fragment_failover", saved_fo)
+
+    # -- materialized-view probe overhead (r20) ------------------------------
+    # The view probe sits ABOVE admission on every broker query. On the
+    # NON-view path its steady-state cost is one flag check plus a
+    # probe-cache lookup resolving to a cached miss entry (the compile
+    # happens once per distinct script text). Modeled like the other
+    # gates: per-probe ns on a warm cached miss — measured with a LIVE
+    # registry holding a registered view the query does not match —
+    # over the warm broker query time, gated <1%; plus an off-vs-on A/B
+    # of the full broker query as the direct check.
+    from pixie_tpu.vizier.datastore import Datastore as _Datastore
+
+    saved_mv = flags.get("materialized_views")
+    flags.set("materialized_views", False)
+    run_broker_warm(3)
+    views_off_ns = run_broker_warm(warm_runs)
+    flags.set("materialized_views", True)
+    fo_broker.start_views(c.table_store, datastore=_Datastore())
+    # A decoy view over the same table with a different fold signature
+    # and predicate digest: the measured query probes and MISSES.
+    fo_broker.views.register(
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[df.service == 'a']\n"
+        "s = df.groupby(['service']).agg(n=('latency', px.count))\n"
+        "px.display(s, 'out')\n",
+        name="mb-decoy",
+    )
+    r_probe = fo_broker.execute_script(query, timeout_s=30)
+    assert r_probe.view is None, "decoy view must not serve the query"
+
+    def _views_probe_ns(iters: int = 20_000) -> float:
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            if fo_broker.views.try_serve(query) is not None:
+                raise AssertionError
+        return (time.perf_counter_ns() - t0) / iters
+
+    _views_probe_ns(1_000)  # warm the probe cache's miss entry
+    views_probe_ns = _views_probe_ns()
+    run_broker_warm(3)
+    views_on_ns = run_broker_warm(warm_runs)
+    flags.set("materialized_views", saved_mv)
+    views_modeled_pct = 100.0 * views_probe_ns / views_off_ns
+    views_overhead = {
+        "probe_miss_ns": round(views_probe_ns, 1),
+        "warm_probes_per_query": 1,
+        "warm_disabled_modeled_pct": round(views_modeled_pct, 5),
+        "broker_query_views_off_ms": round(views_off_ns / 1e6, 3),
+        "broker_query_views_on_ms": round(views_on_ns / 1e6, 3),
+        "views_on_delta_pct": round(
+            100.0 * (views_on_ns - views_off_ns) / views_off_ns, 3
+        ),
+        "pass_under_1pct": bool(views_modeled_pct < 1.0),
+    }
+    log(
+        f"views: probe miss {views_probe_ns:.0f}ns -> "
+        f"{views_modeled_pct:.4f}% modeled on the non-view path; broker "
+        f"warm {views_overhead['broker_query_views_off_ms']}ms off vs "
+        f"{views_overhead['broker_query_views_on_ms']}ms on "
+        f"({views_overhead['views_on_delta_pct']:+.1f}%)"
+    )
+
     fo_broker.stop()
     for a in fo_agents:
         a.stop()
@@ -601,6 +668,7 @@ def main() -> None:
             and durability_overhead["pass_under_1pct"]
             and profiler_overhead["pass_under_1pct"]
             and failover_overhead["pass_under_1pct"]
+            and views_overhead["pass_under_1pct"]
         ),
         "platform": jax.devices()[0].platform,
     }
@@ -609,6 +677,7 @@ def main() -> None:
     out["durability_overhead"] = durability_overhead
     out["profiler_overhead"] = profiler_overhead
     out["failover_overhead"] = failover_overhead
+    out["views_overhead"] = views_overhead
     print(json.dumps(out))
 
     if os.environ.get("MB_WRITE_BENCH_DETAIL") == "1":
@@ -621,7 +690,7 @@ def main() -> None:
             if k not in (
                 "ack_overhead", "trace_overhead",
                 "durability_overhead", "profiler_overhead",
-                "failover_overhead",
+                "failover_overhead", "views_overhead",
             )
         }
         detail["ack_overhead"] = ack_overhead
@@ -629,13 +698,14 @@ def main() -> None:
         detail["durability_overhead"] = durability_overhead
         detail["profiler_overhead"] = profiler_overhead
         detail["failover_overhead"] = failover_overhead
+        detail["views_overhead"] = views_overhead
         with open(path, "w") as f:
             json.dump(detail, f, indent=1)
             f.write("\n")
         log(
             "BENCH_DETAIL.json updated (fault_overhead, ack_overhead, "
             "trace_overhead, durability_overhead, profiler_overhead, "
-            "failover_overhead)"
+            "failover_overhead, views_overhead)"
         )
 
     if not out["pass_under_1pct"]:
