@@ -128,6 +128,13 @@ reduction >= 5x vs the views-off one-fold-per-request cost, with the
 in-run bit-identity verify as the correctness gate; the full block
 lands in BENCH_DETAIL.json's ``views`` key.
 
+Mesh execution (r21): config 10 (opt-in, BENCH_CONFIGS=...,10) sweeps
+the fold over mesh widths (hosts:1/2/4/8 re-partitioning the same
+device pool) through tools/microbench_mesh.py: bit-identity at every
+width is the correctness gate, the always-present ``mesh_scaling_x``
+headline (per-device fold rate at width 4 vs 1-host) must stay >= 0.7,
+and the sweep lands in BENCH_DETAIL.json's ``mesh`` key.
+
 Env knobs: BENCH_ROWS (configs 2/5; default 256M), BENCH_SMALL_ROWS
 (configs 1/3/4; default 64M), BENCH_HOST_ROWS (config 0; default 8M),
 BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS (comma list, default
@@ -325,7 +332,7 @@ def main() -> None:
         if c.strip()
     ]
     unknown = set(order) - {
-        "0", "1", "2", "3", "4", "5", "6", "7", "8", "9",
+        "0", "1", "2", "3", "4", "5", "6", "7", "8", "9", "10",
     }
     if unknown:
         raise SystemExit(f"BENCH_CONFIGS has unknown entries: {unknown}")
@@ -1198,6 +1205,43 @@ def main() -> None:
         # BENCH_DETAIL.json's ``views`` key after the ledger flush.
         soak_serving.record_views_detail(report)
 
+    # ---- config 10: multi-host mesh fold scaling (r21) --------------------
+    def run_config_10():
+        # Mesh-width sweep through the full engine path: every width
+        # must reproduce the 1-host fold bit-exactly (asserted inside
+        # the sweep), and the per-device fold rate at width 4 must stay
+        # within 30% of 1-host — the r21 acceptance bar. Opt-in via
+        # BENCH_CONFIGS=...,10.
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import microbench_mesh
+
+        summary = microbench_mesh.run_mesh_bench(
+            rows=int(os.environ.get("BENCH_MESH_ROWS", 200_000)),
+            runs=runs,
+        )
+        assert summary["mesh_scaling_x"] >= 0.7, summary
+        ledger.add(
+            {
+                "config": 10,
+                "mesh_widths": [e["hosts"] for e in summary["widths"]],
+                "per_device_mrows_s": {
+                    str(e["hosts"]): e["per_device_mrows_s"]
+                    for e in summary["widths"]
+                },
+                "combine_overhead_pct": {
+                    str(e["hosts"]): e["combine_overhead_pct"]
+                    for e in summary["widths"]
+                },
+                # Always-present headline: a mesh regression shows up as
+                # a sub-0.7 scaling number here, never a silent slowdown.
+                "mesh_scaling_x": summary["mesh_scaling_x"],
+                "metric": "mesh_per_device_fold_scaling_x",
+                "value": summary["mesh_scaling_x"],
+                "unit": "x_vs_1host_at_width_4",
+            }
+        )
+        microbench_mesh.record_mesh_detail(summary)
+
     runners = {
         "0": run_config_0,
         "1": run_config_1,
@@ -1209,6 +1253,7 @@ def main() -> None:
         "7": run_config_7,
         "8": run_config_8,
         "9": run_config_9,
+        "10": run_config_10,
     }
     ran = set()
     for c in order:  # BENCH_CONFIGS order IS the execution order

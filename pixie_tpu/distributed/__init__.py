@@ -22,5 +22,17 @@ from pixie_tpu.distributed.planner import (
     DistributedPlanner,
     DistributedState,
 )
+from pixie_tpu.distributed.mesh import (
+    MeshConfig,
+    match_partition_rules,
+    resolve_mesh,
+)
 
-__all__ = ["AgentInfo", "DistributedPlanner", "DistributedState"]
+__all__ = [
+    "AgentInfo",
+    "DistributedPlanner",
+    "DistributedState",
+    "MeshConfig",
+    "match_partition_rules",
+    "resolve_mesh",
+]

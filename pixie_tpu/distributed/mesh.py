@@ -1,0 +1,229 @@
+"""Mesh geometry + partition rules for multi-host fold execution.
+
+Ref: the SNIPPETS.md pjit/shard_map exemplars — a partition-rule tree
+(regex → ``PartitionSpec``) resolved per named array, plus small
+helpers that wrap a ``jax.sharding.Mesh`` into per-array
+``NamedSharding``s. This module is the single source of truth for
+mesh *geometry*: axis names and sizes are declared here, carried into
+every r7 program signature (``MeshConfig.signature()``), and used by
+the staging layer to place blocks/masks/gids across ALL mesh axes
+while aux/LUT/env values replicate.
+
+Geometry model: the mesh is a tuple of named axes, outermost first.
+A flat single-host mesh is ``d:<ndev>`` — the 1-host special case.
+A simulated (or real) multi-host mesh prefixes a ``hosts`` axis, e.g.
+``hosts:2,d:4``. Data arrays shard their leading (device) dimension
+over the *full* axis tuple; collectives reduce/gather over the full
+tuple, which is bit-identical to the flat mesh because XLA's
+row-major device order makes ``all_gather(x, ("hosts", "d"))`` and a
+fused ``psum(x, ("hosts", "d"))`` coincide with their flat-axis
+counterparts (verified under --xla_force_host_platform_device_count).
+The ``hosts`` axis only changes behavior where code *asks* for it:
+the partitioned join gathers within ``inner_axes()`` (per-host) and
+concatenates shard outputs across ``host_axis()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Optional, Sequence
+
+import numpy as np
+
+from pixie_tpu.utils import flags
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh geometry: ((axis_name, size), ...) outermost first."""
+
+    axes: tuple  # tuple[tuple[str, int], ...]
+
+    def __post_init__(self):
+        if not self.axes:
+            raise ValueError("MeshConfig needs at least one axis")
+        names = [n for n, _ in self.axes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate mesh axis names: {names}")
+        for name, size in self.axes:
+            if not isinstance(size, int) or size < 1:
+                raise ValueError(f"bad mesh axis {name}:{size}")
+
+    @property
+    def names(self) -> tuple:
+        return tuple(n for n, _ in self.axes)
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(s for _, s in self.axes)
+
+    @property
+    def total_devices(self) -> int:
+        return int(math.prod(self.shape))
+
+    def signature(self) -> str:
+        """Geometry string embedded in r7 program signatures."""
+        return ",".join(f"{n}:{s}" for n, s in self.axes)
+
+    @staticmethod
+    def flat(ndev: int) -> "MeshConfig":
+        return MeshConfig(axes=(("d", int(ndev)),))
+
+    @staticmethod
+    def parse(spec: str, ndev: int) -> "MeshConfig":
+        """Parse 'hosts:2,d:4' (one size may be -1 = fill remaining)."""
+        spec = (spec or "").strip()
+        if not spec:
+            return MeshConfig.flat(ndev)
+        axes = []
+        for part in spec.split(","):
+            part = part.strip()
+            m = re.fullmatch(r"([A-Za-z_][A-Za-z0-9_]*)\s*:\s*(-?\d+)", part)
+            if not m:
+                raise ValueError(f"bad mesh axis spec {part!r} in {spec!r}")
+            axes.append((m.group(1), int(m.group(2))))
+        wild = [i for i, (_, s) in enumerate(axes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed: {spec!r}")
+        if wild:
+            known = math.prod(s for _, s in axes if s != -1)
+            if known <= 0 or ndev % known:
+                raise ValueError(
+                    f"mesh {spec!r} does not divide {ndev} devices"
+                )
+            name, _ = axes[wild[0]]
+            axes[wild[0]] = (name, ndev // known)
+        cfg = MeshConfig(axes=tuple(axes))
+        if cfg.total_devices != ndev:
+            raise ValueError(
+                f"mesh {spec!r} wants {cfg.total_devices} devices, "
+                f"have {ndev}"
+            )
+        return cfg
+
+    @staticmethod
+    def of_mesh(mesh) -> "MeshConfig":
+        """Derive the config of an existing jax Mesh."""
+        return MeshConfig(
+            axes=tuple(zip(tuple(mesh.axis_names), tuple(mesh.devices.shape)))
+        )
+
+    @staticmethod
+    def from_flags(ndev: int) -> "MeshConfig":
+        return MeshConfig.parse(flags.mesh_axes, ndev)
+
+    def build(self, devices: Optional[Sequence] = None):
+        """Materialize a jax.sharding.Mesh with this geometry."""
+        import jax
+        from jax.sharding import Mesh
+
+        devs = np.array(list(devices) if devices is not None else jax.devices())
+        if devs.size != self.total_devices:
+            raise ValueError(
+                f"mesh {self.signature()} wants {self.total_devices} "
+                f"devices, have {devs.size}"
+            )
+        return Mesh(devs.reshape(self.shape), self.names)
+
+
+def resolve_mesh(mesh=None, mesh_config: Optional[MeshConfig] = None):
+    """(mesh, config) from whichever the caller has; flags fill gaps.
+
+    - mesh given: config derived from it (explicit mesh wins).
+    - config given: mesh built over all local devices.
+    - neither: geometry comes from the ``mesh_axes`` flag (flat default).
+    """
+    import jax
+
+    if mesh is not None:
+        return mesh, MeshConfig.of_mesh(mesh)
+    if mesh_config is None:
+        mesh_config = MeshConfig.from_flags(len(jax.devices()))
+    return mesh_config.build(), mesh_config
+
+
+# ---------------------------------------------------------------------------
+# Partition-rule helpers (SNIPPETS-style rule trees → per-array shardings)
+# ---------------------------------------------------------------------------
+
+
+def data_axes(mesh) -> tuple:
+    """All mesh axis names, outermost first — the data-sharding tuple."""
+    return tuple(mesh.axis_names)
+
+
+def host_axis(mesh) -> str:
+    """The outermost axis — shard boundary for partitioned work."""
+    return tuple(mesh.axis_names)[0]
+
+
+def inner_axes(mesh) -> tuple:
+    """Axes within one host (empty on a 1-axis mesh)."""
+    return tuple(mesh.axis_names)[1:]
+
+
+def data_spec(mesh):
+    """PartitionSpec sharding dim 0 over every mesh axis."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(data_axes(mesh))
+
+
+def data_sharding(mesh):
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, data_spec(mesh))
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+# Default rule tree for staged fold inputs: blocks/mask/gids carry the
+# row dimension and shard across the full mesh; everything else
+# (env/LUT/aux/dictionary-derived values) replicates.
+STAGED_PARTITION_RULES = (
+    (r"(^|/)blocks(/|$)", "data"),
+    (r"(^|/)mask$", "data"),
+    (r"(^|/)gids$", "data"),
+    (r"(^|/)(env|lut|aux|narrow|dict)(/|$)", "replicated"),
+)
+
+
+def match_partition_rules(rules, names, mesh):
+    """Resolve each name through the rule tree → NamedSharding.
+
+    First matching regex wins; unmatched names replicate (the safe
+    default for scalars/aux, mirroring the SNIPPETS exemplar where
+    unmatched leaves raise — here the fold's aux values are the
+    common case, so replication is the correct fallback).
+    """
+    shardings = {}
+    for name in names:
+        kind = "replicated"
+        for pattern, k in rules:
+            if re.search(pattern, name):
+                kind = k
+                break
+        shardings[name] = (
+            data_sharding(mesh) if kind == "data" else replicated_sharding(mesh)
+        )
+    return shardings
+
+
+__all__ = [
+    "MeshConfig",
+    "resolve_mesh",
+    "data_axes",
+    "host_axis",
+    "inner_axes",
+    "data_spec",
+    "data_sharding",
+    "replicated_sharding",
+    "STAGED_PARTITION_RULES",
+    "match_partition_rules",
+]

@@ -372,7 +372,7 @@ def _decoder(mesh: Mesh, sig: str, nblk: int, b: int):
     lane is device-local (vmap over shards, no collectives); the output
     is the [D, nblk, B] block the fold would have received from an
     uncompressed transfer, bit for bit."""
-    (axis_name,) = mesh.axis_names
+    axis_name = tuple(mesh.axis_names)  # dim0 over every mesh axis
     sharding = NamedSharding(mesh, P(axis_name))
     parts = sig.split(":")
     kind = parts[0]
@@ -427,7 +427,7 @@ def put_payload(mesh: Mesh, payload: CodecPayload) -> list:
     """device_put a payload's host arrays for the decoder: arrays shard
     on the leading (device) axis — this is the only wire transfer the
     column pays — and the delta offset rides replicated."""
-    (axis_name,) = mesh.axis_names
+    axis_name = tuple(mesh.axis_names)  # dim0 over every mesh axis
     sharded = NamedSharding(mesh, P(axis_name))
     repl = NamedSharding(mesh, P())
     args = [jax.device_put(a, sharded) for a in payload.arrays]
@@ -439,7 +439,7 @@ def put_payload(mesh: Mesh, payload: CodecPayload) -> list:
 def decode_avals(plan: CodecPlan, mesh: Mesh):
     """ShapeDtypeStructs of the decoder's args (for background AOT
     compilation on the staging worker)."""
-    (axis_name,) = mesh.axis_names
+    axis_name = tuple(mesh.axis_names)  # dim0 over every mesh axis
     sharding = NamedSharding(mesh, P(axis_name))
     repl = NamedSharding(mesh, P())
     d, L = plan.d, plan.shard_len
@@ -485,7 +485,7 @@ def _converter(
     b: int,
     lut_len: int,
 ):
-    (axis_name,) = mesh.axis_names
+    axis_name = tuple(mesh.axis_names)  # dim0 over every mesh axis
     sharding = NamedSharding(mesh, P(axis_name))
     dst = np.dtype(dst_dtype)
 

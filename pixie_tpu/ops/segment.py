@@ -513,6 +513,43 @@ def merge_join_pairs(sorted_build_keys, build_order, probe_keys, pair_cap: int):
     return build_order[build_pos], probe_rows, t < prefix[-1], fanout
 
 
+def local_sort_merge(lkey, rkey, lmask, rmask, cap_m: int, cap_r: int, cap_l: int):
+    """The sort→merge→compact core shared by the replicated (v1) and
+    partitioned (r21 mesh) join lanes, over whatever key slice the
+    caller holds — the whole table when replicated, one hosts-axis
+    shard when partitioned.
+
+    ``lkey``/``rkey`` are sentinel-applied (padded build rows carry a
+    key above every real id, padded probe rows one higher still, so
+    neither can pair). One stable sort orders the build side by
+    (key, original row), reproducing the host JoinNode's per-key
+    original row order; ``merge_join_pairs`` emits probe-row-major
+    match pairs; the sentinel-sort compaction fronts unmatched rows
+    for the outer variants (cap 0 skips a section).
+
+    Returns ``(build_rows, probe_rows, fanout, ur, ul)`` — int32 row
+    indices into the caller's key slices; ``ur``/``ul`` are None when
+    their cap is 0."""
+    sl_key, sl_idx = jax.lax.sort(
+        (lkey, jnp.arange(lkey.shape[0], dtype=jnp.int32)),
+        num_keys=1,
+        is_stable=True,
+    )
+    build_rows, probe_rows, _pv, fanout = merge_join_pairs(
+        sl_key, sl_idx, rkey, cap_m
+    )
+    ur = ul = None
+    if cap_r:
+        ur = compact_unmatched_rows(rmask & (fanout == 0), cap_r)
+    if cap_l:
+        sr_key = jnp.sort(rkey)
+        l_matched = jnp.searchsorted(
+            sr_key, lkey, side="right"
+        ) > jnp.searchsorted(sr_key, lkey, side="left")
+        ul = compact_unmatched_rows(lmask & ~l_matched, cap_l)
+    return build_rows, probe_rows, fanout, ur, ul
+
+
 def compact_unmatched_rows(unmatched, cap: int):
     """Compact the indices of ``unmatched`` rows to the front, preserving
     original row order — the r8 sentinel-sort idiom (losers collapse onto

@@ -85,6 +85,7 @@ from pixie_tpu.types import DataType
 from pixie_tpu.types.dtypes import host_dtype
 from pixie_tpu.udf.udf import Executor, MergeKind
 from pixie_tpu.parallel import profiler as resattr
+from pixie_tpu.distributed import mesh as mesh_lib
 from pixie_tpu.utils import faults, flags, metrics_registry, trace
 
 _M = metrics_registry()
@@ -884,11 +885,17 @@ class MeshExecutor:
         self,
         mesh: Optional[Mesh] = None,
         block_rows: Optional[int] = None,
+        mesh_config: Optional["mesh_lib.MeshConfig"] = None,
     ):
-        if mesh is None:
-            devs = np.array(jax.devices())
-            mesh = Mesh(devs, ("d",))
-        self.mesh = mesh
+        # Mesh geometry is declarative (distributed/mesh.py): an explicit
+        # mesh wins, else mesh_config, else the mesh_axes flag (flat
+        # single-host default). The geometry signature is embedded in
+        # every compiled-program signature so a geometry change can
+        # never silently reuse a stale executable.
+        self.mesh, self.mesh_config = mesh_lib.resolve_mesh(mesh, mesh_config)
+        mesh = self.mesh
+        self.mesh_axes = mesh_lib.data_axes(mesh)
+        self._mesh_sig = self.mesh_config.signature()
         # PIXIE_TPU_DEVICE_BLOCK_ROWS overrides; staging.DEFAULT_BLOCK_ROWS
         # is the built-in default.
         self.block_rows = (
@@ -1139,7 +1146,7 @@ class MeshExecutor:
         stream_fallback_errors like a fold-compile failure)."""
         from pixie_tpu.ops import codec as _codec
 
-        sig = f"decode|{cp.sig()}|mesh:{self.mesh.devices.shape}"
+        sig = f"decode|{cp.sig()}|mesh:{self._mesh_sig}"
         fn = cache.get(sig)
         if fn is not None:
             return fn
@@ -1170,7 +1177,7 @@ class MeshExecutor:
         if not flags.aot_compile:
             return
         for cp in plan.codecs.values():
-            sig = f"decode|{cp.sig()}|mesh:{self.mesh.devices.shape}"
+            sig = f"decode|{cp.sig()}|mesh:{self._mesh_sig}"
             if sig in self._aot_compiled or sig in self._aot_futures:
                 continue
             try:
@@ -1194,7 +1201,7 @@ class MeshExecutor:
         Either way the resulting block is bit-identical."""
         from pixie_tpu.ops import codec as _codec
 
-        (axis_name,) = self.mesh.axis_names
+        axis_name = self.mesh_axes  # full axis tuple: dim0 over every mesh axis
         sharding = NamedSharding(self.mesh, P(axis_name))
         dev_cols = {}
         for n2 in col_names:
@@ -1850,7 +1857,7 @@ class MeshExecutor:
         col_names = sorted(staged.blocks)
         narrow_names = sorted(staged.narrow_offsets)
         preds = [e for n, e in evaluator.named_exprs if n.startswith("pred")]
-        axis = self.mesh.axis_names[0]
+        axis = self.mesh_axes  # collectives reduce over the FULL mesh
         ndev = staged.num_devices
         aux_order = list(aux.keys())
         stat_kinds = []  # [(spec out name, kind)] kinds: sum/min/max
@@ -1878,7 +1885,7 @@ class MeshExecutor:
                 "aux:" + ",".join(
                     f"{np.shape(v)}:{np.asarray(v).dtype}" for v in aux.values()
                 ),
-                f"mesh:{self.mesh.devices.shape}",
+                f"mesh:{self._mesh_sig}",
             ]
         )
         arg_exprs = {o: e for o, e, _n in right_specs}
@@ -2041,7 +2048,7 @@ class MeshExecutor:
         col_names = sorted(staged.blocks)
         narrow_names = sorted(staged.narrow_offsets)
         preds = [e for n, e in evaluator.named_exprs if n.startswith("pred")]
-        axis = self.mesh.axis_names[0]
+        axis = self.mesh_axes  # collectives reduce over the FULL mesh
         ndev = staged.num_devices
         aux_order = list(aux.keys())
         stat_names = sorted(rstats)
@@ -2070,7 +2077,7 @@ class MeshExecutor:
                 "aux:" + ",".join(
                     f"{np.shape(v)}:{np.asarray(v).dtype}" for v in aux.values()
                 ),
-                f"mesh:{self.mesh.devices.shape}",
+                f"mesh:{self._mesh_sig}",
             ]
         )
         if sig not in self._program_cache:
@@ -2491,6 +2498,23 @@ class MeshExecutor:
             {src for side, src, _o, _dt in out_plan if side == 1}
             or {m.right_key_exprs[0].name}
         )
+        # r21 distributed sort-merge (tentpole): on a multi-axis mesh,
+        # range-partition both sides by packed key across the hosts
+        # axis and sort+merge locally per shard, instead of replicating
+        # the whole key space onto every device. Any refusal falls
+        # through to the replicated v1 path below — never to the host.
+        if (
+            flags.mesh_distributed_join
+            and len(self.mesh_axes) > 1
+            and int(self.mesh.devices.shape[0]) > 1
+        ):
+            out = self._try_partitioned_join(
+                m, lt, rt, kl, kr, K, count_l, count_r, how,
+                out_plan, key_space_sig, cols_l, cols_r,
+                left_sel, right_sel, nl, nr,
+            )
+            if out is not None:
+                return m.join_nid, out
         ck_l = (
             m.left_source_op.table_name,
             (lt.min_row_id(), lt.end_row_id()),
@@ -2598,7 +2622,7 @@ class MeshExecutor:
         r_names = sorted(staged_r.blocks)
         l_narrow = sorted(staged_l.narrow_offsets)
         r_narrow = sorted(staged_r.narrow_offsets)
-        axis = self.mesh.axis_names[0]
+        axis = self.mesh_axes  # collectives reduce over the FULL mesh
         ndev = staged_l.num_devices
         sig = "|".join(
             [
@@ -2620,7 +2644,7 @@ class MeshExecutor:
                     f"{side}:{src}:{dt.name}"
                     for side, src, _o, dt in out_plan
                 ),
-                f"mesh:{self.mesh.devices.shape}",
+                f"mesh:{self._mesh_sig}",
             ]
         )
         if sig not in self._program_cache:
@@ -2665,27 +2689,11 @@ class MeshExecutor:
                 # id so they can never pair (build pads K, probe pads K+1).
                 lkey = jnp.where(lmask, lgid, kq)
                 rkey = jnp.where(rmask, rgid, kq + 1)
-                sl_key, sl_idx = jax.lax.sort(
-                    (lkey, jnp.arange(lkey.shape[0], dtype=jnp.int32)),
-                    num_keys=1,
-                    is_stable=True,
-                )
-                build_rows, probe_rows, _pv, fanout = (
-                    _segment.merge_join_pairs(sl_key, sl_idx, rkey, cap_m)
-                )
-                ur = ul = None
-                if cap_r:
-                    ur = _segment.compact_unmatched_rows(
-                        rmask & (fanout == 0), cap_r
+                build_rows, probe_rows, _fan, ur, ul = (
+                    _segment.local_sort_merge(
+                        lkey, rkey, lmask, rmask, cap_m, cap_r, cap_l
                     )
-                if cap_l:
-                    sr_key = jnp.sort(rkey)
-                    l_matched = jnp.searchsorted(
-                        sr_key, lkey, side="right"
-                    ) > jnp.searchsorted(sr_key, lkey, side="left")
-                    ul = _segment.compact_unmatched_rows(
-                        lmask & ~l_matched, cap_l
-                    )
+                )
                 outs = []
                 for side, src, _o, dt in out_plan:
                     if side == 0:
@@ -2812,6 +2820,372 @@ class MeshExecutor:
             m.out_relation, data, eow=True, eos=True
         )
 
+    def _try_partitioned_join(
+        self, m, lt, rt, kl, kr, K, count_l, count_r, how,
+        out_plan, key_space_sig, cols_l, cols_r,
+        left_sel, right_sel, nl, nr,
+    ):
+        """Distributed sort-merge join over the hosts axis (r21): both
+        sides range-partition by packed key id into one contiguous key
+        range per host (balanced by per-key join work from the exact
+        host bincounts), stage shard-major so every host's devices hold
+        only its shard, and each host sorts + merges its shard locally
+        (all_gather over the INNER axes only). Shard outputs then
+        concatenate over the hosts axis and the host reorders them to
+        the engine's emission order — bit-identical to both the v1
+        replicated lane and the host EquijoinNode. Returns the spliced
+        RowBatch, or None to fall through to the v1 replicated path."""
+        H = int(self.mesh.devices.shape[0])
+        # Balanced contiguous key ranges: per-key cost = emitted pairs
+        # plus the rows that move (both exact).
+        work = count_l * count_r + count_l + count_r
+        cum = np.cumsum(work)
+        total_w = int(cum[-1]) if len(cum) else 0
+        if total_w <= 0:
+            return None
+        targets = (np.arange(1, H, dtype=np.int64) * total_w) // H
+        bounds = np.searchsorted(cum, targets, side="left")
+        key_shard = np.searchsorted(
+            bounds, np.arange(K), side="right"
+        ).astype(np.int32)
+        shard_l = key_shard[kl]
+        shard_r = key_shard[kr]
+        # Stable shard-major permutations: original row order survives
+        # WITHIN each shard, which is what makes the host-side inverse
+        # reorder below exact.
+        perm_l = np.argsort(shard_l, kind="stable")
+        perm_r = np.argsort(shard_r, kind="stable")
+        rows_l = np.bincount(shard_l, minlength=H).astype(np.int64)
+        rows_r = np.bincount(shard_r, minlength=H).astype(np.int64)
+        # Exact per-shard output counts -> uniform static caps (the
+        # max over shards, so one compiled program serves every shard).
+        m_s = np.zeros(H, np.int64)
+        np.add.at(m_s, key_shard, count_l * count_r)
+        ur_s = np.zeros(H, np.int64)
+        np.add.at(ur_s, key_shard, np.where(count_l == 0, count_r, 0))
+        ul_s = np.zeros(H, np.int64)
+        np.add.at(ul_s, key_shard, np.where(count_r == 0, count_l, 0))
+        cap_m_s = _pow2_at_least(max(int(m_s.max()), 1))
+        cap_r_s = (
+            _pow2_at_least(max(int(ur_s.max()), 1))
+            if how in (JoinType.RIGHT, JoinType.OUTER)
+            else 0
+        )
+        cap_l_s = (
+            _pow2_at_least(max(int(ul_s.max()), 1))
+            if how in (JoinType.LEFT, JoinType.OUTER)
+            else 0
+        )
+        staged_l, ck_l = self._stage_partitioned_side(
+            lt, m.left_source_op, cols_l, kl, perm_l, rows_l, K,
+            left_sel, nl, key_space_sig, H, "L",
+        )
+        if staged_l is None:
+            return None
+        staged_r, ck_r = self._stage_partitioned_side(
+            rt, m.right_source_op, cols_r, kr, perm_r, rows_r, K,
+            right_sel, nr, key_space_sig, H, "R",
+        )
+        if staged_r is None:
+            return None
+        outs = self._run_partitioned_join(
+            m, staged_l, staged_r, ck_l, ck_r, out_plan, K, H,
+            cap_m_s, cap_r_s, cap_l_s,
+        )
+        if outs is None:
+            return None
+        # Inverse reorder to the engine's emission order. Matched pairs:
+        # the device emits probe-row-major per shard; the engine emits
+        # probe-row-major over the ORIGINAL probe order with per-probe
+        # build matches contiguous — so a stable argsort of the emitted
+        # original probe indices (fanout-repeated) is the exact inverse.
+        fan_r = count_l[kr[perm_r]]
+        order_m = np.argsort(
+            np.repeat(perm_r, fan_r), kind="stable"
+        )
+        emit_r = perm_r[(count_l[kr] == 0)[perm_r]]
+        order_r = np.argsort(emit_r, kind="stable")
+        emit_l = perm_l[(count_r[kl] == 0)[perm_l]]
+        order_l = np.argsort(emit_l, kind="stable")
+        sect = cap_m_s + cap_r_s + cap_l_s
+        data = {}
+        for ci, (side, src, out_name, dt) in enumerate(out_plan):
+            arr = np.asarray(outs[ci]).reshape(H, sect)
+            segs = [
+                np.concatenate(
+                    [arr[h, : m_s[h]] for h in range(H)]
+                )[order_m]
+            ]
+            off = cap_m_s
+            if cap_r_s:
+                segs.append(
+                    np.concatenate(
+                        [arr[h, off : off + ur_s[h]] for h in range(H)]
+                    )[order_r]
+                )
+                off += cap_r_s
+            if cap_l_s:
+                segs.append(
+                    np.concatenate(
+                        [arr[h, off : off + ul_s[h]] for h in range(H)]
+                    )[order_l]
+                )
+            a = np.concatenate(segs) if len(segs) > 1 else segs[0]
+            if dt == DataType.STRING:
+                codes = a.astype(np.int32)
+                d2 = (lt if side == 0 else rt).dictionaries.get(src)
+                if d2 is None:
+                    return None
+                if (codes < 0).any():
+                    vocab = np.asarray(list(d2.values()), dtype=object)
+                    vals = np.empty(len(codes), dtype=object)
+                    neg = codes < 0
+                    vals[~neg] = vocab[codes[~neg]]
+                    vals[neg] = ""
+                    data[out_name] = vals
+                else:
+                    data[out_name] = DictColumn(codes, d2)
+            else:
+                data[out_name] = a.astype(host_dtype(dt))
+        return RowBatch.from_pydict(
+            m.out_relation, data, eow=True, eos=True
+        )
+
+    def _stage_partitioned_side(
+        self, table, src_op, cols_needed, kk, perm, rows_s, K,
+        sel, n_expect, key_space_sig, H, tag,
+    ):
+        """Read-filter-permute-stage one join side shard-major, with the
+        same residency registration and OOM clear-and-retry policy as
+        _stage_cached (which cannot express a reorder: its row_sel is
+        an order-preserving boolean mask)."""
+        from pixie_tpu.parallel import staging as _staging
+
+        ck = (
+            src_op.table_name,
+            (table.min_row_id(), table.end_row_id()),
+            tuple(cols_needed),
+            src_op.start_time,
+            src_op.stop_time,
+            self.block_rows,
+            f":meshjoin{tag}:{H}:" + repr(key_space_sig),
+            K,
+            (),
+        )
+        staged = self._staged_lookup(ck)
+        if staged is not None and staged.num_rows == n_expect:
+            return staged, ck
+        cols, n = read_columns(
+            table,
+            sorted(set(cols_needed)),
+            src_op.start_time,
+            src_op.stop_time,
+        )
+        if sel is not None:
+            if len(sel) != n:
+                return None, None  # table moved under us
+            cols = {c: np.asarray(a)[sel] for c, a in cols.items()}
+            n = int(np.count_nonzero(sel))
+        if n != n_expect or len(kk) != n:
+            return None, None  # table moved under us
+        cols = {c: np.asarray(a)[perm] for c, a in cols.items()}
+        gids = kk[perm].astype(np.int32)
+
+        def _do():
+            return _staging.stage_partitioned(
+                self.mesh, cols, gids, rows_s, K,
+                block_rows=self.block_rows,
+            )
+
+        try:
+            staged = _do()
+        except Exception as e:
+            if "RESOURCE_EXHAUSTED" not in str(e) and (
+                "Out of memory" not in str(e)
+            ):
+                raise
+            self._staged_cache.clear(reason="oom")
+            staged = _do()
+        self._staged_insert(ck, staged, src_op.table_name, ck[1])
+        return staged, ck
+
+    def _run_partitioned_join(
+        self, m, staged_l, staged_r, ck_l, ck_r, out_plan, K, H,
+        cap_m_s, cap_r_s, cap_l_s,
+    ):
+        """Compile-or-reuse the partitioned merge program. Identical to
+        the v1 program except: flatten gathers over the INNER axes only
+        (each host assembles its own shard), caps are per-shard, and
+        every output concatenates over the hosts axis — per-host layout
+        [matched cap_m_s | probe-unmatched cap_r_s | build-unmatched
+        cap_l_s], global shape [H * sect]."""
+        from pixie_tpu.ops import segment as _segment
+
+        l_names = sorted(staged_l.blocks)
+        r_names = sorted(staged_r.blocks)
+        l_narrow = sorted(staged_l.narrow_offsets)
+        r_narrow = sorted(staged_r.narrow_offsets)
+        axes = self.mesh_axes
+        inner = axes[1:]
+        sig = "|".join(
+            [
+                "join",
+                "joinlane:partitioned",
+                f"how:{m.join_op.how.value}",
+                "L:" + ",".join(
+                    f"{n2}:{a.shape}:{a.dtype}"
+                    for n2, a in sorted(staged_l.blocks.items())
+                ),
+                f"lnarrow:{l_narrow}",
+                "R:" + ",".join(
+                    f"{n2}:{a.shape}:{a.dtype}"
+                    for n2, a in sorted(staged_r.blocks.items())
+                ),
+                f"rnarrow:{r_narrow}",
+                f"caps:{cap_m_s},{cap_r_s},{cap_l_s}",
+                "out:" + ";".join(
+                    f"{side}:{src}:{dt.name}"
+                    for side, src, _o, dt in out_plan
+                ),
+                f"mesh:{self._mesh_sig}",
+            ]
+        )
+        if sig not in self._program_cache:
+            _segment.lane_count("join_partitioned")
+
+            def shard_fn(*arrs):
+                i = len(l_names)
+                lcols = dict(zip(l_names, arrs[:i]))
+                lmask_b, lgids_b = arrs[i], arrs[i + 1]
+                i += 2
+                rcols = dict(zip(r_names, arrs[i : i + len(r_names)]))
+                i += len(r_names)
+                rmask_b, rgids_b = arrs[i], arrs[i + 1]
+                k_arr = arrs[i + 2]
+                i += 3
+                lnarrow_vec = rnarrow_vec = None
+                if l_narrow:
+                    lnarrow_vec = arrs[i]
+                    i += 1
+                if r_narrow:
+                    rnarrow_vec = arrs[i]
+
+                def flatten(a):
+                    # Per-device [1, nblk, B] -> THIS HOST's shard only:
+                    # gather over the inner axes; the hosts axis stays
+                    # partitioned (that is the whole point).
+                    x = a[0].reshape(-1)
+                    if inner:
+                        x = jax.lax.all_gather(x, inner).reshape(-1)
+                    return x
+
+                lmask = flatten(lmask_b)
+                lgid = flatten(lgids_b).astype(jnp.int32)
+                rmask = flatten(rmask_b)
+                rgid = flatten(rgids_b).astype(jnp.int32)
+                kq = k_arr.astype(jnp.int32)
+                # Same sentinels as v1: other shards' keys never appear
+                # locally, so K / K+1 still top every local real id.
+                lkey = jnp.where(lmask, lgid, kq)
+                rkey = jnp.where(rmask, rgid, kq + 1)
+                build_rows, probe_rows, _fan, ur, ul = (
+                    _segment.local_sort_merge(
+                        lkey, rkey, lmask, rmask,
+                        cap_m_s, cap_r_s, cap_l_s,
+                    )
+                )
+                outs = []
+                for side, src, _o, dt in out_plan:
+                    if side == 0:
+                        col = flatten(lcols[src])
+                        narrow_v = (
+                            lnarrow_vec[l_narrow.index(src)]
+                            if src in l_narrow
+                            else None
+                        )
+                        midx, uidx_r, uidx_l = build_rows, None, ul
+                    else:
+                        col = flatten(rcols[src])
+                        narrow_v = (
+                            rnarrow_vec[r_narrow.index(src)]
+                            if src in r_narrow
+                            else None
+                        )
+                        midx, uidx_r, uidx_l = probe_rows, ur, None
+                    nside = col.shape[0]
+                    odt = jnp.int64 if narrow_v is not None else col.dtype
+                    nullv = -1 if dt == DataType.STRING else 0
+
+                    def gath(idx, col=col, narrow_v=narrow_v, nside=nside):
+                        g = col[jnp.clip(idx, 0, nside - 1)]
+                        if narrow_v is not None:
+                            g = g.astype(jnp.int64) + narrow_v
+                        return g
+
+                    secs = [gath(midx)]
+                    if cap_r_s:
+                        secs.append(
+                            gath(uidx_r)
+                            if uidx_r is not None
+                            else jnp.full(cap_r_s, nullv, odt)
+                        )
+                    if cap_l_s:
+                        secs.append(
+                            gath(uidx_l)
+                            if uidx_l is not None
+                            else jnp.full(cap_l_s, nullv, odt)
+                        )
+                    outs.append(
+                        jnp.concatenate(secs) if len(secs) > 1 else secs[0]
+                    )
+                return tuple(outs)
+
+            n_sharded = len(l_names) + 2 + len(r_names) + 2
+            n_repl = 1 + (1 if l_narrow else 0) + (1 if r_narrow else 0)
+            program = jax.jit(
+                shard_map(
+                    shard_fn,
+                    mesh=self.mesh,
+                    in_specs=tuple(
+                        [P(axes)] * n_sharded + [P()] * n_repl
+                    ),
+                    out_specs=tuple([P(axes[0])] * len(out_plan)),
+                    **_SM_CHECK_KW,
+                )
+            )
+            self._program_cache[sig] = (program, 0, None)
+            _PROGRAMS.set(len(self._program_cache))
+        program = self._program_cache[sig][0]
+        args = [staged_l.blocks[n2] for n2 in l_names]
+        args.append(staged_l.mask)
+        args.append(staged_l.gids)
+        args += [staged_r.blocks[n2] for n2 in r_names]
+        args.append(staged_r.mask)
+        args.append(staged_r.gids)
+        args.append(jnp.asarray(K, jnp.int32))
+        if l_narrow:
+            args.append(
+                jnp.asarray(
+                    [staged_l.narrow_offsets[n2] for n2 in l_narrow],
+                    jnp.int64,
+                )
+            )
+        if r_narrow:
+            args.append(
+                jnp.asarray(
+                    [staged_r.narrow_offsets[n2] for n2 in r_narrow],
+                    jnp.int64,
+                )
+            )
+        if faults.ACTIVE:
+            faults.check("device.join_dispatch")
+        with self._staged_cache.pin(ck_l):
+            with self._staged_cache.pin(ck_r):
+                with _segment.platform_hint(
+                    self.mesh.devices.flat[0].platform
+                ):
+                    return program(*args)
+
     # -- device scan (filter/project/limit, no aggregate) --------------------
     def _try_execute_scan(
         self, fragment, relations, table_store, registry, func_ctx
@@ -2888,9 +3262,10 @@ class MeshExecutor:
                 "aux:" + ",".join(
                     f"{np.shape(v)}:{np.asarray(v).dtype}" for v in aux_vals
                 ),
-                f"mesh:{self.mesh.devices.shape}",
+                f"mesh:{self._mesh_sig}",
             ]
         )
+        assert f"mesh:{self._mesh_sig}" in sig  # geometry guard (r21)
         entry = self._program_cache.get(sig)
         if entry is None:
             program = self._build_scan_program(
@@ -3148,7 +3523,7 @@ class MeshExecutor:
     def _build_scan_program(
         self, m: _ScanMatch, evaluator, staged, aux_key_order, out_dtypes
     ):
-        axis = self.mesh.axis_names[0]
+        axis = self.mesh_axes  # collectives reduce over the FULL mesh
         col_names = sorted(staged.blocks)
         narrow_names = sorted(staged.narrow_offsets)
         limit = m.limit
@@ -3796,7 +4171,7 @@ class MeshExecutor:
             "aux:" + ",".join(
                 f"{np.shape(v)}:{np.asarray(v).dtype}" for v in aux_vals
             ),
-            f"mesh:{self.mesh.devices.shape}",
+            f"mesh:{self._mesh_sig}",
         ]
         return "|".join(parts)
 
@@ -3884,12 +4259,22 @@ class MeshExecutor:
             "aux:" + ",".join(
                 f"{np.shape(v)}:{np.asarray(v).dtype}" for v in aux_vals
             ),
-            f"mesh:{self.mesh.devices.shape}",
+            f"mesh:{self._mesh_sig}",
         ]
         return "|".join(parts)
 
     def _get_program(self, sig: str, build, n_aux: int = 0):
         """Program-cache lookup-or-build shared by every unit."""
+        # Geometry guard: every cached executable was traced against ONE
+        # mesh geometry, and every signature must carry that geometry.
+        # A lookup whose signature names a different geometry than the
+        # executor's mesh means a caller mixed executors/meshes — fail
+        # loudly instead of silently reusing a stale compiled program.
+        if f"mesh:{self._mesh_sig}" not in sig:
+            raise AssertionError(
+                f"program signature {sig!r} does not carry this "
+                f"executor's mesh geometry {self._mesh_sig!r}"
+            )
         entry = self._program_cache.get(sig)
         if entry is None or entry[1] != n_aux:
             self._program_cache[sig] = (build(), n_aux, None)
@@ -3911,7 +4296,7 @@ class MeshExecutor:
         treedef, leaves = self._state_template(specs, capacity)
         n_leaves = len(leaves)
         lanes = self._uda_set_sig(specs)
-        mesh_s = f"{self.mesh.devices.shape}"
+        mesh_s = self._mesh_sig
         col_names = sorted(staged.blocks)
         narrow_names = sorted(staged.narrow_offsets)
         int_dict_names = sorted(staged.int_dicts)
@@ -4047,7 +4432,7 @@ class MeshExecutor:
         )
         if fold_sig in self._aot_compiled or fold_sig in self._aot_futures:
             return None  # single-window stream: warm sig == stream sig
-        (axis_name,) = self.mesh.axis_names
+        axis_name = self.mesh_axes  # full axis tuple: dim0 over every mesh axis
         sharded = NamedSharding(self.mesh, P(axis_name))
         repl = NamedSharding(self.mesh, P())
         _treedef, leaves = self._state_template(specs, capacity)
@@ -4216,7 +4601,7 @@ class MeshExecutor:
         if fold_sig in self._aot_compiled or fold_sig in self._aot_futures:
             self._prewarmed.add(fold_sig)
             return fold_sig
-        (axis_name,) = self.mesh.axis_names
+        axis_name = self.mesh_axes  # full axis tuple: dim0 over every mesh axis
         sharded = NamedSharding(self.mesh, P(axis_name))
         repl = NamedSharding(self.mesh, P())
         avals = [
@@ -4320,7 +4705,7 @@ class MeshExecutor:
                 fold_sig in self._aot_futures
             ):
                 return fold_sig
-            (axis_name,) = self.mesh.axis_names
+            axis_name = self.mesh_axes  # full axis tuple: dim0 over every mesh axis
             sharded = NamedSharding(self.mesh, P(axis_name))
             repl = NamedSharding(self.mesh, P())
             avals = [
@@ -4687,7 +5072,7 @@ class MeshExecutor:
     def _build_program(
         self, m, specs, evaluator, key_plan, staged, aux_key_order, capacity
     ):
-        axis = self.mesh.axis_names[0]
+        axis = self.mesh_axes  # collectives reduce over the FULL mesh
         fin_modes, _ = self._finalize_modes(
             specs, capacity, m.agg_op.stage == AggStage.PARTIAL
         )
@@ -4793,7 +5178,7 @@ class MeshExecutor:
         (init == merge identity by UDA contract): each device folds its
         own shard; the merge program combines them over ICI."""
         d = self.mesh.devices.size
-        (axis_name,) = self.mesh.axis_names
+        axis_name = self.mesh_axes  # full axis tuple: dim0 over every mesh axis
         sharding = NamedSharding(self.mesh, P(axis_name))
 
         def init():
@@ -4827,7 +5212,7 @@ class MeshExecutor:
         so every fold dispatch is device-local and async, and the fold
         executable is reused by any query whose scan lane matches
         (_fold_signature), regardless of finalize."""
-        axis = self.mesh.axis_names[0]
+        axis = self.mesh_axes  # collectives reduce over the FULL mesh
         has_host_gids = key_plan.host_gids is not None
         has_key_lut = isinstance(key_plan.device_expr, tuple)
         device_key = key_plan.device_expr
@@ -4900,7 +5285,7 @@ class MeshExecutor:
         merged states out — one collective per UDA, nothing else. Keyed
         only by (UDA lane set, capacity, mesh), so every query sharing the
         lane set reuses it across staging geometries."""
-        axis = self.mesh.axis_names[0]
+        axis = self.mesh_axes  # collectives reduce over the FULL mesh
         ndev = self.mesh.devices.size
 
         def shard_fn(*arrs):
@@ -5039,7 +5424,7 @@ class MeshExecutor:
             specs, capacity, m.agg_op.stage == AggStage.PARTIAL
         )
 
-        (axis_name,) = self.mesh.axis_names
+        axis_name = self.mesh_axes  # full axis tuple: dim0 over every mesh axis
         sharding = NamedSharding(self.mesh, P(axis_name))
         repl = NamedSharding(self.mesh, P())
         has_host_gids = key_plan.host_gids is not None
@@ -5525,7 +5910,7 @@ class MeshExecutor:
         batch width) — the r7 init unit with a slot axis between the
         device axis and the state."""
         d = self.mesh.devices.size
-        (axis_name,) = self.mesh.axis_names
+        axis_name = self.mesh_axes  # full axis tuple: dim0 over every mesh axis
         sharding = NamedSharding(self.mesh, P(axis_name))
 
         def init():
@@ -5569,7 +5954,7 @@ class MeshExecutor:
         predicate term tables ride as replicated args after the aux
         lane. One compiled executable serves every predicate-compatible
         batch at this (geometry, lanes, batch, terms) bucket."""
-        axis = self.mesh.axis_names[0]
+        axis = self.mesh_axes  # collectives reduce over the FULL mesh
         has_host_gids = key_plan.host_gids is not None
         has_key_lut = isinstance(key_plan.device_expr, tuple)
         device_key = key_plan.device_expr
@@ -5671,7 +6056,7 @@ class MeshExecutor:
             ),
             n_aux=len(aux_vals),
         )
-        (axis_name,) = self.mesh.axis_names
+        axis_name = self.mesh_axes  # full axis tuple: dim0 over every mesh axis
         sharded = NamedSharding(self.mesh, P(axis_name))
         repl = NamedSharding(self.mesh, P())
         d = staged.num_devices
@@ -5864,7 +6249,7 @@ class MeshExecutor:
                 fold_fn = fold_p
         treedef, leaves = self._state_template(specs, capacity)
         lanes = self._uda_set_sig(specs)
-        mesh_s = f"{self.mesh.devices.shape}"
+        mesh_s = self._mesh_sig
         col_names = sorted(staged.blocks)
         init_p = self._get_program(
             f"binit|{lanes}|cap:{capacity}|batch:{B}|mesh:{mesh_s}",
@@ -6107,6 +6492,7 @@ class MeshExecutor:
     ):
         col_names = sorted(staged.blocks)
         sig = self._signature(m, specs, key_plan, staged, aux_vals, capacity)
+        assert f"mesh:{self._mesh_sig}" in sig  # geometry guard (r21)
         entry = self._program_cache.get(sig)
         if entry is None or entry[1] != len(aux_vals):
             aux_key_order = list(aux.keys())

@@ -270,7 +270,7 @@ def _mask_builder(mesh: Mesh, d: int, nblk: int, b: int):
     """Jitted per (mesh, geometry) — a fresh jit per staging would pay a
     trace+compile each time; num_rows stays a traced argument so one
     compiled kernel serves every row count at this geometry."""
-    (axis_name,) = mesh.axis_names
+    axis_name = tuple(mesh.axis_names)  # dim0 over every mesh axis
     sharding = NamedSharding(mesh, P(axis_name))
 
     def make(n):
@@ -311,7 +311,7 @@ def stage_columns(
     int_dict_encode) to their value LUTs."""
     from pixie_tpu.ops import codec as _codec
 
-    (axis_name,) = mesh.axis_names
+    axis_name = tuple(mesh.axis_names)  # dim0 over every mesh axis
     d = mesh.devices.size
     b, nblk = block_geometry(num_rows, d, block_rows)
     total = d * nblk * b
@@ -435,6 +435,107 @@ def _narrow_gids(gids: np.ndarray, num_groups: int) -> np.ndarray:
     if num_groups <= 0xFFFF + 1:
         return gids.astype(np.uint16)
     return gids.astype(np.int32)
+
+
+@functools.lru_cache(maxsize=64)
+def _shard_mask_builder(mesh: Mesh, d: int, nblk: int, b: int, region: int):
+    """Per-shard validity mask for partitioned stagings: each hosts-axis
+    shard owns a contiguous ``region`` of the flat row space, valid up
+    to its own row count (tail-padding WITHIN each region, unlike the
+    single global tail _mask_builder models). Jitted per geometry; the
+    [H] counts vector stays a traced argument."""
+    axis_name = tuple(mesh.axis_names)  # dim0 over every mesh axis
+    sharding = NamedSharding(mesh, P(axis_name))
+
+    def make(counts):
+        idx = jax.lax.broadcasted_iota(jnp.int64, (d, nblk, b), 0) * (
+            nblk * b
+        ) + jax.lax.broadcasted_iota(jnp.int64, (d, nblk, b), 1) * b + (
+            jax.lax.broadcasted_iota(jnp.int64, (d, nblk, b), 2)
+        )
+        return (idx % region) < counts[idx // region]
+
+    return jax.jit(make, out_shardings=sharding)
+
+
+def stage_partitioned(
+    mesh: Mesh,
+    cols: dict[str, np.ndarray],
+    gids: np.ndarray,
+    shard_rows: np.ndarray,
+    num_groups: int,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+) -> StagedColumns:
+    """Stage shard-major host columns so each hosts-axis shard owns a
+    contiguous region of devices (the r21 distributed join's layout).
+
+    ``cols``/``gids`` arrive ALREADY permuted shard-major (rows of
+    shard h contiguous, original order preserved within a shard) with
+    ``shard_rows[h]`` rows per shard. Geometry is per-host: every host
+    gets the block_geometry of the LARGEST shard over its ``d/H``
+    devices, so regions are uniform (one compiled program) and ragged
+    shards tail-pad within their own region — the per-shard mask comes
+    from _shard_mask_builder, not the global-tail mask. Narrowing
+    matches stage_columns (one frame-of-reference offset per column
+    over the whole permuted array); the staging codec is not applied
+    on this path (shard regions break the contiguous-rows assumption
+    of the window codec plans — revisit if transfer dominates)."""
+    axis_name = tuple(mesh.axis_names)  # dim0 over every mesh axis
+    H = int(mesh.devices.shape[0])
+    d = mesh.devices.size
+    d_host = d // H
+    shard_rows = np.asarray(shard_rows, np.int64)
+    assert shard_rows.shape == (H,) and int(shard_rows.sum()) == len(gids)
+    b, nblk = block_geometry(int(max(shard_rows.max(), 1)), d_host, block_rows)
+    region = d_host * nblk * b
+    total = d * nblk * b
+    offs = np.concatenate([[0], np.cumsum(shard_rows)[:-1]])
+    sharding = NamedSharding(mesh, P(axis_name))
+
+    def scatter(arr, fill):
+        out = np.full(total, fill, dtype=arr.dtype if arr.size else np.int32)
+        for h in range(H):
+            r = int(shard_rows[h])
+            out[h * region : h * region + r] = arr[offs[h] : offs[h] + r]
+        return out
+
+    narrow_offsets: dict[str, int] = {}
+    blocks: dict[str, jax.Array] = {}
+    for name, a in cols.items():
+        with timed("stage_host_pack"):
+            a, off = _narrow_int(np.asarray(a))
+            if off is not None:
+                narrow_offsets[name] = off
+            flat = scatter(a, 0)
+        COLD_PROFILE["stage_bytes"] = COLD_PROFILE.get(
+            "stage_bytes", 0.0
+        ) + float(flat.nbytes)
+        with timed("stage_transfer"):
+            blocks[name] = jax.device_put(flat.reshape(d, nblk, b), sharding)
+            COLD_PROFILE["wire_bytes"] = COLD_PROFILE.get(
+                "wire_bytes", 0.0
+            ) + float(flat.nbytes)
+    gflat = scatter(_narrow_gids(np.asarray(gids), num_groups), 0)
+    gids_dev = jax.device_put(gflat.reshape(d, nblk, b), sharding)
+    with timed("stage_transfer"):
+        jax.block_until_ready(list(blocks.values()) + [gids_dev])
+    mask_dev = _shard_mask_builder(mesh, d, nblk, b, region)(
+        jnp.asarray(shard_rows)
+    )
+    return StagedColumns(
+        blocks=blocks,
+        mask=mask_dev,
+        gids=gids_dev,
+        num_rows=int(shard_rows.sum()),
+        num_devices=d,
+        block_rows=b,
+        num_groups=num_groups,
+        capacity=_pow2_at_least(max(num_groups, 1)),
+        key_columns=[],
+        dictionaries={},
+        narrow_offsets=narrow_offsets,
+        int_dicts={},
+    )
 
 
 # -- streaming, double-buffered staging (the r6 cold-path pipeline) ----------
@@ -738,7 +839,7 @@ def put_window_gids(mesh: Mesh, pgids, nblk: int, b: int):
 
     if pgids is None:
         return None
-    (axis_name,) = mesh.axis_names
+    axis_name = tuple(mesh.axis_names)  # dim0 over every mesh axis
     if isinstance(pgids, _codec.CodecPayload):
         args = _codec.put_payload(mesh, pgids)
         return _codec.decoder(mesh, pgids.plan, nblk, b)(*args)
@@ -764,7 +865,7 @@ def _concat_builder(mesh: Mesh, n_parts: int):
     preserved (device-local copies; no collective). Used to assemble the
     streamed windows into one monolithic StagedColumns for the warm-path
     HBM cache."""
-    (axis_name,) = mesh.axis_names
+    axis_name = tuple(mesh.axis_names)  # dim0 over every mesh axis
     sharding = NamedSharding(mesh, P(axis_name))
     return jax.jit(
         lambda *xs: jnp.concatenate(xs, axis=1), out_shardings=sharding
@@ -776,7 +877,7 @@ def _zeros_builder(mesh: Mesh, d: int, nblk: int, b: int, dtype_str: str):
     """Device-allocated zero blocks (sharded, NO host transfer): the
     bucket padding appended to a concatenated stream staging. Padding
     blocks are fully masked, so the warm program scans them as no-ops."""
-    (axis_name,) = mesh.axis_names
+    axis_name = tuple(mesh.axis_names)  # dim0 over every mesh axis
     sharding = NamedSharding(mesh, P(axis_name))
     return jax.jit(
         lambda: jnp.zeros((d, nblk, b), np.dtype(dtype_str)),

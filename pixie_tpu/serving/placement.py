@@ -73,13 +73,27 @@ _REBALANCE_MOVES = _M.counter(
 # "no residency" — ranked by load then latency then name, so a fresh
 # agent isn't starved just because a warmer-history one exists); the
 # labels stay distinct for metrics.
-OUTCOMES = ("view_hit", "ring_hit", "replica_hit", "latency_fallback", "cold")
+OUTCOMES = (
+    "view_hit",
+    "ring_hit",
+    "replica_hit",
+    "latency_fallback",
+    "cold",
+    "mesh_fold",
+)
+# mesh_fold (r21) is not an agent rung: decide() returns it INSTEAD of a
+# pick when the span's estimated staging bytes exceed every eligible
+# agent's advertised HBM budget — forcing a single-agent placement would
+# only thrash that agent's residency ring, so the broker plans the fold
+# across the full fleet (spanning placement). Order 3 is for the
+# metrics/ladder listing only; it never competes in the rank tuple.
 _OUTCOME_ORDER = {
     "view_hit": -1,
     "ring_hit": 0,
     "replica_hit": 1,
     "latency_fallback": 2,
     "cold": 2,
+    "mesh_fold": 3,
 }
 
 View = List[Dict[str, Any]]  # AgentTracker.failover_view() entries
@@ -205,14 +219,35 @@ class PlacementPlane:
         view: View,
         needed: FrozenSet[str],
         fold_latency: Optional[Dict[str, Dict]] = None,
+        estimated_bytes: int = 0,
     ) -> Tuple[Optional[str], Optional[str]]:
         """Rank eligible data-plane agents for ``needed``.
 
-        Returns (agent_id, outcome) or (None, None) when no live
-        non-kelvin agent covers the span.
+        Returns (agent_id, outcome), (None, "mesh_fold") when the span
+        is too big for any single agent's HBM (see _OUTCOME_ORDER), or
+        (None, None) when no live non-kelvin agent covers the span.
         """
         if not needed:
             return None, None
+        # r21 mesh_fold rung: with a staging estimate in hand, refuse a
+        # single-agent pick when the span exceeds EVERY eligible
+        # agent's advertised HBM budget (heartbeat residency snapshot).
+        # An agent without an advertised budget is unknown — assume it
+        # fits, keeping the rung conservative.
+        if estimated_bytes > 0 and flags.mesh_fold_placement:
+            any_eligible = False
+            fits_somewhere = False
+            for a in view:
+                if a["is_kelvin"] or not eligible(a, needed):
+                    continue
+                any_eligible = True
+                res = (a.get("health") or {}).get("residency") or {}
+                budget = int(res.get("budget_bytes") or 0)
+                if budget <= 0 or estimated_bytes <= budget:
+                    fits_somewhere = True
+                    break
+            if any_eligible and not fits_somewhere:
+                return None, "mesh_fold"
         lat = agent_latency(fold_latency)
         best: Optional[Tuple[Tuple, str, str]] = None
         with self._lock:
@@ -282,6 +317,27 @@ class PlacementPlane:
                 + self._outcomes["replica_hit"]
             )
         _HIT_RATE.set(hits / total if total else 0.0)
+
+    def route_view_tail(
+        self,
+        agent_id: str,
+        needed: FrozenSet[str],
+        weight: float = 1.0,
+    ) -> None:
+        """r21: a view hit's unflushed-tail delta fold, routed to the
+        view's maintain agent (the tracker pick recorded at
+        registration). Attribution only — not an admission decision,
+        so the outcome/hit-rate counters are untouched; the agent's
+        WFQ load, inflight occupancy, and table heat do move so the
+        rebalancer and the ladder see the tail work where it runs.
+        Pair with ``release(agent_id)`` when the fold completes."""
+        with self._lock:
+            self._placed[agent_id] += 1
+            self._load[agent_id] += 1.0 / max(float(weight), 1e-6)
+            self._inflight[agent_id] += 1
+            for t in needed:
+                self._heat[t] += 1
+                self._heat_total[t] += 1
 
     def release(self, agent_id: str) -> None:
         with self._lock:

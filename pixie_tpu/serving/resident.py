@@ -267,7 +267,7 @@ class ResidentRing:
                 k, k * self.window_rows, self.window_rows, win_cols
             )
 
-        (axis_name,) = self.mesh.axis_names
+        axis_name = tuple(self.mesh.axis_names)  # dim0 over every mesh axis
         sharding = NamedSharding(self.mesh, P(axis_name))
         total = self.d * self.nblk * self.b
         W = self.window_rows
@@ -524,7 +524,7 @@ class ReplicaRing:
                 # behind the leader's watermark for this window.
                 _REPLICA_LAGGED.inc(table=self.table_name)
                 return False
-            (axis_name,) = self.mesh.axis_names
+            axis_name = tuple(self.mesh.axis_names)  # dim0 over every mesh axis
             sharding = NamedSharding(self.mesh, P(axis_name))
             shard_len = self.nblk * self.b
             blocks = {}

@@ -106,6 +106,12 @@ _VIEW_REBUILDS = _M.counter(
     "the table — the from-scratch fold can no longer see them, so "
     "bit-identity demands a rebuild from the new min_row_id.",
 )
+_VIEW_TAIL_ROUTED = _M.counter(
+    "view_tail_folds_routed_total",
+    "View-hit tail delta folds attributed to the view's maintain "
+    "agent (the tracker pick recorded at registration) instead of "
+    "the broker, by view and agent.",
+)
 
 _SCRIPT_PREFIX = "/view_scripts/"
 _STATE_PREFIX = "/view_state/"
@@ -284,6 +290,10 @@ class MaterializedView:
         self.fail_count = 0
         self.breaker_open = False
         self.last_error: Optional[str] = None
+        # r21: the maintain agent this view's tail folds route to —
+        # the tracker pick recorded at registration (None until the
+        # tracker can name an owner; re-resolved lazily on first read).
+        self.maintain_agent: Optional[str] = None
         self._lock = threading.RLock()
         self._read_memo: dict = {}
 
@@ -495,10 +505,14 @@ class MaterializedView:
             arg_dicts=arg_dicts,
         )
 
-    def read(self, table, entry: _ProbeEntry):
+    def read(self, table, entry: _ProbeEntry, tail_agent=None,
+             tail_wrap=None):
         """Serve one query: carried state ⊕ tail delta fold, MERGE-
         finalized under the query's names. Returns (RowBatch, freshness
-        dict) or (None, reason) when the view cannot serve."""
+        dict) or (None, reason) when the view cannot serve. When
+        ``tail_wrap`` is given the tail fold runs through it (r21 view
+        admission placement: the registry attributes the fold to the
+        view's maintain agent); memo hits never re-enter the wrapper."""
         with self._lock:
             if self.breaker_open:
                 return None, "breaker_open"
@@ -514,9 +528,13 @@ class MaterializedView:
             )
             memo = self._read_memo.get(memo_key)
             if memo is None:
-                tail, tail_rows = self._fold_range(
-                    table, self.watermark, end
-                )
+                def _fold():
+                    return self._fold_range(table, self.watermark, end)
+
+                if tail_wrap is not None:
+                    tail, tail_rows = tail_wrap(_fold)
+                else:
+                    tail, tail_rows = _fold()
                 carried = self._rename(
                     self.state, entry.out_names, entry.group_names
                 )
@@ -571,6 +589,7 @@ class MaterializedView:
             "staleness_s": staleness,
             "watermark": int(wm),
             "tail_rows": int(tail_rows),
+            "tail_agent": tail_agent,
         }
 
     def status(self, table=None) -> dict:
@@ -678,6 +697,9 @@ class ViewRegistry:
             raw = self._ds.get(_STATE_PREFIX + view_id)
             if raw is not None:
                 view.recover(raw)
+            # r21: record the maintain-agent pick at registration —
+            # tail folds on read route to it (view admission placement).
+            view.maintain_agent = self._maintain_agent(view.table_name)
             self._views[view_id] = view
             self._by_key[(sig, digest)] = view_id
             self._probe_cache.clear()
@@ -808,7 +830,10 @@ class ViewRegistry:
             _VIEW_MISSES.inc(reason="no_table")
             return None
         t0 = time.perf_counter_ns()
-        batch, info = view.read(table, entry)
+        tail_agent, tail_wrap = self._tail_route(view)
+        batch, info = view.read(
+            table, entry, tail_agent=tail_agent, tail_wrap=tail_wrap
+        )
         if batch is None:
             self.misses += 1
             _VIEW_MISSES.inc(reason=info)
@@ -824,6 +849,42 @@ class ViewRegistry:
         )
         result.view = info
         return result
+
+    # -- tail-fold routing (r21) ---------------------------------------------
+    def _tail_route(self, view: MaterializedView):
+        """Resolve where a view hit's unflushed-tail delta fold is
+        attributed. Returns (agent_id, wrap) — (None, None) when the
+        flag is off or no maintain agent is known. The wrap charges
+        the fold to the maintain agent's WFQ load / inflight / table
+        heat for exactly the duration of the fold, so the rebalancer
+        and the placement ladder see the tail work where the r18
+        posture says it belongs — never the broker."""
+        if not flags.view_tail_placement:
+            return None, None
+        agent = view.maintain_agent
+        if agent is None:
+            # Registration may have preceded agent discovery; adopt
+            # the tracker's current pick once it can name an owner.
+            agent = view.maintain_agent = self._maintain_agent(
+                view.table_name
+            )
+        if agent is None:
+            return None, None
+        placement = getattr(self._broker, "placement", None)
+
+        def wrap(fold, _agent=agent, _view=view, _placement=placement):
+            if _placement is not None:
+                _placement.route_view_tail(
+                    _agent, frozenset([_view.table_name])
+                )
+            try:
+                return fold()
+            finally:
+                if _placement is not None:
+                    _placement.release(_agent)
+                _VIEW_TAIL_ROUTED.inc(view=_view.name, agent=_agent)
+
+        return agent, wrap
 
     # -- observability -------------------------------------------------------
     def _maintain_agent(self, table_name: str) -> Optional[str]:
@@ -850,7 +911,9 @@ class ViewRegistry:
         out = []
         for v in views:
             s = v.status(self._tables.get_table(v.table_name))
-            s["maintain_agent"] = self._maintain_agent(v.table_name)
+            s["maintain_agent"] = (
+                v.maintain_agent or self._maintain_agent(v.table_name)
+            )
             out.append(s)
         total = hits + misses
         return {

@@ -234,6 +234,46 @@ define_flag(
     "prefix math must stay exact).",
 )
 define_flag(
+    "mesh_axes",
+    "",
+    help_="Mesh geometry for MeshExecutor when no mesh is passed "
+    "explicitly, as comma-separated name:size pairs, outermost axis "
+    "first (e.g. 'hosts:2,d:4'). A size of -1 (at most one axis) "
+    "means 'all remaining devices'. Empty: a flat single-host mesh "
+    "'d:<ndevices>'. Geometry is part of every compiled program "
+    "signature, so a geometry change can never reuse a stale "
+    "executable (pixie_tpu/distributed/mesh.py).",
+)
+define_flag(
+    "mesh_distributed_join",
+    True,
+    help_="On a multi-axis mesh, run device equijoins as a distributed "
+    "sort-merge: range-partition both sides by packed key across the "
+    "hosts axis (balanced by per-key join work from the exact host "
+    "bincounts), sort + merge locally per shard, concatenate — "
+    "instead of the v1 replicated all_gather sort. Bit-identical to "
+    "the host EquijoinNode. Off, or on a flat mesh: the v1 replicated "
+    "path runs unchanged.",
+)
+define_flag(
+    "mesh_fold_placement",
+    True,
+    help_="Adds the mesh_fold rung to the placement ladder: when a "
+    "query's estimated staging span exceeds every live agent's "
+    "advertised HBM headroom, admission stops forcing a single-agent "
+    "pick and plans the fold across the full fleet (spanning "
+    "placement) instead of thrashing one agent's residency ring.",
+)
+define_flag(
+    "view_tail_placement",
+    True,
+    help_="Route a view hit's unflushed-tail delta fold to the view's "
+    "maintain agent (the r18 tracker pick recorded at registration) "
+    "instead of folding on the broker — the agent already holds the "
+    "table's resident ring and the view's carried state. Off: tail "
+    "folds run wherever the probe runs (broker-local).",
+)
+define_flag(
     "agent_expiry_s",
     2.0,
     help_="Heartbeat silence before an agent is pruned from plans "

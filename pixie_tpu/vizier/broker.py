@@ -986,8 +986,30 @@ class QueryBroker:
                     self.tracker.failover_view(),
                     needed,
                     fold_latency=self.tracker.fold_latency_view(),
+                    estimated_bytes=self._estimate_staging(query),
                 )
-                if pick is not None:
+                if pick is None and outcome == "mesh_fold":
+                    # r21: the span's estimated staging exceeds every
+                    # agent's HBM budget — don't force a single-agent
+                    # pick; plan over the UNMODIFIED state so fragments
+                    # span the fleet and per-agent folds stay inside
+                    # their budgets. Commit under the "__mesh__" pseudo
+                    # agent (load/inflight accounting + the outcome
+                    # counter; affinity on it never matches a real pick).
+                    try:
+                        plan = planner.plan(logical, state)
+                    except ValueError:
+                        plan = None
+                    if plan is not None:
+                        placed_agent = "__mesh__"
+                        placement_outcome = "mesh_fold"
+                        self.placement.commit(
+                            "__mesh__",
+                            "mesh_fold",
+                            needed,
+                            weight=self.admission._weight(tenant or "default"),
+                        )
+                elif pick is not None:
                     placed_state = DistributedState(
                         agents=[
                             AgentInfo(

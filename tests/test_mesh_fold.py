@@ -1,0 +1,387 @@
+"""Multi-host mesh execution plane (r21) on the 8-virtual-device CPU mesh.
+
+The contract under test: the fold is ONE program over the global mesh —
+a multi-axis ``hosts × d`` geometry is BIT-IDENTICAL to the flat 1-host
+mesh across the UDA lanes (count / sum / min / max / HLL / count-min
+sketch states, group emission order included), because collectives
+reduce over the full axis tuple and XLA's row-major device order makes
+the fused cross-host combine tree coincide with the flat one. The
+distributed sort-merge join range-partitions both sides by key across
+the ``hosts`` axis and stays bit-identical to the host EquijoinNode for
+all four join types, ragged and empty shards included. Geometry is part
+of the r7 program signature: a different mesh shape can never replay
+another geometry's cached program. The placement ladder's ``mesh_fold``
+rung refuses a single-agent pick when the span exceeds every agent's
+advertised HBM budget.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from pixie_tpu.distributed.mesh import MeshConfig
+from pixie_tpu.engine import Carnot
+from pixie_tpu.ops import segment as segment_ops
+from pixie_tpu.parallel import MeshExecutor
+from pixie_tpu.serving.placement import PlacementPlane
+from pixie_tpu.types import DataType, Relation
+from pixie_tpu.utils import flags
+
+F, I, S = DataType.FLOAT64, DataType.INT64, DataType.STRING
+
+
+@pytest.fixture
+def flagset():
+    saved = {}
+
+    def set_(name, value):
+        if name not in saved:
+            saved[name] = flags.get(name)
+        flags.set(name, value)
+
+    yield set_
+    for name, value in saved.items():
+        flags.set(name, value)
+
+
+# -- geometry ----------------------------------------------------------------
+
+
+def test_mesh_config_parse_and_signature():
+    assert MeshConfig.flat(8).signature() == "d:8"
+    cfg = MeshConfig.parse("hosts:2,d:4", 8)
+    assert cfg.axes == (("hosts", 2), ("d", 4))
+    assert cfg.names == ("hosts", "d")
+    assert cfg.shape == (2, 4)
+    assert cfg.total_devices == 8
+    assert cfg.signature() == "hosts:2,d:4"
+    # One wildcard fills the remaining devices.
+    assert MeshConfig.parse("hosts:2,d:-1", 8).shape == (2, 4)
+    assert MeshConfig.parse("hosts:-1,d:2", 8).shape == (4, 2)
+    # Empty spec is the flat 1-host special case.
+    assert MeshConfig.parse("", 8) == MeshConfig.flat(8)
+
+
+def test_mesh_config_rejects_bad_geometry():
+    with pytest.raises(ValueError):
+        MeshConfig.parse("hosts:3,d:4", 8)  # 12 != 8
+    with pytest.raises(ValueError):
+        MeshConfig.parse("hosts:-1,d:-1", 8)  # two wildcards
+    with pytest.raises(ValueError):
+        MeshConfig.parse("hosts:3,d:-1", 8)  # 8 % 3 != 0
+    with pytest.raises(ValueError):
+        MeshConfig.parse("hosts=2", 8)  # malformed axis
+    with pytest.raises(ValueError):
+        MeshConfig(axes=(("d", 4), ("d", 2)))  # duplicate axis name
+    with pytest.raises(ValueError):
+        MeshConfig(axes=())
+
+
+def test_mesh_build_matches_devices():
+    cfg = MeshConfig.parse("hosts:2,d:4", 8)
+    mesh = cfg.build(jax.devices("cpu"))
+    assert tuple(mesh.axis_names) == ("hosts", "d")
+    assert mesh.devices.shape == (2, 4)
+    with pytest.raises(ValueError):
+        MeshConfig.parse("hosts:2,d:2", 4).build(jax.devices("cpu"))
+
+
+# -- fold bit-identity --------------------------------------------------------
+
+AGG_QUERY = (
+    "df = px.DataFrame(table='http')\n"
+    "df = df[df.status >= 1]\n"
+    "g = df.groupby('service').agg("
+    "n=('lat', px.count), s=('lat', px.sum),"
+    " mn=('lat', px.min), mx=('lat', px.max),"
+    " u=('service', px.approx_count_distinct),"
+    " cm=('status', px.count_min))\n"
+    "px.display(g, 'out')\n"
+)
+
+
+def _fold(cfg, n=3000, nsvc=37, seed=7):
+    ex = MeshExecutor(block_rows=256, mesh_config=cfg)
+    carnot = Carnot(device_executor=ex)
+    rel = Relation.of(("service", S), ("status", I), ("lat", F))
+    t = carnot.table_store.create_table("http", rel)
+    rng = np.random.default_rng(seed)
+    t.write_pydict(
+        {
+            "service": np.array(
+                [f"svc{i}" for i in rng.integers(0, nsvc, n)]
+            ),
+            "status": rng.integers(0, 5, n),
+            "lat": rng.standard_normal(n),
+        }
+    )
+    out = carnot.execute_query(AGG_QUERY).table("out")
+    assert not ex.fallback_errors, ex.fallback_errors
+    return out, ex
+
+
+def _assert_same(a, b, ctx=""):
+    assert list(a.keys()) == list(b.keys()), ctx
+    for k in a:
+        x, y = np.asarray(a[k]), np.asarray(b[k])
+        # Values AND group emission order, sketch states included.
+        assert np.array_equal(x, y), (ctx, k, x[:5], y[:5])
+
+
+def test_fold_bit_identical_across_mesh_geometries():
+    flat, ex1 = _fold(MeshConfig.flat(8))
+    two_four, ex2 = _fold(MeshConfig.parse("hosts:2,d:4", 8))
+    four_two, ex3 = _fold(MeshConfig.parse("hosts:4,d:2", 8))
+    _assert_same(flat, two_four, "d:8 vs hosts:2,d:4")
+    _assert_same(flat, four_two, "d:8 vs hosts:4,d:2")
+    # Geometry is carried into every cached program signature.
+    assert ex1._mesh_sig == "d:8"
+    assert ex2._mesh_sig == "hosts:2,d:4"
+    for sig in ex2._program_cache:
+        assert "mesh:hosts:2,d:4" in sig, sig
+
+
+def test_fold_ragged_and_empty_shards_bit_identical():
+    # 13 rows over 8 devices: ragged per-device tails, and on the 4x2
+    # geometry some host shards see almost nothing; 3 rows leaves most
+    # devices entirely empty (padding-mask-only blocks).
+    for n in (13, 3):
+        flat, _ = _fold(MeshConfig.flat(8), n=n, nsvc=3)
+        multi, _ = _fold(MeshConfig.parse("hosts:4,d:2", 8), n=n, nsvc=3)
+        _assert_same(flat, multi, f"ragged n={n}")
+
+
+def test_geometry_change_means_distinct_cached_program():
+    """The r7 cache can never replay a program compiled for a different
+    mesh shape: the signature carries the geometry and the executor
+    asserts agreement at lookup."""
+    _, ex_flat = _fold(MeshConfig.flat(8), n=64, nsvc=3)
+    _, ex_mesh = _fold(MeshConfig.parse("hosts:2,d:4", 8), n=64, nsvc=3)
+    sigs_flat = set(ex_flat._program_cache)
+    sigs_mesh = set(ex_mesh._program_cache)
+    assert sigs_flat and sigs_mesh
+    assert not (sigs_flat & sigs_mesh), "geometries shared a signature"
+    foreign = next(iter(sigs_flat))
+    with pytest.raises(AssertionError):
+        ex_mesh._get_program(foreign, lambda: None)
+
+
+# -- distributed sort-merge join ----------------------------------------------
+
+REL_L = Relation.of(("svc", S), ("owner", F), ("rank", I))
+REL_R = Relation.of(("service", S), ("lat", F), ("code", I))
+
+
+def _join_carnot(cfg, nl=600, nr=900, seed=3, kl=24, kr=30):
+    ex = (
+        MeshExecutor(block_rows=256, mesh_config=cfg)
+        if cfg is not None
+        else None
+    )
+    carnot = Carnot(device_executor=ex)
+    ts = carnot.table_store
+    tl = ts.create_table("dims", REL_L)
+    tr = ts.create_table("facts", REL_R)
+    rng = np.random.default_rng(seed)
+    tl.write_pydict(
+        {
+            "svc": np.array([f"s{i}" for i in rng.integers(0, kl, nl)]),
+            "owner": rng.standard_normal(nl),
+            "rank": rng.integers(-5, 2_000_000, nl),
+        }
+    )
+    tr.write_pydict(
+        {
+            "service": np.array(
+                [f"s{i}" for i in rng.integers(kl // 2, kr, nr)]
+            ),
+            "lat": rng.standard_normal(nr),
+            "code": rng.integers(0, 7, nr),
+        }
+    )
+    return carnot, ex
+
+
+JOIN_Q = (
+    "l = px.DataFrame(table='dims')\n"
+    "r = px.DataFrame(table='facts')\n"
+    "j = l.merge(r, how='{how}', left_on=['svc'],"
+    " right_on=['service'], suffixes=['', '_r'])\n"
+    "px.display(j, 'joined')\n"
+)
+
+
+def _run_join(cfg, q, **kw):
+    carnot, ex = _join_carnot(cfg, **kw)
+    out = carnot.execute_query(q).table("joined")
+    if ex is not None:
+        assert not ex.fallback_errors, ex.fallback_errors
+    return out
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+def test_partitioned_join_bit_identical_to_host(flagset, how):
+    flagset("device_join_min_rows", 1)
+    q = JOIN_Q.format(how=how)
+    host = _run_join(None, q)
+    segment_ops.reduce_lanes(reset=True)
+    part = _run_join(MeshConfig.parse("hosts:2,d:4", 8), q)
+    lanes = segment_ops.reduce_lanes(reset=True)
+    assert lanes.get("join_partitioned"), (how, lanes)
+    _assert_same(host, part, how)
+
+
+def test_partitioned_join_empty_shards(flagset):
+    """3 distinct keys range-partitioned across 4 host shards: at least
+    one shard holds no keys at all and must emit nothing."""
+    flagset("device_join_min_rows", 1)
+    q = JOIN_Q.format(how="outer")
+    host = _run_join(None, q, nl=90, nr=140, kl=3, kr=5)
+    segment_ops.reduce_lanes(reset=True)
+    part = _run_join(
+        MeshConfig.parse("hosts:4,d:2", 8), q, nl=90, nr=140, kl=3, kr=5
+    )
+    assert segment_ops.reduce_lanes(reset=True).get("join_partitioned")
+    _assert_same(host, part, "empty-shard outer")
+
+
+def test_partitioned_join_flag_off_uses_replicated_lane(flagset):
+    """mesh_distributed_join=0 falls back to the v1 replicated sort —
+    still bit-identical on the multi-axis mesh."""
+    flagset("device_join_min_rows", 1)
+    flagset("mesh_distributed_join", False)
+    q = JOIN_Q.format(how="inner")
+    host = _run_join(None, q)
+    segment_ops.reduce_lanes(reset=True)
+    dev = _run_join(MeshConfig.parse("hosts:2,d:4", 8), q)
+    lanes = segment_ops.reduce_lanes(reset=True)
+    assert not lanes.get("join_partitioned"), lanes
+    _assert_same(host, dev, "replicated lane on 2x4")
+
+
+# -- multi-column equijoin keys (r19 follow-on) -------------------------------
+
+TWO_COL_Q = (
+    "l = px.DataFrame(table='dims2')\n"
+    "r = px.DataFrame(table='facts2')\n"
+    "j = l.merge(r, how='{how}', left_on=['svc', 'code'],"
+    " right_on=['service', 'code2'], suffixes=['', '_r'])\n"
+    "px.display(j, 'joined')\n"
+)
+
+
+def _two_col_carnot(cfg, nl=500, nr=800, seed=11):
+    ex = (
+        MeshExecutor(block_rows=256, mesh_config=cfg)
+        if cfg is not None
+        else None
+    )
+    carnot = Carnot(device_executor=ex)
+    ts = carnot.table_store
+    tl = ts.create_table(
+        "dims2", Relation.of(("svc", S), ("code", I), ("owner", F))
+    )
+    tr = ts.create_table(
+        "facts2", Relation.of(("service", S), ("code2", I), ("lat", F))
+    )
+    rng = np.random.default_rng(seed)
+    tl.write_pydict(
+        {
+            "svc": np.array([f"s{i}" for i in rng.integers(0, 9, nl)]),
+            "code": rng.integers(0, 5, nl),
+            "owner": rng.standard_normal(nl),
+        }
+    )
+    tr.write_pydict(
+        {
+            "service": np.array(
+                [f"s{i}" for i in rng.integers(4, 14, nr)]
+            ),
+            "code2": rng.integers(2, 8, nr),
+            "lat": rng.standard_normal(nr),
+        }
+    )
+    return carnot, ex
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_two_column_key_join_bit_identical(flagset, how):
+    """Composite (string, int) equijoin keys ride the shared
+    GroupEncoder onto the device lane — bit-identical to the host
+    engine on the flat mesh AND through the partitioned lane."""
+    flagset("device_join_min_rows", 1)
+    q = TWO_COL_Q.format(how=how)
+    ch, _ = _two_col_carnot(None)
+    host = ch.execute_query(q).table("joined")
+    cd, ex = _two_col_carnot(MeshConfig.flat(8))
+    flat = cd.execute_query(q).table("joined")
+    assert not ex.fallback_errors, ex.fallback_errors
+    _assert_same(host, flat, f"two-col {how} flat")
+    segment_ops.reduce_lanes(reset=True)
+    cp, exp = _two_col_carnot(MeshConfig.parse("hosts:2,d:4", 8))
+    part = cp.execute_query(q).table("joined")
+    assert not exp.fallback_errors, exp.fallback_errors
+    assert segment_ops.reduce_lanes(reset=True).get("join_partitioned")
+    _assert_same(host, part, f"two-col {how} partitioned")
+
+
+# -- mesh_fold placement rung -------------------------------------------------
+
+
+def _agent(aid, budget=0, is_kelvin=False):
+    return {
+        "agent_id": aid,
+        "tables": frozenset({"http"}),
+        "replica_tables": frozenset(),
+        "is_kelvin": is_kelvin,
+        "health": {
+            "residency": {
+                "tables": ["http"],
+                "used_bytes": 0,
+                "budget_bytes": budget,
+            },
+            "resident_ingest": ["http"],
+            "replicas": {},
+        },
+    }
+
+
+def test_mesh_fold_rung_refuses_oversized_span(flagset):
+    flagset("mesh_fold_placement", True)
+    plane = PlacementPlane()
+    needed = frozenset({"http"})
+    view = [_agent("pem1", budget=1 << 20), _agent("pem2", budget=1 << 21)]
+    # Fits on pem2: normal single-agent pick.
+    aid, outcome = plane.decide(view, needed, estimated_bytes=(1 << 21) - 1)
+    assert aid is not None and outcome != "mesh_fold"
+    # Exceeds every advertised budget: the span must shard the fold.
+    assert plane.decide(view, needed, estimated_bytes=(1 << 22)) == (
+        None,
+        "mesh_fold",
+    )
+    # An agent without an advertised budget is unknown — assume it fits.
+    view_unknown = [_agent("pem1", budget=1 << 20), _agent("pem3", budget=0)]
+    aid, outcome = plane.decide(
+        view_unknown, needed, estimated_bytes=(1 << 30)
+    )
+    assert aid is not None and outcome != "mesh_fold"
+    # No estimate, or flag off: the rung never triggers.
+    aid, outcome = plane.decide(view, needed)
+    assert outcome != "mesh_fold"
+    flagset("mesh_fold_placement", False)
+    aid, outcome = plane.decide(view, needed, estimated_bytes=(1 << 30))
+    assert outcome != "mesh_fold"
+
+
+def test_view_tail_route_moves_load_not_outcomes():
+    """route_view_tail is attribution, not an admission decision: the
+    agent's inflight/load/heat move, the hit-rate counters do not."""
+    plane = PlacementPlane()
+    before = dict(plane._outcomes)
+    plane.route_view_tail("pem1", frozenset({"http"}))
+    assert plane._inflight["pem1"] == 1
+    assert plane._load["pem1"] > 0
+    assert plane._heat["http"] == 1
+    assert dict(plane._outcomes) == before
+    plane.release("pem1")
+    assert plane._inflight["pem1"] == 0

@@ -324,3 +324,37 @@ def test_time_bucket_view_serves_windowed_aggregation(cluster, flagset):
     assert res.view is not None
     assert _pydict(res) == scratch
     assert len(scratch["bucket"]) > 1  # actually bucketed
+
+
+def test_view_tail_fold_routes_to_maintain_agent(cluster, flagset):
+    """r21 view admission placement: a view hit's unflushed-tail delta
+    fold is attributed to the view's maintain agent (the tracker pick
+    recorded at registration), surfaced in the freshness stamp, and
+    drained from the agent's inflight occupancy when the fold ends."""
+    broker, store, t = cluster
+    flagset("materialized_views", True)
+    flagset("view_tail_placement", True)
+    broker.start_views(store, datastore=Datastore())
+    broker.views.register(QUERY, name="routed", refresh_interval_s=30)
+    # Unflushed tail: rows appended after the registration maintenance.
+    t.write_pydict(_rows(np.random.default_rng(5), 500, start=N))
+    res = broker.execute_script(QUERY)
+    assert res.view is not None
+    assert res.view["tail_rows"] == 500
+    agent = res.view["tail_agent"]
+    assert agent == "pem0"  # pem0 owns 'http'; kelvin never maintains
+    view = next(iter(broker.views._views.values()))
+    assert view.maintain_agent == agent  # the registration-time pick
+    st = broker.views.status()["views"][0]
+    assert st["maintain_agent"] == agent
+    if broker.placement is not None:
+        assert broker.placement._inflight[agent] == 0  # drained
+        assert broker.placement._load[agent] > 0  # but charged
+    # Served answer is still bit-identical to the from-scratch fold.
+    assert _pydict(res) == _scratch(broker, QUERY)
+    # Flag off: the tail folds un-routed and the stamp says so.
+    flagset("view_tail_placement", False)
+    t.write_pydict(_rows(np.random.default_rng(6), 100, start=N + 500))
+    res2 = broker.execute_script(QUERY)
+    assert res2.view is not None
+    assert res2.view["tail_agent"] is None

@@ -402,7 +402,6 @@ def _run_soak_inner(
     n_agents=1, fleet_tables=0, views=False,
 ) -> dict:
     import jax
-    from jax.sharding import Mesh
 
     from pixie_tpu.exec import BridgeRouter
     from pixie_tpu.parallel import MeshExecutor
@@ -424,8 +423,10 @@ def _run_soak_inner(
         ("resp_status", I),
         ("latency", F),
     )
-    mesh = Mesh(np.array(jax.devices()), ("d",))
-    ex = MeshExecutor(mesh=mesh)
+    # r21: geometry comes from the mesh_axes flag (flat by default) so
+    # the soak can exercise multi-host sub-meshes via
+    # PIXIE_TPU_MESH_AXES=hosts:2,d:-1 without code changes.
+    ex = MeshExecutor()
     store = TableStore()
     rng = np.random.default_rng(seed)
     fleet = fleet_tables > 0
@@ -536,7 +537,7 @@ def _run_soak_inner(
         # bit-identical wherever it lands (the r17 pem2 construction,
         # N-wide).
         for i in range(2, n_agents + 1):
-            exn = MeshExecutor(mesh=Mesh(np.array(jax.devices()), ("d",)))
+            exn = MeshExecutor()  # same flag-resolved geometry as pem1
             agents.insert(
                 i - 1,
                 Agent(
@@ -549,7 +550,7 @@ def _run_soak_inner(
         # executor at the same mesh geometry (device folds stay
         # bit-identical), advertised as replica-only — the planner
         # never scans it, failover does.
-        ex2 = MeshExecutor(mesh=Mesh(np.array(jax.devices()), ("d",)))
+        ex2 = MeshExecutor()  # same flag-resolved geometry as pem1
         agents.insert(
             1,
             Agent(
