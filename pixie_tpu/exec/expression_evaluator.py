@@ -233,10 +233,12 @@ class ExpressionEvaluator:
         lut_key = f"lut:{id(expr)}"
         if lut_key in aux:
             # Precomputed dictionary-value table; gather through codes.
+            # Only the string COLUMN feeds the gather; string constants
+            # (e.g. a pluck key) are already baked into the table.
             (arg,) = [
                 self.device_eval(a, env, aux)
                 for a, t in zip(expr.args, arg_types)
-                if t == DataType.STRING
+                if t == DataType.STRING and isinstance(a, ColumnRef)
             ]
             import jax.numpy as jnp
 

@@ -88,6 +88,22 @@ from pixie_tpu.parallel import profiler as resattr
 from pixie_tpu.distributed import mesh as mesh_lib
 from pixie_tpu.utils import faults, flags, metrics_registry, trace
 
+# r22 learned cost model, resolved lazily (serving's package init
+# transitively imports this module, so a top-level import would cycle).
+# After first resolution every gate is `_cost_model().ACTIVE` — a cached
+# global + attribute load, held <1% by microbench_fault_overhead's
+# cost_model_overhead key.
+_COST_MODEL = None
+
+
+def _cost_model():
+    global _COST_MODEL
+    if _COST_MODEL is None:
+        from pixie_tpu.serving import cost_model
+
+        _COST_MODEL = cost_model
+    return _COST_MODEL
+
 _M = metrics_registry()
 _OFFLOAD_HITS = _M.counter(
     "device_offload_total", "Fragments executed on the device mesh."
@@ -240,7 +256,11 @@ def normalize_predicates(predicates, evaluator, staged, aux):
     const)`` folds into ONE membership term (op 6) whose values
     ride a per-term LUT lane in the batched fold, so IN-heavy
     query families join predicate batches instead of falling back
-    to solo folds. Exactness contract per term: int/bool/code
+    to solo folds; and — r22 — a LUT-backed host-func predicate
+    (``f(col)`` or ``cmp(f(col), c)`` over a dictionary column,
+    via ``_lut_pred_term``), which collapses to the op-6
+    membership of the codes the precomputed per-value table
+    keeps. Exactness contract per term: int/bool/code
     columns compare in int64 (every staged int value and
     dictionary code fits exactly); float columns compare in
     float64 with the threshold pre-rounded through the column's
@@ -274,21 +294,27 @@ def _normalize_pred(p, evaluator, staged, aux, terms):
             return False
         terms.append(("i", p.name, 1, 0, 0.0, ()))  # col != 0
         return True
-    if not isinstance(p, FuncCall) or len(p.args) != 2:
+    if not isinstance(p, FuncCall):
         return False
-    if p.name == "logical_and":
+    if p.name == "logical_and" and len(p.args) == 2:
         # A conjunction is just more terms.
         return _normalize_pred(
             p.args[0], evaluator, staged, aux, terms
         ) and _normalize_pred(
             p.args[1], evaluator, staged, aux, terms
         )
-    if p.name == "logical_or":
+    if p.name == "logical_or" and len(p.args) == 2:
         t = _in_list_term(p, evaluator, staged, aux)
         if t is None:
             return False
         terms.append(t)
         return True
+    t = _lut_pred_term(p, evaluator, staged, aux)
+    if t is not None:
+        terms.append(t)
+        return True
+    if len(p.args) != 2:
+        return False
     op = _CMP_OPS.get(p.name)
     if op is None:
         return False
@@ -417,6 +443,82 @@ def _in_list_term(p, evaluator, staged, aux):
     # Membership is order/multiplicity-insensitive; sort+dedup so
     # equivalent IN-lists share one slot under the exact-key ladder.
     return ("i", col_name, 6, 0, 0.0, tuple(sorted(set(vals))))
+
+
+# numpy mirrors of the device comparison ids — x64 is enabled globally
+# (pixie_tpu/__init__), so host-numpy and on-device jnp comparisons of
+# the same LUT values against the same scalar agree bitwise.
+_NP_CMP = {
+    0: np.equal, 1: np.not_equal, 2: np.less,
+    3: np.less_equal, 4: np.greater, 5: np.greater_equal,
+}
+# Bound on the op-6 lane width a LUT predicate may demand: a predicate
+# keeping more dictionary values than this refuses normalization (the
+# query still folds solo) rather than inflating the batched fold's L
+# bucket for every co-batched query.
+_LUT_PRED_MAX_KEPT = 1024
+
+
+def _lut_pred_term(p, evaluator, staged, aux):
+    """r22 (r18 carry-over): lower a LUT-backed host-func predicate to
+    one membership term. Two shapes: a bare boolean host func over one
+    dictionary column (``f(col)`` whose aux table ``lut:{id(p)}`` was
+    precomputed by ``build_aux``) and a comparison of such a func
+    against a numeric constant (``cmp(f(col), c)``, either order).
+    Both reduce to the SET OF DICTIONARY CODES the predicate keeps —
+    an op-6 membership term over the column's code block. This is
+    bit-equal to the solo device path by construction: the solo fold
+    gathers the SAME per-code table and masks on (a comparison of) the
+    gathered value, so row code ``k`` survives there iff ``lut[k]``
+    passes — exactly membership of ``k`` in the kept set (an empty
+    kept set keeps nothing on both paths). None refuses: no LUT in
+    ``aux`` (host/digest shim, or not dict_compatible), a non-bool LUT
+    on the bare shape, string/bool constants, or a kept set wider than
+    the op-6 lane cap."""
+    op = _CMP_OPS.get(p.name)
+    const = None
+    if op is not None and len(p.args) == 2:
+        a0, a1 = p.args
+        if isinstance(a0, FuncCall) and isinstance(a1, Constant):
+            f_expr, const = a0, a1
+        elif isinstance(a1, FuncCall) and isinstance(a0, Constant):
+            f_expr, const = a1, a0
+            op = _CMP_FLIP[op]
+        else:
+            return None
+    elif f"lut:{id(p)}" in aux:
+        f_expr, op = p, None  # bare boolean func: keep where truthy
+    else:
+        return None
+    lut = aux.get(f"lut:{id(f_expr)}")
+    if lut is None:
+        return None
+    cols = [a for a in f_expr.args if isinstance(a, ColumnRef)]
+    if len(cols) != 1:
+        return None
+    col = cols[0]
+    if col.name not in staged.blocks or col.name in staged.int_dicts:
+        return None
+    lut = np.asarray(lut)
+    if lut.ndim != 1 or lut.dtype.kind not in "bif":
+        return None
+    if op is None:
+        # Bare predicate: the solo path ANDs the gathered value into a
+        # boolean mask, which only traces for bool LUTs — mirror that.
+        if lut.dtype != np.bool_:
+            return None
+        kept = lut
+    else:
+        v = const.value
+        if not isinstance(
+            v, (int, float, np.integer, np.floating)
+        ) or isinstance(v, bool):
+            return None
+        kept = _NP_CMP[op](lut, v)
+    codes = np.nonzero(np.asarray(kept, dtype=bool))[0]
+    if len(codes) > _LUT_PRED_MAX_KEPT:
+        return None
+    return ("i", col.name, 6, 0, 0.0, tuple(int(c) for c in codes))
 
 
 @dataclasses.dataclass
@@ -1325,6 +1427,12 @@ class MeshExecutor:
                     resattr.record_dispatch(
                         "fold", elapsed_ns / 1e9, program=bkey[:120]
                     )
+                cm = _cost_model()
+                if cm.ACTIVE:
+                    # r22: the whole-offload wall feeds the shapeless
+                    # ``fold`` cost family — the controller's predictive
+                    # term and admission's fold-seconds advisory.
+                    cm.observe_family("fold", 0, elapsed_ns / 1e9)
             return out
         except Exception as e:
             import logging
@@ -2408,8 +2516,21 @@ class MeshExecutor:
             nr = int(np.count_nonzero(right_sel))
         if nl == 0 or nr == 0:
             return None  # trivial side: the host hash join wins outright
-        if nl + nr < flags.device_join_min_rows:
+        cm = _cost_model()
+        if cm.ACTIVE:
+            # r22: with measured wall times for BOTH join lanes (device
+            # sort-merge vs host EquijoinNode — bit-identical outputs by
+            # the r19 contract) the cost model may move the
+            # device_join_min_rows gate, within rails: never device
+            # below flag/rail_factor rows. Cold or shadow, the default
+            # reproduces the flag comparison exactly.
+            if not cm.choose_device_join(
+                nl + nr, nl + nr >= int(flags.device_join_min_rows)
+            ):
+                return None
+        elif nl + nr < flags.device_join_min_rows:
             return None
+        _join_t0 = time.perf_counter()
         # Shared join-key id space over BOTH sides (the join-agg idiom):
         # string keys align through one StringDictionary, then a
         # GroupEncoder densifies; right-only keys get ids the left never
@@ -2514,6 +2635,12 @@ class MeshExecutor:
                 left_sel, right_sel, nl, nr,
             )
             if out is not None:
+                if cm.ACTIVE:
+                    cm.observe_family(
+                        "join|joinlane:sort_merge",
+                        nl + nr,
+                        time.perf_counter() - _join_t0,
+                    )
                 return m.join_nid, out
         ck_l = (
             m.left_source_op.table_name,
@@ -2557,6 +2684,15 @@ class MeshExecutor:
         )
         if out is None:
             return None
+        if cm.ACTIVE:
+            # r22: the device lane's measured wall (encode + stage +
+            # sort-merge dispatch) is the B side of the gate the cost
+            # model now decides.
+            cm.observe_family(
+                "join|joinlane:sort_merge",
+                nl + nr,
+                time.perf_counter() - _join_t0,
+            )
         return m.join_nid, out
 
     def _host_pred_mask(
@@ -5583,6 +5719,13 @@ class MeshExecutor:
                     "stream_fold", dt,
                     program=resattr.program_name(fold_sig),
                 )
+            cm = _cost_model()
+            if cm.ACTIVE:
+                # r22: padded window geometry is the shape that prices a
+                # stream fold (masked rows still flow through the lanes).
+                cm.observe(
+                    fold_sig, plan.d * plan.nblk * plan.b, dt
+                )
             # Double-buffer backpressure: block on window k-2's fold so
             # at most two windows are in flight (one transferring, one
             # packing) — bounds host-pinned buffers and the device
@@ -5656,6 +5799,17 @@ class MeshExecutor:
                             program=resattr.program_name(fold_sig),
                             rows=rows, staged_bytes=wbytes,
                             wire_bytes=nbytes,
+                        )
+                    cm = _cost_model()
+                    if cm.ACTIVE and w not in hits and wbytes > 0:
+                        # r22: staged-bytes/s per wire lane (codec vs
+                        # raw) calibrates the codec_min_ratio decision;
+                        # resident-ring hits moved ~nothing over the
+                        # wire and would pollute either rate.
+                        cm.observe_family(
+                            "stage|codec" if nbytes < wbytes
+                            else "stage|raw",
+                            int(wbytes), dt_put,
                         )
                     if cacheable:
                         win_blocks.append(dev_cols)
@@ -6315,13 +6469,17 @@ class MeshExecutor:
                         jax.device_put(np.int32(p * capacity), repl),
                     )
                 )
+                dt_b = time.perf_counter() - t0
                 if resattr.ACTIVE:
                     resattr.record_dispatch(
                         "batched_fold",
-                        time.perf_counter() - t0,
+                        dt_b,
                         program=resattr.program_name(bsig),
                         rows=staged.num_rows,
                     )
+                cm = _cost_model()
+                if cm.ACTIVE:
+                    cm.observe(bsig, staged.num_rows, dt_b)
                 for s in range(nslots):
                     merged_flat = merge_p(*[leaf[:, s] for leaf in flat])
                     buf = fin_p(*merged_flat)

@@ -1,0 +1,775 @@
+"""Learned cost model behind every lane gate and pacing decision (r22).
+
+The engine carries ~a dozen hand-tuned thresholds (``SORTED_MIN_ROWS``,
+``device_join_min_rows``, ``staging_codec_min_ratio``, hedge
+quantile/delay, the MIMD controller steps) that are all
+provisional-on-CPU — yet the r15 attribution plane already records
+everything needed to learn them: ``device_programs`` rows carry XLA
+cost_analysis flops/bytes, ``device_dispatches`` carries measured wall
+time per program key, and the r11 fold-latency view is consulted for
+hedging. This module closes that loop with ONE model:
+
+  observation   every device dispatch (whole-offload fold, stream fold,
+                stream window, batched fold, device join) and the host
+                join feed ``observe(sig, rows, wall_s)`` — a bounded
+                per-(program-family, pow2 rows bucket) reservoir of wall
+                seconds plus a per-family rows/s throughput reservoir
+                (deque eviction = natural decay toward recent behavior).
+  prediction    ``predict_seconds`` answers from the bucket reservoir
+                when the exact shape has been seen, falls back to the
+                family throughput for unseen shapes of a known family,
+                and bottoms out in a roofline prior — cost_analysis
+                flops/bytes x device flop/byte rates calibrated online
+                from the SAME dispatches — for never-seen programs.
+                ``None`` means "no opinion": the caller keeps its
+                hand-tuned heuristic EXACTLY, so cold-start and
+                flag-off behavior are bit-for-bit the pre-r22 engine.
+  decision      the lane gates consult ``choose_*`` helpers that return
+                the heuristic default unless the model has at least
+                ``cost_model_min_samples`` observations on BOTH sides,
+                and every flip is clamped to hard rails derived from
+                the hand-tuned flag (``cost_model_rail_factor``) — the
+                flags stop being the answer but remain the fence.
+
+Every routed decision picks between bit-identical lanes (sort-compact
+vs direct scatter, device vs host join, codec vs raw wire), so the
+model changes only speed, never answers.
+
+Shadow mode (``cost_model_shadow``): predictions and decisions are
+computed and recorded (``shadow_snapshot``) but never actuated — the
+heuristic path runs while the model's would-be choices and its
+prediction error (``error_snapshot``) accumulate for offline review.
+
+Persistence: the full reservoir state serializes as one JSON blob under
+``costmodel/state`` in a vizier datastore (``attach_datastore``), the
+FoldSignatureStore posture — advisory, never raises — so calibration
+survives restarts with zero re-learning.
+
+Design contract (mirrors utils/faults.py and parallel/profiler.py):
+call sites gate on the module-level ``ACTIVE`` bool — disabled, every
+hook is one attribute load + branch, held <1% by
+tools/microbench_fault_overhead.py's ``cost_model_overhead`` key.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import threading
+from typing import Optional
+
+from pixie_tpu.utils.config import define_flag, flags
+
+_log = logging.getLogger("pixie_tpu.serving")
+
+define_flag(
+    "cost_model",
+    True,
+    help_="Route lane gates, hedge delay, admission estimates, and the "
+    "controller through the learned CostModel (r22). Off, every "
+    "decision falls back to its hand-tuned flag exactly (pre-r22 "
+    "behavior); the flags always remain hard rails either way.",
+)
+define_flag(
+    "cost_model_shadow",
+    False,
+    help_="Shadow mode: the CostModel observes dispatches and records "
+    "its would-be decisions and prediction error, but never actuates — "
+    "every gate runs its hand-tuned heuristic.",
+)
+define_flag(
+    "cost_model_min_samples",
+    3,
+    help_="Observations required per (family, bucket) reservoir before "
+    "the model voices an opinion; below it, predict_seconds falls "
+    "through to the next backoff rung (throughput, roofline, None).",
+)
+define_flag(
+    "cost_model_rail_factor",
+    8.0,
+    help_="Hard-rail width around each hand-tuned flag: the model may "
+    "move a gate threshold or pacing value at most this factor away "
+    "from the configured flag in either direction.",
+)
+define_flag(
+    "cost_model_reservoir",
+    64,
+    help_="Samples kept per (family, bucket) wall-time reservoir and "
+    "per-family rate reservoir; deque eviction is the decay.",
+)
+define_flag(
+    "cost_model_persist_every",
+    64,
+    help_="Observations between datastore snapshots of the model state "
+    "(when a datastore is attached); 0 disables periodic persistence.",
+)
+
+# Fast gates, synced with the cost_model/cost_model_shadow flags: one
+# attribute load + branch per call site when the model is off.
+ACTIVE = False
+SHADOW = False
+
+_DS_KEY = "costmodel/state"
+_STATE_VERSION = 1
+
+
+def refresh() -> None:
+    global ACTIVE, SHADOW
+    SHADOW = bool(flags.cost_model_shadow)
+    ACTIVE = bool(flags.cost_model) or SHADOW
+
+
+def set_enabled(on: bool, shadow: bool = False) -> None:
+    """Flip the model's observe/decide gates directly (tests, benches)."""
+    global ACTIVE, SHADOW
+    SHADOW = bool(shadow)
+    ACTIVE = bool(on) or SHADOW
+
+
+def family_of(sig: str) -> str:
+    """Program-key family: the unit-kind prefix plus any lane tokens
+    (``sortlane:``/``joinlane:``) — the identity that determines which
+    physical lane ran, with the shape-specific remainder erased so
+    observations pool across shapes of one lane."""
+    parts = str(sig).split("|")
+    fam = [parts[0]]
+    fam += [
+        p
+        for p in parts[1:]
+        if p.startswith("sortlane:") or p.startswith("joinlane:")
+    ]
+    return "|".join(fam)
+
+
+def bucket_of(rows: int) -> int:
+    """Pow2 shape bucket; 0 holds shapeless (whole-offload) costs."""
+    r = int(rows)
+    return r.bit_length() if r > 0 else 0
+
+
+def _median(vals) -> Optional[float]:
+    s = sorted(vals)
+    n = len(s)
+    if not n:
+        return None
+    m = n // 2
+    return float(s[m]) if n % 2 else float((s[m - 1] + s[m]) / 2.0)
+
+
+def _quantile(vals, q: float) -> Optional[float]:
+    s = sorted(vals)
+    if not s:
+        return None
+    idx = min(int(q * len(s)), len(s) - 1)
+    return float(s[idx])
+
+
+class CostModel:
+    """Per-family cost reservoirs + calibrated roofline prior.
+
+    All public methods are thread-safe and never raise: prediction is
+    advisory, a broken model must never fail a query."""
+
+    def __init__(self, cap: Optional[int] = None):
+        self._lock = threading.RLock()
+        self._cap = max(int(cap or flags.cost_model_reservoir), 4)
+        # (family, bucket) -> deque[wall seconds]
+        self._samples: dict = {}
+        # family -> deque[units/s] (rows/s for folds+joins, bytes/s for
+        # the stage|codec / stage|raw wire families)
+        self._rates: dict = {}
+        # family -> deque[relative prediction error] (predict-then-learn)
+        self._errors: dict = {}
+        # Calibrated device rates from cost_analysis-bearing dispatches.
+        self._flop_rate: collections.deque = collections.deque(
+            maxlen=self._cap
+        )
+        self._byte_rate: collections.deque = collections.deque(
+            maxlen=self._cap
+        )
+        # Hedge plane: program_key -> deque[seconds] fed from the r11
+        # fold-latency view (a smoothed, decayed per-key estimate).
+        self._latency: dict = {}
+        # Shadow decision log (site, default, model choice, evidence).
+        self._shadow_log: collections.deque = collections.deque(maxlen=256)
+        self._ds = None
+        self._dirty = 0
+
+    # -- reservoirs ----------------------------------------------------------
+    def _deque(self, table: dict, key):
+        d = table.get(key)
+        if d is None:
+            d = table[key] = collections.deque(maxlen=self._cap)
+        return d
+
+    def _min_samples(self) -> int:
+        return max(int(flags.cost_model_min_samples), 1)
+
+    def _rail(self) -> float:
+        return max(float(flags.cost_model_rail_factor), 1.0)
+
+    # -- observation ---------------------------------------------------------
+    def observe(self, sig: str, rows: int, wall_s: float) -> None:
+        """One measured dispatch. Predict-first: the pre-ingest
+        prediction's relative error lands in the family error reservoir
+        (this is the honest error — the sample has not yet influenced
+        the model), then the sample is ingested and, when the r15
+        program registry knows this sig's cost_analysis, the implied
+        device flop/byte rates calibrate the roofline prior."""
+        try:
+            wall = float(wall_s)
+            if wall <= 0.0:
+                return
+            fam = family_of(sig)
+            with self._lock:
+                pred = self._predict_locked(sig=sig, family=fam, rows=rows)
+                if pred is not None:
+                    self._deque(self._errors, fam).append(
+                        abs(pred - wall) / wall
+                    )
+                self._ingest_locked(fam, rows, wall)
+                self._calibrate_locked(sig, wall)
+                self._maybe_persist_locked()
+        except Exception:
+            pass  # advisory: observation must never fail a dispatch
+
+    def observe_family(self, family: str, rows: int, wall_s: float) -> None:
+        """Like ``observe`` but for lanes without a program signature
+        (the host join, the whole-offload breaker key)."""
+        try:
+            wall = float(wall_s)
+            if wall <= 0.0:
+                return
+            with self._lock:
+                pred = self._predict_locked(family=family, rows=rows)
+                if pred is not None:
+                    self._deque(self._errors, family).append(
+                        abs(pred - wall) / wall
+                    )
+                self._ingest_locked(family, rows, wall)
+                self._maybe_persist_locked()
+        except Exception:
+            pass
+
+    def _ingest_locked(self, family: str, rows: int, wall: float) -> None:
+        self._deque(self._samples, (family, bucket_of(rows))).append(wall)
+        if rows > 0:
+            self._deque(self._rates, family).append(rows / wall)
+        self._dirty += 1
+
+    def _calibrate_locked(self, sig: str, wall: float) -> None:
+        cost = _program_cost(sig)
+        if not cost:
+            return
+        flops = float(cost.get("flops", 0.0) or 0.0)
+        nbytes = float(cost.get("bytes_accessed", 0.0) or 0.0)
+        if flops > 0:
+            self._flop_rate.append(flops / wall)
+        if nbytes > 0:
+            self._byte_rate.append(nbytes / wall)
+
+    # -- prediction ----------------------------------------------------------
+    def predict_seconds(
+        self,
+        sig: Optional[str] = None,
+        family: Optional[str] = None,
+        rows: int = 0,
+    ) -> Optional[float]:
+        """Backoff ladder: exact (family, bucket) reservoir median ->
+        family throughput (rows / median rows-per-s) -> roofline prior
+        (cost_analysis x calibrated rates, sig required) -> None."""
+        try:
+            with self._lock:
+                return self._predict_locked(sig=sig, family=family, rows=rows)
+        except Exception:
+            return None
+
+    def _predict_locked(
+        self,
+        sig: Optional[str] = None,
+        family: Optional[str] = None,
+        rows: int = 0,
+    ) -> Optional[float]:
+        fam = family or (family_of(sig) if sig else None)
+        need = self._min_samples()
+        if fam is not None:
+            d = self._samples.get((fam, bucket_of(rows)))
+            if d is not None and len(d) >= need:
+                return _median(d)
+            if rows > 0:
+                r = self._rates.get(fam)
+                if r is not None and len(r) >= need:
+                    rate = _median(r)
+                    if rate and rate > 0:
+                        return rows / rate
+        if sig is not None:
+            return self._roofline_locked(sig)
+        return None
+
+    def _roofline_locked(self, sig: str) -> Optional[float]:
+        cost = _program_cost(sig)
+        if not cost:
+            return None
+        need = self._min_samples()
+        flops = float(cost.get("flops", 0.0) or 0.0)
+        nbytes = float(cost.get("bytes_accessed", 0.0) or 0.0)
+        est = []
+        if flops > 0 and len(self._flop_rate) >= need:
+            fr = _median(self._flop_rate)
+            if fr and fr > 0:
+                est.append(flops / fr)
+        if nbytes > 0 and len(self._byte_rate) >= need:
+            br = _median(self._byte_rate)
+            if br and br > 0:
+                est.append(nbytes / br)
+        return max(est) if est else None
+
+    def pooled_rate(self, kinds=("fold", "bfold", "stream_fold")) -> (
+        Optional[float]
+    ):
+        """Median units/s pooled across every family whose kind prefix
+        is in ``kinds`` (cross-lane generalization for callers that
+        know a size but not which lane will run)."""
+        try:
+            with self._lock:
+                pool = []
+                for fam, d in self._rates.items():
+                    if fam.split("|", 1)[0] in kinds:
+                        pool.extend(d)
+                if len(pool) < self._min_samples():
+                    return None
+                return _median(pool)
+        except Exception:
+            return None
+
+    # -- decisions (each returns the heuristic default unless evidence
+    # -- clears min_samples on both sides AND the flip stays inside the
+    # -- rails; shadow mode records the would-be choice and defers) ----------
+    def _shadow_record(self, site: str, default, choice, **ev) -> None:
+        self._shadow_log.append(
+            dict(site=site, default=default, choice=choice, **ev)
+        )
+
+    def choose_sorted_lane(
+        self, n_rows: int, nseg: Optional[int], default: bool, min_rows: int
+    ) -> bool:
+        """r8 sort-compact vs direct-scatter lane (ops/segment.py).
+        Rails: a lane choice is equivalent to moving the ``min_rows``
+        threshold, and the model may move it at most ``rail_factor``
+        from the hand-tuned value in either direction — below
+        ``min_rows / rail`` the sorted lane is refused, at or above
+        ``min_rows * rail`` it is forced, and the compacted-scatter
+        structural guard (nseg*4 > n_rows) stays hard everywhere. Both
+        lanes are bit-identical (test-pinned), so a flip changes only
+        speed."""
+        try:
+            p1 = self.predict_seconds(
+                family="fold|sortlane:1", rows=n_rows
+            )
+            p0 = self.predict_seconds(
+                family="fold|sortlane:0", rows=n_rows
+            )
+            if p1 is None or p0 is None:
+                return default
+            choice = p1 < p0
+            rail = self._rail()
+            if n_rows >= int(min_rows * rail):
+                choice = True  # rail: the flag decides far above it
+            if choice and n_rows < int(min_rows / rail):
+                choice = False  # rail: never sort far below the flag
+            if choice and nseg is not None and nseg * 4 > n_rows:
+                choice = False  # structural guard stays hard
+            if SHADOW:
+                self._shadow_record(
+                    "sorted_lane", default, choice, n_rows=int(n_rows),
+                    pred_sorted_s=p1, pred_direct_s=p0,
+                )
+                return default
+            return choice
+        except Exception:
+            return default
+
+    def choose_device_join(self, total_rows: int, default: bool) -> bool:
+        """r19 device sort-merge vs host EquijoinNode gate
+        (``device_join_min_rows``). True = device. Rails: the model may
+        move the effective threshold at most ``rail_factor`` from the
+        flag in either direction — never device below
+        ``device_join_min_rows / rail`` rows, always device at or above
+        ``device_join_min_rows * rail`` (so a test or operator pinning
+        the flag to 0 forces the device lane exactly as pre-r22)."""
+        try:
+            pd = self.predict_seconds(
+                family="join|joinlane:sort_merge", rows=total_rows
+            )
+            ph = self.predict_seconds(family="join|host", rows=total_rows)
+            if pd is None or ph is None:
+                return default
+            choice = pd < ph
+            rail = self._rail()
+            flag_rows = int(flags.device_join_min_rows)
+            if total_rows >= int(flag_rows * rail):
+                choice = True
+            if choice and total_rows < int(flag_rows / rail):
+                choice = False
+            if SHADOW:
+                self._shadow_record(
+                    "device_join", default, choice,
+                    total_rows=int(total_rows),
+                    pred_device_s=pd, pred_host_s=ph,
+                )
+                return default
+            return choice
+        except Exception:
+            return default
+
+    def codec_min_ratio(self) -> float:
+        """Effective ``staging_codec_min_ratio``: the flag scaled by the
+        measured codec-vs-raw seconds-per-staged-byte ratio (codec lane
+        cheaper per byte -> lower bar -> encode more), clamped to
+        [max(1, flag/rail), flag*rail]. Cold or shadow: the flag,
+        exactly. Either lane decodes bit-identically, so this moves
+        only wire bytes and seconds."""
+        base = float(flags.staging_codec_min_ratio)
+        try:
+            need = self._min_samples()
+            with self._lock:
+                rc = self._rates.get("stage|codec")
+                rr = self._rates.get("stage|raw")
+                if (
+                    rc is None or rr is None
+                    or len(rc) < need or len(rr) < need
+                ):
+                    return base
+                codec_bps = _median(rc)
+                raw_bps = _median(rr)
+            if not codec_bps or not raw_bps:
+                return base
+            # seconds/byte ratio == inverse bytes/s ratio
+            eff = base * (raw_bps / codec_bps)
+            rail = self._rail()
+            eff = min(max(eff, max(1.0, base / rail)), base * rail)
+            if SHADOW:
+                self._shadow_record(
+                    "codec_min_ratio", base, eff,
+                    codec_bytes_per_s=codec_bps, raw_bytes_per_s=raw_bps,
+                )
+                return base
+            return eff
+        except Exception:
+            return base
+
+    def hedge_delay_s(
+        self, program_keys, view: dict, q_key: str, raw_s: Optional[float]
+    ) -> Optional[float]:
+        """r17 hedge pacing: ingest the instantaneous fold-latency view
+        into decayed per-program-key reservoirs and answer with the
+        smoothed median of the relevant keys, clamped to
+        [raw/rail, raw*rail] around the instantaneous value the r17
+        heuristic would have used. ``None`` = defer to the caller's
+        raw value (cold) — and no data at all still means no hedge."""
+        try:
+            with self._lock:
+                vals = []
+                for pk in program_keys:
+                    d = self._deque(self._latency, str(pk))
+                    for st in (view.get(pk) or {}).values():
+                        v = st.get(q_key)
+                        if v:
+                            d.append(float(v) / 1e3)
+                    if len(d) >= self._min_samples():
+                        m = _median(d)
+                        if m:
+                            vals.append(m)
+                self._dirty += 1
+                self._maybe_persist_locked()
+            if not vals:
+                return None
+            pred = max(vals)
+            if raw_s is not None and raw_s > 0:
+                rail = self._rail()
+                pred = min(max(pred, raw_s / rail), raw_s * rail)
+            if SHADOW:
+                self._shadow_record(
+                    "hedge_delay", raw_s, pred, q_key=q_key
+                )
+                return None
+            return pred
+        except Exception:
+            return None
+
+    def estimate_fold_seconds(self, rows: int) -> Optional[float]:
+        """Admission advisory: predicted fold seconds for a query
+        touching ``rows`` staged rows, from the pooled fold-lane
+        throughput. None cold — the bytes-only admission check (which
+        this never replaces) carries alone."""
+        if rows <= 0:
+            return None
+        rate = self.pooled_rate()
+        return rows / rate if rate else None
+
+    def estimate_seconds_for_bytes(self, nbytes: int) -> Optional[float]:
+        """Predicted staging seconds for ``nbytes`` staged bytes from
+        the wire-lane byte rates (codec and raw pooled)."""
+        if nbytes <= 0:
+            return None
+        rate = self.pooled_rate(kinds=("stage",))
+        return nbytes / rate if rate else None
+
+    def fold_seconds_p50(self) -> Optional[float]:
+        """Controller-facing: median whole-offload fold seconds (the
+        shapeless bucket-0 reservoir of the ``fold`` family)."""
+        try:
+            with self._lock:
+                d = self._samples.get(("fold", 0))
+                if d is None or len(d) < self._min_samples():
+                    return None
+                return _median(d)
+        except Exception:
+            return None
+
+    def controller_predicted_wait_ms(
+        self, queue_depth: int, concurrent: int
+    ) -> Optional[float]:
+        """r16 controller upgrade: predicted time-in-queue for the
+        backlog — queue_depth folds at the learned per-fold median,
+        drained ``concurrent`` at a time. The controller raises
+        concurrency when THIS exceeds the wait target, before the
+        reactive windowed quantile has even seen the slow folds. None
+        cold (pure-MIMD, pre-r22); shadow records and defers."""
+        if queue_depth <= 0:
+            return None
+        s = self.fold_seconds_p50()
+        if s is None:
+            return None
+        pred = queue_depth * s * 1e3 / max(int(concurrent), 1)
+        if SHADOW:
+            self._shadow_record(
+                "controller_wait", None, pred,
+                queue_depth=int(queue_depth), concurrent=int(concurrent),
+            )
+            return None
+        return pred
+
+    def placement_latency_ms(self) -> Optional[float]:
+        """r18 placement: a model-predicted default per-fold latency for
+        agents the latency view has not measured yet, so a known-cost
+        workload ranks them on the ``latency_fallback`` rung instead of
+        ``cold``. None cold (pre-r22 ladder exactly)."""
+        s = self.fold_seconds_p50()
+        return s * 1e3 if s is not None else None
+
+    # -- introspection -------------------------------------------------------
+    def error_snapshot(self) -> dict:
+        """Per-family prediction-error quantiles (relative error of the
+        predict-before-ingest estimate vs the measured wall)."""
+        with self._lock:
+            out = {}
+            for fam, d in self._errors.items():
+                if not d:
+                    continue
+                out[fam] = {
+                    "n": len(d),
+                    "p50": round(_quantile(d, 0.5), 4),
+                    "p90": round(_quantile(d, 0.9), 4),
+                }
+            return out
+
+    def shadow_snapshot(self) -> list:
+        with self._lock:
+            return [dict(e) for e in self._shadow_log]
+
+    def sample_counts(self) -> dict:
+        with self._lock:
+            return {
+                f"{fam}@{b}": len(d)
+                for (fam, b), d in self._samples.items()
+            }
+
+    # -- persistence (FoldSignatureStore posture: advisory, never raises) ----
+    def attach_datastore(self, ds) -> None:
+        """Load any persisted state, then snapshot every
+        ``cost_model_persist_every`` observations."""
+        self._ds = ds
+        self.load(ds)
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "v": _STATE_VERSION,
+                "samples": {
+                    f"{fam}\t{b}": list(d)
+                    for (fam, b), d in self._samples.items()
+                },
+                "rates": {f: list(d) for f, d in self._rates.items()},
+                "errors": {f: list(d) for f, d in self._errors.items()},
+                "flop_rate": list(self._flop_rate),
+                "byte_rate": list(self._byte_rate),
+                "latency": {k: list(d) for k, d in self._latency.items()},
+            }
+
+    def load_state(self, st: dict) -> None:
+        def _dq(vals):
+            return collections.deque(
+                [float(v) for v in vals], maxlen=self._cap
+            )
+
+        with self._lock:
+            self._samples = {}
+            for key, vals in (st.get("samples") or {}).items():
+                fam, _, b = key.rpartition("\t")
+                self._samples[(fam, int(b))] = _dq(vals)
+            self._rates = {
+                f: _dq(v) for f, v in (st.get("rates") or {}).items()
+            }
+            self._errors = {
+                f: _dq(v) for f, v in (st.get("errors") or {}).items()
+            }
+            self._flop_rate = _dq(st.get("flop_rate") or [])
+            self._byte_rate = _dq(st.get("byte_rate") or [])
+            self._latency = {
+                k: _dq(v) for k, v in (st.get("latency") or {}).items()
+            }
+            self._dirty = 0
+
+    def save(self, ds=None) -> bool:
+        if ds is None:
+            ds = self._ds
+        if ds is None:
+            return False
+        try:
+            blob = json.dumps(self.state(), sort_keys=True).encode()
+            ds.set(_DS_KEY, blob)
+            with self._lock:
+                self._dirty = 0
+            return True
+        except Exception:
+            _log.warning("cost-model persist failed (ignored)", exc_info=True)
+            return False
+
+    def load(self, ds=None) -> bool:
+        if ds is None:
+            ds = self._ds
+        if ds is None:
+            return False
+        try:
+            raw = ds.get(_DS_KEY)
+            if not raw:
+                return False
+            st = json.loads(raw.decode())
+            if int(st.get("v", 0)) != _STATE_VERSION:
+                return False
+            self.load_state(st)
+            return True
+        except Exception:
+            _log.warning("cost-model load failed (ignored)", exc_info=True)
+            return False
+
+    def _maybe_persist_locked(self) -> None:
+        every = int(flags.cost_model_persist_every)
+        if self._ds is None or every <= 0 or self._dirty < every:
+            return
+        # Snapshot outside the request path would be nicer; the blob is
+        # a few KB and the datastore write is advisory, so inline is
+        # fine at this cadence.
+        self._dirty = 0
+        try:
+            blob = json.dumps(self.state(), sort_keys=True).encode()
+            self._ds.set(_DS_KEY, blob)
+        except Exception:
+            pass
+
+
+def _program_cost(sig: str) -> Optional[dict]:
+    """r15 program-registry row for ``sig`` (flops/bytes_accessed), or
+    None. Lazy import: profiler lives in the parallel package, whose
+    __init__ pulls the full pipeline — resolving it at call time keeps
+    this module import-light (config only)."""
+    try:
+        from pixie_tpu.parallel import profiler
+
+        return profiler.program_cost(sig)
+    except Exception:
+        return None
+
+
+# -- module-level singleton + forwarding call sites --------------------------
+MODEL = CostModel()
+
+
+def model() -> CostModel:
+    return MODEL
+
+
+def reset() -> None:
+    """Fresh model + gates resynced from flags (tests)."""
+    global MODEL
+    MODEL = CostModel()
+    refresh()
+
+
+def observe(sig: str, rows: int, wall_s: float) -> None:
+    MODEL.observe(sig, rows, wall_s)
+
+
+def observe_family(family: str, rows: int, wall_s: float) -> None:
+    MODEL.observe_family(family, rows, wall_s)
+
+
+def predict_seconds(sig=None, family=None, rows: int = 0):
+    return MODEL.predict_seconds(sig=sig, family=family, rows=rows)
+
+
+def choose_sorted_lane(n_rows, nseg, default, min_rows) -> bool:
+    return MODEL.choose_sorted_lane(n_rows, nseg, default, min_rows)
+
+
+def choose_device_join(total_rows, default) -> bool:
+    return MODEL.choose_device_join(total_rows, default)
+
+
+def codec_min_ratio() -> float:
+    return MODEL.codec_min_ratio()
+
+
+def hedge_delay_s(program_keys, view, q_key, raw_s):
+    return MODEL.hedge_delay_s(program_keys, view, q_key, raw_s)
+
+
+def estimate_fold_seconds(rows: int):
+    return MODEL.estimate_fold_seconds(rows)
+
+
+def estimate_seconds_for_bytes(nbytes: int):
+    return MODEL.estimate_seconds_for_bytes(nbytes)
+
+
+def fold_seconds_p50():
+    return MODEL.fold_seconds_p50()
+
+
+def controller_predicted_wait_ms(queue_depth: int, concurrent: int):
+    return MODEL.controller_predicted_wait_ms(queue_depth, concurrent)
+
+
+def placement_latency_ms():
+    return MODEL.placement_latency_ms()
+
+
+def error_snapshot() -> dict:
+    return MODEL.error_snapshot()
+
+
+def shadow_snapshot() -> list:
+    return MODEL.shadow_snapshot()
+
+
+def attach_datastore(ds) -> None:
+    MODEL.attach_datastore(ds)
+
+
+def save(ds=None) -> bool:
+    return MODEL.save(ds)
+
+
+refresh()
