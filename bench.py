@@ -142,6 +142,14 @@ error (predict-before-ingest vs measured wall) must stay <= 0.30, the
 headline ``cost_model_warmed_p50_accuracy_x`` is its inverse, and the
 sweep lands in BENCH_DETAIL.json's ``cost_model`` key.
 
+Mesh chaos recovery (r23): config 12 (opt-in, BENCH_CONFIGS=...,12)
+kills one simulated host mid-stream during a windowed fold at
+hosts:2,d:N/2 (tools/microbench_mesh.py MB_MESH_CHAOS path): the
+degraded-geometry ladder must recover bit-identically from the last
+window checkpoint, the headline ``mesh_chaos_checkpoint_saved_fraction``
+is the stream fraction NOT refolded, and recovery seconds + the
+refolded-window fraction land in BENCH_DETAIL.json's ``mesh_chaos`` key.
+
 Env knobs: BENCH_ROWS (configs 2/5; default 256M), BENCH_SMALL_ROWS
 (configs 1/3/4; default 64M), BENCH_HOST_ROWS (config 0; default 8M),
 BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS (comma list, default
@@ -152,7 +160,8 @@ cache, BENCH_SOAK_CLIENTS/BENCH_SOAK_REQUESTS/BENCH_SOAK_ROWS for
 config 6, BENCH_FLEET_AGENTS/BENCH_FLEET_CLIENTS/BENCH_FLEET_ROWS/
 BENCH_FLEET_TABLES/BENCH_FLEET_HBM_MB for config 7, BENCH_JOIN_ROWS
 for config 8, BENCH_VIEWS_CLIENTS/BENCH_VIEWS_REQUESTS/
-BENCH_VIEWS_ROWS for config 9, BENCH_CM_ROWS for config 11.
+BENCH_VIEWS_ROWS for config 9, BENCH_CM_ROWS for config 11,
+BENCH_MESH_ROWS/BENCH_MESH_WINDOWS for config 12.
 """
 
 import copy
@@ -1283,6 +1292,48 @@ def main() -> None:
         )
         microbench_cost_model.record_cost_model_detail(summary)
 
+    # ---- config 12: mesh chaos recovery (r23) -----------------------------
+    def run_config_12():
+        # One simulated host killed mid-stream: the degraded-geometry
+        # ladder must resume from the last window checkpoint
+        # bit-identically, refolding only the post-checkpoint windows.
+        # Records recovery seconds + refolded-window fraction under
+        # BENCH_DETAIL.json's mesh_chaos block. Opt-in via
+        # BENCH_CONFIGS=...,12.
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import microbench_mesh
+
+        summary = microbench_mesh.run_mesh_chaos_bench(
+            rows=int(os.environ.get("BENCH_MESH_ROWS", 120_000)),
+            windows=int(os.environ.get("BENCH_MESH_WINDOWS", 8)),
+            runs=runs,
+        )
+        assert summary["bit_identical"], summary
+        assert summary["restored_after_next_fold"], summary
+        # Checkpoints must have saved work: a full refold means the
+        # window checkpoint plane silently stopped persisting.
+        assert summary["refolded_window_fraction"] < 1.0, summary
+        ledger.add(
+            {
+                "config": 12,
+                "geometry": summary["geometry"],
+                "windows": summary["windows"],
+                "fault_after_window": summary["fault_after_window"],
+                "recovery_seconds": summary["recovery_seconds"],
+                "refolded_window_fraction": summary[
+                    "refolded_window_fraction"
+                ],
+                "degrade_events": summary["degrade_events"],
+                # Always-present headline (higher is better, and
+                # deterministic for a fixed window count): the stream
+                # fraction the checkpoints did NOT have to refold.
+                "metric": "mesh_chaos_checkpoint_saved_fraction",
+                "value": summary["checkpoint_saved_fraction"],
+                "unit": "fraction_of_windows",
+            }
+        )
+        microbench_mesh.record_mesh_chaos_detail(summary)
+
     runners = {
         "0": run_config_0,
         "1": run_config_1,
@@ -1296,6 +1347,7 @@ def main() -> None:
         "9": run_config_9,
         "10": run_config_10,
         "11": run_config_11,
+        "12": run_config_12,
     }
     ran = set()
     for c in order:  # BENCH_CONFIGS order IS the execution order

@@ -35,6 +35,43 @@ import numpy as np
 from pixie_tpu.utils import flags
 
 
+class MeshGeometryError(RuntimeError):
+    """A mesh-geometry failure the executor can recover from (r23).
+
+    ``kind`` drives the recovery policy in ``MeshExecutor``:
+
+    - ``host_loss`` / ``collective_timeout`` — the current geometry is
+      suspect; re-plan the fold onto the next degradation rung
+      (``MeshConfig.degrade``), bit-identical by the r21 invariant.
+    - ``checkpoint_corrupt`` — a window checkpoint read back bad;
+      discard it and refold from scratch on the surviving geometry
+      (r14 RingSpill posture: never resurrect corrupt state).
+    - ``signature_mismatch`` — a cached program's geometry disagrees
+      with the executor's; caller error, routed straight to the host
+      engine fallback (no degrade retry — the geometry itself is fine).
+    """
+
+    KINDS = (
+        "host_loss",
+        "collective_timeout",
+        "checkpoint_corrupt",
+        "signature_mismatch",
+    )
+
+    def __init__(self, kind: str, detail: str = ""):
+        assert kind in self.KINDS, kind
+        super().__init__(
+            f"mesh geometry failure [{kind}]" + (f": {detail}" if detail else "")
+        )
+        self.kind = kind
+        self.detail = detail
+
+    @property
+    def recoverable(self) -> bool:
+        """True iff retrying on a degraded geometry can help."""
+        return self.kind in ("host_loss", "collective_timeout")
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Declarative mesh geometry: ((axis_name, size), ...) outermost first."""
@@ -113,6 +150,61 @@ class MeshConfig:
     @staticmethod
     def from_flags(ndev: int) -> "MeshConfig":
         return MeshConfig.parse(flags.mesh_axes, ndev)
+
+    def degrade(self, lost_hosts: int = 1) -> "Optional[MeshConfig]":
+        """Best surviving geometry after losing ``lost_hosts`` hosts (r23).
+
+        The simulated runtime keeps every local device; "losing a host"
+        is a trust statement about the outermost axis, so each rung
+        preserves ``total_devices`` and refolds the freed devices into
+        the innermost axis — which is exactly what keeps the answer
+        bit-identical (r21: any factorization of the same device set
+        folds bit-for-bit the same). Ladder shape for hosts:4,d:2 →
+        hosts:2,d:4 → d:8 → None (None = host engine, past the mesh).
+
+        A flat (single-axis) mesh has no hosts to shed: returns None.
+        The surviving host count is the largest divisor of
+        ``total_devices`` that is < the current host count and
+        <= hosts - lost_hosts; if none >= 2 exists, collapse to flat.
+        """
+        if len(self.axes) < 2:
+            return None
+        hosts = self.shape[0]
+        ndev = self.total_devices
+        want = hosts - max(1, int(lost_hosts))
+        survivors = 0
+        for h in range(min(want, hosts - 1), 1, -1):
+            if ndev % h == 0:
+                survivors = h
+                break
+        if survivors < 2:
+            return MeshConfig.flat(ndev)
+        inner = list(self.axes[1:])
+        others = math.prod(s for _, s in inner[:-1])
+        per_host = ndev // survivors
+        if per_host % others:
+            # The surviving per-host share no longer factors through the
+            # middle axes: flatten everything inner into the last axis.
+            return MeshConfig(
+                axes=((self.axes[0][0], survivors), (inner[-1][0], per_host))
+            )
+        inner[-1] = (inner[-1][0], per_host // others)
+        return MeshConfig(axes=((self.axes[0][0], survivors), *inner))
+
+    def ladder(self) -> "list[Optional[MeshConfig]]":
+        """Full degradation ladder, this geometry first, ``None`` (host
+        engine) last. Each rung is one ``degrade()`` step; the list is
+        what the executor's per-geometry breaker walks."""
+        rungs: "list[Optional[MeshConfig]]" = [self]
+        cur: "Optional[MeshConfig]" = self
+        while cur is not None:
+            cur = cur.degrade()
+            if cur is not None and cur.signature() == rungs[-1].signature():
+                break
+            rungs.append(cur)
+        if rungs[-1] is not None:
+            rungs.append(None)
+        return rungs
 
     def build(self, devices: Optional[Sequence] = None):
         """Materialize a jax.sharding.Mesh with this geometry."""
@@ -217,6 +309,7 @@ def match_partition_rules(rules, names, mesh):
 
 __all__ = [
     "MeshConfig",
+    "MeshGeometryError",
     "resolve_mesh",
     "data_axes",
     "host_axis",

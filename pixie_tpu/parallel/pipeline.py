@@ -24,6 +24,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import re
 import threading
 import time
 from typing import Any, Optional
@@ -129,6 +130,30 @@ _BREAKER_SKIPS = _M.counter(
 _PROGRAMS = _M.gauge(
     "device_program_cache_size", "Compiled shard_map programs cached."
 )
+_MESH_DEGRADE = _M.counter(
+    "mesh_degrade_events_total",
+    "Mesh geometry failures (host loss / hung collective) recovered by "
+    "re-planning the fold onto the next degradation rung (r23; the "
+    "retried answer is bit-identical by the r21 geometry invariant).",
+)
+_MESH_CKPT_WINDOWS = _M.counter(
+    "mesh_checkpoint_windows_total",
+    "Stream-fold windows whose carried UDA state was checkpointed "
+    "host-side at the window boundary (flag mesh_fold_checkpoint).",
+)
+_MESH_RESUMES = _M.counter(
+    "mesh_checkpoint_resumes_total",
+    "Stream folds resumed from a window checkpoint on a surviving "
+    "geometry instead of refolding from scratch.",
+)
+
+# One multi-axis collective program in flight per process: two
+# concurrent all-device programs interleave their per-device executions
+# in different orders and deadlock the rendezvous (observed on the
+# 8-virtual-device CPU sim the moment two executors folded at
+# hosts:2,d:4 at once). Flat single-axis dispatches carry no cross-host
+# rendezvous and never take this lock.
+_MESH_COLLECTIVE_LOCK = threading.Lock()
 
 # Persistent-compilation-cache hit counter: jax emits a monitoring event
 # per .jax_cache deserialization; the AOT compile thread snapshots it
@@ -1084,6 +1109,44 @@ class MeshExecutor:
         # /statusz shows per-phase percentiles without running a query.
         self._fold_lat: dict[str, "collections.deque"] = {}
         self._fold_lat_lock = threading.Lock()
+        # Mesh recovery plane (r23): the geometry degradation ladder
+        # (full geometry first, flat last, None = host engine), built
+        # meshes cached per rung — restoring a rung reuses the SAME
+        # Mesh object, so resident-ring/mesh identity checks hold on
+        # recovery — a per-geometry breaker keyed by mesh signature
+        # (repeat offenders skip straight to the degraded rung, with
+        # half-open recovery back to full geometry), and window-level
+        # fold checkpoints keyed by geometry-FREE fold identity (a
+        # resume lands on a different rung by construction).
+        self._geom_lock = threading.RLock()
+        self._full_mesh_config = self.mesh_config
+        self._geom_ladder = self.mesh_config.ladder()
+        self._rung_meshes = {self._mesh_sig: self.mesh}
+        self._geom_breaker: dict[str, list] = {}
+        self._fold_ckpt: "collections.OrderedDict[str, dict]" = (
+            collections.OrderedDict()
+        )
+        self._geom_events = {
+            "degrade": 0,
+            "checkpoint_windows": 0,
+            "resumes": 0,
+            "recovered_folds": 0,
+        }
+        # Window accounting of the most recent checkpoint resume
+        # (bench config 12 reads the refolded-window fraction here).
+        self.last_resume_stats: "dict | None" = None
+        # Fold signatures that completed at least one multi-axis
+        # dispatch on this executor: the DERIVED watchdog deadline only
+        # arms for these — a first dispatch may compile inline (AOT
+        # miss / monolithic fallback), and a cost-model prediction of
+        # steady-state fold wall says nothing about compile time.
+        self._warm_dispatch_sigs: set = set()
+        # Worst multi-axis dispatch wall observed on this executor
+        # (abandoned dispatches report theirs too): the derived
+        # watchdog deadline rails over this as well as the model's
+        # solo prediction, so a loaded process does not read its own
+        # ambient slowness as a hang.
+        self._dispatch_wall_max = 0.0
 
     # -- public -------------------------------------------------------------
     @staticmethod
@@ -1179,6 +1242,10 @@ class MeshExecutor:
             "replicas": (
                 self._resident.replica_snapshot() if self._resident else {}
             ),
+            # Mesh recovery plane (r23): active vs full geometry, the
+            # degradation ladder, per-geometry breaker, and the
+            # degrade/checkpoint/resume event counts.
+            "mesh": self.mesh_recovery_snapshot(),
         }
 
     # -- device-resident incremental ingest (r13) ----------------------------
@@ -1234,6 +1301,11 @@ class MeshExecutor:
         replica rings serve (r17 failover: the follower never observes
         appends, so the flag gating owned ingest does not apply)."""
         if self._resident is None:
+            return None
+        if self._resident.mesh is not self.mesh:
+            # Degraded geometry (r23): ring windows are sharded on the
+            # full mesh. They serve again when the breaker's half-open
+            # trial restores that rung (same Mesh object, cached).
             return None
         if src_op.start_time is not None or src_op.stop_time is not None:
             return None
@@ -1381,6 +1453,382 @@ class MeshExecutor:
                     flags.device_breaker_cooldown_s, st[0], key,
                 )
 
+    # -- mesh geometry recovery (r23) ----------------------------------------
+    def _geom_breaker_open(self, sig: str) -> bool:
+        threshold = flags.mesh_breaker_threshold
+        if threshold <= 0:
+            return False
+        with self._geom_lock:
+            st = self._geom_breaker.get(sig)
+            return st is not None and st[1] > time.monotonic()
+
+    def _geom_breaker_record(self, sig: str, ok: bool) -> None:
+        threshold = flags.mesh_breaker_threshold
+        if threshold <= 0:
+            return
+        with self._geom_lock:
+            if ok:
+                self._geom_breaker.pop(sig, None)  # success closes it
+                return
+            st = self._geom_breaker.setdefault(sig, [0, 0.0])
+            st[0] += 1
+            if st[0] >= threshold:
+                # Open (or re-open after a failed half-open trial): new
+                # folds skip this rung for the cooldown; the first
+                # post-cooldown fold is the half-open trial back toward
+                # full geometry.
+                st[1] = time.monotonic() + flags.mesh_breaker_cooldown_s
+                import logging
+
+                logging.getLogger("pixie_tpu.parallel").warning(
+                    "mesh geometry breaker OPEN for %.1fs: %s failed %d "
+                    "consecutive folds; new folds start on the next "
+                    "degradation rung",
+                    flags.mesh_breaker_cooldown_s, sig, st[0],
+                )
+
+    def mesh_breaker_snapshot(self) -> dict[str, dict]:
+        """Per-geometry breaker state (mirrors ``breaker_snapshot``):
+        ``mesh_sig -> {state, failures, open_remaining_s}``."""
+        if flags.mesh_breaker_threshold <= 0:
+            return {}
+        now = time.monotonic()
+        out = {}
+        with self._geom_lock:
+            for sig, (fails, open_until) in self._geom_breaker.items():
+                if open_until > now:
+                    state = "open"
+                elif open_until > 0:
+                    state = "half_open"
+                else:
+                    state = "degrading"
+                out[sig] = {
+                    "state": state,
+                    "failures": fails,
+                    "open_remaining_s": round(max(0.0, open_until - now), 3),
+                }
+        return out
+
+    def mesh_recovery_snapshot(self) -> dict:
+        """The r23 recovery plane's health section (rides heartbeats and
+        /statusz): active vs full geometry, the degradation ladder, the
+        per-geometry breaker, and the degrade/checkpoint/resume counts
+        that make every recovery auditable."""
+        with self._geom_lock:
+            full = self._full_mesh_config.signature()
+            return {
+                "geometry": self._mesh_sig,
+                "full_geometry": full,
+                "degraded": self._mesh_sig != full,
+                "ladder": [
+                    c.signature() if c is not None else "host"
+                    for c in self._geom_ladder
+                ],
+                "breaker": self.mesh_breaker_snapshot(),
+                "degrade_events": self._geom_events["degrade"],
+                "checkpoint_windows": self._geom_events["checkpoint_windows"],
+                "checkpoint_resumes": self._geom_events["resumes"],
+                "recovered_folds": self._geom_events["recovered_folds"],
+                "checkpoints_held": len(self._fold_ckpt),
+            }
+
+    def _activate_geometry(self, cfg: "mesh_lib.MeshConfig") -> None:
+        """Point the executor at ``cfg``'s mesh. Rung meshes are cached,
+        so restoring a rung reuses the ORIGINAL Mesh object (resident
+        rings resume serving on mesh identity, not equality). Staged
+        cache entries re-place lazily at lookup via the partition-rule
+        tree; compiled programs carry the geometry signature, so a
+        stale executable can never dispatch on the new mesh."""
+        with self._geom_lock:
+            sig = cfg.signature()
+            if sig == self._mesh_sig:
+                return
+            mesh = self._rung_meshes.get(sig)
+            if mesh is None:
+                mesh = cfg.build()
+                self._rung_meshes[sig] = mesh
+            self.mesh = mesh
+            self.mesh_config = cfg
+            self.mesh_axes = mesh_lib.data_axes(mesh)
+            self._mesh_sig = sig
+
+    def _execute_with_recovery(
+        self, fragment, table_store, registry, func_ctx
+    ):
+        """Walk the geometry degradation ladder (r23): start at the
+        first rung whose per-geometry breaker is closed (an expired
+        cooldown makes the attempt the half-open trial), and on a
+        recoverable ``MeshGeometryError`` (host loss, hung collective)
+        re-plan the SAME fold one rung down — the retried answer is
+        bit-identical by the r21 invariant, and a window checkpoint
+        (flag ``mesh_fold_checkpoint``) lets the stream resume instead
+        of refolding. A non-recoverable error or an exhausted ladder
+        propagates to the caller's host-engine fallback."""
+        rungs = self._geom_ladder
+        last_err = None
+        for i, cfg in enumerate(rungs):
+            if cfg is None:
+                break  # past the mesh: host engine
+            sig = cfg.signature()
+            if self._geom_breaker_open(sig):
+                continue
+            if sig != self._mesh_sig:
+                self._activate_geometry(cfg)
+            try:
+                out = self._try_execute_fragment(
+                    fragment, table_store, registry, func_ctx
+                )
+                self._geom_breaker_record(sig, ok=True)
+                if last_err is not None and out is not None:
+                    with self._geom_lock:
+                        self._geom_events["recovered_folds"] += 1
+                return out
+            except mesh_lib.MeshGeometryError as e:
+                if not e.recoverable:
+                    raise  # signature mismatch etc: host fallback
+                self._geom_breaker_record(sig, ok=False)
+                _MESH_DEGRADE.inc()
+                with self._geom_lock:
+                    self._geom_events["degrade"] += 1
+                nxt = next(
+                    (
+                        r.signature()
+                        for r in rungs[i + 1:]
+                        if r is not None
+                    ),
+                    "host",
+                )
+                if trace.ACTIVE:
+                    trace.record(
+                        "mesh.recover",
+                        0,
+                        attrs={"kind": e.kind, "from": sig, "to": nxt},
+                    )
+                import logging
+
+                logging.getLogger("pixie_tpu.parallel").warning(
+                    "mesh geometry failure [%s] on %s: re-planning the "
+                    "fold on %s",
+                    e.kind, sig, nxt,
+                )
+                last_err = e
+        if last_err is not None:
+            raise last_err
+        return None
+
+    def _watchdog_deadline(self, fold_sig=None, warm=True) -> "float | None":
+        """Collective-watchdog deadline for one sharded dispatch, or
+        None (no watchdog). The flag wins when positive; 0 derives the
+        deadline from the r22 CostModel prediction x the rail factor
+        (no opinion = no watchdog — a deadline must come from evidence);
+        negative disables outright. A derived deadline additionally
+        requires ``warm`` — this signature already completed a dispatch
+        here — because a cold dispatch may compile inline and the model
+        predicts steady-state fold wall, not XLA compile time."""
+        t = float(flags.mesh_dispatch_timeout_s)
+        if t > 0:
+            return t
+        if t < 0 or not warm:
+            return None
+        cm = _cost_model()
+        if not cm.ACTIVE:
+            return None
+        pred = (
+            cm.predict_seconds(sig=fold_sig)
+            if fold_sig is not None
+            else cm.predict_seconds(family="fold")
+        )
+        if not pred or pred <= 0:
+            return None
+        # Floor keeps a microsecond-scale prediction from tripping on
+        # ordinary scheduler jitter, and the worst dispatch wall seen
+        # locally x4 keeps ambient load from masquerading as a hang:
+        # the model predicts SOLO wall, but this process may be running
+        # clients, agents, and a second executor on the same cores. The
+        # watchdog hunts HANGS — a hang is unbounded, 4x the slowest
+        # completed dispatch is not.
+        return max(
+            0.25,
+            pred * float(flags.mesh_watchdog_rail_factor),
+            self._dispatch_wall_max * 4.0,
+        )
+
+    def _mesh_dispatch(self, fn, what: str = "fold", fold_sig=None):
+        """Run one synchronizing sharded dispatch under the recovery
+        plane (r23): deterministic fault sites first (``mesh.host_loss``
+        / ``mesh.collective_timeout`` — from inside one process a dead
+        host and a hung collective both look like a dispatch that never
+        completes, so both inject here), then the collective watchdog —
+        the dispatch runs on a reaper thread and a deadline miss raises
+        a detected ``MeshGeometryError`` instead of hanging the query
+        (the stuck thread is abandoned; it holds no executor locks,
+        only the process-wide collective lock — see _watchdog_run).
+        Every multi-axis dispatch serializes on _MESH_COLLECTIVE_LOCK:
+        two interleaved all-device collective programs deadlock the
+        shared pool. Single-axis meshes have no hosts to lose and no
+        cross-host collectives: plain call. The disabled path (flat
+        mesh, or no armed site and no deadline) is a handful of
+        attribute reads — microbench_fault_overhead holds it under
+        1%."""
+        if len(self.mesh_config.axes) > 1:
+            if faults.ACTIVE:
+                if faults.fires("mesh.host_loss"):
+                    raise mesh_lib.MeshGeometryError(
+                        "host_loss", f"{what} on {self._mesh_sig}"
+                    )
+                if faults.fires("mesh.collective_timeout"):
+                    raise mesh_lib.MeshGeometryError(
+                        "collective_timeout", f"{what} on {self._mesh_sig}"
+                    )
+            deadline = self._watchdog_deadline(
+                fold_sig, warm=fold_sig in self._warm_dispatch_sigs
+            )
+            if deadline is not None:
+                out = self._watchdog_run(deadline, fn, what)
+            else:
+                with _MESH_COLLECTIVE_LOCK:
+                    t0 = time.perf_counter()
+                    # Dispatch is ASYNC even on CPU: fn() returns once
+                    # the program is enqueued. Block before releasing
+                    # the lock or the next all-device program overlaps
+                    # this one's still-running collectives and wedges
+                    # the rendezvous.
+                    out = jax.block_until_ready(fn())
+                    self._note_dispatch_wall(time.perf_counter() - t0)
+            if fold_sig is not None:
+                self._warm_dispatch_sigs.add(fold_sig)
+            return out
+        if len(self._full_mesh_config.axes) > 1:
+            # Degraded-rung dispatch of a multi-axis executor: the flat
+            # program still rendezvouses every device, so it must not
+            # interleave with an abandoned (timed-out) full-geometry
+            # program that is draining on the same pool — queue behind
+            # it. Executors that were BORN flat never take the lock.
+            with _MESH_COLLECTIVE_LOCK:
+                return jax.block_until_ready(fn())
+        return fn()
+
+    def _note_dispatch_wall(self, wall: float) -> None:
+        if wall > self._dispatch_wall_max:
+            self._dispatch_wall_max = wall
+
+    def _watchdog_run(self, deadline: float, fn, what: str):
+        from pixie_tpu.ops import segment as _segment
+
+        box: dict = {}
+        platform = self.mesh.devices.flat[0].platform
+        started = threading.Event()
+        done = threading.Event()
+
+        def run():
+            # The collective lock is taken ON the reaper thread so an
+            # abandoned (timed-out) dispatch keeps holding it until its
+            # collective actually returns: overlapping a fresh
+            # all-device program with a wedged one deadlocks the whole
+            # pool, which is strictly worse than queueing behind it.
+            with _MESH_COLLECTIVE_LOCK:
+                started.set()
+                t0 = time.perf_counter()
+                try:
+                    # First call may trace: carry the caller's platform
+                    # hint onto the reaper thread so lane strategy
+                    # stays pinned. block_until_ready: dispatch is
+                    # async — the lock must outlive the EXECUTION, not
+                    # just the enqueue (see _mesh_dispatch).
+                    with _segment.platform_hint(platform):
+                        box["value"] = jax.block_until_ready(fn())
+                except BaseException as e:  # re-raised on the caller
+                    box["error"] = e
+                finally:
+                    # Recorded even when the caller already gave up on
+                    # this dispatch: a false trip (slow-but-healthy
+                    # collective) raises the observed rail, so the NEXT
+                    # deadline clears it — one bad prediction cannot
+                    # cascade.
+                    self._note_dispatch_wall(time.perf_counter() - t0)
+                    done.set()
+
+        th = threading.Thread(target=run, name="mesh-watchdog", daemon=True)
+        th.start()
+        # Queue wait is NOT a hang: the deadline times the exclusive
+        # execution window only — concurrent dispatches line up on the
+        # collective lock, and a cost-model prediction knows nothing
+        # about the queue in front of this one.
+        started.wait()
+        if not done.wait(timeout=deadline):
+            raise mesh_lib.MeshGeometryError(
+                "collective_timeout",
+                f"{what} exceeded the {deadline:.3f}s watchdog deadline "
+                f"on {self._mesh_sig}",
+            )
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    def _staged_mesh_ok(self, staged) -> bool:
+        """False when a cached staging's shards live on a different mesh
+        than the executor's current one (a degradation rung switched
+        geometry since it staged)."""
+        for a in staged.blocks.values():
+            sh = getattr(a, "sharding", None)
+            if sh is None:
+                return True
+            try:
+                return sh.mesh == self.mesh or sh.mesh is self.mesh
+            except Exception:
+                return True
+        return True
+
+    def _save_fold_checkpoint(self, key, windows_done, host_state) -> None:
+        with self._geom_lock:
+            self._fold_ckpt[key] = {
+                "windows": int(windows_done),
+                "state": host_state,
+            }
+            self._fold_ckpt.move_to_end(key)
+            while len(self._fold_ckpt) > 4:
+                self._fold_ckpt.popitem(last=False)
+            self._geom_events["checkpoint_windows"] += 1
+        _MESH_CKPT_WINDOWS.inc()
+
+    def _load_fold_checkpoint(self, key, leaves, d, sharding):
+        """Validated checkpoint state for ``key``, device_put onto the
+        CURRENT mesh (bit-exact: the pull was a host copy of per-device
+        carry state, and every rung keeps the device count, so shapes
+        are unchanged). Returns (flat_state, windows_done) or (None, 0).
+        A corrupt checkpoint — injected, or a shape/dtype mismatch
+        against the fold's state template — is DISCARDED and the fold
+        restarts from scratch: never resurrect bad carry state (r14
+        RingSpill posture)."""
+        with self._geom_lock:
+            ck = self._fold_ckpt.get(key)
+        if ck is None:
+            return None, 0
+        corrupt = faults.ACTIVE and faults.fires("mesh.checkpoint_corrupt")
+        if not corrupt:
+            st = ck["state"]
+            if len(st) != len(leaves):
+                corrupt = True
+            else:
+                for a, leaf in zip(st, leaves):
+                    if a.shape != (d,) + tuple(leaf.shape) or (
+                        a.dtype != leaf.dtype
+                    ):
+                        corrupt = True
+                        break
+        if corrupt:
+            import logging
+
+            with self._geom_lock:
+                self._fold_ckpt.pop(key, None)
+            logging.getLogger("pixie_tpu.parallel").warning(
+                "discarding corrupt mesh fold checkpoint (refolding "
+                "from scratch, never resuming bad carry state)"
+            )
+            return None, 0
+        state = [jax.device_put(a, sharding) for a in ck["state"]]
+        return state, int(ck["windows"])
+
     def try_execute_fragment(
         self, fragment: PlanFragment, table_store, registry, func_ctx=None
     ) -> Optional[tuple[int, RowBatch]]:
@@ -1402,7 +1850,11 @@ class MeshExecutor:
             return None
         try:
             t0 = time.perf_counter_ns()
-            out = self._try_execute_fragment(
+            # r23: the fold runs under the geometry degradation ladder —
+            # a host loss or hung collective re-plans the same fold on
+            # the next surviving geometry (bit-identical) before the
+            # host engine is ever considered.
+            out = self._execute_with_recovery(
                 fragment, table_store, registry, func_ctx
             )
             (_OFFLOAD_HITS if out is not None else _OFFLOAD_MISS).inc()
@@ -1595,6 +2047,21 @@ class MeshExecutor:
                     cache_key = k
                     staged = v
                     break
+        if staged is not None and not self._staged_mesh_ok(staged):
+            # Geometry changed since this entry staged (an r23
+            # degradation rung, or a half-open recovery back to full):
+            # re-place its shards onto the current mesh through the
+            # partition-rule tree — same bytes, no host restage. The
+            # old entry retires (zombie while a concurrent fold on the
+            # old mesh still pins it).
+            from pixie_tpu.parallel import staging as _staging_mod
+
+            with _timed("stage_repartition"):
+                staged = _staging_mod.repartition_staged(self.mesh, staged)
+            if cacheable:
+                self._staged_insert(
+                    cache_key, staged, m.source_op.table_name, version
+                )
         if staged is not None:
             self._staged_cache.touch(cache_key)
         merged = capacity = None
@@ -4406,10 +4873,16 @@ class MeshExecutor:
         # A lookup whose signature names a different geometry than the
         # executor's mesh means a caller mixed executors/meshes — fail
         # loudly instead of silently reusing a stale compiled program.
+        # A mismatch means a caller mixed executors/meshes — a
+        # structured MeshGeometryError (r23) that routes through the
+        # breaker/fallback ladder to the host engine instead of
+        # crashing the query path (it is NOT recoverable by degrading:
+        # the geometry itself is fine, the caller's signature is not).
         if f"mesh:{self._mesh_sig}" not in sig:
-            raise AssertionError(
+            raise mesh_lib.MeshGeometryError(
+                "signature_mismatch",
                 f"program signature {sig!r} does not carry this "
-                f"executor's mesh geometry {self._mesh_sig!r}"
+                f"executor's mesh geometry {self._mesh_sig!r}",
             )
         entry = self._program_cache.get(sig)
         if entry is None or entry[1] != n_aux:
@@ -5475,6 +5948,12 @@ class MeshExecutor:
                 m, specs, evaluator, key_plan, table, cols, n,
                 f32_cols, cell_cols, aux, cacheable, base_row,
             )
+        except mesh_lib.MeshGeometryError:
+            # r23: a geometry failure must reach the degradation ladder
+            # (re-plan on the surviving geometry, resume from the last
+            # window checkpoint) — monolithic staging on the SAME
+            # failed geometry would just hit the fault again.
+            raise
         except Exception as e:
             import logging
             import traceback
@@ -5559,6 +6038,26 @@ class MeshExecutor:
         _, templates = self._finalize_modes(
             specs, capacity, m.agg_op.stage == AggStage.PARTIAL
         )
+
+        # Window-level fold checkpointing (r23, flag mesh_fold_checkpoint,
+        # multi-axis-CONFIGURED executors only — gated on the FULL
+        # geometry, not the current rung, because a resume lands on a
+        # DIFFERENT (often flat) degradation rung by construction): the
+        # fold's identity is keyed geometry-FREE, and every rung keeps
+        # the total device count, so the padded window geometry (and
+        # with it the carried state's shape) is invariant across rungs.
+        ckpt_key = None
+        start_w = 0
+        if flags.mesh_fold_checkpoint and len(self._full_mesh_config.axes) > 1:
+            ckpt_key = "|".join(
+                (
+                    re.sub(r"mesh:[^|]*", "mesh:*", fold_sig),
+                    f"rows:{n}",
+                    f"win:{plan.window_rows}",
+                    f"base:{base_row}",
+                    m.source_op.table_name,
+                )
+            )
 
         axis_name = self.mesh_axes  # full axis tuple: dim0 over every mesh axis
         sharding = NamedSharding(self.mesh, P(axis_name))
@@ -5701,6 +6200,8 @@ class MeshExecutor:
             self._kick_decode_aot(plan)
         dec_cache: dict = {}
 
+        windows_folded = [0]  # dispatches this attempt (resume-aware)
+
         def dispatch_fold(dev_cols, mask, dev_g):
             nonlocal flat_state
             args = list(flat_state)
@@ -5711,7 +6212,16 @@ class MeshExecutor:
             args.extend(extra_args)
             args.append(gid_base)
             t0 = time.perf_counter()
-            flat_state = list(fold_fn(*args))
+            # r23: the sharded dispatch runs under the recovery plane —
+            # fault sites + collective watchdog; a geometry failure
+            # raises out to the degradation ladder.
+            flat_state = list(
+                self._mesh_dispatch(
+                    lambda: fold_fn(*args),
+                    what="stream_fold",
+                    fold_sig=fold_sig,
+                )
+            )
             dt = time.perf_counter() - t0
             prof("stage_stream_dispatch", dt)
             if resattr.ACTIVE:
@@ -5738,6 +6248,22 @@ class MeshExecutor:
                     "stage_stream_compute_wait",
                     time.perf_counter() - t0,
                 )
+            windows_folded[0] += 1
+            if ckpt_key is not None:
+                # Window-boundary checkpoint (r23): pull the carried
+                # per-device UDA state host-side, bit-exact (numpy copy
+                # of the device buffers — no re-merge, no re-order). The
+                # pull synchronizes the window, trading the double-buffer
+                # overlap for mid-stream resumability; that is the
+                # flag's documented cost, and it only applies on
+                # multi-axis meshes.
+                t0 = time.perf_counter()
+                self._save_fold_checkpoint(
+                    ckpt_key,
+                    start_w + windows_folded[0],
+                    [np.asarray(x) for x in flat_state],
+                )
+                prof("stage_stream_ckpt", time.perf_counter() - t0)
 
         t_wall0 = time.perf_counter()
         pool = concurrent.futures.ThreadPoolExecutor(
@@ -5745,7 +6271,23 @@ class MeshExecutor:
         )
         try:
             with _segment.platform_hint(self.mesh.devices.flat[0].platform):
-                flat_state = list(init_p())
+                if ckpt_key is not None:
+                    # Resume (r23): a prior attempt on a failed geometry
+                    # checkpointed its carry state at window boundaries;
+                    # adopt it on THIS mesh and refold only the windows
+                    # after the last checkpoint. Merge order is
+                    # untouched — the carry state is the same per-device
+                    # partial the unfaulted fold would hold here, so
+                    # sketches and group order stay bit-identical.
+                    flat_state, start_w = self._load_fold_checkpoint(
+                        ckpt_key, leaves, plan.d, sharding
+                    )
+                    if start_w:
+                        _MESH_RESUMES.inc()
+                        with self._geom_lock:
+                            self._geom_events["resumes"] += 1
+                if flat_state is None:
+                    flat_state = list(init_p())
                 # Pack workers adopt the query's trace context and
                 # attribution (r15): host CPU burned packing windows
                 # samples under this query's label, not as anonymous
@@ -5815,6 +6357,12 @@ class MeshExecutor:
                         win_blocks.append(dev_cols)
                         win_masks.append(mask)
                         win_gids.append(dev_g)
+                    if w < start_w:
+                        # Resumed fold (r23): windows below the
+                        # checkpoint are already in the adopted carry
+                        # state — transferred for the warm-cache concat,
+                        # never refolded.
+                        continue
                     if not resolve_fold(block=False):
                         # Compile still running: keep streaming transfers
                         # (the windows land in HBM, where the cacheable
@@ -5844,7 +6392,13 @@ class MeshExecutor:
                     dispatch_fold(*d_args)
                 deferred.clear()
                 t0 = time.perf_counter()
-                merged_flat = merge_p(*flat_state)
+                # The final cross-host merge is a sharded dispatch too:
+                # same recovery plane as the per-window folds (r23).
+                merged_flat = self._mesh_dispatch(
+                    lambda: merge_p(*flat_state),
+                    what="stream_merge",
+                    fold_sig=fold_sig,
+                )
                 buf = fin_p(*merged_flat)
                 merged = self._unpack_outputs(templates, capacity, buf)
                 prof("stage_stream_drain", time.perf_counter() - t0)
@@ -5852,6 +6406,17 @@ class MeshExecutor:
             pool.shutdown(wait=True)
             prof("stage_overlap", time.perf_counter() - t_wall0)
             prof("stream_windows", float(plan.n_windows))
+        if ckpt_key is not None:
+            # Success: the fold's answer is out; the checkpoint must not
+            # outlive it (a LATER fold of the same identity starts clean).
+            with self._geom_lock:
+                self._fold_ckpt.pop(ckpt_key, None)
+            if start_w:
+                self.last_resume_stats = {
+                    "resumed_from_window": int(start_w),
+                    "refolded_windows": int(plan.n_windows - start_w),
+                    "total_windows": int(plan.n_windows),
+                }
         staged_for_cache = None
         if cacheable:
             # Concatenate the windows into one monolithic staging so warm
@@ -6463,10 +7028,12 @@ class MeshExecutor:
             for p in range(n_passes):
                 flat = list(init_p())
                 t0 = time.perf_counter()
+                gb = jax.device_put(np.int32(p * capacity), repl)
                 flat = list(
-                    fold_fn(
-                        *flat, *args,
-                        jax.device_put(np.int32(p * capacity), repl),
+                    self._mesh_dispatch(
+                        lambda: fold_fn(*flat, *args, gb),
+                        what="batched_fold",
+                        fold_sig=bsig,
                     )
                 )
                 dt_b = time.perf_counter() - t0
@@ -6606,17 +7173,20 @@ class MeshExecutor:
                 folded = False
                 if fold_exec is not None:
                     try:
+                        gb = jax.device_put(
+                            np.int32(p * capacity),
+                            NamedSharding(self.mesh, P()),
+                        )
                         flat = list(
-                            fold_exec(
-                                *flat,
-                                *cargs,
-                                jax.device_put(
-                                    np.int32(p * capacity),
-                                    NamedSharding(self.mesh, P()),
-                                ),
+                            self._mesh_dispatch(
+                                lambda: fold_exec(*flat, *cargs, gb),
+                                what="warm_fold",
+                                fold_sig=fold_sig,
                             )
                         )
                         folded = True
+                    except mesh_lib.MeshGeometryError:
+                        raise  # r23: recovery ladder, not the jit retry
                     except Exception as e:
                         import logging
                         import traceback
@@ -6635,7 +7205,11 @@ class MeshExecutor:
                                 key,
                             )
                 if not folded:
-                    flat = fold_p(*flat, *args, jnp.int32(p * capacity))
+                    flat = self._mesh_dispatch(
+                        lambda: fold_p(*flat, *args, jnp.int32(p * capacity)),
+                        what="warm_fold",
+                        fold_sig=fold_sig,
+                    )
                 merged_flat = merge_p(*flat)
                 buf = fin_p(*merged_flat)
                 # ONE blocking fetch per pass: completion + transfer.
@@ -6650,7 +7224,12 @@ class MeshExecutor:
     ):
         col_names = sorted(staged.blocks)
         sig = self._signature(m, specs, key_plan, staged, aux_vals, capacity)
-        assert f"mesh:{self._mesh_sig}" in sig  # geometry guard (r21)
+        if f"mesh:{self._mesh_sig}" not in sig:  # geometry guard (r21/r23)
+            raise mesh_lib.MeshGeometryError(
+                "signature_mismatch",
+                f"fused program signature does not carry this "
+                f"executor's mesh geometry {self._mesh_sig!r}",
+            )
         entry = self._program_cache.get(sig)
         if entry is None or entry[1] != len(aux_vals):
             aux_key_order = list(aux.keys())
@@ -6686,7 +7265,11 @@ class MeshExecutor:
         per_pass = []
         with _segment.platform_hint(self.mesh.devices.flat[0].platform):
             for p in range(n_passes):
-                buf = program(*args, jnp.int32(p * capacity))
+                buf = self._mesh_dispatch(
+                    lambda: program(*args, jnp.int32(p * capacity)),
+                    what="fused_fold",
+                    fold_sig=sig,
+                )
                 # ONE blocking fetch per pass: completion + transfer.
                 per_pass.append(
                     self._unpack_outputs(templates, capacity, buf)

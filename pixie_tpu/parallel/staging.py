@@ -447,6 +447,39 @@ def stage_columns(
     )
 
 
+def repartition_staged(mesh: Mesh, staged: StagedColumns) -> StagedColumns:
+    """Re-place one staged table onto ``mesh`` (r23 geometry recovery).
+
+    Every rung of the degradation ladder keeps the total device count
+    (losing a host is a trust statement about the ``hosts`` axis, not a
+    removal of local silicon), so the [D, nblk, B] shapes are unchanged
+    and the move is a pure ``device_put`` resolved through the SAME
+    partition-rule tree that placed the shards originally: blocks, mask,
+    and gids shard dim 0 over the new axis tuple; values bit-identical.
+    Host-side key bookkeeping carries over untouched."""
+    from pixie_tpu.distributed import mesh as mesh_lib
+
+    names = [f"blocks/{n}" for n in staged.blocks] + ["mask"]
+    if staged.gids is not None:
+        names.append("gids")
+    sh = mesh_lib.match_partition_rules(
+        mesh_lib.STAGED_PARTITION_RULES, names, mesh
+    )
+    return dataclasses.replace(
+        staged,
+        blocks={
+            n: jax.device_put(a, sh[f"blocks/{n}"])
+            for n, a in staged.blocks.items()
+        },
+        mask=jax.device_put(staged.mask, sh["mask"]),
+        gids=(
+            jax.device_put(staged.gids, sh["gids"])
+            if staged.gids is not None
+            else None
+        ),
+    )
+
+
 def _narrow_gids(gids: np.ndarray, num_groups: int) -> np.ndarray:
     """Dense gids ship u8/u16 when the group count fits (the compiled
     programs cast to int32 per block anyway)."""

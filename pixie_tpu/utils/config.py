@@ -265,6 +265,53 @@ define_flag(
     "placement) instead of thrashing one agent's residency ring.",
 )
 define_flag(
+    "mesh_fold_checkpoint",
+    True,
+    help_="Window-level fold checkpointing on multi-axis meshes (r23): "
+    "the stream fold pulls its carried per-device UDA state host-side "
+    "at every window boundary, so a mid-stream geometry failure "
+    "(host loss, hung collective) resumes from the last completed "
+    "window on the degraded geometry instead of refolding from "
+    "scratch. Merge order is preserved, so sketches and group order "
+    "stay bit-identical. No effect on a flat (single-host) mesh.",
+)
+define_flag(
+    "mesh_dispatch_timeout_s",
+    0.0,
+    help_="Collective watchdog deadline (seconds) around each sharded "
+    "mesh fold dispatch: a dispatch that blocks past the deadline is "
+    "treated as a hung collective and re-planned on the next "
+    "degradation rung (pixie_tpu/distributed/mesh.py ladder). 0 = "
+    "derive the deadline from the r22 CostModel prediction x "
+    "mesh_watchdog_rail_factor when the model has an opinion (no "
+    "opinion = no watchdog). Negative disables the watchdog outright.",
+)
+define_flag(
+    "mesh_watchdog_rail_factor",
+    32.0,
+    help_="Multiplier on the r22 CostModel's predicted fold-dispatch "
+    "seconds when deriving the collective-watchdog deadline (only when "
+    "mesh_dispatch_timeout_s is 0). Generous by design: the watchdog "
+    "exists to catch HUNG collectives, not slow ones — a false trip "
+    "costs a full re-plan on the degraded rung.",
+)
+define_flag(
+    "mesh_breaker_threshold",
+    2,
+    help_="Consecutive geometry failures (host loss / collective "
+    "timeout) on one mesh signature before the per-geometry breaker "
+    "opens and new folds skip straight to the next degradation rung. "
+    "0 disables the per-geometry breaker (every fold starts at full "
+    "geometry).",
+)
+define_flag(
+    "mesh_breaker_cooldown_s",
+    30.0,
+    help_="Seconds an open mesh-geometry rung stays skipped before a "
+    "half-open trial is allowed back on that geometry (success closes "
+    "the breaker and restores the rung; failure re-opens it).",
+)
+define_flag(
     "view_tail_placement",
     True,
     help_="Route a view hit's unflushed-tail delta fold to the view's "

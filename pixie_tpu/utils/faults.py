@@ -36,7 +36,16 @@ and the fragment-epoch dedup must drop exactly one; r19 join site:
 ``device.join_dispatch`` — the device sort-merge join lane fails after
 planning accepts the shape, before staging (chaos tests prove the r9
 breaker trips and the query completes bit-identical on the host
-JoinNode)), and tests/operators arm them deterministically.
+JoinNode); r23 mesh-recovery sites: ``mesh.host_loss`` — a host of the
+multi-axis mesh dies mid-sharded-fold (the dispatch raises a
+MeshGeometryError and the executor re-plans onto the next degradation
+rung, bit-identical by the r21 invariant), ``mesh.collective_timeout``
+— a cross-host collective hangs past the watchdog deadline (same
+recovery, detected by deadline instead of error),
+``mesh.checkpoint_corrupt`` — a window-boundary fold checkpoint reads
+back corrupt on resume and recovery must discard it and refold from
+scratch, never resurrect bad carry state (r14 RingSpill posture)), and
+tests/operators arm them deterministically.
 
 Design contract:
 

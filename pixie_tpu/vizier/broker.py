@@ -322,6 +322,22 @@ class AgentTracker:
                     out.setdefault(key, {})[aid] = st
         return out
 
+    def mesh_view(self) -> dict[str, dict]:
+        """agent_id -> the executor's mesh-recovery section from its
+        latest heartbeat (r23): current vs full geometry, degradation
+        ladder, per-geometry breaker state, degrade/checkpoint/resume
+        counters. Operators read this off /statusz to see which agents
+        are running on a degraded mesh rung (and whether the full
+        geometry's breaker is open, half-open, or recovered) without
+        touching the agents."""
+        out = {}
+        with self._lock:
+            for aid, a in sorted(self._agents.items()):
+                mesh = (a.get("health") or {}).get("mesh")
+                if mesh:
+                    out[aid] = mesh
+        return out
+
     def agents_snapshot(self) -> list[dict]:
         """Rows for the GetAgentStatus UDTF (ref: md_udtfs.h reads the
         agent manager's registry), plus r10 health-plane columns."""
@@ -602,6 +618,10 @@ class QueryBroker:
                     if self.views is not None
                     else None
                 ),
+                # r23: per-agent mesh-recovery plane — degraded
+                # geometry rungs, per-geometry breaker state, and
+                # checkpoint/resume counters from executor heartbeats.
+                "mesh": self.tracker.mesh_view(),
             },
             extra_routes={
                 "/agentz": lambda: self.tracker.agents_snapshot(),

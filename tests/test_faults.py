@@ -866,6 +866,87 @@ def test_staging_pack_poison_falls_back_to_monolithic(mesh, flagset):
     ), f"stream fallback not recorded: {list(dev.stream_fallback_errors)}"
 
 
+# -- mesh geometry recovery (r23) --------------------------------------------
+
+
+def _seed_mesh_carnot():
+    """A multi-axis (hosts:2,d:4) executor over the standard store —
+    the geometry the r23 recovery sites target (flat meshes have no
+    hosts to lose). Deep rung-by-rung coverage lives in
+    tests/test_mesh_recovery.py; these pin the chaos-site contracts."""
+    from pixie_tpu.distributed.mesh import MeshConfig
+    from pixie_tpu.parallel import MeshExecutor
+
+    dev = MeshExecutor(
+        block_rows=1024, mesh_config=MeshConfig.parse("hosts:2,d:4", 8)
+    )
+    c = Carnot(device_executor=dev)
+    t = c.table_store.create_table("http_events", REL)
+    rng = np.random.default_rng(13)
+    n = 4000
+    t.write_pydict(
+        {
+            "time_": np.arange(n),
+            "service": rng.choice(["a", "b", "c"], n).astype(object),
+            "latency": rng.integers(1, 100, n).astype(np.float64),
+        }
+    )
+    t.compact()
+    t.stop()
+    return c, dev
+
+
+def test_mesh_host_loss_degrades_geometry_bit_identical(mesh):
+    """Acceptance: a host dying mid-sharded-fold re-plans the SAME fold
+    one degradation rung down — no host fallback, bit-identical rows,
+    and the degrade counter moves."""
+    c2, _ = _seed_mesh_carnot()  # uninjected twin for truth
+    truth = _sorted_rows(c2.execute_query(AGG_QUERY))
+    deg = metrics_registry().counter("mesh_degrade_events_total")
+    d0 = deg.total()
+    c, dev = _seed_mesh_carnot()
+    faults.arm("mesh.host_loss", count=1)
+    res = _sorted_rows(c.execute_query(AGG_QUERY))
+    assert res == truth, "degraded-geometry retry must be bit-identical"
+    assert not dev.fallback_errors, dev.fallback_errors
+    assert deg.total() == d0 + 1
+    snap = dev.mesh_recovery_snapshot()
+    assert snap["degraded"] and snap["geometry"] == "d:8"
+
+
+def test_mesh_collective_timeout_degrades_geometry(mesh):
+    c2, _ = _seed_mesh_carnot()
+    truth = _sorted_rows(c2.execute_query(AGG_QUERY))
+    c, dev = _seed_mesh_carnot()
+    faults.arm("mesh.collective_timeout", count=1)
+    res = _sorted_rows(c.execute_query(AGG_QUERY))
+    assert res == truth
+    assert not dev.fallback_errors, dev.fallback_errors
+    assert dev.mesh_recovery_snapshot()["degrade_events"] == 1
+
+
+def test_mesh_checkpoint_corrupt_discards_never_resurrects(mesh, flagset):
+    """A corrupt window checkpoint must be discarded — the recovered
+    fold restarts from scratch on the new rung (never resumes bad carry
+    state) and still answers bit-identically."""
+    flagset("streaming_window_rows", 1024)  # 4000 rows -> 4 stream windows
+    c2, _ = _seed_mesh_carnot()
+    truth = _sorted_rows(c2.execute_query(AGG_QUERY))
+    c, dev = _seed_mesh_carnot()
+    faults.arm("mesh.host_loss", count=1, after=2)  # 2 windows checkpoint
+    faults.arm("mesh.checkpoint_corrupt", count=1)
+    res = _sorted_rows(c.execute_query(AGG_QUERY))
+    assert faults.stats()["mesh.checkpoint_corrupt"][1] == 1, (
+        "the resume path must have consulted the checkpoint"
+    )
+    assert res == truth
+    assert not dev.fallback_errors, dev.fallback_errors
+    snap = dev.mesh_recovery_snapshot()
+    assert snap["checkpoint_resumes"] == 0, "must NOT resume corrupt state"
+    assert dev.last_resume_stats is None
+    assert snap["checkpoints_held"] == 0
+
+
 # -- datastore ---------------------------------------------------------------
 
 

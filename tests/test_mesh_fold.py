@@ -153,8 +153,12 @@ def test_fold_ragged_and_empty_shards_bit_identical():
 
 def test_geometry_change_means_distinct_cached_program():
     """The r7 cache can never replay a program compiled for a different
-    mesh shape: the signature carries the geometry and the executor
-    asserts agreement at lookup."""
+    mesh shape: the signature carries the geometry, and a lookup naming
+    a foreign geometry raises a STRUCTURED MeshGeometryError (r23 —
+    routed through the fallback ladder to the host engine, never an
+    assertion crashing the query path)."""
+    from pixie_tpu.distributed.mesh import MeshGeometryError
+
     _, ex_flat = _fold(MeshConfig.flat(8), n=64, nsvc=3)
     _, ex_mesh = _fold(MeshConfig.parse("hosts:2,d:4", 8), n=64, nsvc=3)
     sigs_flat = set(ex_flat._program_cache)
@@ -162,8 +166,10 @@ def test_geometry_change_means_distinct_cached_program():
     assert sigs_flat and sigs_mesh
     assert not (sigs_flat & sigs_mesh), "geometries shared a signature"
     foreign = next(iter(sigs_flat))
-    with pytest.raises(AssertionError):
+    with pytest.raises(MeshGeometryError) as ei:
         ex_mesh._get_program(foreign, lambda: None)
+    assert ei.value.kind == "signature_mismatch"
+    assert not ei.value.recoverable  # host fallback, no degrade retry
 
 
 # -- distributed sort-merge join ----------------------------------------------

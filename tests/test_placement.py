@@ -443,6 +443,42 @@ def test_placed_query_survives_agent_kill_bit_identical(
     assert all(a["inflight"] == 0 for a in st["per_agent"].values())
 
 
+def test_mesh_placed_query_survives_agent_kill_bit_identical(
+    placed_cluster, monkeypatch
+):
+    """r23: the ``__mesh__`` placement rung joins the r17 failover path.
+    A span too big for any single agent commits under the ``__mesh__``
+    pseudo agent and plans across the fleet; an agent dying mid-query is
+    then an ordinary r17 fragment failover — the result is FULL,
+    bit-identical, and carries a recovered annotation, never a degraded
+    one, and the ``__mesh__`` inflight accounting drains."""
+    broker, _ = placed_cluster
+    monkeypatch.setattr(broker_mod, "AGENT_EXPIRY_S", 0.4)
+    # Force the mesh_fold outcome (the rung itself is pinned by
+    # test_mesh_fold_rung_refuses_oversized_span): every query's span
+    # exceeds every advertised HBM budget.
+    monkeypatch.setattr(
+        broker.placement, "decide", lambda *a, **k: (None, "mesh_fold")
+    )
+    baseline_res = broker.execute_script(AGG_QUERY, timeout_s=30)
+    assert baseline_res.degraded is None and baseline_res.recovered is None
+    baseline = _sorted_rows(baseline_res)
+    assert baseline, "baseline produced no rows"
+    st0 = broker.placement.status()
+    assert st0["per_agent"]["__mesh__"]["placed"] >= 1
+    assert st0["decisions"].get("mesh_fold", 0) >= 1
+    faults.arm("agent.kill_holding_fragment@pem1", count=1)
+    res = broker.execute_script(AGG_QUERY, timeout_s=20)
+    assert res.degraded is None, res.degraded
+    assert res.recovered is not None
+    (entry,) = res.recovered["retried"]
+    assert entry["reason"] == "agent_lost"
+    assert entry["from"] == "pem1" and entry["to"] == "pem2"
+    assert _sorted_rows(res) == baseline
+    st = broker.placement.status()
+    assert all(a["inflight"] == 0 for a in st["per_agent"].values())
+
+
 # -- 2-agent fleet smoke -----------------------------------------------------
 
 SMOKE_TABLES = {"events_a": REL, "events_b": REL}

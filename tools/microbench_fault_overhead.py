@@ -41,6 +41,12 @@ with the model DISABLED (the ``cm = _cost_model(); if cm.ACTIVE:``
 idiom at every observation recorder and lane gate), censused from the
 observations an enabled run ingests.
 
+Also gates (r23) the mesh recovery plane: <1% modeled on the warm fold
+at the default single-axis geometry, where every sharded dispatch pays
+exactly one axis-count branch in _mesh_dispatch (no fault-site probes,
+no watchdog, no collective lock), censused by counting dispatches
+through one warm query.
+
 Prints ONE JSON line on stdout. With MB_WRITE_BENCH_DETAIL=1, merges the
 headline numbers into BENCH_DETAIL.json under the ``fault_overhead``,
 ``ack_overhead``, ``trace_overhead``, ``durability_overhead`` and
@@ -464,6 +470,49 @@ def main() -> None:
         f"{cost_model_overhead['warm_enabled_delta_pct']:+.2f}% warm"
     )
 
+    # -- mesh recovery overhead (r23) ----------------------------------------
+    # Disabled gate: on a single-axis (flat) mesh — the default — every
+    # sharded dispatch crosses _mesh_dispatch exactly once and pays one
+    # axis-count branch (len(mesh_config.axes) > 1) before calling the
+    # program: no fault-site probes, no watchdog, no collective lock.
+    # Census: dispatches per warm query counted by wrapping
+    # _mesh_dispatch through one query; modeled disabled overhead =
+    # dispatches * branch_ns / op_ns, gated <1%.
+    def _mesh_probe_ns(iters: int = 1_000_000) -> float:
+        cfg = dev.mesh_config
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            if len(cfg.axes) > 1:
+                raise AssertionError
+        return (time.perf_counter_ns() - t0) / iters
+
+    mesh_probe_ns = _mesh_probe_ns()
+    mesh_calls = [0]
+    _orig_md = type(dev)._mesh_dispatch
+
+    def _counting_md(self, fn, what="fold", fold_sig=None):
+        mesh_calls[0] += 1
+        return _orig_md(self, fn, what, fold_sig=fold_sig)
+
+    type(dev)._mesh_dispatch = _counting_md
+    try:
+        c.execute_query(query)
+    finally:
+        type(dev)._mesh_dispatch = _orig_md
+    mesh_hooks = mesh_calls[0]
+    mesh_modeled_pct = 100.0 * mesh_hooks * mesh_probe_ns / warm_idle_ns
+    mesh_recovery_overhead = {
+        "dispatch_probe_ns": round(mesh_probe_ns, 2),
+        "warm_dispatches_per_query": int(mesh_hooks),
+        "warm_disabled_modeled_pct": round(mesh_modeled_pct, 5),
+        "pass_under_1pct": bool(mesh_modeled_pct < 1.0),
+    }
+    log(
+        f"mesh recovery: {mesh_hooks} dispatches/warm-query at "
+        f"{mesh_probe_ns:.1f}ns -> {mesh_modeled_pct:.4f}% disabled "
+        f"modeled on the flat path"
+    )
+
     # -- durability spill overhead (r14) -------------------------------------
     # Disabled gate: with no WAL attached, every durability hook on the
     # send/ack path is a bare ``wal is None`` attribute branch —
@@ -732,6 +781,7 @@ def main() -> None:
             and failover_overhead["pass_under_1pct"]
             and views_overhead["pass_under_1pct"]
             and cost_model_overhead["pass_under_1pct"]
+            and mesh_recovery_overhead["pass_under_1pct"]
         ),
         "platform": jax.devices()[0].platform,
     }
@@ -742,6 +792,7 @@ def main() -> None:
     out["failover_overhead"] = failover_overhead
     out["views_overhead"] = views_overhead
     out["cost_model_overhead"] = cost_model_overhead
+    out["mesh_recovery_overhead"] = mesh_recovery_overhead
     print(json.dumps(out))
 
     if os.environ.get("MB_WRITE_BENCH_DETAIL") == "1":
@@ -755,7 +806,7 @@ def main() -> None:
                 "ack_overhead", "trace_overhead",
                 "durability_overhead", "profiler_overhead",
                 "failover_overhead", "views_overhead",
-                "cost_model_overhead",
+                "cost_model_overhead", "mesh_recovery_overhead",
             )
         }
         detail["ack_overhead"] = ack_overhead
@@ -765,13 +816,15 @@ def main() -> None:
         detail["failover_overhead"] = failover_overhead
         detail["views_overhead"] = views_overhead
         detail["cost_model_overhead"] = cost_model_overhead
+        detail["mesh_recovery_overhead"] = mesh_recovery_overhead
         with open(path, "w") as f:
             json.dump(detail, f, indent=1)
             f.write("\n")
         log(
             "BENCH_DETAIL.json updated (fault_overhead, ack_overhead, "
             "trace_overhead, durability_overhead, profiler_overhead, "
-            "failover_overhead, views_overhead, cost_model_overhead)"
+            "failover_overhead, views_overhead, cost_model_overhead, "
+            "mesh_recovery_overhead)"
         )
 
     if not out["pass_under_1pct"]:

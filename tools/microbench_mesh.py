@@ -18,10 +18,17 @@ to 1-host (always present; falls back to the widest measured width when
 With ``MB_WRITE_BENCH_DETAIL=1`` the summary lands in BENCH_DETAIL.json
 under the ``mesh`` key, like ``join`` and ``codec``.
 
+``MB_MESH_CHAOS=1`` runs the r23 recovery bench instead (bench.py
+config 12): a windowed streaming fold at ``hosts:2,d:N/2`` with one
+simulated host killed mid-stream — recovery wall seconds and the
+refolded-window fraction land under ``mesh_chaos``.
+
 Run: JAX_PLATFORMS=cpu python tools/microbench_mesh.py
-Env: MB_MESH_ROWS    rows folded per width (default 200_000)
-     MB_MESH_WIDTHS  comma list of host counts (default 1,2,4,8)
-     MB_RUNS         timed repetitions, best-of (default 3)
+Env: MB_MESH_ROWS     rows folded per width (default 200_000)
+     MB_MESH_WIDTHS   comma list of host counts (default 1,2,4,8)
+     MB_RUNS          timed repetitions, best-of (default 3)
+     MB_MESH_CHAOS    1 = run the r23 recovery bench instead
+     MB_MESH_WINDOWS  stream windows for the recovery bench (default 8)
 """
 
 from __future__ import annotations
@@ -166,6 +173,150 @@ def run_mesh_bench(rows: int = 200_000, runs: int = 3, widths=None) -> dict:
     return summary
 
 
+def run_mesh_chaos_bench(
+    rows: int = 120_000, windows: int = 8, runs: int = 3
+) -> dict:
+    """r23 recovery microbench: one simulated host killed mid-stream.
+
+    A windowed streaming fold runs at ``hosts:2,d:N/2`` with
+    ``mesh.host_loss`` armed to fire after ``windows // 2`` window
+    dispatches. The executor's degradation ladder re-plans the fold on
+    the surviving geometry and resumes from the last window-boundary
+    checkpoint; the summary prices that recovery — wall seconds over
+    the unfaulted fold and the fraction of windows refolded — and
+    asserts the recovered output is bit-identical to an unfaulted flat
+    fold. Callable from bench.py config 12."""
+    import jax
+
+    from pixie_tpu.distributed.mesh import MeshConfig
+    from pixie_tpu.engine import Carnot
+    from pixie_tpu.parallel import MeshExecutor
+    from pixie_tpu.types import DataType, Relation
+    from pixie_tpu.utils import faults, flags
+
+    ndev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    win_rows = max(1, rows // windows)
+    log(
+        f"devices: {ndev} ({platform})  rows={rows}  "
+        f"windows={windows} ({win_rows} rows each)"
+    )
+
+    rng = np.random.default_rng(23)
+    data = {
+        "service": np.array(
+            [f"svc{i}" for i in rng.integers(0, 64, rows)]
+        ),
+        "status": rng.integers(0, 7, rows),
+        "lat": rng.standard_normal(rows),
+    }
+    rel = Relation.of(
+        ("service", DataType.STRING),
+        ("status", DataType.INT64),
+        ("lat", DataType.FLOAT64),
+    )
+
+    def cold_fold(cfg):
+        # Fresh executor + store per fold: a warm executor with no new
+        # rows serves the repeat from its stream cache (one merge
+        # dispatch, no windows), so only cold folds exercise the full
+        # windowed stream. Both sides of the recovery delta pay the
+        # same cold compile, leaving ladder re-plan + degraded-rung
+        # compile + post-checkpoint refold as the difference.
+        ex = MeshExecutor(block_rows=1 << 15, mesh_config=cfg)
+        carnot = Carnot(device_executor=ex)
+        carnot.table_store.create_table("mesh_bench", rel).write_pydict(
+            data
+        )
+        t0 = time.perf_counter()
+        out = carnot.execute_query(AGG_QUERY).table("out")
+        wall = time.perf_counter() - t0
+        return ex, carnot, out, wall
+
+    fault_after = max(1, windows // 2)
+    flags.set("streaming_window_rows", win_rows)
+    try:
+        # Unfaulted flat fold: the bit-identity truth.
+        _, _, truth, _ = cold_fold(MeshConfig.flat(ndev))
+
+        cfg = MeshConfig.parse(f"hosts:2,d:{ndev // 2}", ndev)
+        unfaulted = float("inf")
+        for _ in range(runs):
+            unfaulted = min(unfaulted, cold_fold(cfg)[3])
+
+        # Kill one simulated host after fault_after window dispatches:
+        # the fold must resume from the last checkpoint on the degraded
+        # rung. The faulted wall includes the degraded rung's compile —
+        # that IS part of what recovery costs.
+        faults.arm("mesh.host_loss", count=1, after=fault_after)
+        try:
+            ex, carnot, out, faulted = cold_fold(cfg)
+        finally:
+            faults.reset()
+        assert not ex.fallback_errors, ex.fallback_errors
+        for k in truth:
+            assert np.array_equal(
+                np.asarray(truth[k]), np.asarray(out[k])
+            ), f"recovered fold diverged on {k}"
+        snap = ex.mesh_recovery_snapshot()
+        rs = ex.last_resume_stats
+        assert rs is not None, snap
+        # New rows + one more fold: the executor must restore its full
+        # configured geometry once the loss clears.
+        carnot.table_store.get_table("mesh_bench").write_pydict(data)
+        carnot.execute_query(AGG_QUERY)
+        restored = not ex.mesh_recovery_snapshot()["degraded"]
+    finally:
+        flags.reset("streaming_window_rows")
+
+    frac = round(rs["refolded_windows"] / rs["total_windows"], 4)
+    summary = {
+        "platform": platform,
+        "rows": rows,
+        "windows": rs["total_windows"],
+        "geometry": cfg.signature(),
+        "fault_after_window": fault_after,
+        "unfaulted_fold_s": round(unfaulted, 6),
+        "faulted_fold_s": round(faulted, 6),
+        # Wall-clock price of the host loss: ladder re-plan + degraded
+        # rung compile + refolding the post-checkpoint windows.
+        "recovery_seconds": round(max(0.0, faulted - unfaulted), 6),
+        "resumed_from_window": rs["resumed_from_window"],
+        "refolded_windows": rs["refolded_windows"],
+        "refolded_window_fraction": frac,
+        # Deterministic headline (higher is better): the fraction of
+        # the stream the window checkpoints did NOT have to refold.
+        "checkpoint_saved_fraction": round(1.0 - frac, 4),
+        "degrade_events": snap["degrade_events"],
+        "bit_identical": True,
+        "restored_after_next_fold": restored,
+        "note": (
+            "Simulated host loss on one local device pool; recovery "
+            "seconds include the degraded rung's one-time compile. "
+            "Real multi-host numbers await a TPU pod campaign."
+        ),
+    }
+    log(
+        f"recovery: {summary['recovery_seconds']:.3f}s over unfaulted "
+        f"{summary['unfaulted_fold_s']:.3f}s; refolded "
+        f"{rs['refolded_windows']}/{rs['total_windows']} windows"
+    )
+    return summary
+
+
+def record_mesh_chaos_detail(summary: dict, path: str = None) -> None:
+    """Merge one mesh recovery bench into BENCH_DETAIL.json's
+    ``mesh_chaos`` block (read-modify-write: other blocks survive)."""
+    bd_path = path or os.path.join(REPO, "BENCH_DETAIL.json")
+    with open(bd_path) as f:
+        detail = json.load(f)
+    detail["mesh_chaos"] = summary
+    with open(bd_path, "w") as f:
+        json.dump(detail, f, indent=1)
+        f.write("\n")
+    log("BENCH_DETAIL.json updated (mesh_chaos)")
+
+
 def record_mesh_detail(summary: dict, path: str = None) -> None:
     """Merge one mesh sweep into BENCH_DETAIL.json's ``mesh`` block
     (read-modify-write: the other recorded blocks survive)."""
@@ -202,6 +353,17 @@ def main() -> int:
         if widths_env
         else None
     )
+    if os.environ.get("MB_MESH_CHAOS") == "1":
+        # r23: the recovery bench instead of the width sweep.
+        summary = run_mesh_chaos_bench(
+            rows=rows,
+            windows=int(os.environ.get("MB_MESH_WINDOWS", 8)),
+            runs=runs,
+        )
+        print(json.dumps(summary, indent=1))
+        if os.environ.get("MB_WRITE_BENCH_DETAIL") == "1":
+            record_mesh_chaos_detail(summary)
+        return 0
     summary = run_mesh_bench(rows=rows, runs=runs, widths=widths)
     print(json.dumps(summary, indent=1))
     if os.environ.get("MB_WRITE_BENCH_DETAIL") == "1":

@@ -200,6 +200,9 @@ def _tables_equal(a: dict, b: dict) -> bool:
 # r17 fragment failover on, ZERO degraded results: every query
 # completes bit-identical to the unfaulted run, with
 # broker_fragment_retries_total proving failover (not luck) did it.
+# When the flag-resolved mesh geometry is multi-axis, --chaos also arms
+# mesh.host_loss (count=1, mid-phase) — the r23 degraded-geometry
+# ladder, not broker failover, must carry that one (see _run_soak_inner).
 CHAOS_SITES = {
     "serving.admission_reject": dict(p=0.03, seed=101),
     "agent.execute@pem1": dict(p=0.03, seed=102),
@@ -707,9 +710,15 @@ def _run_soak_inner(
     retries_c = reg.counter("broker_fragment_retries_total")
     recovered_c = reg.counter("broker_recovered_queries_total")
     wasted_c = reg.counter("broker_hedge_both_complete_total")
-    r0, rec0, w0 = (
-        retries_c.total(), recovered_c.total(), wasted_c.total()
+    mesh_degrade_c = reg.counter("mesh_degrade_events_total")
+    r0, rec0, w0, md0 = (
+        retries_c.total(), recovered_c.total(), wasted_c.total(),
+        mesh_degrade_c.total(),
     )
+    # r23: the mesh phase only exists when the flag-resolved geometry is
+    # multi-axis (PIXIE_TPU_MESH_AXES=hosts:2,d:-1) — a flat executor
+    # never checks the mesh fault sites.
+    mesh_chaos = chaos and len(ex.mesh_config.axes) > 1
     if chaos:
         # Armed AFTER the unfaulted baselines: every concurrent result
         # is still judged against clean truth.
@@ -717,7 +726,17 @@ def _run_soak_inner(
 
         for site, kw in CHAOS_SITES.items():
             faults.arm(site, **kw)
-        log(f"chaos armed: {sorted(CHAOS_SITES)}")
+        armed = sorted(CHAOS_SITES)
+        if mesh_chaos:
+            # r23 mesh phase: kill one simulated host mid-fold partway
+            # into the concurrent phase. The executor's degradation
+            # ladder must re-plan the fold onto the surviving geometry
+            # bit-identically — the broker never sees the loss, so the
+            # gate stays ZERO degraded while mesh_degrade_events_total
+            # proves the ladder (not luck) carried the faulted fold.
+            faults.arm("mesh.host_loss", count=1, after=10, seed=107)
+            armed.append("mesh.host_loss")
+        log(f"chaos armed: {armed}")
 
     # Continuous profiler (r15): sample this process's Python stacks —
     # broker/agent/worker threads carry their query attribution — through
@@ -1157,6 +1176,16 @@ def _run_soak_inner(
                 "hedge_both_complete": int(wasted_c.total() - w0),
             },
         }
+        if mesh_chaos:
+            # r23 mesh phase verdict: the host kill degraded geometry
+            # (counter moved) and BOTH executors finished the run back
+            # on their full configured geometry — recovery was internal
+            # to the executor, invisible to the broker's accounting.
+            report["contention"]["chaos"]["mesh"] = {
+                "degrade_events": int(mesh_degrade_c.total() - md0),
+                "owner": ex.mesh_recovery_snapshot(),
+                "replica": ex2.mesh_recovery_snapshot(),
+            }
     return report
 
 
@@ -1289,7 +1318,12 @@ def main() -> int:
         "phase, with r17 fragment failover ON and a replica agent in "
         "the cluster. The pass gate requires ZERO degraded results "
         "(every query bit-identical to the unfaulted baseline) and "
-        "broker_fragment_retries_total > 0 (failover, not luck).",
+        "broker_fragment_retries_total > 0 (failover, not luck). "
+        "Under a multi-axis geometry (PIXIE_TPU_MESH_AXES="
+        "hosts:2,d:-1) a mesh phase also kills one simulated host "
+        "mid-fold: the gate additionally requires "
+        "mesh_degrade_events_total > 0 with both executors back on "
+        "their full geometry (r23).",
     )
     ap.add_argument(
         "--profile", action="store_true",
@@ -1483,6 +1517,17 @@ def main() -> int:
             and chaos_block["recovered"] > 0
             and chaos_block["failover"]["fragment_retries"] > 0
         )
+        mesh_blk = chaos_block.get("mesh")
+        if mesh_blk is not None:
+            # r23 acceptance: under a multi-axis geometry
+            # (PIXIE_TPU_MESH_AXES=hosts:2,d:-1) the armed host kill
+            # must have actually degraded geometry (counter moved) AND
+            # every executor must finish back on its full configured
+            # geometry — zero degraded above already proved the
+            # recovery was bit-identical.
+            ok = ok and mesh_blk["degrade_events"] > 0
+            for side in ("owner", "replica"):
+                ok = ok and not mesh_blk[side]["degraded"]
     else:
         ok = ok and report["degraded"] == 0
     log(f"soak {'PASS' if ok else 'FAIL'}")
