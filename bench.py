@@ -150,6 +150,14 @@ window checkpoint, the headline ``mesh_chaos_checkpoint_saved_fraction``
 is the stream fraction NOT refolded, and recovery seconds + the
 refolded-window fraction land in BENCH_DETAIL.json's ``mesh_chaos`` key.
 
+Ingest chaos soak (r24): config 13 (opt-in, BENCH_CONFIGS=...,13) runs
+tools/soak_ingest.py's mixed-protocol replay (all six parsers) through
+the bounded-tracker/shedding-ladder/quarantine ingest plane with the
+ingest.* fault sites armed and concurrent queries checked
+bit-identical; asserts the exact drop-accounting invariant, records
+offered events/s (headline ``ingest_soak_events_per_s``) plus drop
+fractions by reason into BENCH_DETAIL.json's ``ingest_soak`` key.
+
 Env knobs: BENCH_ROWS (configs 2/5; default 256M), BENCH_SMALL_ROWS
 (configs 1/3/4; default 64M), BENCH_HOST_ROWS (config 0; default 8M),
 BENCH_RUNS, BENCH_SERVICES, BENCH_CONFIGS (comma list, default
@@ -161,7 +169,9 @@ config 6, BENCH_FLEET_AGENTS/BENCH_FLEET_CLIENTS/BENCH_FLEET_ROWS/
 BENCH_FLEET_TABLES/BENCH_FLEET_HBM_MB for config 7, BENCH_JOIN_ROWS
 for config 8, BENCH_VIEWS_CLIENTS/BENCH_VIEWS_REQUESTS/
 BENCH_VIEWS_ROWS for config 9, BENCH_CM_ROWS for config 11,
-BENCH_MESH_ROWS/BENCH_MESH_WINDOWS for config 12.
+BENCH_MESH_ROWS/BENCH_MESH_WINDOWS for config 12,
+BENCH_INGEST_SECONDS/BENCH_INGEST_FEEDERS/BENCH_INGEST_CLIENTS for
+config 13.
 """
 
 import copy
@@ -327,12 +337,18 @@ class Ledger:
             best_now[e["metric"]] = max(
                 best_now.get(e["metric"], 0), e["value"]
             )
+        # Read-modify-write: the microbench/soak recorders merge their
+        # own top-level keys (mesh, ingest_soak, fault_overhead, ...)
+        # into this file — a bench run must not clobber them.
+        doc: dict = {}
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+        doc.update({"configs": self.detail, "best": best_now, "gate": gate})
         with open(self.path, "w") as f:
-            json.dump(
-                {"configs": self.detail, "best": best_now, "gate": gate},
-                f,
-                indent=1,
-            )
+            json.dump(doc, f, indent=1)
 
 
 def main() -> None:
@@ -349,6 +365,7 @@ def main() -> None:
     ]
     unknown = set(order) - {
         "0", "1", "2", "3", "4", "5", "6", "7", "8", "9", "10", "11",
+        "12", "13",
     }
     if unknown:
         raise SystemExit(f"BENCH_CONFIGS has unknown entries: {unknown}")
@@ -1334,6 +1351,51 @@ def main() -> None:
         )
         microbench_mesh.record_mesh_chaos_detail(summary)
 
+    # ---- config 13: ingest chaos soak (r24) -------------------------------
+    def run_config_13():
+        # The overload-proof ingest plane under chaos: mixed-protocol
+        # replay (all six parsers) through reassembly -> trackers ->
+        # tables -> store with the ingest.* fault sites armed and
+        # concurrent queries checked bit-identical. Records offered
+        # events/s, drop fractions by reason, and the exact
+        # drop-accounting invariant under BENCH_DETAIL.json's
+        # ingest_soak block. Opt-in via BENCH_CONFIGS=...,13.
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import soak_ingest
+
+        report = soak_ingest.run_soak(
+            duration_s=float(
+                os.environ.get("BENCH_INGEST_SECONDS", 3.0)
+            ),
+            feeders=int(os.environ.get("BENCH_INGEST_FEEDERS", 4)),
+            clients=int(os.environ.get("BENCH_INGEST_CLIENTS", 1)),
+        )
+        for k in (
+            "law_a_exact", "law_b_exact", "law_c_exact",
+            "law_push_exact",
+        ):
+            assert report["gates"][k], report["accounting"]
+        assert report["gates"]["zero_errors"], report["errors"]
+        assert report["gates"]["queries_bit_identical"], report["gates"]
+        assert report["gates"]["trackers_drained"], report["gates"]
+        ledger.add(
+            {
+                "config": 13,
+                "events_offered": report["events_offered"],
+                "drop_fraction": report["drop_fraction"],
+                "drop_fractions_by_reason": report[
+                    "drop_fractions_by_reason"
+                ],
+                "accounting_exact": True,
+                "peak_shed_level": report["peak_shed_level"],
+                "quarantine_opens": report["quarantine_opens"],
+                "metric": "ingest_soak_events_per_s",
+                "value": report["events_per_s"],
+                "unit": "events_per_s",
+            }
+        )
+        soak_ingest.record_ingest_soak_detail(report)
+
     runners = {
         "0": run_config_0,
         "1": run_config_1,
@@ -1348,6 +1410,7 @@ def main() -> None:
         "10": run_config_10,
         "11": run_config_11,
         "12": run_config_12,
+        "13": run_config_13,
     }
     ran = set()
     for c in order:  # BENCH_CONFIGS order IS the execution order

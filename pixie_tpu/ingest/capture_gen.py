@@ -1,0 +1,222 @@
+"""Synthetic mixed-protocol capture generation (r24).
+
+One place that knows how to fabricate valid request/response byte
+exchanges for every shipped parser (http, http2/gRPC, dns, mysql,
+pgsql, redis), so the chaos soak (tools/soak_ingest.py), the fuzz
+corpus tests, and the microbench all replay the SAME wire shapes the
+protocol tests assert on — a capture built here parses to at least one
+record per exchange on a healthy pipe.
+
+The builders are deterministic functions of an integer ``i`` so replays
+are reproducible without any RNG, and a corrupted replay (the fuzz
+tests flip bits / truncate / interleave garbage) still exercises real
+framing logic rather than random noise the parsers reject trivially.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from pixie_tpu.protocols import http2 as http2_proto
+
+# -- per-protocol wire builders ---------------------------------------------
+
+
+def http_exchange(i: int, body: str = "") -> tuple[bytes, bytes]:
+    body = body or f"payload-{i}"
+    req = (
+        f"GET /api/v{i % 7}/items/{i} HTTP/1.1\r\n"
+        f"Host: svc{i % 13}.example.com\r\n\r\n"
+    ).encode()
+    resp = (
+        f"HTTP/1.1 200 OK\r\nContent-Length: {len(body)}\r\n"
+        f"Content-Type: text/plain\r\n\r\n{body}"
+    ).encode()
+    return req, resp
+
+
+def _h2_frame(ftype: int, fflags: int, stream_id: int, payload: bytes) -> bytes:
+    return (
+        len(payload).to_bytes(3, "big")
+        + bytes([ftype, fflags])
+        + stream_id.to_bytes(4, "big")
+        + payload
+    )
+
+
+def _h2_headers(pairs) -> bytes:
+    # Literal-without-indexing with plain strings: a valid HPACK
+    # encoding every decoder must accept.
+    out = bytearray()
+    for name, value in pairs:
+        out.append(0x00)
+        nb, vb = name.encode(), value.encode()
+        out.append(len(nb))
+        out += nb
+        out.append(len(vb))
+        out += vb
+    return bytes(out)
+
+
+def http2_exchange(i: int, body: str = "") -> tuple[bytes, bytes]:
+    """A gRPC call on stream 1. The request side includes the client
+    connection preface, so each exchange is a self-contained conn."""
+    sid = 1
+    data = (body or f"grpc-msg-{i}").encode()
+    req = (
+        http2_proto.PREFACE
+        + _h2_frame(
+            http2_proto.HEADERS,
+            http2_proto.FLAG_END_HEADERS,
+            sid,
+            _h2_headers(
+                [
+                    (":method", "POST"),
+                    (":path", f"/px.api.Svc{i % 5}/Call"),
+                    (":scheme", "http"),
+                    ("content-type", "application/grpc"),
+                ]
+            ),
+        )
+        + _h2_frame(
+            http2_proto.DATA,
+            http2_proto.FLAG_END_STREAM,
+            sid,
+            b"\x00" + len(data).to_bytes(4, "big") + data,
+        )
+    )
+    resp = (
+        _h2_frame(
+            http2_proto.HEADERS,
+            http2_proto.FLAG_END_HEADERS,
+            sid,
+            _h2_headers(
+                [(":status", "200"), ("content-type", "application/grpc")]
+            ),
+        )
+        + _h2_frame(
+            http2_proto.DATA, 0, sid, b"\x00\x00\x00\x00\x02ok"
+        )
+        + _h2_frame(
+            http2_proto.HEADERS,
+            http2_proto.FLAG_END_HEADERS | http2_proto.FLAG_END_STREAM,
+            sid,
+            _h2_headers([("grpc-status", "0"), ("grpc-message", "")]),
+        )
+    )
+    return req, resp
+
+
+def dns_exchange(i: int, body: str = "") -> tuple[bytes, bytes]:
+    txid = i & 0xFFFF
+    name = body or f"svc{i % 97}.default.svc.cluster.local"
+    q = struct.pack(">HHHHHH", txid, 0x0100, 1, 0, 0, 0)
+    for label in name.split("."):
+        q += bytes([len(label)]) + label.encode()
+    q += b"\x00" + struct.pack(">HH", 1, 1)  # A IN
+    r = struct.pack(">HHHHHH", txid, 0x8180, 1, 1, 0, 0)
+    enc = (
+        b"".join(
+            bytes([len(l)]) + l.encode() for l in name.split(".")
+        )
+        + b"\x00"
+    )
+    r += enc + struct.pack(">HH", 1, 1)
+    r += struct.pack(">H", 0xC00C)  # compressed pointer to the query name
+    addr = bytes([10, (i >> 8) & 0xFF, i & 0xFF, 9])
+    r += struct.pack(">HHIH", 1, 1, 60, len(addr)) + addr
+    return q, r
+
+
+def _mypkt(seq: int, payload: bytes) -> bytes:
+    return len(payload).to_bytes(3, "little") + bytes([seq]) + payload
+
+
+def mysql_exchange(i: int, body: str = "") -> tuple[bytes, bytes]:
+    sql = body or f"SELECT * FROM t{i % 31} WHERE id = {i}"
+    req = _mypkt(0, b"\x03" + sql.encode())  # COM_QUERY
+    # A one-column, one-row resultset.
+    resp = _mypkt(1, b"\x01")
+    resp += _mypkt(2, b"\x03def" + b"col0")
+    resp += _mypkt(3, b"\xfe\x00\x00\x02\x00")  # EOF after columns
+    val = str(i).encode()
+    resp += _mypkt(4, bytes([len(val)]) + val)
+    resp += _mypkt(5, b"\xfe\x00\x00\x02\x00")  # EOF after rows
+    return req, resp
+
+
+def _pg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack(">I", len(payload) + 4) + payload
+
+
+def pgsql_exchange(i: int, body: str = "") -> tuple[bytes, bytes]:
+    sql = body or f"SELECT name FROM users WHERE id = {i};"
+    req = _pg(b"Q", sql.encode() + b"\x00")
+    val = f"user-{i}".encode()
+    resp = (
+        _pg(
+            b"D",
+            struct.pack(">H", 1) + struct.pack(">i", len(val)) + val,
+        )
+        + _pg(b"C", b"SELECT 1\x00")
+        + _pg(b"Z", b"I")
+    )
+    return req, resp
+
+
+def _bulk(*parts: str) -> bytes:
+    out = f"*{len(parts)}\r\n".encode()
+    for x in parts:
+        out += f"${len(x)}\r\n{x}\r\n".encode()
+    return out
+
+
+def redis_exchange(i: int, body: str = "") -> tuple[bytes, bytes]:
+    val = body or f"value-{i}"
+    req = _bulk("SET", f"key:{i % 101}", val) + _bulk("GET", f"key:{i % 101}")
+    resp = b"+OK\r\n" + f"${len(val)}\r\n{val}\r\n".encode()
+    return req, resp
+
+
+EXCHANGES = {
+    "http": http_exchange,
+    "http2": http2_exchange,
+    "dns": dns_exchange,
+    "mysql": mysql_exchange,
+    "pgsql": pgsql_exchange,
+    "redis": redis_exchange,
+}
+PROTOCOLS = tuple(EXCHANGES)
+
+
+def build_conn_events(
+    conn, protocol: str, n_exchanges: int = 1, start: int = 0, body: str = ""
+) -> list[tuple]:
+    """The full capture-tuple sequence for one connection: open, then
+    ``n_exchanges`` pipelined request/response exchanges (send/recv
+    positions advance per direction), then close. Feed through
+    SocketTraceConnector.replay or event-by-event."""
+    from pixie_tpu.protocols.base import TraceRole
+
+    mk = EXCHANGES[protocol]
+    events: list[tuple] = [
+        (
+            "open",
+            conn,
+            protocol,
+            TraceRole.CLIENT,
+            f"10.0.{(start >> 8) & 0xFF}.{start & 0xFF}",
+            4000 + (start % 1000),
+        )
+    ]
+    spos = rpos = 0
+    ts = (start + 1) * 1000
+    for k in range(n_exchanges):
+        req, resp = mk(start + k, body)
+        events.append(("data", conn, "send", spos, req, ts))
+        events.append(("data", conn, "recv", rpos, resp, ts + 500))
+        spos += len(req)
+        rpos += len(resp)
+        ts += 1000
+    events.append(("close", conn))
+    return events

@@ -97,17 +97,45 @@ class IngestCore:
         def push(table_name: str, tablet: str, columns: dict) -> None:
             t = store.get_table(table_name, tablet or "")
             if t is None:
-                t = Table(relations[table_name], name=table_name)
+                rel = relations.get(table_name)
+                if rel is None:
+                    # Sources that build their DataTables in init_impl
+                    # (e.g. SocketTraceConnector) publish nothing at
+                    # wiring time — resolve live on first push.
+                    rel = self.publish().get(table_name)
+                    if rel is None:
+                        raise KeyError(
+                            f"no relation published for {table_name!r}"
+                        )
+                    relations[table_name] = rel
+                t = Table(rel, name=table_name)
                 store.add_table(table_name, t, tablet_id=tablet or "")
                 enable_ring(t)
             t.write_pydict(columns)
 
         self.register_data_push_callback(push)
 
+    # -- observability -------------------------------------------------------
+    def status(self) -> dict:
+        """Ingest-plane observability: per-source ``ingest_status()``
+        snapshots (the r24 accounting/ladder/quarantine state) keyed by
+        source name — surfaced by agent heartbeats and /statusz."""
+        out: dict[str, dict] = {}
+        for s in list(self._sources):
+            fn = getattr(s, "ingest_status", None)
+            if fn is None:
+                continue
+            try:
+                out[s.name] = fn()
+            except Exception:
+                continue
+        return out
+
     # -- run loop (stirling.cc:802-852) -------------------------------------
     def run(self) -> None:
         assert self._push_cb is not None, "no data push callback registered"
         for s in list(self._sources):
+            s.error_recorder = self.error_connector.record
             try:
                 s.init()
                 if s is not self.error_connector:
@@ -156,10 +184,30 @@ class IngestCore:
                 )
                 self._stop.wait(timeout=max(0.0, next_tick - time.monotonic()))
         finally:
-            # Final flush so short-lived runs lose nothing.
-            for s in list(self._sources):
-                s.push_data(self._push_cb)
-                s.stop()
+            # Final flush so short-lived runs lose nothing. Wrapped
+            # per-source: one failing source must not skip the flush and
+            # stop of every remaining source (and the error connector
+            # flushes LAST so failures recorded here still land).
+            sources = list(self._sources)
+            if self.error_connector in sources:
+                sources.remove(self.error_connector)
+                sources.append(self.error_connector)
+            for s in sources:
+                try:
+                    s.push_data(self._push_cb)
+                except Exception as e:
+                    self.error_connector.record(
+                        s.name,
+                        2,
+                        error=str(e),
+                        context={"event": "final_flush"},
+                    )
+                try:
+                    s.stop()
+                except Exception as e:
+                    self.error_connector.record(
+                        s.name, 2, error=str(e), context={"event": "stop"}
+                    )
 
     def run_as_thread(self) -> None:
         """ref: Stirling::RunAsThread (stirling.h:163)."""

@@ -9,12 +9,47 @@ connector consumes *socket events* — (conn, direction, position, bytes,
 timestamp) tuples — from replayed captures or synthetic workloads, and
 runs the SAME userspace pipeline: ConnTracker → DataStreamBuffer →
 parser → stitcher → http_events / dns_events rows.
+
+r24 overload-proofing (flag ``ingest_robustness``, default on):
+
+- **Bounded memory**: per-tracker byte budgets (oldest head bytes evict
+  first), a global ingest byte budget that rejects events at admission,
+  per-DataTable pending-row caps, and inactivity-based tracker disposal
+  (a conn_open with no conn_close no longer leaks its tracker forever).
+- **Shedding ladder** — pressure = max(buffer-bytes fraction, table-row
+  fraction); a stalled push path forces level ≥ 2::
+
+      level 1 (≥0.50)  truncate string bodies at ingest_shed_body_cap
+      level 2 (≥0.75)  + sample new connections (deterministic crc32)
+      level 3 (≥0.90)  + evict tracker buffers down to budget/4
+
+- **Exact drop accounting** — three chained conservation laws, each
+  checkable at any quiescent point via ``ingest_status()``:
+
+      (A) events_fed  == Σ per-cause attributions + events pending
+      (B) frames_parsed == frames_stitched + frames_drained + pending
+      (C) records_stitched == rows_emitted + rows dropped at table cap
+
+  plus the push stage: rows_emitted == rows_pushed + rows_dropped_push
+  + rows pending in tables. Every event lands in exactly one bucket.
+- **Parser quarantine**: a per-connection breaker (faults-registry
+  style) — ``ingest_quarantine_threshold`` strikes open it (buffers
+  drained to cause 'quarantine', incoming events dropped), a cooldown
+  later it half-opens for one trial tick, success closes it. One
+  poisoned connection never aborts the transfer tick for the others.
+- **Deterministic fault sites** ``ingest.parse_error`` /
+  ``ingest.push_stall`` / ``ingest.event_flood`` /
+  ``ingest.tracker_leak`` (utils/faults.py) drive the chaos soak in
+  tools/soak_ingest.py.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import threading
+import time
+import zlib
+from collections import deque
 from typing import Optional
 
 from pixie_tpu.ingest.http_gen import HTTP_EVENTS_REL
@@ -27,6 +62,73 @@ from pixie_tpu.protocols import pgsql as pgsql_proto
 from pixie_tpu.protocols import redis as redis_proto
 from pixie_tpu.protocols.base import ConnTracker, TraceRole
 from pixie_tpu.types import DataType, Relation, SemanticType
+from pixie_tpu.utils import faults, metrics_registry
+from pixie_tpu.utils.config import define_flag, flags
+
+define_flag(
+    "ingest_global_budget_bytes",
+    64 << 20,
+    help_="Global ingest byte budget across every ConnTracker buffer. "
+    "Events arriving while the estimate exceeds it are dropped at "
+    "admission (ledger cause 'global_budget'). The estimate grows per "
+    "event and is re-anchored exactly each transfer tick.",
+)
+define_flag(
+    "ingest_table_pending_rows",
+    200_000,
+    help_="Per-DataTable cap on rows buffered between transfer and "
+    "push. Appends beyond it are rejected and counted (ledger cause "
+    "'table_cap') — conservation law C stays exact.",
+)
+define_flag(
+    "ingest_tracker_idle_s",
+    300.0,
+    help_="Dispose a tracker after this much inactivity even without a "
+    "conn_close (ref: ConnTracker inactivity disposal). Its buffered "
+    "events drain to ledger cause 'idle_evict'.",
+)
+define_flag(
+    "ingest_shed_body_cap",
+    256,
+    help_="Shedding ladder level >=1: string row values truncate to "
+    "this many characters before landing in tables.",
+)
+define_flag(
+    "ingest_quarantine_threshold",
+    3,
+    help_="Parser exceptions from one connection before its quarantine "
+    "breaker opens (buffers drained, events dropped).",
+)
+define_flag(
+    "ingest_quarantine_cooldown_s",
+    5.0,
+    help_="Seconds a quarantine breaker stays open before a half-open "
+    "trial tick re-admits the connection.",
+)
+
+_M = metrics_registry()
+_EVENTS = _M.counter(
+    "ingest_events_total", "Socket events fed to the ingest plane."
+)
+_DROPS = _M.counter(
+    "ingest_drops_total",
+    "Ingest events/rows dropped, labeled by ladder/budget reason.",
+)
+_ROWS = _M.counter(
+    "ingest_rows_total", "Rows emitted by the socket tracer, by table."
+)
+_TRACKERS_G = _M.gauge(
+    "ingest_trackers", "Live ConnTrackers in the socket tracer."
+)
+_BUFFER_G = _M.gauge(
+    "ingest_buffer_bytes", "Bytes buffered across all tracker streams."
+)
+_SHED_G = _M.gauge(
+    "ingest_shed_level", "Current shedding-ladder level (0-3)."
+)
+_QUARANTINED_G = _M.gauge(
+    "ingest_quarantined", "Connections with an open quarantine breaker."
+)
 
 I, S, T = DataType.INT64, DataType.STRING, DataType.TIME64NS
 
@@ -109,6 +211,68 @@ _TABLE_FOR = {
     "redis": "redis_events",
 }
 
+# Buffer-level causes come from DataStreamBuffer attribution; the rest
+# are counted at the connector's admission/processing boundary.
+EVENT_CAUSES = (
+    "parsed",
+    "parsed_meta",
+    "stale_dup",
+    "gap_skip",
+    "resync",
+    "evict",
+    "drain",
+    "quarantine",
+    "idle_evict",
+    "unknown_conn",
+    "bad_direction",
+    "post_close",
+    "conn_sampled",
+    "global_budget",
+    "event_flood",
+)
+# Causes that represent shed/dropped data (vs. normal consumption).
+DROP_CAUSES = frozenset(EVENT_CAUSES) - {"parsed", "parsed_meta"}
+
+
+class IngestLedger:
+    """Connector-wide event/frame/row accounting (r24).
+
+    Per-tracker ledgers delta-sync into ``causes`` at transfer ticks and
+    retirement; admission-path drops count here directly. All mutation
+    happens under ``lock`` so the conservation laws hold exactly even
+    with a feeder thread racing the transfer thread.
+    """
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.events_fed = 0
+        self.causes: dict[str, int] = {}
+        # Frame/row totals for retired trackers (live trackers' counters
+        # are added on top when a status snapshot is taken).
+        self.frames_parsed = 0
+        self.frames_stitched = 0
+        self.frames_drained = 0
+        self.records_stitched = 0
+        self.rows_emitted = 0
+        self.rows_dropped_table_cap = 0
+        self.rows_dropped_push = 0
+        self.rows_pushed = 0
+        self.bodies_truncated = 0
+        self.conns_sampled_out = 0
+        self.quarantine_opens = 0
+        self.leaked_closes = 0
+
+    def count(self, cause: str, n: int = 1) -> None:
+        with self.lock:
+            self.causes[cause] = self.causes.get(cause, 0) + n
+
+    def merge_causes(self, deltas: dict) -> None:
+        if not deltas:
+            return
+        with self.lock:
+            for cause, n in deltas.items():
+                self.causes[cause] = self.causes.get(cause, 0) + n
+
 
 @dataclasses.dataclass(frozen=True)
 class ConnId:
@@ -117,6 +281,17 @@ class ConnId:
     upid: str
     fd: int
     tsid: int = 0
+
+
+class _Quarantine:
+    """Per-connection breaker state (closed → open → half-open)."""
+
+    __slots__ = ("strikes", "open_until", "half_open")
+
+    def __init__(self):
+        self.strikes = 0
+        self.open_until: Optional[float] = None
+        self.half_open = False
 
 
 class SocketTraceConnector(SourceConnector):
@@ -130,15 +305,58 @@ class SocketTraceConnector(SourceConnector):
         self._lock = threading.Lock()
         self._trackers: dict[ConnId, ConnTracker] = {}
         self._protocol: dict[ConnId, str] = {}
+        # r24 state. _robust caches the master flag at construction so
+        # the per-event fast path is one attribute load.
+        self._robust = bool(flags.ingest_robustness)
+        self.ledger = IngestLedger()
+        self._quarantine: dict[ConnId, _Quarantine] = {}
+        self._global_bytes = 0  # estimate; re-anchored each tick
+        self._global_budget = int(flags.ingest_global_budget_bytes)
+        self._shed_level = 0
+        self._push_stalled = False
+        self._ev_synced = 0
+        self._cause_synced: dict[str, int] = {}
+        # Bounded memory of recently retired conns so late events count
+        # as post_close / conn_sampled instead of unknown_conn.
+        self._recently_closed: set[ConnId] = set()
+        self._recently_closed_q: deque[ConnId] = deque()
+        self._sampled_out: set[ConnId] = set()
+        self._sampled_out_q: deque[ConnId] = deque()
+        self._RECENT_CAP = 4096
 
     def init_impl(self) -> None:
+        self._global_budget = int(flags.ingest_global_budget_bytes)
+        cap = (
+            flags.ingest_table_pending_rows if self._robust else None
+        )
         self.tables = [
-            DataTable("http_events", HTTP_EVENTS_REL),
-            DataTable("dns_events", DNS_EVENTS_REL),
-            DataTable("mysql_events", MYSQL_EVENTS_REL),
-            DataTable("pgsql_events", PGSQL_EVENTS_REL),
-            DataTable("redis_events", REDIS_EVENTS_REL),
+            DataTable("http_events", HTTP_EVENTS_REL, max_pending_rows=cap),
+            DataTable("dns_events", DNS_EVENTS_REL, max_pending_rows=cap),
+            DataTable("mysql_events", MYSQL_EVENTS_REL, max_pending_rows=cap),
+            DataTable("pgsql_events", PGSQL_EVENTS_REL, max_pending_rows=cap),
+            DataTable("redis_events", REDIS_EVENTS_REL, max_pending_rows=cap),
         ]
+
+    def _remember(self, conn: ConnId, which: str) -> None:
+        """Record a retired/sampled conn in a bounded set (under _lock)."""
+        s, q = (
+            (self._recently_closed, self._recently_closed_q)
+            if which == "closed"
+            else (self._sampled_out, self._sampled_out_q)
+        )
+        if conn not in s:
+            s.add(conn)
+            q.append(conn)
+            while len(q) > self._RECENT_CAP:
+                s.discard(q.popleft())
+
+    def _record_error(self, error: str, context: dict) -> None:
+        rec = self.error_recorder
+        if rec is not None:
+            try:
+                rec(self.name, 2, error, context)
+            except Exception:
+                pass  # self-monitoring must never take down ingest
 
     # -- event feed (the capture boundary) -----------------------------------
     def conn_open(
@@ -151,15 +369,44 @@ class SocketTraceConnector(SourceConnector):
     ) -> None:
         if protocol not in _PARSERS:
             raise ValueError(f"unsupported protocol {protocol!r}")
+        if not self._robust:
+            with self._lock:
+                self._trackers[conn] = ConnTracker(
+                    _PARSERS[protocol],
+                    upid=conn.upid,
+                    remote_addr=remote_addr,
+                    remote_port=remote_port,
+                    role=role,
+                )
+                self._protocol[conn] = protocol
+            return
+        led = self.ledger
+        if self._shed_level >= 2:
+            # Ladder level 2: deterministic new-connection sampling —
+            # the same conn id always gets the same verdict, so a replay
+            # sheds identically.
+            key = f"{conn.upid}:{conn.fd}:{conn.tsid}".encode()
+            if zlib.crc32(key) & 1:
+                with self._lock:
+                    self._remember(conn, "sampled")
+                with led.lock:
+                    led.conns_sampled_out += 1
+                return
+        tracker = ConnTracker(
+            _PARSERS[protocol],
+            upid=conn.upid,
+            remote_addr=remote_addr,
+            remote_port=remote_port,
+            role=role,
+            byte_budget=flags.ingest_stream_buffer_bytes,
+            track_drops=True,
+        )
+        tracker.last_activity_ns = time.monotonic_ns()
         with self._lock:
-            self._trackers[conn] = ConnTracker(
-                _PARSERS[protocol],
-                upid=conn.upid,
-                remote_addr=remote_addr,
-                remote_port=remote_port,
-                role=role,
-            )
+            self._trackers[conn] = tracker
             self._protocol[conn] = protocol
+            self._sampled_out.discard(conn)
+            self._recently_closed.discard(conn)
 
     def data_event(
         self,
@@ -171,16 +418,72 @@ class SocketTraceConnector(SourceConnector):
     ) -> None:
         """One captured chunk (ref: socket_trace.c data events carry
         per-direction byte positions so userspace can reassemble)."""
+        if not self._robust:
+            if direction != "send" and direction != "recv":
+                raise ValueError(
+                    f"data_event direction must be 'send' or 'recv', "
+                    f"got {direction!r}"
+                )
+            with self._lock:
+                tracker = self._trackers.get(conn)
+            if tracker is None:
+                return  # conn never opened (capture raced) — drop
+            with tracker.lock:
+                if direction == "send":
+                    tracker.add_send(pos, data, timestamp_ns)
+                else:
+                    tracker.add_recv(pos, data, timestamp_ns)
+            return
+        led = self.ledger
+        with led.lock:
+            led.events_fed += 1
+        if direction != "send" and direction != "recv":
+            led.count("bad_direction")
+            return
+        if faults.ACTIVE and faults.fires("ingest.event_flood"):
+            # The flood site models admission control rejecting a burst:
+            # the event is dropped at the door, exactly counted.
+            led.count("event_flood")
+            return
         with self._lock:
             tracker = self._trackers.get(conn)
-        if tracker is None:
-            return  # conn never opened (capture raced) — drop, like the ref
-        if direction == "send":
-            tracker.add_send(pos, data, timestamp_ns)
-        else:
-            tracker.add_recv(pos, data, timestamp_ns)
+            if tracker is None:
+                if conn in self._sampled_out:
+                    led.count("conn_sampled")
+                elif conn in self._recently_closed:
+                    led.count("post_close")
+                else:
+                    led.count("unknown_conn")
+                return
+            if tracker.quarantined:
+                led.count("quarantine")
+                return
+            if self._global_bytes >= self._global_budget:
+                led.count("global_budget")
+                return
+            self._global_bytes += len(data)
+        with tracker.lock:
+            if tracker.retired:
+                # Lost the race with retirement — the tracker's ledger
+                # was already final-synced, so count at the connector.
+                led.count("post_close")
+                return
+            tracker.last_activity_ns = time.monotonic_ns()
+            if direction == "send":
+                tracker.add_send(pos, data, timestamp_ns)
+            else:
+                tracker.add_recv(pos, data, timestamp_ns)
 
     def conn_close(self, conn: ConnId) -> None:
+        if self._robust and faults.ACTIVE and faults.fires(
+            "ingest.tracker_leak"
+        ):
+            # The close event is "lost" — the tracker must now be
+            # reclaimed by inactivity disposal, or it leaks forever
+            # (the exact bug this release fixes).
+            with self.ledger.lock:
+                self.ledger.leaked_closes += 1
+            return
         with self._lock:
             tracker = self._trackers.get(conn)
         if tracker is not None:
@@ -204,10 +507,202 @@ class SocketTraceConnector(SourceConnector):
 
     # -- the sample step ------------------------------------------------------
     def transfer_data_impl(self, ctx) -> None:
+        if not self._robust:
+            self._transfer_legacy()
+            return
+        led = self.ledger
+        now = time.monotonic()
+        now_ns = time.monotonic_ns()
+        idle_ns = int(flags.ingest_tracker_idle_s * 1e9)
+        with self._lock:
+            items = list(self._trackers.items())
+        # Exact pressure readings drive the ladder for this tick.
+        total_bytes = 0
+        for _, tracker in items:
+            with tracker.lock:
+                total_bytes += tracker.byte_size()
+        budget = max(1, flags.ingest_global_budget_bytes)
+        row_cap = max(1, flags.ingest_table_pending_rows)
+        rows_frac = max(
+            (t.occupancy / row_cap for t in self.tables), default=0.0
+        )
+        pressure = max(total_bytes / budget, rows_frac)
+        level = 0
+        if pressure >= 0.9:
+            level = 3
+        elif pressure >= 0.75:
+            level = 2
+        elif pressure >= 0.5:
+            level = 1
+        if self._push_stalled:
+            level = max(level, 2)
+        self._shed_level = level
+        body_cap = flags.ingest_shed_body_cap
+        q_threshold = flags.ingest_quarantine_threshold
+        q_cooldown = flags.ingest_quarantine_cooldown_s
+        retire: list[tuple[ConnId, str]] = []
+        for conn, tracker in items:
+            # Inactivity disposal: an open-but-silent tracker (lost
+            # close event) drains to 'idle_evict' and retires.
+            if (
+                not tracker.closed
+                and now_ns - tracker.last_activity_ns > idle_ns
+            ):
+                retire.append((conn, "idle_evict"))
+                continue
+            q = self._quarantine.get(conn)
+            if q is not None and q.open_until is not None:
+                if now < q.open_until:
+                    continue  # breaker open: skip this tracker entirely
+                # Cooldown elapsed → half-open trial tick.
+                q.open_until = None
+                q.half_open = True
+                tracker.quarantined = False
+                _QUARANTINED_G.dec()
+            try:
+                with tracker.lock:
+                    if faults.ACTIVE and faults.fires(
+                        "ingest.parse_error"
+                    ):
+                        raise RuntimeError(
+                            "injected ingest.parse_error"
+                        )
+                    records = tracker.process_to_records()
+            except Exception as e:
+                if q is None:
+                    q = self._quarantine.setdefault(conn, _Quarantine())
+                q.strikes += 1
+                if q.half_open or q.strikes >= q_threshold:
+                    # Open (or re-open) the breaker: drain what's
+                    # buffered, refuse new events until the cooldown.
+                    q.half_open = False
+                    q.open_until = now + q_cooldown
+                    tracker.quarantined = True
+                    with tracker.lock:
+                        tracker.drain_all("quarantine")
+                    with led.lock:
+                        led.quarantine_opens += 1
+                    _QUARANTINED_G.inc()
+                    self._record_error(
+                        str(e),
+                        {
+                            "event": "quarantine_open",
+                            "conn": f"{conn.upid}/{conn.fd}/{conn.tsid}",
+                            "strikes": q.strikes,
+                        },
+                    )
+                continue
+            if q is not None and q.half_open:
+                # Trial tick survived: breaker closes, slate wiped.
+                del self._quarantine[conn]
+            if records:
+                self._emit_rows(conn, tracker, records, level, body_cap)
+            with tracker.lock:
+                done = tracker.closed and (
+                    tracker.byte_size() == 0
+                    and tracker.frames_pending() == 0
+                )
+            if done:
+                retire.append((conn, "drain"))
+            elif level >= 3:
+                # Ladder level 3: shed the oldest buffered bytes down to
+                # a quarter of the per-tracker budget.
+                target = flags.ingest_stream_buffer_bytes // 4
+                with tracker.lock:
+                    for s in (tracker.send, tracker.recv):
+                        b = s.buffer
+                        over = b.byte_size() - target
+                        if over > 0:
+                            k = min(over, len(b.head()))
+                            if k:
+                                b.evictions += 1
+                                b.consume(k, "evict")
+        # Delta-sync every live tracker's ledger, then retire the dead.
+        for conn, tracker in items:
+            with tracker.lock:
+                if tracker.ledger:
+                    deltas = dict(tracker.ledger)
+                    tracker.ledger.clear()
+                else:
+                    deltas = None
+            led.merge_causes(deltas)
+        with self._lock:
+            for conn, cause in retire:
+                tracker = self._trackers.pop(conn, None)
+                if tracker is None:
+                    continue
+                self._protocol.pop(conn, None)
+                self._quarantine.pop(conn, None)
+                if tracker.quarantined:
+                    _QUARANTINED_G.dec()
+                self._remember(conn, "closed")
+                with tracker.lock:
+                    # Seal the tracker: straggler events that raced the
+                    # feeder drain to the retirement cause, the final
+                    # ledger deltas sync, and `retired` makes any adds
+                    # after this point count at the connector instead.
+                    tracker.retired = True
+                    tracker.drain_all(cause)
+                    deltas = dict(tracker.ledger)
+                    tracker.ledger.clear()
+                led.merge_causes(deltas)
+                with led.lock:
+                    led.frames_parsed += tracker.frames_parsed()
+                    led.frames_stitched += tracker.frames_stitched
+                    led.frames_drained += tracker.frames_drained
+                    led.records_stitched += tracker.records_stitched
+            # Re-anchor the global-bytes estimate exactly.
+            total = 0
+            for tracker in self._trackers.values():
+                with tracker.lock:
+                    total += tracker.byte_size()
+            self._global_bytes = total
+            n_trackers = len(self._trackers)
+        self._sync_metrics(n_trackers)
+
+    def _emit_rows(
+        self, conn, tracker, records, level: int, body_cap: int
+    ) -> None:
+        led = self.ledger
+        proto = self._protocol[conn]
+        table = next(
+            t for t in self.tables if t.name == _TABLE_FOR[proto]
+        )
+        row_fn = _ROW_FNS[proto]
+        emitted = capped = truncated = 0
+        for rec in records:
+            row = row_fn(
+                rec,
+                tracker.upid,
+                tracker.remote_addr,
+                tracker.remote_port,
+                int(tracker.role),
+            )
+            if level >= 1:
+                # Ladder level 1: bodies shrink before rows land.
+                for k, v in row.items():
+                    if isinstance(v, str) and len(v) > body_cap:
+                        row[k] = v[:body_cap]
+                        truncated += 1
+            if table.append_record(**row):
+                emitted += 1
+            else:
+                capped += 1
+        with led.lock:
+            led.rows_emitted += emitted
+            led.rows_dropped_table_cap += capped
+            led.bodies_truncated += truncated
+        if emitted:
+            _ROWS.inc(emitted, table=table.name)
+        if capped:
+            _DROPS.inc(capped, reason="table_cap")
+
+    def _transfer_legacy(self) -> None:
         with self._lock:
             items = list(self._trackers.items())
         for conn, tracker in items:
-            records = tracker.process_to_records()
+            with tracker.lock:
+                records = tracker.process_to_records()
             if not records:
                 continue
             proto = self._protocol[conn]
@@ -239,3 +734,130 @@ class SocketTraceConnector(SourceConnector):
             ]:
                 del self._trackers[conn]
                 del self._protocol[conn]
+
+    # -- the push step --------------------------------------------------------
+    def push_data(self, push_cb) -> None:
+        if not self._robust:
+            super().push_data(push_cb)
+            return
+        led = self.ledger
+        stalled = False
+        for dt in self.tables:
+            data = dt.take()
+            if data is None:
+                continue
+            nrows = len(next(iter(data.values()))) if data else 0
+            try:
+                if faults.ACTIVE and faults.fires("ingest.push_stall"):
+                    raise RuntimeError("injected ingest.push_stall")
+                push_cb(dt.name, dt.tablet, data)
+            except Exception as e:
+                # The rows are gone (take() already cleared the table):
+                # count them so conservation stays exact, surface the
+                # stall, and force the ladder to level >= 2 next tick.
+                stalled = True
+                with led.lock:
+                    led.rows_dropped_push += nrows
+                _DROPS.inc(nrows, reason="push_stall")
+                self._record_error(
+                    str(e), {"event": "push_stall", "table": dt.name}
+                )
+                continue
+            with led.lock:
+                led.rows_pushed += nrows
+        self._push_stalled = stalled
+
+    # -- observability --------------------------------------------------------
+    def _sync_metrics(self, n_trackers: int) -> None:
+        led = self.ledger
+        with led.lock:
+            events = led.events_fed
+            causes = dict(led.causes)
+        _EVENTS.inc(max(0, events - self._ev_synced))
+        self._ev_synced = events
+        synced = self._cause_synced
+        for cause, n in causes.items():
+            if cause in DROP_CAUSES:
+                d = n - synced.get(cause, 0)
+                if d > 0:
+                    _DROPS.inc(d, reason=cause)
+        self._cause_synced = causes
+        _TRACKERS_G.set(n_trackers)
+        _BUFFER_G.set(self._global_bytes)
+        _SHED_G.set(self._shed_level)
+
+    def ingest_status(self) -> dict:
+        """Exact accounting snapshot: totals, per-cause attributions,
+        and the three conservation laws. At a quiescent point (no feeder
+        racing, post transfer+push) every law holds exactly."""
+        led = self.ledger
+        with self._lock:
+            trackers = list(self._trackers.values())
+            n_trackers = len(trackers)
+            global_bytes = self._global_bytes
+        causes: dict[str, int] = {}
+        pending_events = 0
+        frames_parsed = frames_stitched = frames_drained = 0
+        records_stitched = frames_pending = 0
+        quarantined = 0
+        for t in trackers:
+            with t.lock:
+                if t.ledger:
+                    for cause, n in t.ledger.items():
+                        causes[cause] = causes.get(cause, 0) + n
+                pending_events += t.events_pending()
+                frames_parsed += t.frames_parsed()
+                frames_stitched += t.frames_stitched
+                frames_drained += t.frames_drained
+                records_stitched += t.records_stitched
+                frames_pending += t.frames_pending()
+                if t.quarantined:
+                    quarantined += 1
+        with led.lock:
+            for cause, n in led.causes.items():
+                causes[cause] = causes.get(cause, 0) + n
+            events_fed = led.events_fed
+            frames_parsed += led.frames_parsed
+            frames_stitched += led.frames_stitched
+            frames_drained += led.frames_drained
+            records_stitched += led.records_stitched
+            rows_emitted = led.rows_emitted
+            rows_dropped_table_cap = led.rows_dropped_table_cap
+            rows_dropped_push = led.rows_dropped_push
+            rows_pushed = led.rows_pushed
+            extra = {
+                "bodies_truncated": led.bodies_truncated,
+                "conns_sampled_out": led.conns_sampled_out,
+                "quarantine_opens": led.quarantine_opens,
+                "leaked_closes": led.leaked_closes,
+            }
+        rows_pending = sum(t.occupancy for t in self.tables)
+        attributed = sum(causes.values())
+        return {
+            "events_fed": events_fed,
+            "causes": causes,
+            "events_pending": pending_events,
+            "events_attributed": attributed,
+            "law_a_ok": events_fed == attributed + pending_events,
+            "frames_parsed": frames_parsed,
+            "frames_stitched": frames_stitched,
+            "frames_drained": frames_drained,
+            "frames_pending": frames_pending,
+            "law_b_ok": frames_parsed
+            == frames_stitched + frames_drained + frames_pending,
+            "records_stitched": records_stitched,
+            "rows_emitted": rows_emitted,
+            "rows_dropped_table_cap": rows_dropped_table_cap,
+            "law_c_ok": records_stitched
+            == rows_emitted + rows_dropped_table_cap,
+            "rows_pushed": rows_pushed,
+            "rows_dropped_push": rows_dropped_push,
+            "rows_pending": rows_pending,
+            "law_push_ok": rows_emitted
+            == rows_pushed + rows_dropped_push + rows_pending,
+            "trackers": n_trackers,
+            "buffer_bytes": global_bytes,
+            "shed_level": self._shed_level,
+            "quarantined": quarantined,
+            **extra,
+        }

@@ -20,17 +20,36 @@ class DataTable:
     """Buffers appended records between TransferData and PushData
     (ref: core/data_table.h:51; occupancy-based push thresholds)."""
 
-    def __init__(self, name: str, relation: Relation, tablet: str = ""):
+    def __init__(
+        self,
+        name: str,
+        relation: Relation,
+        tablet: str = "",
+        max_pending_rows: Optional[int] = None,
+    ):
         self.name = name
         self.relation = relation
         self.tablet = tablet
         self._pending: dict[str, list] = {c.name: [] for c in relation}
         self._rows = 0
+        # r24 bounded memory: rows buffered between transfer and push may
+        # never exceed this cap (None = unbounded legacy behavior). A
+        # rejected append returns False and counts in dropped_rows so the
+        # connector can attribute it (ledger cause 'table_cap').
+        self.max_pending_rows = max_pending_rows
+        self.dropped_rows = 0
 
-    def append_record(self, **values) -> None:
+    def append_record(self, **values) -> bool:
+        if (
+            self.max_pending_rows is not None
+            and self._rows >= self.max_pending_rows
+        ):
+            self.dropped_rows += 1
+            return False
         for c in self.relation:
             self._pending[c.name].append(values[c.name])
         self._rows += 1
+        return True
 
     def append_columns(self, data: dict) -> None:
         n = len(next(iter(data.values())))
@@ -88,6 +107,10 @@ class SourceConnector:
         self._sample_mgr = FrequencyManager(self.sample_period_s)
         self._push_mgr = FrequencyManager(self.push_period_s)
         self._initialized = False
+        # Optional callback(source, status, error, context) wired by
+        # IngestCore.run() to the stirling_error connector so sources can
+        # surface recoverable faults as queryable rows (r24).
+        self.error_recorder = None
 
     # -- lifecycle ----------------------------------------------------------
     def init(self) -> None:

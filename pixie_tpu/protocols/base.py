@@ -16,8 +16,10 @@ Ref mapping:
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 import enum
+import threading
 from typing import Any, Optional
 
 from pixie_tpu.utils import metrics_registry
@@ -29,6 +31,24 @@ define_flag(
     help_="Bytes a stream buffer may hold waiting for a gap to fill "
     "before fast-forwarding past the missing data "
     "(ref: datastream buffer size limits).",
+)
+define_flag(
+    "ingest_robustness",
+    True,
+    help_="Master gate for the r24 overload-proof ingest plane: "
+    "per-tracker byte budgets with oldest-chunk eviction, the global "
+    "ingest budget, the shedding ladder, parser quarantine, and the "
+    "exact per-reason drop ledger. Off restores the unbounded legacy "
+    "path (the <1% disabled-overhead gate in "
+    "tools/microbench_fault_overhead.py measures that path).",
+)
+define_flag(
+    "ingest_stream_buffer_bytes",
+    1 << 20,
+    help_="Per-direction ConnTracker byte budget (contiguous head + "
+    "pending out-of-order chunks). Exceeding it evicts oldest head "
+    "bytes, attributed to the 'evict' ledger cause (ref: the "
+    "reference's DataStreamBuffer size limit + eviction posture).",
 )
 
 _M = metrics_registry()
@@ -87,7 +107,12 @@ class DataStreamBuffer:
     timestamping (ref: data_stream_buffer.h position/timestamp API).
     """
 
-    def __init__(self, gap_limit: Optional[int] = None):
+    def __init__(
+        self,
+        gap_limit: Optional[int] = None,
+        byte_budget: Optional[int] = None,
+        ledger: Optional[dict] = None,
+    ):
         self._chunks: dict[int, tuple[bytes, int]] = {}  # pos -> (data, ts)
         self._pos = 0  # stream position of buf start
         self._buf = bytearray()
@@ -97,13 +122,50 @@ class DataStreamBuffer:
             if gap_limit is not None
             else flags.protocol_stream_gap_limit
         )
+        # r24 bounded memory: head+pending may never exceed byte_budget
+        # (oldest head bytes evict first, ledger cause 'evict'). The gap
+        # allowance is clamped under the budget so pending out-of-order
+        # chunks can't exceed it either (_assemble fast-forwards first).
+        self._byte_budget = byte_budget
+        if byte_budget is not None:
+            self._gap_limit = min(self._gap_limit, byte_budget)
+        # r24 event-disposition ledger: when given (a caller-owned dict,
+        # shared by both directions of a tracker and guarded by the
+        # tracker's lock), every add() is ONE capture event, attributed
+        # to exactly one cause when its FINAL byte leaves the buffer:
+        # parsed / parsed_meta / resync / gap_skip / evict / drain — or
+        # stale_dup immediately if it duplicates consumed bytes. The
+        # conservation law `events in == events attributed + events
+        # pending` is exact; the soak gate builds on it.
+        self._ledger = ledger
+        self._event_ends: list[int] = []  # sorted event end positions
         self.gap_skips = 0
+        self.evictions = 0
 
     def add(self, pos: int, data: bytes, timestamp_ns: int) -> None:
+        led = self._ledger
         if pos + len(data) <= self._pos:
+            if led is not None:
+                led["stale_dup"] = led.get("stale_dup", 0) + 1
             return  # duplicate of already-consumed bytes
+        if led is not None:
+            bisect.insort(self._event_ends, pos + len(data))
         self._chunks[pos] = (bytes(data), timestamp_ns)
         self._assemble()
+        if self._byte_budget is not None:
+            self._enforce_budget()
+
+    def _enforce_budget(self) -> None:
+        """Evict oldest contiguous head bytes until head+pending fits the
+        budget. _assemble already fast-forwarded any over-allowance gap
+        (gap_limit <= byte_budget), so head eviction alone suffices."""
+        pending = sum(len(d) for d, _ in self._chunks.values())
+        over = len(self._buf) + pending - self._byte_budget
+        if over > 0:
+            k = min(over, len(self._buf))
+            if k:
+                self.evictions += 1
+                self.consume(k, "evict")
 
     def _assemble(self) -> None:
         progressed = True
@@ -132,32 +194,60 @@ class DataStreamBuffer:
                 _GAP_SKIPS.inc()
                 self._pos = nxt
                 self._buf.clear()
+                if self._ledger is not None:
+                    self._attribute("gap_skip")
                 self._assemble()
 
     def head(self) -> bytes:
         return bytes(self._buf)
 
-    def drain(self) -> None:
+    def byte_size(self) -> int:
+        """Buffered bytes: contiguous head + pending out-of-order chunks
+        (the quantity the r24 byte budgets bound)."""
+        return len(self._buf) + sum(
+            len(d) for d, _ in self._chunks.values()
+        )
+
+    def drain(self, cause: str = "drain") -> None:
         """Discard everything buffered (contiguous head AND pending
         out-of-order chunks) — used when the connection closed and the
-        bytes can never complete a frame."""
+        bytes can never complete a frame. ``cause`` names the ledger
+        bucket the still-unattributed events land in (quarantine and
+        idle disposal pass their own)."""
         end = self._pos + len(self._buf)
         for pos, (data, _) in self._chunks.items():
             end = max(end, pos + len(data))
         self._chunks.clear()
         self._buf.clear()
         self._pos = end
+        if self._ledger is not None and self._event_ends:
+            led = self._ledger
+            led[cause] = led.get(cause, 0) + len(self._event_ends)
+            self._event_ends.clear()
 
     def position(self) -> int:
         return self._pos
 
-    def consume(self, n: int) -> None:
+    def consume(self, n: int, cause: str = "parsed") -> None:
         assert 0 <= n <= len(self._buf)
         self._pos += n
         del self._buf[:n]
         self._ts_marks = [
             (p, t) for p, t in self._ts_marks if p >= self._pos
         ] or self._ts_marks[-1:]
+        if self._ledger is not None:
+            self._attribute(cause)
+
+    def _attribute(self, cause: str) -> None:
+        """Attribute every event whose final byte is now behind the
+        stream position to ``cause`` — each event lands in exactly one
+        bucket, which is what makes the soak's accounting invariant
+        exact rather than approximate."""
+        i = bisect.bisect_right(self._event_ends, self._pos)
+        if i:
+            led = self._ledger
+            led[cause] = led.get(cause, 0) + i
+            del self._event_ends[:i]
 
     def timestamp_at(self, pos: int) -> int:
         """Arrival timestamp of the chunk covering stream position pos."""
@@ -216,9 +306,18 @@ class _DataStream:
     """One direction of a connection: buffer + parsed-frame deque
     (ref: data_stream.h:50)."""
 
-    def __init__(self, parser: ProtocolParser, msg_type: MessageType):
-        self.buffer = DataStreamBuffer()
+    def __init__(
+        self,
+        parser: ProtocolParser,
+        msg_type: MessageType,
+        byte_budget: Optional[int] = None,
+        ledger: Optional[dict] = None,
+    ):
+        self.buffer = DataStreamBuffer(
+            byte_budget=byte_budget, ledger=ledger
+        )
         self.frames: list = []
+        self.frames_parsed = 0  # completed messages appended, ever
         self._parser = parser
         self._msg_type = msg_type
         self._last_ts = 0
@@ -240,7 +339,7 @@ class _DataStream:
                 if frame is None:
                     # Frame consumed but no message completed yet (e.g. an
                     # HTTP/2 SETTINGS frame, or a DATA frame mid-stream).
-                    self.buffer.consume(consumed)
+                    self.buffer.consume(consumed, "parsed_meta")
                     continue
                 if frame.timestamp_ns == 0:
                     # Frames within one captured chunk share its arrival
@@ -252,7 +351,8 @@ class _DataStream:
                     )
                 self._last_ts = frame.timestamp_ns
                 self.frames.append(frame)
-                self.buffer.consume(consumed)
+                self.frames_parsed += 1
+                self.buffer.consume(consumed, "parsed")
             elif state == ParseState.NEEDS_MORE_DATA:
                 return
             else:  # INVALID: resync at the next plausible boundary
@@ -261,7 +361,7 @@ class _DataStream:
                 nxt = self._parser.find_frame_boundary(
                     self._msg_type, buf, 1
                 )
-                self.buffer.consume(len(buf) if nxt < 0 else nxt)
+                self.buffer.consume(len(buf) if nxt < 0 else nxt, "resync")
 
 
 class ConnTracker:
@@ -277,21 +377,46 @@ class ConnTracker:
         remote_addr: str = "",
         remote_port: int = 0,
         role: TraceRole = TraceRole.CLIENT,
+        byte_budget: Optional[int] = None,
+        track_drops: bool = False,
     ):
         self.parser = parser
         self.upid = upid
         self.remote_addr = remote_addr
         self.remote_port = remote_port
         self.role = TraceRole(role)
+        # r24: the event-disposition ledger shared by both direction
+        # buffers. Guarded by self.lock — the feeder thread adds events
+        # while the transfer thread parses/drains. The connector
+        # delta-syncs it each transfer tick (copy + clear, identity kept).
+        self.ledger: Optional[dict] = {} if track_drops else None
+        self.lock = threading.Lock()
+        self.last_activity_ns = 0  # stamped by the connector on events
+        self.quarantined = False  # breaker-open: drop incoming events
+        self.retired = False  # set (under lock) when the connector GCs
         # send stream carries requests for clients, responses for servers.
         if self.role == TraceRole.SERVER:
-            self.send = _DataStream(parser, MessageType.RESPONSE)
-            self.recv = _DataStream(parser, MessageType.REQUEST)
+            self.send = _DataStream(
+                parser, MessageType.RESPONSE, byte_budget, self.ledger
+            )
+            self.recv = _DataStream(
+                parser, MessageType.REQUEST, byte_budget, self.ledger
+            )
         else:
-            self.send = _DataStream(parser, MessageType.REQUEST)
-            self.recv = _DataStream(parser, MessageType.RESPONSE)
+            self.send = _DataStream(
+                parser, MessageType.REQUEST, byte_budget, self.ledger
+            )
+            self.recv = _DataStream(
+                parser, MessageType.RESPONSE, byte_budget, self.ledger
+            )
         self.protocol_state = parser.new_state()
         self.closed = False
+        # Frame-conservation counters (law B of the soak invariant):
+        # frames_parsed (per stream) == frames_stitched + frames_drained
+        # + frames still pending in the stream deques.
+        self.frames_stitched = 0
+        self.frames_drained = 0
+        self.records_stitched = 0
         # One full process cycle of grace after close before draining:
         # capture sources can deliver a conn's final data chunks after its
         # close event (ref: ConnTracker::MarkForDeath iteration countdown).
@@ -318,10 +443,16 @@ class ConnTracker:
         resp_stream.parse_loop(
             conn_closed=self.closed, proto_state=self.protocol_state
         )
+        before = len(req_stream.frames) + len(resp_stream.frames)
         records, errors, req_keep, resp_keep = self.parser.stitch(
             req_stream.frames, resp_stream.frames, self.protocol_state
         )
         req_stream.frames, resp_stream.frames = req_keep, resp_keep
+        # Law B bookkeeping: every parsed frame either got consumed by
+        # this stitch round, is still pending in a deque, or will be
+        # drained at close — three exhaustive, exclusive fates.
+        self.frames_stitched += before - (len(req_keep) + len(resp_keep))
+        self.records_stitched += len(records)
         if errors:
             _PARSE_ERRORS.inc(errors, protocol=self.parser.name)
         if self.closed:
@@ -333,10 +464,33 @@ class ConnTracker:
                 # transfers) and unpaired frames can never complete —
                 # drain both directions so the connector can GC this
                 # tracker (ref: ConnTracker::MarkForDeath + countdown).
-                for s in (self.send, self.recv):
-                    s.buffer.drain()
-                    s.frames.clear()
+                self.drain_all()
         return records
+
+    def drain_all(self, cause: str = "drain") -> None:
+        """Discard both directions' buffered bytes and pending frames,
+        attributing still-unattributed events to ``cause`` (close drain,
+        quarantine, or idle disposal)."""
+        for s in (self.send, self.recv):
+            s.buffer.drain(cause)
+            self.frames_drained += len(s.frames)
+            s.frames.clear()
+
+    def byte_size(self) -> int:
+        """Total buffered bytes across both directions."""
+        return self.send.buffer.byte_size() + self.recv.buffer.byte_size()
+
+    def frames_pending(self) -> int:
+        return len(self.send.frames) + len(self.recv.frames)
+
+    def frames_parsed(self) -> int:
+        return self.send.frames_parsed + self.recv.frames_parsed
+
+    def events_pending(self) -> int:
+        """Capture events not yet attributed to a ledger cause."""
+        return len(self.send.buffer._event_ends) + len(
+            self.recv.buffer._event_ends
+        )
 
 
 def stitch_by_timestamp(requests: list, responses: list):

@@ -44,7 +44,17 @@ rung, bit-identical by the r21 invariant), ``mesh.collective_timeout``
 recovery, detected by deadline instead of error),
 ``mesh.checkpoint_corrupt`` — a window-boundary fold checkpoint reads
 back corrupt on resume and recovery must discard it and refold from
-scratch, never resurrect bad carry state (r14 RingSpill posture)), and
+scratch, never resurrect bad carry state (r14 RingSpill posture)); r24
+ingest sites: ``ingest.parse_error`` — a ConnTracker's parser throws
+mid-transfer-tick (the quarantine breaker must isolate that connection
+while every other tracker processes the same tick),
+``ingest.push_stall`` — the table-store/WAL/resident-ring push path
+fails (rows counted as ``rows_dropped_push``, the shedding ladder is
+forced to level >= 2 next tick), ``ingest.event_flood`` — admission
+control rejects a data event at the door (counted ``event_flood``, the
+exact-accounting invariant must still balance), ``ingest.tracker_leak``
+— a conn_close event is lost before the connector sees it (the tracker
+must be reclaimed by inactivity disposal, never leak)), and
 tests/operators arm them deterministically.
 
 Design contract:

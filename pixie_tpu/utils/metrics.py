@@ -49,6 +49,22 @@ class _Metric:
         with self._lock:
             return sorted(self._values.items())
 
+    def by_label(self, label: str) -> dict[str, float]:
+        """Break the metric down by ONE label key (r24): sums every
+        sample carrying that label, keyed by its value —
+        ``drops.by_label("reason")`` → ``{"evict": 3.0, ...}``. Samples
+        without the label are omitted."""
+        out: dict[str, float] = {}
+        with self._lock:
+            for key, v in self._values.items():
+                if isinstance(v, dict):
+                    continue
+                for k, lv in key:
+                    if k == label:
+                        out[lv] = out.get(lv, 0.0) + v
+                        break
+        return out
+
 
 class Counter(_Metric):
     kind = "counter"

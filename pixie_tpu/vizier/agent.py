@@ -58,10 +58,16 @@ class Agent:
         vizier_ctx=None,
         wal_dir: Optional[str] = None,
         owned_tables: "Optional[list[str]]" = None,
+        ingest_core=None,
     ):
         self.agent_id = agent_id
         self.bus = bus
         self.is_kelvin = is_kelvin
+        # r24: a PEM agent running an IngestCore advertises its ingest
+        # accounting (events/drops/ladder/quarantine gauges) on every
+        # heartbeat, so the broker's /statusz shows overload shedding
+        # fleet-wide without scraping each host.
+        self.ingest_core = ingest_core
         # Data-plane ownership (r17): ``owned_tables`` is what this agent
         # ADVERTISES for query planning. None = every table in its store
         # (the pre-r17 behavior). A REPLICA agent passes an explicit
@@ -386,6 +392,33 @@ class Agent:
             # ring_restaged_windows, recovery_seconds).
             health = dict(health or {})
             health["recovery"] = self.recovery_info
+        if self.ingest_core is not None:
+            # r24 ingest gauges: a compact subset of each source's
+            # ingest_status() — enough for the broker to see shedding
+            # and quarantine fleet-wide without the full cause ledger.
+            try:
+                ingest = {}
+                for name, st in self.ingest_core.status().items():
+                    ingest[name] = {
+                        "events_fed": st.get("events_fed", 0),
+                        "rows_emitted": st.get("rows_emitted", 0),
+                        "trackers": st.get("trackers", 0),
+                        "buffer_bytes": st.get("buffer_bytes", 0),
+                        "shed_level": st.get("shed_level", 0),
+                        "quarantined": st.get("quarantined", 0),
+                        "drops": sum(
+                            n
+                            for c, n in st.get("causes", {}).items()
+                            if c not in ("parsed", "parsed_meta")
+                        )
+                        + st.get("rows_dropped_table_cap", 0)
+                        + st.get("rows_dropped_push", 0),
+                    }
+                if ingest:
+                    health = dict(health or {})
+                    health["ingest"] = ingest
+            except Exception:
+                pass  # advisory; never fail the heartbeat
         return health
 
     def _advertised_tables(self) -> list[str]:

@@ -338,6 +338,21 @@ class AgentTracker:
                     out[aid] = mesh
         return out
 
+    def ingest_view(self) -> dict[str, dict]:
+        """agent_id -> the ingest-plane section from its latest
+        heartbeat (r24): per-source events fed, rows emitted, total
+        drops, live trackers, buffered bytes, current shedding-ladder
+        level, and open quarantine breakers. /statusz surfaces it so an
+        operator sees WHICH hosts are shedding (and why) during an
+        overload without scraping per-host /metrics."""
+        out = {}
+        with self._lock:
+            for aid, a in sorted(self._agents.items()):
+                ingest = (a.get("health") or {}).get("ingest")
+                if ingest:
+                    out[aid] = ingest
+        return out
+
     def agents_snapshot(self) -> list[dict]:
         """Rows for the GetAgentStatus UDTF (ref: md_udtfs.h reads the
         agent manager's registry), plus r10 health-plane columns."""
@@ -622,6 +637,10 @@ class QueryBroker:
                 # geometry rungs, per-geometry breaker state, and
                 # checkpoint/resume counters from executor heartbeats.
                 "mesh": self.tracker.mesh_view(),
+                # r24: per-agent ingest plane — events/rows/drops,
+                # tracker and buffer gauges, shedding-ladder level, and
+                # quarantine breakers from PEM heartbeats.
+                "ingest": self.tracker.ingest_view(),
             },
             extra_routes={
                 "/agentz": lambda: self.tracker.agents_snapshot(),

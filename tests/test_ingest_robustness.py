@@ -1,0 +1,635 @@
+"""r24 overload-proof ingest: bounded trackers, shedding ladder,
+parser quarantine, exact drop accounting, and malformed-stream fuzzing.
+
+Ref posture: the reference's conn_tracker hardening (inactivity
+disposal, data-loss counters, per-protocol parse-error isolation) plus
+the r9 chaos-framework idiom — every shed byte is counted, never
+silently lost, and one poisoned connection never aborts the transfer
+tick for the rest of the fleet.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from pixie_tpu.ingest.capture_gen import (
+    EXCHANGES,
+    PROTOCOLS,
+    build_conn_events,
+)
+from pixie_tpu.ingest.socket_tracer import (
+    ConnId,
+    SocketTraceConnector,
+)
+from pixie_tpu.protocols.base import (
+    ConnTracker,
+    DataStreamBuffer,
+    TraceRole,
+)
+from pixie_tpu.utils import faults
+from pixie_tpu.utils.config import flags
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _mk_connector(**flag_overrides):
+    for k, v in flag_overrides.items():
+        flags.set(k, v)
+    c = SocketTraceConnector()
+    c.init()
+    return c
+
+
+@pytest.fixture
+def restore_flags():
+    names = [
+        "ingest_robustness",
+        "ingest_stream_buffer_bytes",
+        "ingest_global_budget_bytes",
+        "ingest_table_pending_rows",
+        "ingest_tracker_idle_s",
+        "ingest_shed_body_cap",
+        "ingest_quarantine_threshold",
+        "ingest_quarantine_cooldown_s",
+    ]
+    yield
+    for n in names:
+        flags.reset(n)
+
+
+def _feed(c, events):
+    for ev in events:
+        if ev[0] == "open":
+            c.conn_open(*ev[1:])
+        elif ev[0] == "data":
+            c.data_event(*ev[1:])
+        else:
+            c.conn_close(ev[1])
+
+
+def _settle(c, ticks=3):
+    for _ in range(ticks):
+        c.transfer_data(None)
+
+
+def _assert_laws(st):
+    assert st["law_a_ok"], st
+    assert st["law_b_ok"], st
+    assert st["law_c_ok"], st
+
+
+# -- exact accounting on a healthy pipe --------------------------------------
+
+
+def test_conservation_laws_clean_mixed_replay(restore_flags):
+    c = _mk_connector()
+    for j, proto in enumerate(PROTOCOLS):
+        conn = ConnId(f"pid{j}", 100 + j)
+        _feed(c, build_conn_events(conn, proto, n_exchanges=4, start=j * 50))
+    _settle(c)
+    st = c.ingest_status()
+    _assert_laws(st)
+    # 2 events per exchange, 4 exchanges, 6 protocols — all parsed.
+    assert st["events_fed"] == 2 * 4 * 6
+    assert st["causes"].get("parsed", 0) == st["events_fed"]
+    assert st["events_pending"] == 0
+    assert st["rows_emitted"] >= 4 * 6  # >=1 record per exchange
+    assert st["trackers"] == 0  # every closed conn retired
+
+
+# -- fuzz corpus: no exception escapes, accounting still exact ----------------
+
+
+def _corruptions(req: bytes, resp: bytes):
+    """The malformed-stream corpus: truncation, bit flips, garbage
+    interleave, pathological lengths — on both directions."""
+    yield req[: len(req) // 2], resp  # truncated request
+    yield req, resp[: max(1, len(resp) // 3)]  # truncated response
+    flipped = bytearray(req)
+    for k in range(0, len(flipped), 7):
+        flipped[k] ^= 0x80
+    yield bytes(flipped), resp  # bit flips
+    yield b"\xde\xad\xbe\xef" * 8 + req, resp  # garbage prefix
+    yield req, b"\x00" * 16 + resp + b"\xff" * 16  # garbage interleave
+    # Pathological length prefixes: max out every plausible length
+    # field by blasting 0xff over the frame header region.
+    patho = bytearray(req)
+    patho[: min(9, len(patho))] = b"\xff" * min(9, len(patho))
+    yield bytes(patho), resp
+    yield req + req[: len(req) // 2], resp + resp  # duplicated tails
+
+
+@pytest.mark.parametrize("proto", PROTOCOLS)
+def test_fuzz_corpus_never_escapes_tracker(proto, restore_flags):
+    mk = EXCHANGES[proto]
+    req, resp = mk(7)
+    fd = 0
+    c = _mk_connector()
+    for cr_req, cr_resp in _corruptions(req, resp):
+        fd += 1
+        conn = ConnId("fuzz", fd)
+        c.conn_open(conn, proto)
+        c.data_event(conn, "send", 0, cr_req, 100)
+        c.data_event(conn, "recv", 0, cr_resp, 200)
+        c.conn_close(conn)
+        # Must never raise — frames resync or land as counted errors.
+        _settle(c)
+    st = c.ingest_status()
+    _assert_laws(st)
+    assert st["events_fed"] == 2 * fd
+    assert st["events_pending"] == 0  # close-drain attributed everything
+    assert st["trackers"] == 0
+
+
+@pytest.mark.parametrize("proto", PROTOCOLS)
+def test_fuzz_direct_tracker_never_raises(proto):
+    """Same corpus straight at ConnTracker.process_to_records (no
+    connector isolation in the way) — the parsers themselves must
+    resync, not crash."""
+    from pixie_tpu.ingest.socket_tracer import _PARSERS
+
+    req, resp = EXCHANGES[proto](3)
+    for cr_req, cr_resp in _corruptions(req, resp):
+        t = ConnTracker(_PARSERS[proto], role=TraceRole.CLIENT)
+        t.add_send(0, cr_req, 100)
+        t.add_recv(0, cr_resp, 200)
+        t.process_to_records()
+        t.closed = True
+        t.process_to_records()
+        t.process_to_records()  # grace passed: close-drain runs
+
+
+# -- bounded memory -----------------------------------------------------------
+
+
+def test_per_tracker_byte_budget_evicts_oldest(restore_flags):
+    b = DataStreamBuffer(byte_budget=64, ledger={})
+    for k in range(10):
+        b.add(k * 32, b"x" * 32, k)
+    assert b.byte_size() <= 64
+    assert b.evictions > 0
+    led = b._ledger
+    # Every fully-evicted event is attributed, none double-counted.
+    assert led.get("evict", 0) + len(b._event_ends) == 10
+
+
+def test_tracker_budget_bounds_pending_chunks_too(restore_flags):
+    # Out-of-order chunks (never contiguous) must also respect the
+    # budget: the clamped gap allowance fast-forwards past the hole.
+    b = DataStreamBuffer(byte_budget=128, ledger={})
+    for k in range(1, 40):  # positions 100, 200, ... leave gaps
+        b.add(k * 100, b"y" * 50, k)
+    assert b.byte_size() <= 128
+
+
+def test_global_budget_rejects_at_admission(restore_flags):
+    c = _mk_connector(
+        ingest_robustness=True, ingest_global_budget_bytes=256
+    )
+    conn = ConnId("pid", 1)
+    c.conn_open(conn, "http")
+    # Feed far more than the global budget without a transfer tick.
+    for k in range(100):
+        c.data_event(conn, "send", k * 64, b"Z" * 64, k)
+    st = c.ingest_status()
+    assert st["causes"].get("global_budget", 0) > 0
+    _assert_laws(st)
+
+
+def test_table_pending_row_cap_counts_drops(restore_flags):
+    c = _mk_connector(
+        ingest_robustness=True, ingest_table_pending_rows=5
+    )
+    conn = ConnId("pid", 2)
+    _feed(c, build_conn_events(conn, "http", n_exchanges=20))
+    _settle(c)
+    st = c.ingest_status()
+    _assert_laws(st)
+    assert st["rows_dropped_table_cap"] > 0
+    assert st["rows_emitted"] <= 5
+    assert (
+        st["records_stitched"]
+        == st["rows_emitted"] + st["rows_dropped_table_cap"]
+    )
+
+
+def test_idle_tracker_disposal_reclaims_leak(restore_flags):
+    c = _mk_connector(
+        ingest_robustness=True, ingest_tracker_idle_s=0.01
+    )
+    conn = ConnId("pid", 3)
+    c.conn_open(conn, "http")
+    c.data_event(conn, "send", 0, b"GET /x HTTP/1.1", 1)  # torn, no close
+    time.sleep(0.05)
+    c.transfer_data(None)
+    st = c.ingest_status()
+    assert st["trackers"] == 0
+    assert st["causes"].get("idle_evict", 0) == 1
+    _assert_laws(st)
+
+
+def test_tracker_leak_fault_site_recovered_by_idle_disposal(restore_flags):
+    c = _mk_connector(
+        ingest_robustness=True, ingest_tracker_idle_s=0.01
+    )
+    faults.arm("ingest.tracker_leak", count=1)
+    conn = ConnId("pid", 4)
+    _feed(c, build_conn_events(conn, "http", n_exchanges=1))
+    assert c.ledger.leaked_closes == 1  # the close was "lost"
+    c.transfer_data(None)
+    assert c.ingest_status()["trackers"] == 1  # still live: no close seen
+    time.sleep(0.05)
+    c.transfer_data(None)
+    st = c.ingest_status()
+    assert st["trackers"] == 0  # inactivity disposal reclaimed it
+    _assert_laws(st)
+
+
+# -- shedding ladder ----------------------------------------------------------
+
+
+def test_shed_level1_truncates_bodies(restore_flags):
+    c = _mk_connector(
+        ingest_robustness=True,
+        ingest_table_pending_rows=100,
+        ingest_shed_body_cap=16,
+    )
+    # Push table occupancy past 50% to reach ladder level 1 — the level
+    # is computed from pressure at tick START, so the 60 warm rows must
+    # already be pending when the big-body exchange's tick begins.
+    conn0 = ConnId("warm", 1)
+    _feed(c, build_conn_events(conn0, "http", n_exchanges=60))
+    c.transfer_data(None)  # appends ~60 rows; level was 0 at tick start
+    conn = ConnId("pid", 5)
+    _feed(
+        c,
+        build_conn_events(conn, "http", n_exchanges=1, body="B" * 400),
+    )
+    c.transfer_data(None)  # occupancy 60/100 → level 1 this tick
+    assert c._shed_level >= 1
+    _settle(c)
+    st = c.ingest_status()
+    assert st["bodies_truncated"] > 0
+    _assert_laws(st)
+    rows = next(
+        t for t in c.tables if t.name == "http_events"
+    )._pending["resp_body"]
+    assert all(len(v) <= 16 for v in rows[60:])
+
+
+def test_shed_level2_samples_new_connections(restore_flags):
+    c = _mk_connector(ingest_robustness=True)
+    c._shed_level = 2  # force the ladder rung directly
+    admitted = sampled = 0
+    for fd in range(64):
+        conn = ConnId("pid", fd)
+        c.conn_open(conn, "http")
+        if conn in c._trackers:
+            admitted += 1
+        else:
+            sampled += 1
+            c.data_event(conn, "send", 0, b"x", 1)  # counted, not lost
+    assert admitted > 0 and sampled > 0  # crc32 splits the population
+    st = c.ingest_status()
+    assert st["causes"].get("conn_sampled", 0) == sampled
+    assert st["conns_sampled_out"] == sampled
+    _assert_laws(st)
+
+
+def test_push_stall_forces_shed_and_counts_rows(restore_flags):
+    c = _mk_connector(ingest_robustness=True)
+    conn = ConnId("pid", 6)
+    _feed(c, build_conn_events(conn, "http", n_exchanges=3))
+    c.transfer_data(None)
+
+    def bad_push(name, tablet, data):
+        raise RuntimeError("table store unavailable")
+
+    c.push_data(bad_push)
+    st = c.ingest_status()
+    assert st["rows_dropped_push"] > 0
+    assert st["law_push_ok"], st
+    c.transfer_data(None)  # stall forces ladder >= 2 next tick
+    assert c._shed_level >= 2
+
+
+def test_push_stall_fault_site(restore_flags):
+    c = _mk_connector(ingest_robustness=True)
+    conn = ConnId("pid", 7)
+    _feed(c, build_conn_events(conn, "http", n_exchanges=2))
+    c.transfer_data(None)
+    faults.arm("ingest.push_stall", count=1)
+    got = []
+    c.push_data(lambda n, t, d: got.append(n))
+    st = c.ingest_status()
+    assert st["rows_dropped_push"] > 0
+    assert st["law_push_ok"], st
+
+
+def test_event_flood_fault_site_counted(restore_flags):
+    c = _mk_connector(ingest_robustness=True)
+    conn = ConnId("pid", 8)
+    c.conn_open(conn, "http")
+    faults.arm("ingest.event_flood", count=3)
+    for k in range(10):
+        c.data_event(conn, "send", k, b"x", k)
+    st = c.ingest_status()
+    assert st["causes"].get("event_flood", 0) == 3
+    _assert_laws(st)
+
+
+# -- parser quarantine --------------------------------------------------------
+
+
+def test_quarantine_isolates_poisoned_connection(restore_flags):
+    c = _mk_connector(
+        ingest_robustness=True,
+        ingest_quarantine_threshold=2,
+        ingest_quarantine_cooldown_s=0.02,
+    )
+    bad = ConnId("bad", 1)
+    good = ConnId("good", 2)
+    c.conn_open(bad, "http")
+    c.conn_open(good, "http")
+    tracker = c._trackers[bad]
+
+    def boom():
+        raise RuntimeError("parser poisoned")
+
+    real = tracker.process_to_records
+    tracker.process_to_records = boom
+    req, resp = EXCHANGES["http"](1)
+    c.data_event(bad, "send", 0, req, 100)
+    c.data_event(good, "send", 0, req, 100)
+    c.data_event(good, "recv", 0, resp, 200)
+    # Strike 1: good conn still processes the SAME tick.
+    c.transfer_data(None)
+    assert c.ingest_status()["rows_emitted"] >= 1
+    # Strike 2 opens the breaker: buffers drain, new events drop.
+    c.transfer_data(None)
+    st = c.ingest_status()
+    assert st["quarantined"] == 1
+    assert st["quarantine_opens"] == 1
+    c.data_event(bad, "send", len(req), b"more", 300)
+    st = c.ingest_status()
+    assert st["causes"].get("quarantine", 0) >= 1
+    _assert_laws(st)
+    # Cooldown passes → half-open trial; healed parser closes it.
+    tracker.process_to_records = real
+    time.sleep(0.03)
+    c.transfer_data(None)
+    st = c.ingest_status()
+    assert st["quarantined"] == 0
+    assert bad not in c._quarantine
+    _assert_laws(st)
+
+
+def test_parse_error_fault_site_trips_breaker(restore_flags):
+    c = _mk_connector(
+        ingest_robustness=True, ingest_quarantine_threshold=1
+    )
+    conn = ConnId("pid", 9)
+    c.conn_open(conn, "http")
+    c.data_event(conn, "send", 0, b"GET / HTTP/1.1\r\n\r\n", 1)
+    faults.arm("ingest.parse_error", count=1)
+    c.transfer_data(None)
+    st = c.ingest_status()
+    assert st["quarantined"] == 1
+    assert st["quarantine_opens"] == 1
+    _assert_laws(st)
+
+
+# -- satellites ---------------------------------------------------------------
+
+
+def test_data_event_direction_validated_legacy(restore_flags):
+    flags.set("ingest_robustness", False)
+    c = SocketTraceConnector()
+    c.init()
+    conn = ConnId("pid", 10)
+    c.conn_open(conn, "http")
+    with pytest.raises(ValueError, match="direction"):
+        c.data_event(conn, "sned", 0, b"x", 1)  # the typo case
+
+
+def test_data_event_direction_counted_robust(restore_flags):
+    c = _mk_connector(ingest_robustness=True)
+    conn = ConnId("pid", 11)
+    c.conn_open(conn, "http")
+    c.data_event(conn, "sned", 0, b"x", 1)
+    st = c.ingest_status()
+    assert st["causes"].get("bad_direction", 0) == 1
+    _assert_laws(st)
+
+
+def test_post_close_and_unknown_conn_counted(restore_flags):
+    c = _mk_connector(ingest_robustness=True)
+    conn = ConnId("pid", 12)
+    _feed(c, build_conn_events(conn, "http", n_exchanges=1))
+    _settle(c)  # conn retires
+    c.data_event(conn, "send", 10_000, b"late", 999)
+    c.data_event(ConnId("ghost", 13), "send", 0, b"x", 1)
+    st = c.ingest_status()
+    assert st["causes"].get("post_close", 0) == 1
+    assert st["causes"].get("unknown_conn", 0) == 1
+    _assert_laws(st)
+
+
+def test_ingest_core_final_flush_survives_bad_source(restore_flags):
+    """One failing source's final flush must not skip the flush/stop of
+    every remaining source (the r24 finally-block fix)."""
+    from pixie_tpu.ingest.core import IngestCore
+    from pixie_tpu.ingest.source_connector import (
+        DataTable,
+        SourceConnector,
+    )
+    from pixie_tpu.ingest.http_gen import HTTP_EVENTS_REL
+
+    stops = []
+
+    class BadFlush(SourceConnector):
+        name = "bad_flush"
+
+        def init_impl(self):
+            self.tables = []
+
+        def transfer_data_impl(self, ctx):
+            pass
+
+        def push_data(self, push_cb):
+            raise RuntimeError("flush exploded")
+
+        def stop_impl(self):
+            stops.append(self.name)
+
+    class Good(SourceConnector):
+        name = "good_source"
+
+        def init_impl(self):
+            self.tables = [DataTable("http_events", HTTP_EVENTS_REL)]
+
+        def transfer_data_impl(self, ctx):
+            pass
+
+        def stop_impl(self):
+            stops.append(self.name)
+
+    core = IngestCore()
+    core.register_source(BadFlush())
+    good = Good()
+    core.register_source(good)
+    pushed = {}
+    core.register_data_push_callback(
+        lambda name, tablet, data: pushed.setdefault(name, data)
+    )
+    core._stop.set()  # run() executes init + one finally-flush pass
+    core.run()
+    # Both sources stopped despite the bad one's flush raising...
+    assert "bad_flush" in stops and "good_source" in stops
+    # ...and the failure landed as a stirling_error row, flushed LAST
+    # (the error connector is moved to the end of the flush order).
+    assert "stirling_error" in pushed
+    assert any(
+        "final_flush" in ctx for ctx in pushed["stirling_error"]["context"]
+    ), pushed["stirling_error"]["context"]
+
+
+def test_ingest_core_status_aggregates_sources(restore_flags):
+    from pixie_tpu.ingest.core import IngestCore
+
+    core = IngestCore()
+    c = _mk_connector(ingest_robustness=True)
+    core.register_source(c)
+    conn = ConnId("pid", 14)
+    _feed(c, build_conn_events(conn, "http", n_exchanges=1))
+    _settle(c)
+    st = core.status()
+    assert "socket_tracer" in st
+    assert st["socket_tracer"]["law_a_ok"]
+
+
+def test_wire_before_init_push_lands_rows(restore_flags):
+    """wire_to_table_store before source init: the push closure must
+    resolve relations live for sources that build their DataTables in
+    init_impl (SocketTraceConnector) instead of KeyError-ing and
+    silently counting every push as dropped."""
+    from pixie_tpu.ingest.core import IngestCore
+    from pixie_tpu.table.table_store import TableStore
+
+    flags.set("ingest_robustness", True)
+    core = IngestCore()
+    c = SocketTraceConnector()
+    core.register_source(c)
+    store = TableStore()
+    core.wire_to_table_store(store)  # publishes nothing yet
+    c.init()
+    conn = ConnId("pid", 16)
+    _feed(c, build_conn_events(conn, "http", n_exchanges=2))
+    _settle(c)
+    c.push_data(core._push_cb)
+    st = c.ingest_status()
+    assert st["rows_pushed"] == 2 and st["rows_dropped_push"] == 0, st
+    assert st["law_push_ok"], st
+    t = store.get_table("http_events")
+    assert t is not None and t.end_row_id() == 2
+
+
+def test_error_recorder_wires_quarantine_to_stirling_error(restore_flags):
+    """A quarantine open surfaces as a queryable stirling_error row via
+    the error_recorder hook IngestCore wires into every source."""
+    from pixie_tpu.ingest.core import IngestCore
+
+    core = IngestCore()
+    c = _mk_connector(
+        ingest_robustness=True, ingest_quarantine_threshold=1
+    )
+    core.register_source(c)
+    c.error_recorder = core.error_connector.record  # what run() wires
+    conn = ConnId("pid", 15)
+    c.conn_open(conn, "http")
+    c.data_event(conn, "send", 0, b"GET / HTTP/1.1\r\n\r\n", 1)
+    faults.arm("ingest.parse_error", count=1)
+    c.transfer_data(None)
+    assert c.ingest_status()["quarantined"] == 1
+    err = core.error_connector.tables[0]._pending
+    assert any("quarantine_open" in ctx for ctx in err["context"]), err
+
+
+def test_heartbeat_carries_ingest_section(restore_flags):
+    """Agent._health rides the ingest gauges; the broker's ingest_view
+    aggregates them for /statusz."""
+    from pixie_tpu.ingest.core import IngestCore
+    from pixie_tpu.vizier.agent import Agent
+    from pixie_tpu.vizier.broker import AgentTracker
+    from pixie_tpu.vizier.bus import MessageBus
+
+    core = IngestCore()
+    c = _mk_connector(ingest_robustness=True)
+    core.register_source(c)
+    conn = ConnId("pid", 16)
+    _feed(c, build_conn_events(conn, "http", n_exchanges=2))
+    _settle(c)
+
+    # Call the unbound heartbeat builder against a stub agent: the
+    # ingest section must ride health without a device executor.
+    stub = type(
+        "StubAgent",
+        (),
+        {
+            "carnot": type("C", (), {"device_executor": None})(),
+            "recovery_info": None,
+            "ingest_core": core,
+        },
+    )()
+    health = Agent._health(stub)
+    assert health is not None and "ingest" in health
+    sec = health["ingest"]["socket_tracer"]
+    assert sec["events_fed"] == 4
+    assert sec["rows_emitted"] >= 2
+    assert sec["shed_level"] == 0 and sec["quarantined"] == 0
+
+    bus = MessageBus()
+    tracker = AgentTracker(bus)
+    try:
+        bus.publish(
+            "agent_status",
+            {
+                "type": "heartbeat",
+                "agent_id": "pem1",
+                "epoch": 1,
+                "is_kelvin": False,
+                "tables": [],
+                "health": health,
+            },
+        )
+        deadline = time.monotonic() + 5
+        view = {}
+        while time.monotonic() < deadline:
+            view = tracker.ingest_view()
+            if view:
+                break
+            time.sleep(0.01)
+        assert "pem1" in view
+        assert view["pem1"]["socket_tracer"]["events_fed"] == 4
+    finally:
+        tracker.stop()
+
+
+def test_metrics_by_label(restore_flags):
+    from pixie_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    drops = reg.counter("test_drops", "test")
+    drops.inc(3, reason="evict")
+    drops.inc(2, reason="gap_skip")
+    drops.inc(5, reason="evict", table="http")
+    assert drops.by_label("reason") == {"evict": 8.0, "gap_skip": 2.0}
+    assert drops.by_label("table") == {"http": 5.0}
+    assert drops.by_label("nope") == {}

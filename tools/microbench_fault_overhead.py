@@ -47,10 +47,17 @@ exactly one axis-count branch in _mesh_dispatch (no fault-site probes,
 no watchdog, no collective lock), censused by counting dispatches
 through one warm query.
 
+Also gates (r24) the ingest-robustness hooks: <1% modeled on the
+per-event legacy capture pipe with ``ingest_robustness`` DISABLED —
+every event pays only bare branches (the connector's cached
+``self._robust`` check and the stream buffer's ledger-is-None guards);
+no budget, ledger, or quarantine bookkeeping exists on that path.
+Enabled cost reported as a replay A/B.
+
 Prints ONE JSON line on stdout. With MB_WRITE_BENCH_DETAIL=1, merges the
 headline numbers into BENCH_DETAIL.json under the ``fault_overhead``,
-``ack_overhead``, ``trace_overhead``, ``durability_overhead`` and
-``profiler_overhead`` keys.
+``ack_overhead``, ``trace_overhead``, ``durability_overhead``,
+``profiler_overhead`` and ``ingest_overhead`` keys.
 
 Env knobs: MB_ROWS (default 200k), MB_WARM_RUNS (default 20),
 MB_RTT_MSGS (default 400), MB_THRPT_MSGS (default 2000), JAX_PLATFORMS.
@@ -89,6 +96,10 @@ SITES = (
     "agent.kill_holding_fragment",
     "resident.replica_lag",
     "hedge.both_complete",
+    "ingest.parse_error",
+    "ingest.push_stall",
+    "ingest.event_flood",
+    "ingest.tracker_leak",
 )
 
 
@@ -513,6 +524,87 @@ def main() -> None:
         f"modeled on the flat path"
     )
 
+    # -- ingest-robustness overhead (r24) ------------------------------------
+    # Disabled gate: with ``ingest_robustness`` off, every captured
+    # event pays only bare branches — data_event's cached
+    # ``self._robust`` check, the stream buffer's ledger-is-None guards
+    # on add/consume, and the stale-duplicate position compare. No
+    # ledger dict, no event-end bisect, no budget/quarantine
+    # bookkeeping exists on that path. Census: 4 branches/event at the
+    # measured idiom cost, over the measured per-event legacy pipe time
+    # (feed -> reassemble -> parse -> stitch -> rows), gated <1%.
+    # Enabled cost: the same replay with full r24 accounting, as an A/B.
+    from pixie_tpu.ingest.capture_gen import build_conn_events
+    from pixie_tpu.ingest.socket_tracer import (
+        ConnId as _ConnId,
+        SocketTraceConnector as _STC,
+    )
+
+    def _ingest_branch_ns(iters: int = 1_000_000) -> float:
+        holder = type("H", (), {"robust": False})()
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            if holder.robust:
+                raise AssertionError
+        return (time.perf_counter_ns() - t0) / iters
+
+    ingest_branch_ns = _ingest_branch_ns()
+
+    def _ingest_per_event_ns(robust: bool, conns: int = 120) -> float:
+        saved = flags.get("ingest_robustness")
+        flags.set("ingest_robustness", robust)
+        try:
+            src = _STC()
+            src.init()
+            events = []
+            for j in range(conns):
+                events.extend(
+                    build_conn_events(
+                        _ConnId("mb", j), "http", n_exchanges=4, start=j
+                    )
+                )
+            n_data = sum(1 for e in events if e[0] == "data")
+            t0 = time.perf_counter_ns()
+            for ev in events:
+                if ev[0] == "open":
+                    src.conn_open(*ev[1:])
+                elif ev[0] == "data":
+                    src.data_event(*ev[1:])
+                else:
+                    src.conn_close(ev[1])
+            src.transfer_data(None)
+            return (time.perf_counter_ns() - t0) / n_data
+        finally:
+            flags.set("ingest_robustness", saved)
+
+    _ingest_per_event_ns(False, conns=20)  # warm
+    ingest_legacy_ns = _ingest_per_event_ns(False)
+    ingest_robust_ns = _ingest_per_event_ns(True)
+    ingest_checks_per_event = 4.0
+    ingest_modeled_pct = (
+        100.0 * ingest_checks_per_event * ingest_branch_ns
+        / ingest_legacy_ns
+    )
+    ingest_overhead = {
+        "ingest_branch_ns": round(ingest_branch_ns, 2),
+        "disabled_checks_per_event": ingest_checks_per_event,
+        "legacy_event_ns": round(ingest_legacy_ns, 1),
+        "robust_event_ns": round(ingest_robust_ns, 1),
+        "disabled_modeled_pct": round(ingest_modeled_pct, 5),
+        "robust_on_delta_pct": round(
+            100.0 * (ingest_robust_ns - ingest_legacy_ns)
+            / ingest_legacy_ns, 2
+        ),
+        "pass_under_1pct": bool(ingest_modeled_pct < 1.0),
+    }
+    log(
+        f"ingest: {ingest_legacy_ns:.0f}ns/event legacy pipe, "
+        f"{ingest_checks_per_event:.0f} branches/event at "
+        f"{ingest_branch_ns:.1f}ns -> {ingest_modeled_pct:.4f}% disabled "
+        f"modeled; robust-on A/B "
+        f"{ingest_overhead['robust_on_delta_pct']:+.1f}%"
+    )
+
     # -- durability spill overhead (r14) -------------------------------------
     # Disabled gate: with no WAL attached, every durability hook on the
     # send/ack path is a bare ``wal is None`` attribute branch —
@@ -782,6 +874,7 @@ def main() -> None:
             and views_overhead["pass_under_1pct"]
             and cost_model_overhead["pass_under_1pct"]
             and mesh_recovery_overhead["pass_under_1pct"]
+            and ingest_overhead["pass_under_1pct"]
         ),
         "platform": jax.devices()[0].platform,
     }
@@ -793,6 +886,7 @@ def main() -> None:
     out["views_overhead"] = views_overhead
     out["cost_model_overhead"] = cost_model_overhead
     out["mesh_recovery_overhead"] = mesh_recovery_overhead
+    out["ingest_overhead"] = ingest_overhead
     print(json.dumps(out))
 
     if os.environ.get("MB_WRITE_BENCH_DETAIL") == "1":
@@ -807,6 +901,7 @@ def main() -> None:
                 "durability_overhead", "profiler_overhead",
                 "failover_overhead", "views_overhead",
                 "cost_model_overhead", "mesh_recovery_overhead",
+                "ingest_overhead",
             )
         }
         detail["ack_overhead"] = ack_overhead
@@ -817,6 +912,7 @@ def main() -> None:
         detail["views_overhead"] = views_overhead
         detail["cost_model_overhead"] = cost_model_overhead
         detail["mesh_recovery_overhead"] = mesh_recovery_overhead
+        detail["ingest_overhead"] = ingest_overhead
         with open(path, "w") as f:
             json.dump(detail, f, indent=1)
             f.write("\n")
@@ -824,7 +920,7 @@ def main() -> None:
             "BENCH_DETAIL.json updated (fault_overhead, ack_overhead, "
             "trace_overhead, durability_overhead, profiler_overhead, "
             "failover_overhead, views_overhead, cost_model_overhead, "
-            "mesh_recovery_overhead)"
+            "mesh_recovery_overhead, ingest_overhead)"
         )
 
     if not out["pass_under_1pct"]:

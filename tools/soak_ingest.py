@@ -1,0 +1,569 @@
+"""Ingest chaos soak: mixed-protocol replay at full rate, faults armed,
+WAL on, concurrent queries live (r24).
+
+The acceptance harness for the overload-proof ingest plane: feeder
+threads replay synthetic captures for ALL SIX shipped parsers (http,
+http2/gRPC, dns, mysql, pgsql, redis) as fast as Python can offer them
+— the target posture is ~1M events/s — through the full pipe:
+SocketTraceConnector admission → ConnTracker reassembly → parser →
+stitcher → DataTables → table-store push → HBM-resident ring ingest,
+while
+
+- the r24 fault sites are armed (``ingest.parse_error`` quarantines,
+  ``ingest.push_stall`` sheds rows and forces the ladder,
+  ``ingest.event_flood`` rejects at admission, ``ingest.tracker_leak``
+  loses conn_close events so inactivity disposal must reclaim),
+- the WAL is on (``wal_dir`` + ``durable_resident``: ring ingest spills
+  through the r14 durability path), and
+- concurrent placed-fleet clients execute a scripted query against a
+  static baseline table through the broker the whole time.
+
+Gates (the r24 acceptance bar):
+
+1. zero uncaught exceptions anywhere (feeders, ingest loop, clients);
+2. bounded gauges: peak tracker count ≤ conns offered, final trackers
+   == 0 (leaked closes reclaimed), peak buffered bytes ≤ global budget
+   (+ small feeder-race slack);
+3. the EXACT drop-accounting invariant: fed events ≡ attributed causes
+   (law A), parsed frames ≡ stitched + drained + pending (law B),
+   stitched records ≡ emitted rows + counted drops (law C), emitted ≡
+   pushed + push-dropped + pending (push law) — all exactly;
+4. every concurrent query result bit-identical to the unfaulted serial
+   baseline;
+5. offered events/s ≥ the configured floor.
+
+Env knobs: SOAK_ING_SECONDS (4), SOAK_ING_FEEDERS (4),
+SOAK_ING_CLIENTS (2), SOAK_ING_EXCHANGES (8 per conn),
+SOAK_ING_CHAOS (1), SOAK_ING_MIN_RATE (20_000 events/s floor —
+the offered-rate *posture* is ~1M/s; the floor is what a busy CI
+box must still clear),
+SOAK_ING_ROWS (50_000 baseline rows), SOAK_ING_JSON (report path),
+SOAK_WRITE_BENCH_DETAIL (1 = merge the report into BENCH_DETAIL.json
+under ``ingest_soak``).
+
+Run: JAX_PLATFORMS=cpu python tools/soak_ingest.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# Low steady probabilities: the soak's point is that a constant drizzle
+# of injected ingest failures yields counted drops and quarantines —
+# never a crash, never a lost-uncounted event, and never a perturbed
+# query result.
+CHAOS_SITES = {
+    "ingest.parse_error": dict(p=0.002, seed=241),
+    "ingest.push_stall": dict(p=0.05, seed=242),
+    "ingest.event_flood": dict(p=0.005, seed=243),
+    "ingest.tracker_leak": dict(p=0.05, seed=244),
+}
+
+BASELINE_QUERY = (
+    "df = px.DataFrame(table='soak_base')\n"
+    "st = df.groupby(['service']).agg(\n"
+    "    n=('time_', px.count),\n"
+    "    s=('latency', px.sum),\n"
+    ")\n"
+    "px.display(st, 'out')\n"
+)
+
+
+def _table_key(result) -> dict:
+    from pixie_tpu.table.row_batch import RowBatch
+
+    batches = [b for b in result.tables["out"] if b.num_rows]
+    return RowBatch.concat(batches).to_pydict() if batches else {}
+
+
+def _tables_equal(a: dict, b: dict) -> bool:
+    if set(a) != set(b):
+        return False
+    for col in a:
+        av, bv = np.asarray(a[col]), np.asarray(b[col])
+        if av.dtype != bv.dtype or not np.array_equal(av, bv):
+            return False
+    return True
+
+
+def run_soak(
+    duration_s: float = 4.0,
+    feeders: int = 4,
+    clients: int = 2,
+    exchanges_per_conn: int = 8,
+    rows: int = 50_000,
+    chaos: bool = True,
+    seed: int = 7,
+) -> dict:
+    # Flag definitions live in the modules that consume them.
+    import pixie_tpu.ingest.socket_tracer  # noqa: F401
+    import pixie_tpu.protocols.base  # noqa: F401
+    from pixie_tpu.utils.config import flags
+
+    wal_dir = tempfile.mkdtemp(prefix="soak_ingest_wal_")
+    soak_flags = {
+        "ingest_robustness": True,
+        # Budgets small enough that full-rate feeding provokes the
+        # ladder and real eviction/admission drops (all counted).
+        "ingest_global_budget_bytes": 8 << 20,
+        "ingest_stream_buffer_bytes": 256 << 10,
+        "ingest_table_pending_rows": 50_000,
+        # Leaked closes (ingest.tracker_leak) must be reclaimed within
+        # the settle phase, not the 300s production default.
+        "ingest_tracker_idle_s": 1.0,
+        "ingest_quarantine_cooldown_s": 0.5,
+        # WAL on: ring ingest spills through the r14 durability path.
+        "wal_dir": wal_dir,
+        "durable_resident": True,
+        "resident_ingest": True,
+    }
+    for name, value in soak_flags.items():
+        flags.set(name, value)
+    try:
+        return _run_soak_inner(
+            duration_s, feeders, clients, exchanges_per_conn, rows,
+            chaos, seed,
+        )
+    finally:
+        for name in soak_flags:
+            flags.reset(name)
+
+
+def _run_soak_inner(
+    duration_s, feeders, clients, exchanges_per_conn, rows, chaos, seed
+) -> dict:
+    from pixie_tpu.exec import BridgeRouter
+    from pixie_tpu.ingest.capture_gen import EXCHANGES, PROTOCOLS
+    from pixie_tpu.ingest.core import IngestCore
+    from pixie_tpu.ingest.socket_tracer import (
+        ConnId,
+        SocketTraceConnector,
+    )
+    from pixie_tpu.parallel import MeshExecutor
+    from pixie_tpu.protocols.base import TraceRole
+    from pixie_tpu.table.table_store import TableStore
+    from pixie_tpu.types import DataType, Relation, SemanticType
+    from pixie_tpu.utils import faults
+    from pixie_tpu.vizier import Agent, MessageBus, QueryBroker
+
+    F, I, S, T = (
+        DataType.FLOAT64,
+        DataType.INT64,
+        DataType.STRING,
+        DataType.TIME64NS,
+    )
+    base_rel = Relation.of(
+        ("time_", T, SemanticType.ST_TIME_NS),
+        ("service", S),
+        ("resp_status", I),
+        ("latency", F),
+    )
+    log("soak: building cluster")
+    ex = MeshExecutor()
+    store = TableStore()
+    rng = np.random.default_rng(seed)
+    # The static query target: concurrent results are judged against a
+    # serial baseline over this table, so ingest churn elsewhere in the
+    # store must not perturb them bit-for-bit. Integer-valued floats
+    # keep px.sum exact under any fold grouping.
+    bt = store.create_table("soak_base", base_rel, size_limit=1 << 40)
+    bt.write_pydict(
+        {
+            "time_": np.arange(rows, dtype=np.int64) * 1000,
+            "service": rng.choice(
+                [f"svc-{i}" for i in range(8)], rows
+            ).astype(object),
+            "resp_status": rng.choice([200, 404, 500], rows),
+            "latency": np.floor(rng.exponential(3e7, rows)),
+        }
+    )
+    bt.compact()
+    bt.stop()
+
+    bus = MessageBus()
+    router = BridgeRouter()
+    broker = QueryBroker(
+        bus, router, table_relations={"soak_base": base_rel}
+    )
+
+    # The ingest plane under test, wired into the SAME store the serving
+    # agent reads — pushes land as table writes and resident-ring
+    # ingests (flag resident_ingest) while queries run.
+    log("soak: baseline table staged")
+    core = IngestCore()
+    tracer = SocketTraceConnector()
+    # Tight tick periods: the soak measures the pipe, not the scheduler.
+    tracer._sample_mgr.period_s = tracer.sample_period_s = 0.02
+    tracer._push_mgr.period_s = tracer.push_period_s = 0.05
+    core.register_source(tracer)
+    core.wire_to_table_store(store, device_executor=ex)
+
+    agents = [
+        Agent(
+            "pem1", bus, router, table_store=store,
+            device_executor=ex, ingest_core=core,
+        ),
+        Agent("kelvin", bus, router, is_kelvin=True),
+    ]
+    for a in agents:
+        a.start()
+    time.sleep(0.3)
+
+    # Serial baseline BEFORE faults arm: from-scratch truth.
+    log("soak: agents up, running serial baseline")
+    r = broker.execute_script(
+        BASELINE_QUERY, timeout_s=120, tenant="baseline"
+    )
+    assert r.degraded is None, f"baseline degraded: {r.degraded}"
+    baseline = _table_key(r)
+    assert baseline, "baseline query returned no rows"
+
+    log("soak: baseline captured, starting ingest + chaos")
+    errors: list[str] = []
+    mismatches = [0]
+    query_counts = [0]
+    core.run_as_thread()
+
+    if chaos:
+        for site, kw in CHAOS_SITES.items():
+            faults.arm(site, **kw)
+
+    # -- peak-gauge sampler --------------------------------------------------
+    peaks = {"trackers": 0, "buffer_bytes": 0, "shed_level": 0}
+    sampler_stop = threading.Event()
+
+    def sampler():
+        while not sampler_stop.is_set():
+            peaks["trackers"] = max(
+                peaks["trackers"], len(tracer._trackers)
+            )
+            peaks["buffer_bytes"] = max(
+                peaks["buffer_bytes"], tracer._global_bytes
+            )
+            peaks["shed_level"] = max(
+                peaks["shed_level"], tracer._shed_level
+            )
+            time.sleep(0.005)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+
+    # -- feeders -------------------------------------------------------------
+    # Each feeder owns a disjoint fd space and cycles the six protocols;
+    # exchanges are prebuilt per protocol so the hot loop is pure
+    # data_event calls (the offered-rate measurement, not byte
+    # generation, is the point).
+    prebuilt = {}
+    for pi, proto in enumerate(PROTOCOLS):
+        mk = EXCHANGES[proto]
+        prebuilt[proto] = [mk(k) for k in range(exchanges_per_conn)]
+    conns_opened = [0] * feeders
+    events_offered = [0] * feeders
+    stop_feeding = threading.Event()
+    barrier = threading.Barrier(feeders + clients + 1)
+
+    def feeder(fi: int):
+        try:
+            barrier.wait()
+            fd = fi << 24
+            while not stop_feeding.is_set():
+                proto = PROTOCOLS[fd % len(PROTOCOLS)]
+                conn = ConnId(f"feeder{fi}", fd)
+                fd += 1
+                tracer.conn_open(
+                    conn, proto, TraceRole.CLIENT, "10.0.0.1", 4000
+                )
+                conns_opened[fi] += 1
+                spos = rpos = 0
+                ts = fd * 1000
+                n = 0
+                for req, resp in prebuilt[proto]:
+                    tracer.data_event(conn, "send", spos, req, ts)
+                    tracer.data_event(
+                        conn, "recv", rpos, resp, ts + 500
+                    )
+                    spos += len(req)
+                    rpos += len(resp)
+                    ts += 1000
+                    n += 2
+                    if stop_feeding.is_set():
+                        break
+                tracer.conn_close(conn)
+                events_offered[fi] += n
+        except Exception as e:  # the zero-crash gate
+            errors.append(f"feeder{fi}: {type(e).__name__}: {e}")
+
+    # -- concurrent query clients -------------------------------------------
+    stop_querying = threading.Event()
+
+    def client(ci: int):
+        try:
+            barrier.wait()
+            while not stop_querying.is_set():
+                res = broker.execute_script(
+                    BASELINE_QUERY, timeout_s=120, tenant=f"c{ci}"
+                )
+                query_counts[0] += 1
+                if not _tables_equal(_table_key(res), baseline):
+                    mismatches[0] += 1
+                time.sleep(0.05)
+        except Exception as e:
+            errors.append(f"client{ci}: {type(e).__name__}: {e}")
+
+    threads = [
+        threading.Thread(target=feeder, args=(i,), daemon=True)
+        for i in range(feeders)
+    ]
+    threads += [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    log(f"soak: feeding for {duration_s}s with {feeders} feeders, {clients} clients")
+    t0 = time.perf_counter()
+    barrier.wait()
+    time.sleep(duration_s)
+    stop_feeding.set()
+    for t in threads[:feeders]:
+        t.join(timeout=30)
+    feed_s = time.perf_counter() - t0
+
+    # -- settle: disarm, drain, verify exactness ----------------------------
+    log(f"soak: feed done ({sum(events_offered)} events), settling")
+    chaos_stats = {
+        site: faults.stats().get(site, (0, 0)) for site in CHAOS_SITES
+    } if chaos else {}
+    faults.reset()
+    # Leaked-close trackers are reclaimed by inactivity disposal
+    # (ingest_tracker_idle_s=1.0); closed ones drain through grace.
+    deadline = time.monotonic() + max(20.0, duration_s)
+    while time.monotonic() < deadline:
+        if len(tracer._trackers) == 0:
+            break
+        time.sleep(0.1)
+    log(f"soak: settled, trackers={len(tracer._trackers)}")
+    stop_querying.set()
+    for t in threads[feeders:]:
+        t.join(timeout=60)
+    core.stop(timeout=10)  # final flush runs per-source, wrapped
+    status = tracer.ingest_status()
+
+    sampler_stop.set()
+    sampler_t.join(timeout=2)
+    for a in agents:
+        a.stop()
+    broker.stop()
+
+    log("soak: teardown complete, building report")
+    offered = sum(events_offered)
+    causes = status["causes"]
+    dropped = sum(
+        n
+        for c, n in causes.items()
+        if c not in ("parsed", "parsed_meta")
+    )
+    budget = 8 << 20
+    report = {
+        "duration_s": round(feed_s, 3),
+        "feeders": feeders,
+        "clients": clients,
+        "conns_opened": sum(conns_opened),
+        "events_offered": offered,
+        "events_per_s": int(offered / feed_s) if feed_s else 0,
+        "rows_pushed": status["rows_pushed"],
+        "drop_fraction": round(dropped / max(1, offered), 6),
+        "drop_fractions_by_reason": {
+            c: round(n / max(1, offered), 6)
+            for c, n in sorted(causes.items())
+            if c not in ("parsed", "parsed_meta")
+        },
+        "bodies_truncated": status["bodies_truncated"],
+        "quarantine_opens": status["quarantine_opens"],
+        "leaked_closes": status["leaked_closes"],
+        "conns_sampled_out": status["conns_sampled_out"],
+        "peak_trackers": peaks["trackers"],
+        "peak_buffer_bytes": peaks["buffer_bytes"],
+        "peak_shed_level": peaks["shed_level"],
+        "final_trackers": status["trackers"],
+        "accounting": {
+            k: status[k]
+            for k in (
+                "events_fed",
+                "events_attributed",
+                "events_pending",
+                "law_a_ok",
+                "frames_parsed",
+                "frames_stitched",
+                "frames_drained",
+                "frames_pending",
+                "law_b_ok",
+                "records_stitched",
+                "rows_emitted",
+                "rows_dropped_table_cap",
+                "law_c_ok",
+                "rows_dropped_push",
+                "rows_pending",
+                "law_push_ok",
+            )
+        },
+        "queries": query_counts[0],
+        "query_mismatches": mismatches[0],
+        "errors": errors,
+        "chaos": {
+            site: {"checks": c, "fired": f}
+            for site, (c, f) in chaos_stats.items()
+        },
+        "gates": {},
+    }
+    g = report["gates"]
+    g["zero_errors"] = not errors
+    g["law_a_exact"] = status["law_a_ok"]
+    g["law_b_exact"] = status["law_b_ok"]
+    g["law_c_exact"] = status["law_c_ok"]
+    g["law_push_exact"] = status["law_push_ok"]
+    g["trackers_drained"] = status["trackers"] == 0
+    g["trackers_bounded"] = peaks["trackers"] <= sum(conns_opened)
+    # Feeders race admission between the budget check and the byte
+    # accounting, so the peak may overshoot by in-flight event sizes.
+    g["buffer_bounded"] = peaks["buffer_bytes"] <= int(budget * 1.25)
+    g["queries_bit_identical"] = (
+        mismatches[0] == 0 and query_counts[0] > 0
+    )
+    g["rows_flowed"] = status["rows_pushed"] > 0
+    report["passed"] = all(g.values())
+    return report
+
+
+def record_ingest_soak_detail(report: dict, path: str = None) -> None:
+    """Merge one ingest soak run into BENCH_DETAIL.json's
+    ``ingest_soak`` block (read-modify-write, same idiom as the other
+    soak recorders)."""
+    bd_path = path or os.path.join(REPO, "BENCH_DETAIL.json")
+    detail = {}
+    if os.path.exists(bd_path):
+        try:
+            with open(bd_path) as f:
+                detail = json.load(f)
+        except (OSError, ValueError):
+            detail = {}
+    detail["ingest_soak"] = {
+        "events_per_s": report["events_per_s"],
+        "events_offered": report["events_offered"],
+        "duration_s": report["duration_s"],
+        "drop_fraction": report["drop_fraction"],
+        "drop_fractions_by_reason": report["drop_fractions_by_reason"],
+        "accounting_exact": all(
+            report["gates"][k]
+            for k in (
+                "law_a_exact",
+                "law_b_exact",
+                "law_c_exact",
+                "law_push_exact",
+            )
+        ),
+        "peak_shed_level": report["peak_shed_level"],
+        "quarantine_opens": report["quarantine_opens"],
+        "queries_bit_identical": report["gates"][
+            "queries_bit_identical"
+        ],
+        "passed": report["passed"],
+    }
+    with open(bd_path, "w") as f:
+        json.dump(detail, f, indent=2, sort_keys=True)
+        f.write("\n")
+    log("BENCH_DETAIL.json updated (ingest_soak)")
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="r24 ingest chaos soak (see module docstring)"
+    )
+    ap.add_argument(
+        "--seconds",
+        type=float,
+        default=float(os.environ.get("SOAK_ING_SECONDS", 4.0)),
+    )
+    ap.add_argument(
+        "--feeders",
+        type=int,
+        default=int(os.environ.get("SOAK_ING_FEEDERS", 4)),
+    )
+    ap.add_argument(
+        "--clients",
+        type=int,
+        default=int(os.environ.get("SOAK_ING_CLIENTS", 2)),
+    )
+    ap.add_argument(
+        "--exchanges",
+        type=int,
+        default=int(os.environ.get("SOAK_ING_EXCHANGES", 8)),
+    )
+    ap.add_argument(
+        "--rows",
+        type=int,
+        default=int(os.environ.get("SOAK_ING_ROWS", 50_000)),
+    )
+    ap.add_argument(
+        "--min-rate",
+        type=int,
+        default=int(os.environ.get("SOAK_ING_MIN_RATE", 20_000)),
+        help="events/s floor the offered rate must clear",
+    )
+    ap.add_argument(
+        "--no-chaos",
+        action="store_true",
+        default=not bool(int(os.environ.get("SOAK_ING_CHAOS", "1"))),
+    )
+    args = ap.parse_args()
+
+    report = run_soak(
+        duration_s=args.seconds,
+        feeders=args.feeders,
+        clients=args.clients,
+        exchanges_per_conn=args.exchanges,
+        rows=args.rows,
+        chaos=not args.no_chaos,
+    )
+    report["gates"]["rate_floor"] = (
+        report["events_per_s"] >= args.min_rate
+    )
+    report["passed"] = report["passed"] and report["gates"]["rate_floor"]
+    print(json.dumps(report, indent=2))
+    out = os.environ.get("SOAK_ING_JSON")
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+    if int(os.environ.get("SOAK_WRITE_BENCH_DETAIL", "0")):
+        record_ingest_soak_detail(report)
+    if not report["passed"]:
+        log("INGEST SOAK FAILED: " + json.dumps(report["gates"]))
+        return 1
+    log(
+        f"ingest soak passed: {report['events_per_s']:,} events/s "
+        f"offered, drop fraction {report['drop_fraction']:.4f}, "
+        f"peak shed level {report['peak_shed_level']}, "
+        f"{report['queries']} concurrent queries bit-identical"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
